@@ -1,0 +1,25 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework with the
+capability surface of Eclipse Deeplearning4j, rebuilt on JAX/XLA.
+
+Quick start (mirrors the reference's MultiLayerNetwork workflow):
+
+    from deeplearning4j_tpu import nd
+    from deeplearning4j_tpu.nn import (NeuralNetConfiguration, DenseLayer,
+                                       OutputLayer, MultiLayerNetwork)
+    from deeplearning4j_tpu.train import Adam
+    from deeplearning4j_tpu.data import MnistDataSetIterator
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_in=784, n_out=256, activation="relu"))
+            .layer(OutputLayer(n_in=256, n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init((784,))
+    net.fit(MnistDataSetIterator(128, train=True, flatten=True), epochs=1)
+"""
+
+__version__ = "0.1.0"
+
+from . import ndarray as nd  # noqa: F401 — the Nd4j-style namespace

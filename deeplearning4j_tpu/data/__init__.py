@@ -1,0 +1,9 @@
+"""deeplearning4j_tpu.data — datasets, iterators, normalizers."""
+
+from .dataset import DataSet, MultiDataSet
+from .iterators import (ArrayDataSetIterator, BaseDatasetIterator,
+                        Cifar10DataSetIterator, EmnistDataSetIterator,
+                        IrisDataSetIterator, KFoldIterator,
+                        ListDataSetIterator, MnistDataSetIterator,
+                        MultipleEpochsIterator, RandomDataSetIterator,
+                        make_synthetic_mnist)

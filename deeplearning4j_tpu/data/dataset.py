@@ -1,0 +1,115 @@
+"""DataSet / MultiDataSet — feature+label containers.
+
+Reference parity: ``org.nd4j.linalg.dataset.DataSet`` (features, labels,
+featuresMask, labelsMask, save/load, split, shuffle, batchBy) and
+``MultiDataSet`` (multi-input/multi-output).
+Host-side arrays are numpy (cheap slicing for the input pipeline); they move
+to device only inside the jitted step — minimizing host↔HBM traffic.
+"""
+
+from __future__ import annotations
+
+import io
+import zipfile
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class DataSet:
+    def __init__(self, features, labels, features_mask=None, labels_mask=None):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+
+    # reference getters
+    def get_features(self):
+        return self.features
+
+    def get_labels(self):
+        return self.labels
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def __len__(self):
+        return self.num_examples()
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        return self._take(idx)
+
+    def _take(self, idx) -> "DataSet":
+        return DataSet(
+            self.features[idx], self.labels[idx],
+            None if self.features_mask is None else self.features_mask[idx],
+            None if self.labels_mask is None else self.labels_mask[idx])
+
+    def split_test_and_train(self, n_train: int):
+        """Reference splitTestAndTrain → (train, test)."""
+        return self._take(np.arange(0, n_train)), \
+            self._take(np.arange(n_train, self.num_examples()))
+
+    def sample(self, n: int, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        return self._take(rng.choice(self.num_examples(), size=n, replace=False))
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        for i in range(0, self.num_examples(), batch_size):
+            out.append(self._take(np.arange(i, min(i + batch_size, self.num_examples()))))
+        return out
+
+    def merge(others: Sequence["DataSet"]) -> "DataSet":  # noqa: N805 — static-style
+        ds = list(others)
+        return DataSet(
+            np.concatenate([d.features for d in ds]),
+            np.concatenate([d.labels for d in ds]),
+            None if ds[0].features_mask is None else np.concatenate([d.features_mask for d in ds]),
+            None if ds[0].labels_mask is None else np.concatenate([d.labels_mask for d in ds]))
+
+    def save(self, path):
+        parts = {"features": self.features, "labels": self.labels}
+        if self.features_mask is not None:
+            parts["features_mask"] = self.features_mask
+        if self.labels_mask is not None:
+            parts["labels_mask"] = self.labels_mask
+        np.savez_compressed(path, **parts)
+
+    @staticmethod
+    def load(path) -> "DataSet":
+        with np.load(path) as z:
+            return DataSet(z["features"], z["labels"],
+                           z["features_mask"] if "features_mask" in z else None,
+                           z["labels_mask"] if "labels_mask" in z else None)
+
+    def __repr__(self):
+        return (f"DataSet(features{self.features.shape}, labels{self.labels.shape}, "
+                f"fmask={None if self.features_mask is None else self.features_mask.shape}, "
+                f"lmask={None if self.labels_mask is None else self.labels_mask.shape})")
+
+
+class MultiDataSet:
+    """N features arrays, M labels arrays (reference MultiDataSet)."""
+
+    def __init__(self, features, labels, features_masks=None, labels_masks=None):
+        self.features = [np.asarray(f) for f in _as_list(features)]
+        self.labels = [np.asarray(l) for l in _as_list(labels)]
+        self.features_masks = (None if features_masks is None
+                               else [None if m is None else np.asarray(m)
+                                     for m in _as_list(features_masks)])
+        self.labels_masks = (None if labels_masks is None
+                             else [None if m is None else np.asarray(m)
+                                   for m in _as_list(labels_masks)])
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+    def __len__(self):
+        return self.num_examples()
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]

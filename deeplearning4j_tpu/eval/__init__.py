@@ -1,0 +1,5 @@
+"""deeplearning4j_tpu.eval — evaluation metrics."""
+
+from .classification import ConfusionMatrix, Evaluation, EvaluationBinary
+from .regression import RegressionEvaluation
+from .roc import ROC, ROCBinary, ROCMultiClass

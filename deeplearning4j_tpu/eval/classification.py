@@ -1,0 +1,263 @@
+"""Evaluation — classification metrics.
+
+Reference parity: ``org.nd4j.evaluation.classification.Evaluation``
+(accuracy, precision/recall/F1 micro/macro, MCC, GMeasure, confusion matrix,
+topN accuracy, per-class stats, stats() report) and ``EvaluationBinary``
+(per-output multi-label).
+
+TPU-first: the per-batch accumulation is a jitted confusion-matrix update
+(one scatter-add on device); host code only formats the report. Accumulators
+merge across batches and across devices (psum-compatible counts).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _confusion_update(conf, labels_idx, preds_idx):
+    n = conf.shape[0]
+    flat = labels_idx * n + preds_idx
+    upd = jnp.zeros((n * n,), jnp.int64 if conf.dtype == jnp.int64 else jnp.int32)
+    upd = upd.at[flat].add(1)
+    return conf + upd.reshape(n, n)
+
+
+def _topn_hits(labels_idx, probs, n):
+    _, top = jax.lax.top_k(probs, n)
+    return jnp.sum(jnp.any(top == labels_idx[:, None], axis=1))
+
+
+class ConfusionMatrix:
+    def __init__(self, num_classes: int):
+        self.matrix = np.zeros((num_classes, num_classes), np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1):
+        self.matrix[actual, predicted] += count
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def to_numpy(self):
+        return self.matrix
+
+
+class Evaluation:
+    def __init__(self, num_classes: Optional[int] = None, top_n: int = 1,
+                 labels_list=None):
+        self.num_classes = num_classes
+        self.top_n = top_n
+        self.labels_list = labels_list
+        self._conf = None if num_classes is None else jnp.zeros(
+            (num_classes, num_classes), jnp.int32)
+        self._topn_correct = 0
+        self._count = 0
+
+    # ------------------------------------------------------------------ eval
+    def eval(self, labels, predictions, mask=None):
+        """labels: one-hot or int ids; predictions: probabilities/logits.
+
+        RNN shapes (B,T,C) are flattened with `mask` (B,T) selecting steps.
+        """
+        labels = jnp.asarray(labels)
+        preds = jnp.asarray(predictions)
+        if preds.ndim == 3:  # time series → flatten valid steps
+            b, t, c = preds.shape
+            preds = preds.reshape(b * t, c)
+            labels = labels.reshape(b * t, -1) if labels.ndim == 3 else labels.reshape(b * t)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(b * t) > 0
+                preds = preds[np.asarray(keep)]
+                labels = labels[np.asarray(keep)]
+        c = preds.shape[-1]
+        if self.num_classes is None:
+            self.num_classes = int(c)
+            self._conf = jnp.zeros((c, c), jnp.int32)
+        li = jnp.argmax(labels, -1) if labels.ndim > 1 else labels.astype(jnp.int32)
+        pi = jnp.argmax(preds, -1)
+        self._conf = _confusion_update(self._conf, li, pi)
+        if self.top_n > 1:
+            self._topn_correct += int(_topn_hits(li, preds, min(self.top_n, c)))
+        self._count += int(li.shape[0])
+
+    def merge(self, other: "Evaluation"):
+        if self._conf is None:
+            self._conf = other._conf
+        elif other._conf is not None:
+            self._conf = self._conf + other._conf
+        self._topn_correct += other._topn_correct
+        self._count += other._count
+        return self
+
+    # ---------------------------------------------------------------- stats
+    @property
+    def confusion(self) -> np.ndarray:
+        return np.zeros((0, 0), np.int64) if self._conf is None else np.asarray(self._conf)
+
+    def accuracy(self) -> float:
+        m = self.confusion
+        tot = m.sum()
+        return float(np.trace(m) / tot) if tot else 0.0
+
+    def top_n_accuracy(self) -> float:
+        return self._topn_correct / self._count if self._count else 0.0
+
+    def _tp(self):
+        return np.diag(self.confusion).astype(np.float64)
+
+    def _fp(self):
+        return self.confusion.sum(0) - self._tp()
+
+    def _fn(self):
+        return self.confusion.sum(1) - self._tp()
+
+    def precision(self, cls: Optional[int] = None, average: str = "macro") -> float:
+        tp, fp = self._tp(), self._fp()
+        if cls is not None:
+            d = tp[cls] + fp[cls]
+            return float(tp[cls] / d) if d else 0.0
+        if average == "micro":
+            d = tp.sum() + fp.sum()
+            return float(tp.sum() / d) if d else 0.0
+        per = np.divide(tp, tp + fp, out=np.zeros_like(tp), where=(tp + fp) > 0)
+        seen = (self.confusion.sum(1) + self.confusion.sum(0)) > 0
+        return float(per[seen].mean()) if seen.any() else 0.0
+
+    def recall(self, cls: Optional[int] = None, average: str = "macro") -> float:
+        tp, fn = self._tp(), self._fn()
+        if cls is not None:
+            d = tp[cls] + fn[cls]
+            return float(tp[cls] / d) if d else 0.0
+        if average == "micro":
+            d = tp.sum() + fn.sum()
+            return float(tp.sum() / d) if d else 0.0
+        per = np.divide(tp, tp + fn, out=np.zeros_like(tp), where=(tp + fn) > 0)
+        seen = (self.confusion.sum(1) + self.confusion.sum(0)) > 0
+        return float(per[seen].mean()) if seen.any() else 0.0
+
+    def f1(self, cls: Optional[int] = None, average: str = "macro") -> float:
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            return 2 * p * r / (p + r) if (p + r) else 0.0
+        if average == "micro":
+            p, r = self.precision(average="micro"), self.recall(average="micro")
+            return 2 * p * r / (p + r) if (p + r) else 0.0
+        tp, fp, fn = self._tp(), self._fp(), self._fn()
+        per_p = np.divide(tp, tp + fp, out=np.zeros_like(tp), where=(tp + fp) > 0)
+        per_r = np.divide(tp, tp + fn, out=np.zeros_like(tp), where=(tp + fn) > 0)
+        s = per_p + per_r
+        per_f = np.divide(2 * per_p * per_r, s, out=np.zeros_like(tp), where=s > 0)
+        seen = (self.confusion.sum(1) + self.confusion.sum(0)) > 0
+        return float(per_f[seen].mean()) if seen.any() else 0.0
+
+    def gmeasure(self, cls: Optional[int] = None) -> float:
+        p = self.precision(cls) if cls is not None else self.precision(average="macro")
+        r = self.recall(cls) if cls is not None else self.recall(average="macro")
+        return math.sqrt(p * r)
+
+    def matthews_correlation(self) -> float:
+        """Multiclass MCC (R_k statistic), like the reference."""
+        c = self.confusion.astype(np.float64)
+        t = c.sum()
+        if t == 0:
+            return 0.0
+        s = np.trace(c)
+        pk = c.sum(0)
+        tk = c.sum(1)
+        num = s * t - tk @ pk
+        den = math.sqrt(max(t * t - (pk @ pk), 0)) * math.sqrt(max(t * t - (tk @ tk), 0))
+        return float(num / den) if den else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        m = self.confusion
+        fp = self._fp()[cls]
+        tn = m.sum() - m.sum(0)[cls] - m.sum(1)[cls] + m[cls, cls]
+        return float(fp / (fp + tn)) if (fp + tn) else 0.0
+
+    def false_negative_rate(self, cls: int) -> float:
+        fn, tp = self._fn()[cls], self._tp()[cls]
+        return float(fn / (fn + tp)) if (fn + tp) else 0.0
+
+    def stats(self) -> str:
+        m = self.confusion
+        n = m.shape[0]
+        names = self.labels_list or [str(i) for i in range(n)]
+        lines = ["", "========================Evaluation Metrics========================",
+                 f" # of classes:    {n}",
+                 f" Accuracy:        {self.accuracy():.4f}"]
+        if self.top_n > 1:
+            lines.append(f" Top {self.top_n} Accuracy:  {self.top_n_accuracy():.4f}")
+        lines += [f" Precision:       {self.precision(average='macro'):.4f}",
+                  f" Recall:          {self.recall(average='macro'):.4f}",
+                  f" F1 Score:        {self.f1(average='macro'):.4f}",
+                  f" MCC:             {self.matthews_correlation():.4f}",
+                  "", "=========================Confusion Matrix========================="]
+        header = "     " + " ".join(f"{nm:>6}" for nm in names)
+        lines.append(header)
+        for i in range(n):
+            lines.append(f"{names[i]:>4} " + " ".join(f"{int(m[i, j]):>6}" for j in range(n)))
+        lines.append("==================================================================")
+        return "\n".join(lines)
+
+    def __str__(self):
+        return self.stats()
+
+
+class EvaluationBinary:
+    """Per-output binary metrics for multi-label sigmoid outputs
+    (reference EvaluationBinary)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.tp = None
+        self.fp = None
+        self.fn = None
+        self.tn = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions) > self.threshold
+        lab = labels > 0.5
+        w = np.ones_like(lab, np.float64) if mask is None else np.broadcast_to(
+            np.asarray(mask, np.float64).reshape(labels.shape[0], -1), labels.shape)
+        tp = ((preds & lab) * w).sum(0)
+        fp = ((preds & ~lab) * w).sum(0)
+        fn = ((~preds & lab) * w).sum(0)
+        tn = ((~preds & ~lab) * w).sum(0)
+        if self.tp is None:
+            self.tp, self.fp, self.fn, self.tn = tp, fp, fn, tn
+        else:
+            self.tp += tp
+            self.fp += fp
+            self.fn += fn
+            self.tn += tn
+
+    def accuracy(self, i: int) -> float:
+        tot = self.tp[i] + self.fp[i] + self.fn[i] + self.tn[i]
+        return float((self.tp[i] + self.tn[i]) / tot) if tot else 0.0
+
+    def precision(self, i: int) -> float:
+        d = self.tp[i] + self.fp[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def recall(self, i: int) -> float:
+        d = self.tp[i] + self.fn[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def f1(self, i: int) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def stats(self) -> str:
+        n = len(self.tp)
+        lines = ["Label  Acc     Prec    Rec     F1"]
+        for i in range(n):
+            lines.append(f"{i:<6}{self.accuracy(i):<8.4f}{self.precision(i):<8.4f}"
+                         f"{self.recall(i):<8.4f}{self.f1(i):<8.4f}")
+        return "\n".join(lines)

@@ -1,0 +1,109 @@
+"""RegressionEvaluation — per-column regression metrics.
+
+Reference parity: ``org.nd4j.evaluation.regression.RegressionEvaluation``
+(MSE, MAE, RMSE, RSE, pearson correlation, R^2, per-column + stats()).
+Accumulates streaming sums so batches merge exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RegressionEvaluation:
+    def __init__(self, n_columns=None, column_names=None):
+        self.n = 0
+        self.n_columns = n_columns
+        self.column_names = column_names
+        self._sums = None
+
+    def _ensure(self, c):
+        if self._sums is None:
+            self.n_columns = c
+            z = np.zeros(c, np.float64)
+            self._sums = {k: z.copy() for k in
+                          ("err2", "abs_err", "label", "label2", "pred", "pred2", "lp")}
+
+    def eval(self, labels, predictions, mask=None):
+        y = np.asarray(labels, np.float64)
+        p = np.asarray(predictions, np.float64)
+        if y.ndim == 3:
+            y = y.reshape(-1, y.shape[-1])
+            p = p.reshape(-1, p.shape[-1])
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                y, p = y[keep], p[keep]
+        self._ensure(y.shape[-1])
+        d = p - y
+        s = self._sums
+        s["err2"] += (d * d).sum(0)
+        s["abs_err"] += np.abs(d).sum(0)
+        s["label"] += y.sum(0)
+        s["label2"] += (y * y).sum(0)
+        s["pred"] += p.sum(0)
+        s["pred2"] += (p * p).sum(0)
+        s["lp"] += (y * p).sum(0)
+        self.n += y.shape[0]
+
+    def merge(self, other):
+        if self._sums is None:
+            self._sums, self.n, self.n_columns = other._sums, other.n, other.n_columns
+        elif other._sums is not None:
+            for k in self._sums:
+                self._sums[k] += other._sums[k]
+            self.n += other.n
+        return self
+
+    def mean_squared_error(self, col: int) -> float:
+        return float(self._sums["err2"][col] / self.n)
+
+    def mean_absolute_error(self, col: int) -> float:
+        return float(self._sums["abs_err"][col] / self.n)
+
+    def root_mean_squared_error(self, col: int) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def relative_squared_error(self, col: int) -> float:
+        s = self._sums
+        mean_label = s["label"][col] / self.n
+        denom = s["label2"][col] - 2 * mean_label * s["label"][col] + self.n * mean_label ** 2
+        return float(s["err2"][col] / denom) if denom else 0.0
+
+    def pearson_correlation(self, col: int) -> float:
+        s = self._sums
+        n = self.n
+        cov = s["lp"][col] - s["label"][col] * s["pred"][col] / n
+        vl = s["label2"][col] - s["label"][col] ** 2 / n
+        vp = s["pred2"][col] - s["pred"][col] ** 2 / n
+        d = np.sqrt(max(vl * vp, 0.0))
+        return float(cov / d) if d else 0.0
+
+    def r_squared(self, col: int) -> float:
+        return 1.0 - self.relative_squared_error(col)
+
+    def average_mean_squared_error(self) -> float:
+        return float(np.mean([self.mean_squared_error(i) for i in range(self.n_columns)]))
+
+    def average_mean_absolute_error(self) -> float:
+        return float(np.mean([self.mean_absolute_error(i) for i in range(self.n_columns)]))
+
+    def average_root_mean_squared_error(self) -> float:
+        return float(np.mean([self.root_mean_squared_error(i) for i in range(self.n_columns)]))
+
+    def average_r_squared(self) -> float:
+        return float(np.mean([self.r_squared(i) for i in range(self.n_columns)]))
+
+    def stats(self) -> str:
+        names = self.column_names or [f"col_{i}" for i in range(self.n_columns)]
+        lines = [f"{'Column':<12}{'MSE':>12}{'MAE':>12}{'RMSE':>12}{'RSE':>12}{'PC':>12}{'R^2':>12}"]
+        for i in range(self.n_columns):
+            lines.append(f"{names[i]:<12}{self.mean_squared_error(i):>12.5f}"
+                         f"{self.mean_absolute_error(i):>12.5f}"
+                         f"{self.root_mean_squared_error(i):>12.5f}"
+                         f"{self.relative_squared_error(i):>12.5f}"
+                         f"{self.pearson_correlation(i):>12.5f}"
+                         f"{self.r_squared(i):>12.5f}")
+        return "\n".join(lines)
+
+    def __str__(self):
+        return self.stats()

@@ -1,0 +1,146 @@
+"""ROC / ROCBinary / ROCMultiClass — AUC & AUPRC.
+
+Reference parity: ``org.nd4j.evaluation.classification.{ROC, ROCBinary,
+ROCMultiClass}``. Like the reference, `threshold_steps=0` means EXACT mode
+(store all scores, trapezoidal AUROC) and `threshold_steps=N` uses a fixed
+histogram of N thresholds (streaming, O(N) memory — the mode you want for
+huge eval sets).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _auc(x, y):
+    """Trapezoidal area; x must already be monotone non-decreasing."""
+    return float(np.trapezoid(np.asarray(y), np.asarray(x)))
+
+
+class ROC:
+    """Binary ROC: labels (N,) or one-hot (N,2); probs of positive class."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = threshold_steps
+        if threshold_steps:
+            self._pos_hist = np.zeros(threshold_steps + 1, np.int64)
+            self._neg_hist = np.zeros(threshold_steps + 1, np.int64)
+        else:
+            self._scores = []
+            self._labels = []
+
+    def eval(self, labels, predictions, mask=None):
+        y = np.asarray(labels)
+        p = np.asarray(predictions)
+        if p.ndim == 3:
+            p = p.reshape(-1, p.shape[-1])
+            y = y.reshape(-1, y.shape[-1]) if y.ndim == 3 else y.reshape(-1)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                y, p = y[keep], p[keep]
+        if p.ndim == 2 and p.shape[-1] == 2:
+            p = p[:, 1]
+        elif p.ndim == 2:
+            p = p[:, 0]
+        if y.ndim == 2 and y.shape[-1] == 2:
+            y = y[:, 1]
+        elif y.ndim == 2:
+            y = y[:, 0]
+        y = (y > 0.5).astype(np.int64)
+        if self.threshold_steps:
+            bins = np.clip((p * self.threshold_steps).astype(int), 0, self.threshold_steps)
+            np.add.at(self._pos_hist, bins[y == 1], 1)
+            np.add.at(self._neg_hist, bins[y == 0], 1)
+        else:
+            self._scores.append(p)
+            self._labels.append(y)
+
+    def _curve(self):
+        """Returns (fpr, tpr, precision) with fpr/tpr monotone ascending."""
+        if self.threshold_steps:
+            # tp[i] = positives with score-bin >= i (threshold descending as
+            # i ascends) — reverse so the curve ascends from (0,0) to (1,1)
+            pos = self._pos_hist[::-1].cumsum()[::-1].astype(np.float64)
+            neg = self._neg_hist[::-1].cumsum()[::-1].astype(np.float64)
+            tp = pos[::-1]
+            fp = neg[::-1]
+            p_total = self._pos_hist.sum() or 1
+            n_total = self._neg_hist.sum() or 1
+            tpr = np.concatenate([[0.0], tp / p_total])
+            fpr = np.concatenate([[0.0], fp / n_total])
+            prec = np.concatenate([[1.0], tp / np.maximum(tp + fp, 1)])
+            return fpr, tpr, prec
+        s = np.concatenate(self._scores)
+        y = np.concatenate(self._labels)
+        order = np.argsort(-s)
+        y = y[order]
+        tp = y.cumsum()
+        fp = (1 - y).cumsum()
+        p_total = y.sum() or 1
+        n_total = (1 - y).sum() or 1
+        tpr = np.concatenate([[0.0], tp / p_total])
+        fpr = np.concatenate([[0.0], fp / n_total])
+        prec = np.concatenate([[1.0], tp / np.maximum(tp + fp, 1)])
+        return fpr, tpr, prec
+
+    def calculate_auc(self) -> float:
+        fpr, tpr, _ = self._curve()
+        return _auc(fpr, tpr)
+
+    def calculate_auprc(self) -> float:
+        _, tpr, prec = self._curve()
+        return _auc(tpr, prec)
+
+    def get_roc_curve(self):
+        fpr, tpr, _ = self._curve()
+        return fpr, tpr
+
+
+class ROCBinary:
+    """Per-output ROC for multi-label sigmoid outputs."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = threshold_steps
+        self._rocs = None
+
+    def eval(self, labels, predictions, mask=None):
+        y = np.asarray(labels)
+        p = np.asarray(predictions)
+        c = p.shape[-1]
+        if self._rocs is None:
+            self._rocs = [ROC(self.threshold_steps) for _ in range(c)]
+        for i in range(c):
+            self._rocs[i].eval(y[..., i], p[..., i])
+
+    def calculate_auc(self, i: int) -> float:
+        return self._rocs[i].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs]))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (reference ROCMultiClass)."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = threshold_steps
+        self._rocs = None
+
+    def eval(self, labels, predictions, mask=None):
+        y = np.asarray(labels)
+        p = np.asarray(predictions)
+        c = p.shape[-1]
+        if y.ndim == 1:
+            onehot = np.zeros_like(p)
+            onehot[np.arange(len(y)), y.astype(int)] = 1.0
+            y = onehot
+        if self._rocs is None:
+            self._rocs = [ROC(self.threshold_steps) for _ in range(c)]
+        for i in range(c):
+            self._rocs[i].eval(y[..., i], p[..., i])
+
+    def calculate_auc(self, i: int) -> float:
+        return self._rocs[i].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs]))

@@ -1,0 +1,9 @@
+"""`deeplearning4j_tpu.ndarray` — the ND4J analogue (tensor layer).
+
+Usage: ``from deeplearning4j_tpu import nd`` then ``nd.zeros(3, 4)``,
+``nd.mmul(a, b)``, ``nd.random.randn(2, 2)``. Arrays are plain jax.Arrays.
+"""
+
+from . import indexing, random, workspace
+from .factory import *  # noqa: F401,F403 — the Nd4j-style flat namespace
+from .factory import linalg  # noqa: F401

@@ -1,0 +1,621 @@
+"""ND4J factory analogue — TPU-native array creation and core ops.
+
+Reference parity: upstream ``nd4j-api`` ``org.nd4j.linalg.factory.Nd4j`` and
+``INDArray`` method surface (creation, arithmetic, reductions, shape ops).
+Design departure: arrays ARE ``jax.Array`` — no wrapper object. All functions
+are pure and jit-safe; the DL4J method names (``mmul``, ``norm1``, ``normmax``,
+``tensorMmul``) are provided as module-level functions so a DL4J user can
+translate ``a.mmul(b)`` → ``nd.mmul(a, b)`` mechanically.
+"""
+
+from __future__ import annotations
+
+import builtins
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# dtypes — bfloat16 is first-class on TPU
+# ---------------------------------------------------------------------------
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+
+_DEFAULT_DTYPE = jnp.float32
+
+
+def set_default_dtype(dtype) -> None:
+    global _DEFAULT_DTYPE
+    _DEFAULT_DTYPE = jnp.dtype(dtype)
+
+
+def default_dtype():
+    return _DEFAULT_DTYPE
+
+
+def _dt(dtype):
+    return _DEFAULT_DTYPE if dtype is None else dtype
+
+
+# ---------------------------------------------------------------------------
+# Creation (Nd4j.create / zeros / ones / ...)
+# ---------------------------------------------------------------------------
+
+def create(data, dtype=None):
+    """Nd4j.create analogue: array from nested lists / numpy / jax array."""
+    return jnp.asarray(data, dtype=dtype)
+
+
+asarray = create
+
+
+def zeros(*shape, dtype=None):
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    return jnp.zeros(shape, dtype=_dt(dtype))
+
+
+def ones(*shape, dtype=None):
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    return jnp.ones(shape, dtype=_dt(dtype))
+
+
+def full(shape, value, dtype=None):
+    return jnp.full(shape, value, dtype=_dt(dtype))
+
+
+def value_array_of(shape, value, dtype=None):  # Nd4j.valueArrayOf
+    return full(shape, value, dtype)
+
+
+def empty(shape, dtype=None):
+    return jnp.empty(shape, dtype=_dt(dtype))
+
+
+def zeros_like(a):
+    return jnp.zeros_like(a)
+
+
+def ones_like(a):
+    return jnp.ones_like(a)
+
+
+def eye(n, m=None, dtype=None):
+    return jnp.eye(n, m, dtype=_dt(dtype))
+
+
+def arange(*args, dtype=None):
+    return jnp.arange(*args, dtype=dtype)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=_dt(dtype))
+
+
+def scalar(value, dtype=None):
+    return jnp.asarray(value, dtype=dtype)
+
+
+def diag(v, k=0):
+    return jnp.diag(v, k)
+
+
+def meshgrid(*arrays, indexing="ij"):
+    return jnp.meshgrid(*arrays, indexing=indexing)
+
+
+def tri(n, m=None, k=0, dtype=None):
+    return jnp.tri(n, m, k, dtype=_dt(dtype))
+
+
+def one_hot(indices, depth, dtype=None, axis=-1):
+    return jax.nn.one_hot(indices, depth, dtype=_dt(dtype), axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic / linear algebra (INDArray.mmul / tensorMmul / dot ...)
+# ---------------------------------------------------------------------------
+
+def mmul(a, b):
+    """Matrix multiply (INDArray.mmul) — rides the MXU; prefers bf16 inputs."""
+    return jnp.matmul(a, b)
+
+
+matmul = mmul
+
+
+def dot(a, b):
+    return jnp.dot(a, b)
+
+
+def tensor_mmul(a, b, axes):
+    """INDArray.tensorMmul — tensordot over the given axes."""
+    return jnp.tensordot(a, b, axes=axes)
+
+
+def einsum(subscripts, *operands, precision=None):
+    return jnp.einsum(subscripts, *operands, precision=precision)
+
+
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+def batch_mmul(a, b):
+    return jnp.einsum("bij,bjk->bik", a, b)
+
+
+add = jnp.add
+sub = jnp.subtract
+mul = jnp.multiply
+div = jnp.divide
+rdiv = lambda a, b: jnp.divide(b, a)
+rsub = lambda a, b: jnp.subtract(b, a)
+pow = jnp.power
+mod = jnp.mod
+floor_div = jnp.floor_divide
+neg = jnp.negative
+reciprocal = jnp.reciprocal
+fmod = jnp.fmod
+remainder = jnp.remainder
+maximum = jnp.maximum
+minimum = jnp.minimum
+
+
+def squared_difference(a, b):
+    d = jnp.subtract(a, b)
+    return d * d
+
+
+# comparison
+eq = jnp.equal
+neq = jnp.not_equal
+gt = jnp.greater
+gte = jnp.greater_equal
+lt = jnp.less
+lte = jnp.less_equal
+logical_and = jnp.logical_and
+logical_or = jnp.logical_or
+logical_not = jnp.logical_not
+logical_xor = jnp.logical_xor
+isnan = jnp.isnan
+isinf = jnp.isinf
+isfinite = jnp.isfinite
+
+
+# ---------------------------------------------------------------------------
+# Reductions (INDArray.sum / norm1 / norm2 / normmax / ...)
+# ---------------------------------------------------------------------------
+
+def sum(a, axis=None, keepdims=False, dtype=None):
+    return jnp.sum(a, axis=axis, keepdims=keepdims, dtype=dtype)
+
+
+def mean(a, axis=None, keepdims=False):
+    return jnp.mean(a, axis=axis, keepdims=keepdims)
+
+
+def std(a, axis=None, keepdims=False, ddof=0):
+    return jnp.std(a, axis=axis, keepdims=keepdims, ddof=ddof)
+
+
+def var(a, axis=None, keepdims=False, ddof=0):
+    return jnp.var(a, axis=axis, keepdims=keepdims, ddof=ddof)
+
+
+def max(a, axis=None, keepdims=False):
+    return jnp.max(a, axis=axis, keepdims=keepdims)
+
+
+def min(a, axis=None, keepdims=False):
+    return jnp.min(a, axis=axis, keepdims=keepdims)
+
+
+def prod(a, axis=None, keepdims=False):
+    return jnp.prod(a, axis=axis, keepdims=keepdims)
+
+
+def argmax(a, axis=None):
+    return jnp.argmax(a, axis=axis)
+
+
+def argmin(a, axis=None):
+    return jnp.argmin(a, axis=axis)
+
+
+def norm1(a, axis=None, keepdims=False):
+    """L1 norm (INDArray.norm1)."""
+    return jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdims)
+
+
+def norm2(a, axis=None, keepdims=False):
+    """L2 norm (INDArray.norm2)."""
+    return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdims))
+
+
+def normmax(a, axis=None, keepdims=False):
+    """Max-abs norm (INDArray.normmax)."""
+    return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdims)
+
+
+def squared_norm(a, axis=None, keepdims=False):
+    return jnp.sum(jnp.square(a), axis=axis, keepdims=keepdims)
+
+
+def cumsum(a, axis=None):
+    return jnp.cumsum(a, axis=axis)
+
+
+def cumprod(a, axis=None):
+    return jnp.cumprod(a, axis=axis)
+
+
+def all(a, axis=None, keepdims=False):
+    return jnp.all(a, axis=axis, keepdims=keepdims)
+
+
+def any(a, axis=None, keepdims=False):
+    return jnp.any(a, axis=axis, keepdims=keepdims)
+
+
+def count_nonzero(a, axis=None):
+    return jnp.count_nonzero(a, axis=axis)
+
+
+def entropy(a, axis=None):
+    p = a / jnp.sum(a, axis=axis, keepdims=True)
+    return -jnp.sum(p * jnp.log(jnp.clip(p, 1e-12, None)), axis=axis)
+
+
+def log_sum_exp(a, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdims)
+
+
+# ---------------------------------------------------------------------------
+# Shape ops
+# ---------------------------------------------------------------------------
+
+def reshape(a, *shape):
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    return jnp.reshape(a, shape)
+
+
+def ravel(a):
+    return jnp.ravel(a)
+
+
+def flatten(a):
+    return jnp.ravel(a)
+
+
+def transpose(a, axes=None):
+    return jnp.transpose(a, axes)
+
+
+def permute(a, *axes):
+    """INDArray.permute — axis permutation."""
+    axes = axes[0] if len(axes) == 1 and isinstance(axes[0], (tuple, list)) else axes
+    return jnp.transpose(a, axes)
+
+
+def swap_axes(a, ax1, ax2):
+    return jnp.swapaxes(a, ax1, ax2)
+
+
+def move_axis(a, src, dst):
+    return jnp.moveaxis(a, src, dst)
+
+
+def expand_dims(a, axis):
+    return jnp.expand_dims(a, axis)
+
+
+def squeeze(a, axis=None):
+    return jnp.squeeze(a, axis)
+
+
+def concat(arrays, axis=0):
+    return jnp.concatenate(arrays, axis=axis)
+
+
+concatenate = concat
+hstack = jnp.hstack
+vstack = jnp.vstack
+
+
+def stack(arrays, axis=0):
+    return jnp.stack(arrays, axis=axis)
+
+
+def unstack(a, axis=0):
+    return [jnp.squeeze(s, axis) for s in jnp.split(a, a.shape[axis], axis)]
+
+
+def split(a, n_or_sections, axis=0):
+    return jnp.split(a, n_or_sections, axis=axis)
+
+
+def tile(a, reps):
+    return jnp.tile(a, reps)
+
+
+def repeat(a, repeats, axis=None):
+    return jnp.repeat(a, repeats, axis=axis)
+
+
+def pad(a, pad_width, mode="constant", constant_values=0):
+    if mode == "constant":
+        return jnp.pad(a, pad_width, mode=mode, constant_values=constant_values)
+    return jnp.pad(a, pad_width, mode=mode)
+
+
+def flip(a, axis=None):
+    return jnp.flip(a, axis=axis)
+
+
+def roll(a, shift, axis=None):
+    return jnp.roll(a, shift, axis=axis)
+
+
+def broadcast_to(a, shape):
+    return jnp.broadcast_to(a, shape)
+
+
+def size(a):
+    return a.size
+
+
+def shape(a):
+    return a.shape
+
+
+def rank(a):
+    return a.ndim
+
+
+def length(a):
+    return a.size
+
+
+def dup(a):
+    """INDArray.dup — functional copy (a no-op value-wise under XLA)."""
+    return jnp.asarray(a).copy()
+
+
+def cast(a, dtype):
+    return a.astype(dtype)
+
+
+astype = cast
+
+
+# ---------------------------------------------------------------------------
+# Elementwise transforms (org.nd4j.linalg.ops.transforms.Transforms)
+# ---------------------------------------------------------------------------
+abs = jnp.abs
+sign = jnp.sign
+exp = jnp.exp
+expm1 = jnp.expm1
+log = jnp.log
+log1p = jnp.log1p
+log2 = jnp.log2
+log10 = jnp.log10
+sqrt = jnp.sqrt
+rsqrt = lax.rsqrt
+square = jnp.square
+cbrt = jnp.cbrt
+floor = jnp.floor
+ceil = jnp.ceil
+round = jnp.round
+trunc = jnp.trunc
+sin = jnp.sin
+cos = jnp.cos
+tan = jnp.tan
+asin = jnp.arcsin
+acos = jnp.arccos
+atan = jnp.arctan
+atan2 = jnp.arctan2
+sinh = jnp.sinh
+cosh = jnp.cosh
+tanh = jnp.tanh
+asinh = jnp.arcsinh
+acosh = jnp.arccosh
+atanh = jnp.arctanh
+erf = jax.scipy.special.erf
+erfc = jax.scipy.special.erfc
+sigmoid = jax.nn.sigmoid
+softplus = jax.nn.softplus
+softmax = jax.nn.softmax
+log_softmax = jax.nn.log_softmax
+relu = jax.nn.relu
+relu6 = jax.nn.relu6
+leaky_relu = jax.nn.leaky_relu
+elu = jax.nn.elu
+gelu = jax.nn.gelu
+silu = jax.nn.silu
+hard_sigmoid = jax.nn.hard_sigmoid
+hard_tanh = jax.nn.hard_tanh
+
+
+def clip(a, min=None, max=None):
+    return jnp.clip(a, min, max)
+
+
+clip_by_value = clip
+
+
+def clip_by_norm(a, clip_norm, axis=None):
+    n = norm2(a, axis=axis, keepdims=True)
+    return jnp.where(n > clip_norm, a * (clip_norm / jnp.maximum(n, 1e-12)), a)
+
+
+def step(a):  # heaviside step used by DL4J Transforms.step
+    return (a > 0).astype(a.dtype)
+
+
+def pow_scalar(a, p):
+    return jnp.power(a, p)
+
+
+# ---------------------------------------------------------------------------
+# Sorting / searching / selection
+# ---------------------------------------------------------------------------
+
+def sort(a, axis=-1, descending=False):
+    out = jnp.sort(a, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def argsort(a, axis=-1, descending=False):
+    out = jnp.argsort(a, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def top_k(a, k, axis=-1):
+    if axis in (-1, a.ndim - 1):
+        return lax.top_k(a, k)
+    am = jnp.moveaxis(a, axis, -1)
+    v, i = lax.top_k(am, k)
+    return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+
+
+def where(cond, x=None, y=None):
+    if x is None and y is None:
+        return jnp.where(cond)
+    return jnp.where(cond, x, y)
+
+
+def searchsorted(a, v, side="left"):
+    return jnp.searchsorted(a, v, side=side)
+
+
+def unique(a, size=None, fill_value=None):
+    """jnp.unique; pass `size` for a jit-safe static-shape variant."""
+    if size is not None:
+        return jnp.unique(a, size=size, fill_value=fill_value)
+    return jnp.unique(a)
+
+
+def take(a, indices, axis=None):
+    return jnp.take(a, indices, axis=axis)
+
+
+def take_along_axis(a, indices, axis):
+    return jnp.take_along_axis(a, indices, axis=axis)
+
+
+def gather(a, indices, axis=0):
+    return jnp.take(a, indices, axis=axis)
+
+
+def scatter_update(a, indices, updates):
+    return a.at[indices].set(updates)
+
+
+def scatter_add(a, indices, updates):
+    return a.at[indices].add(updates)
+
+
+def scatter_max(a, indices, updates):
+    return a.at[indices].max(updates)
+
+
+def segment_sum(data, segment_ids, num_segments):
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+# ---------------------------------------------------------------------------
+# Linear algebra (Nd4j.linalg / lapack)
+# ---------------------------------------------------------------------------
+class linalg:
+    cholesky = staticmethod(jnp.linalg.cholesky)
+    qr = staticmethod(jnp.linalg.qr)
+    svd = staticmethod(jnp.linalg.svd)
+    inv = staticmethod(jnp.linalg.inv)
+    pinv = staticmethod(jnp.linalg.pinv)
+    det = staticmethod(jnp.linalg.det)
+    slogdet = staticmethod(jnp.linalg.slogdet)
+    solve = staticmethod(jnp.linalg.solve)
+    lstsq = staticmethod(jnp.linalg.lstsq)
+    eig = staticmethod(jnp.linalg.eig)
+    eigh = staticmethod(jnp.linalg.eigh)
+    norm = staticmethod(jnp.linalg.norm)
+    matrix_rank = staticmethod(jnp.linalg.matrix_rank)
+    triangular_solve = staticmethod(jax.scipy.linalg.solve_triangular)
+
+
+# ---------------------------------------------------------------------------
+# Conv primitives (libnd4j conv ops → lax). NHWC is the TPU-native layout.
+# ---------------------------------------------------------------------------
+
+def conv2d(x, w, stride=(1, 1), padding="SAME", dilation=(1, 1),
+           feature_group_count=1, dimension_numbers=("NHWC", "HWIO", "NHWC")):
+    return lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=padding,
+        rhs_dilation=tuple(dilation), dimension_numbers=dimension_numbers,
+        feature_group_count=feature_group_count,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+
+
+def max_pool2d(x, window=(2, 2), stride=None, padding="VALID"):
+    stride = window if stride is None else stride
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max, (1, *window, 1), (1, *stride, 1), padding)
+
+
+def avg_pool2d(x, window=(2, 2), stride=None, padding="VALID", count_include_pad=True):
+    stride = window if stride is None else stride
+    s = lax.reduce_window(x, 0.0, lax.add, (1, *window, 1), (1, *stride, 1), padding)
+    if count_include_pad or padding == "VALID":
+        return s / (window[0] * window[1])
+    ones_ = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    cnt = lax.reduce_window(ones_, 0.0, lax.add, (1, *window, 1), (1, *stride, 1), padding)
+    return s / cnt
+
+
+def im2col(x, kernel, stride=(1, 1), padding="VALID"):
+    """Extract patches: (N,H,W,C) → (N, OH, OW, kh*kw*C)."""
+    kh, kw = kernel
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=tuple(stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return patches
+
+
+def col2im(cols, x_shape, kernel, stride=(1, 1)):
+    """Scatter-add patches back (used by gradient checks for im2col)."""
+    n, h, w, c = x_shape
+    kh, kw = kernel
+    oh = (h - kh) // stride[0] + 1
+    ow = (w - kw) // stride[1] + 1
+    cols = cols.reshape(n, oh, ow, c, kh, kw)  # patches dim ordering: C major
+    out = jnp.zeros(x_shape, cols.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, i:i + oh * stride[0]:stride[0],
+                         j:j + ow * stride[1]:stride[1], :].add(cols[:, :, :, :, i, j])
+    return out
+
+
+# host transfer helpers
+def to_numpy(a):
+    return _np.asarray(a)
+
+
+def device_put(a, device=None):
+    return jax.device_put(a, device)

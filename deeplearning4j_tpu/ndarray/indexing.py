@@ -1,0 +1,139 @@
+"""NDArrayIndex analogue — structured slicing helpers.
+
+Reference parity: ``org.nd4j.linalg.indexing.NDArrayIndex`` (interval, point,
+all, newAxis) and ``INDArray.get/put(INDArrayIndex...)``, plus BooleanIndexing.
+Arrays are jax.Arrays, so these build standard numpy-style index tuples —
+jit-safe when bounds are static; use `dynamic_slice` helpers for traced starts.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class _All:
+    def resolve(self):
+        return slice(None)
+
+
+class _NewAxis:
+    def resolve(self):
+        return None
+
+
+class Interval:
+    def __init__(self, start, end, step=1):
+        self.start, self.end, self.step = start, end, step
+
+    def resolve(self):
+        return slice(self.start, self.end, self.step)
+
+
+class Point:
+    def __init__(self, i):
+        self.i = i
+
+    def resolve(self):
+        return self.i
+
+
+class Indices:
+    """Fancy index by an integer array along one axis."""
+
+    def __init__(self, idx):
+        self.idx = idx
+
+    def resolve(self):
+        return jnp.asarray(self.idx)
+
+
+def all():
+    return _All()
+
+
+def new_axis():
+    return _NewAxis()
+
+
+def interval(start, end, step=1):
+    return Interval(start, end, step)
+
+
+def point(i):
+    return Point(i)
+
+
+def indices(idx):
+    return Indices(idx)
+
+
+def _resolve(ixs):
+    return tuple(ix.resolve() if hasattr(ix, "resolve") else ix for ix in ixs)
+
+
+def get(a, *ixs):
+    """INDArray.get(NDArrayIndex...)"""
+    return a[_resolve(ixs)]
+
+
+def put(a, *ixs_and_value):
+    """INDArray.put(NDArrayIndex..., value) — functional: returns new array."""
+    *ixs, value = ixs_and_value
+    return jnp.asarray(a).at[_resolve(ixs)].set(value)
+
+
+def put_scalar(a, idx, value):
+    return jnp.asarray(a).at[tuple(idx) if isinstance(idx, (list, tuple)) else idx].set(value)
+
+
+def get_scalar(a, *idx):
+    return a[tuple(idx)]
+
+
+# --- BooleanIndexing analogue ---------------------------------------------
+
+def replace_where(a, replacement, cond_mask):
+    """BooleanIndexing.replaceWhere — functional."""
+    return jnp.where(cond_mask, replacement, a)
+
+
+def apply_where(a, cond_mask, fn):
+    return jnp.where(cond_mask, fn(a), a)
+
+
+def first_index(cond_mask, axis=None):
+    """Index of first True (BooleanIndexing.firstIndex); -1 if none."""
+    flat = cond_mask if axis is not None else cond_mask.ravel()
+    idx = jnp.argmax(flat, axis=axis)
+    has = jnp.any(flat, axis=axis)
+    return jnp.where(has, idx, -1)
+
+
+def last_index(cond_mask, axis=None):
+    flat = cond_mask if axis is not None else cond_mask.ravel()
+    n = flat.shape[axis if axis is not None else 0]
+    rev = jnp.flip(flat, axis=axis if axis is not None else 0)
+    idx = n - 1 - jnp.argmax(rev, axis=axis)
+    has = jnp.any(flat, axis=axis)
+    return jnp.where(has, idx, -1)
+
+
+# --- dynamic (traced-start) slicing ---------------------------------------
+
+def dynamic_slice(a, starts, sizes):
+    return lax.dynamic_slice(a, starts, sizes)
+
+
+def dynamic_update_slice(a, update, starts):
+    return lax.dynamic_update_slice(a, update, starts)
+
+
+def tensor_along_dimension(a, index, dim):
+    """INDArray.tensorAlongDimension — slice at `index` along `dim`."""
+    return jnp.take(a, index, axis=dim)
+
+
+def slice_along_first(a, i):
+    """INDArray.slice(i)."""
+    return a[i]

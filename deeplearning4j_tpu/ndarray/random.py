@@ -1,0 +1,121 @@
+"""Nd4j random analogue on JAX's counter-based PRNG.
+
+Reference parity: ``org.nd4j.linalg.factory.Nd4j.rand/randn`` and
+``org.nd4j.linalg.api.rng`` (stateful seeded RNG). TPU-first departure:
+the canonical API is *explicit keys* (jit-safe, reproducible under SPMD);
+a thin stateful facade (`set_seed`, `rand`, `randn`) exists for DL4J-style
+host-side use and splits a host-held key per call — never use it inside jit.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+_state = {"key": jax.random.PRNGKey(0)}
+
+
+def set_seed(seed: int) -> None:
+    """Nd4j.getRandom().setSeed analogue (host-side only)."""
+    with _lock:
+        _state["key"] = jax.random.PRNGKey(seed)
+
+
+def next_key():
+    """Split and return a fresh subkey from the host-side stream."""
+    with _lock:
+        _state["key"], sub = jax.random.split(_state["key"])
+    return sub
+
+
+def key(seed: int):
+    return jax.random.PRNGKey(seed)
+
+
+def split(k, num: int = 2):
+    return jax.random.split(k, num)
+
+
+def fold_in(k, data: int):
+    return jax.random.fold_in(k, data)
+
+
+# --- explicit-key distributions (jit-safe canonical API) -------------------
+
+def uniform(k, shape=(), dtype=jnp.float32, minval=0.0, maxval=1.0):
+    return jax.random.uniform(k, shape, dtype, minval, maxval)
+
+
+def normal(k, shape=(), dtype=jnp.float32, mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(k, shape, dtype)
+
+
+def truncated_normal(k, shape=(), dtype=jnp.float32, lower=-2.0, upper=2.0, mean=0.0, std=1.0):
+    return mean + std * jax.random.truncated_normal(k, lower, upper, shape, dtype)
+
+
+def bernoulli(k, p=0.5, shape=()):
+    return jax.random.bernoulli(k, p, shape)
+
+
+def binomial(k, n, p, shape=(), dtype=jnp.int32):
+    return jax.random.binomial(k, n, p, shape=shape).astype(dtype)
+
+
+def gamma(k, alpha, shape=(), dtype=jnp.float32):
+    return jax.random.gamma(k, alpha, shape, dtype)
+
+
+def beta(k, a, b, shape=(), dtype=jnp.float32):
+    return jax.random.beta(k, a, b, shape, dtype)
+
+
+def exponential(k, shape=(), dtype=jnp.float32, rate=1.0):
+    return jax.random.exponential(k, shape, dtype) / rate
+
+
+def poisson(k, lam, shape=(), dtype=jnp.int32):
+    return jax.random.poisson(k, lam, shape, dtype)
+
+
+def randint(k, shape, minval, maxval, dtype=jnp.int32):
+    return jax.random.randint(k, shape, minval, maxval, dtype)
+
+
+def categorical(k, logits, axis=-1, shape=None):
+    return jax.random.categorical(k, logits, axis=axis, shape=shape)
+
+
+def permutation(k, x, axis=0):
+    return jax.random.permutation(k, x, axis=axis)
+
+
+def choice(k, a, shape=(), replace=True, p=None):
+    return jax.random.choice(k, a, shape, replace, p)
+
+
+def gumbel(k, shape=(), dtype=jnp.float32):
+    return jax.random.gumbel(k, shape, dtype)
+
+
+def laplace(k, shape=(), dtype=jnp.float32):
+    return jax.random.laplace(k, shape, dtype)
+
+
+# --- stateful facade (Nd4j.rand/randn; host-side convenience) --------------
+
+def rand(*shape, dtype=jnp.float32, minval=0.0, maxval=1.0):
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    return uniform(next_key(), shape, dtype, minval, maxval)
+
+
+def randn(*shape, dtype=jnp.float32):
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    return jax.random.normal(next_key(), shape, dtype)
+
+
+def shuffle(x, axis=0):
+    return jax.random.permutation(next_key(), x, axis=axis)

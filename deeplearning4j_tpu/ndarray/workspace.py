@@ -1,0 +1,79 @@
+"""Memory-workspace analogue for TPU/XLA.
+
+Reference parity: ``org.nd4j.linalg.api.memory.MemoryWorkspace`` /
+``Nd4j.getWorkspaceManager()`` — libnd4j's arena allocator that reuses
+scratch buffers across iterations to avoid GC/alloc churn.
+
+TPU-first redesign: XLA already arena-allocates every intermediate inside a
+compiled program, so the workspace concept maps to (a) *buffer donation* —
+marking inputs whose HBM may be reused for outputs — and (b) keeping the
+whole iteration inside one ``jit`` so nothing round-trips through host
+memory. This module provides the donation bookkeeping and a scoped config
+object so DL4J-style `with workspace(...)` code has a direct equivalent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from dataclasses import dataclass, field
+
+import jax
+
+
+@dataclass
+class WorkspaceConfig:
+    """Mirrors WorkspaceConfiguration: which argnums to donate on the step fn."""
+
+    name: str = "WS_TRAIN"
+    donate_argnums: tuple = ()
+    donate_argnames: tuple = ()
+
+
+_active: list = []
+
+
+@contextlib.contextmanager
+def workspace(config: WorkspaceConfig | None = None, name: str = "WS"):
+    """Scoped workspace; inside the scope `current()` returns the config."""
+    cfg = config or WorkspaceConfig(name=name)
+    _active.append(cfg)
+    try:
+        yield cfg
+    finally:
+        _active.pop()
+
+
+def current() -> WorkspaceConfig | None:
+    return _active[-1] if _active else None
+
+
+def jit_in_workspace(fn=None, *, donate_argnums=(), static_argnums=(), **jit_kw):
+    """jit with donation — the workspace-enter/exit of the TPU world.
+
+    Donated inputs alias their HBM to outputs (params/opt-state in a train
+    step), eliminating the copy the reference's workspace existed to avoid.
+    """
+    if fn is None:
+        return functools.partial(jit_in_workspace, donate_argnums=donate_argnums,
+                                 static_argnums=static_argnums, **jit_kw)
+    return jax.jit(fn, donate_argnums=donate_argnums, static_argnums=static_argnums, **jit_kw)
+
+
+def live_buffer_bytes() -> int:
+    """Total bytes of live device buffers (workspace occupancy introspection)."""
+    total = 0
+    for d in jax.live_arrays():
+        total += d.nbytes
+    return total
+
+
+def device_memory_stats() -> dict:
+    """Per-device memory stats where the backend exposes them."""
+    out = {}
+    for dev in jax.devices():
+        try:
+            out[str(dev)] = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backend may not support stats
+            out[str(dev)] = None
+    return out

@@ -1,0 +1,34 @@
+"""deeplearning4j_tpu.nn — layer API (DL4J-NN analogue)."""
+
+from . import activations, losses, weights
+from .conf import MultiLayerConfiguration, NeuralNetConfiguration
+from .layers.attention import (LearnedSelfAttentionLayer,
+                               RecurrentAttentionLayer, SelfAttentionLayer)
+from .layers.base import Ctx, InputType, Layer
+from .layers.conv import (Convolution1DLayer, Convolution3DLayer,
+                          ConvolutionLayer, Cropping2D, Deconvolution2D,
+                          DepthToSpaceLayer, DepthwiseConvolution2D,
+                          GlobalPoolingLayer, LocallyConnected1D,
+                          LocallyConnected2D, PoolingType,
+                          SeparableConvolution2D, SpaceToDepthLayer,
+                          Subsampling1DLayer, SubsamplingLayer, Upsampling1D,
+                          Upsampling2D, Upsampling3D, ZeroPaddingLayer)
+from .layers.core import (ActivationLayer, AlphaDropout,
+                          CenterLossOutputLayer, DenseLayer, DropoutLayer,
+                          ElementWiseMultiplicationLayer, EmbeddingLayer,
+                          EmbeddingSequenceLayer, GaussianDropout,
+                          GaussianNoise, LossLayer, OutputLayer, PReLULayer,
+                          RnnOutputLayer, SpatialDropout)
+from .layers.norm import (BatchNormalization, LayerNormalization,
+                          LocalResponseNormalization, RMSNorm)
+from .layers.recurrent import (GRU, LSTM, BaseRecurrent, Bidirectional,
+                               BidirectionalMode, GravesBidirectionalLSTM,
+                               GravesLSTM, LastTimeStep, SimpleRnn,
+                               TimeDistributed)
+from .listeners import (CheckpointListener, CollectScoresListener,
+                        EvaluativeListener, NanScoreWatchdog,
+                        PerformanceListener, ScoreIterationListener,
+                        StatsListener, TimeIterationListener)
+from .losses import Loss
+from .multi_layer_network import MultiLayerNetwork
+from .weights import WeightInit

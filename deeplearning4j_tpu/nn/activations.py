@@ -1,0 +1,140 @@
+"""Activation functions — parity with DL4J's ``org.nd4j.linalg.activations.Activation`` enum.
+
+All are pure elementwise fns (XLA fuses them into adjacent matmuls/convs, so
+unlike the reference there is no separate "activation op" cost on TPU).
+Resolve by name via `get(name)`; names match the DL4J enum, lowercase.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_SQRT_2_OVER_PI = 0.7978845608028654
+
+
+def identity(x):
+    return x
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def leakyrelu(x, alpha=0.01):
+    return jax.nn.leaky_relu(x, alpha)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def gelu(x):
+    """DL4J ActivationGELU (tanh approximation is its default path)."""
+    return jax.nn.gelu(x, approximate=True)
+
+
+def gelu_exact(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def logsoftmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def rationaltanh(x):
+    """DL4J ActivationRationalTanh: 1.7159 * tanh(2x/3) rational approximation."""
+    ax = jnp.abs(x)
+    a = 1.0 + ax + x * x + 1.41645 * x * x * x * x
+    return jnp.sign(x) * (1.0 - 1.0 / a) * 1.7159
+
+
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return jax.nn.mish(x)
+
+
+def cube(x):
+    return x * x * x
+
+
+def thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+def gumbel_softmax(x, tau=1.0, axis=-1):
+    return jax.nn.softmax(x / tau, axis=axis)
+
+
+_REGISTRY = {
+    "identity": identity, "linear": identity,
+    "relu": relu, "relu6": relu6, "leakyrelu": leakyrelu, "elu": elu,
+    "selu": selu, "celu": celu, "gelu": gelu, "gelu_exact": gelu_exact,
+    "sigmoid": sigmoid, "hardsigmoid": hardsigmoid,
+    "softmax": softmax, "logsoftmax": logsoftmax,
+    "tanh": tanh, "rationaltanh": rationaltanh, "rectifiedtanh": rectifiedtanh,
+    "hardtanh": hardtanh, "softplus": softplus, "softsign": softsign,
+    "swish": swish, "silu": swish, "mish": mish, "cube": cube,
+    "thresholdedrelu": thresholdedrelu, "gumbel_softmax": gumbel_softmax,
+}
+
+
+def get(name_or_fn):
+    """Resolve an activation by DL4J enum name (case-insensitive) or pass through."""
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown activation '{name_or_fn}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names():
+    return sorted(_REGISTRY)

@@ -1,0 +1,292 @@
+"""ComputationGraph — DAG network runtime.
+
+Reference parity: ``org.deeplearning4j.nn.graph.ComputationGraph``
+(init/fit/output/score/evaluate on multi-input multi-output DAGs).
+The topological order traces into one jaxpr; multi-output losses sum with
+per-output weights like the reference. Shares the train-step design of
+MultiLayerNetwork (one jitted donated step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..train.updaters import NoOp, build_optimizer
+from .graph import ComputationGraphConfiguration
+from .layers.base import Ctx, Layer
+from .layers.core import LossLayer, OutputLayer
+from .preprocessors import CnnToFeedForwardPreProcessor
+from .vertices import GraphVertex
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self._g = conf.globals_
+        self.params: Dict[str, dict] = {}
+        self.states: Dict[str, dict] = {}
+        self._preprocessors: Dict[str, Any] = {}
+        self._optimizer = None
+        self._opt_state = None
+        self.listeners: List[Any] = []
+        self.initialized = False
+        self._train_step = None
+        self._infer_fn = None
+        self.epoch_count = 0
+        self._step_count = 0
+        self._host_key = jax.random.PRNGKey(self._g.seed)
+        self.output_loss_weights = {name: 1.0 for name in conf.outputs}
+
+    # ------------------------------------------------------------------ init
+    def init(self, input_shapes=None):
+        if input_shapes is None:
+            if self.conf.input_types is None:
+                raise ValueError("Provide input_shapes or set_input_types")
+            input_shapes = [tuple(t[1]) for t in self.conf.input_types]
+        shapes = {name: tuple(s) for name, s in zip(self.conf.inputs, input_shapes)}
+        key = jax.random.PRNGKey(self._g.seed)
+        for name in self.conf.topo_order:
+            node = self.conf.nodes[name]
+            in_shapes = [shapes[i] for i in node.inputs]
+            if isinstance(node.op, Layer):
+                from .multi_layer_network import _is_ff_layer
+                s = in_shapes[0]
+                if (_is_ff_layer(node.op) or isinstance(node.op, OutputLayer)) \
+                        and len(s) == 3:
+                    pp = CnnToFeedForwardPreProcessor()
+                    self._preprocessors[name] = pp
+                    s = pp.out_shape(s)
+                key, sub = jax.random.split(key)
+                p, st, out = node.op.init(sub, s)
+                self.params[name] = p
+                self.states[name] = st
+                shapes[name] = out
+            else:
+                shapes[name] = node.op.out_shape(in_shapes)
+                self.params[name] = {}
+                self.states[name] = {}
+        self.output_shapes = {o: shapes[o] for o in self.conf.outputs}
+        self.initialized = True
+        return self
+
+    # -------------------------------------------------------------- forward
+    def _forward(self, params, states, inputs: dict, *, train, rng,
+                 fmask=None, lmask=None, stop_at_output_preact=False):
+        acts = dict(inputs)
+        new_states = {}
+        pre_acts = {}
+        for idx, name in enumerate(self.conf.topo_order):
+            node = self.conf.nodes[name]
+            xs = [acts[i] for i in node.inputs]
+            if isinstance(node.op, Layer):
+                h = xs[0]
+                if name in self._preprocessors:
+                    h = self._preprocessors[name](h)
+                lrng = None if rng is None else jax.random.fold_in(rng, idx)
+                ctx = Ctx(train=train, rng=lrng, mask=fmask, label_mask=lmask)
+                if train and node.op.dropout > 0.0 and lrng is not None:
+                    keep = 1.0 - node.op.dropout
+                    m = jax.random.bernoulli(jax.random.fold_in(lrng, 997), keep, h.shape)
+                    h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
+                if stop_at_output_preact and name in self.conf.outputs and \
+                        isinstance(node.op, (OutputLayer, LossLayer)):
+                    pre_acts[name] = h
+                    new_states[name] = states[name]
+                    acts[name] = h
+                    continue
+                h, s_new = node.op.apply(params[name], states[name], h, ctx)
+                new_states[name] = s_new
+                acts[name] = h
+            else:
+                acts[name] = node.op.apply(xs)
+                new_states[name] = states[name]
+        return acts, pre_acts, new_states
+
+    def output(self, *inputs):
+        if self._infer_fn is None:
+            def infer(params, states, inputs):
+                acts, _, _ = self._forward(params, states, inputs, train=False, rng=None)
+                return [acts[o] for o in self.conf.outputs]
+            self._infer_fn = jax.jit(infer)
+        ins = {n: jnp.asarray(x) for n, x in zip(self.conf.inputs, inputs)}
+        outs = self._infer_fn(self.params, self.states, ins)
+        return outs[0] if len(outs) == 1 else outs
+
+    # ----------------------------------------------------------------- loss
+    def _loss(self, params, states, inputs, labels, rng, fmask, lmask):
+        acts, pre_acts, new_states = self._forward(
+            params, states, inputs, train=True, rng=rng, fmask=fmask, lmask=lmask,
+            stop_at_output_preact=True)
+        total = 0.0
+        for name in self.conf.outputs:
+            node = self.conf.nodes[name]
+            y = labels[name]
+            w = self.output_loss_weights.get(name, 1.0)
+            if isinstance(node.op, OutputLayer):
+                total = total + w * node.op.compute_loss(params[name], pre_acts[name], y, mask=lmask)
+            elif isinstance(node.op, LossLayer):
+                total = total + w * node.op.compute_loss(pre_acts[name], y, mask=lmask)
+            else:
+                raise ValueError(f"output node '{name}' is not an output/loss layer")
+        total = total + self._reg_score(params)
+        return total, new_states
+
+    def _reg_score(self, params):
+        reg = 0.0
+        for name, node in self.conf.nodes.items():
+            op = node.op
+            if not isinstance(op, Layer) or (op.l1 == 0.0 and op.l2 == 0.0):
+                continue
+            for k, w in params[name].items():
+                if k in ("b", "beta", "mean", "var"):
+                    continue
+                if op.l1:
+                    reg = reg + op.l1 * jnp.sum(jnp.abs(w))
+                if op.l2:
+                    reg = reg + 0.5 * op.l2 * jnp.sum(jnp.square(w))
+        return reg
+
+    # ------------------------------------------------------------ optimizer
+    def _build_optimizer(self, ipe=1):
+        g = self._g
+        labels = {}
+        has_override = False
+        per_label = {"__default__": g.updater, "__frozen__": NoOp()}
+        for name, node in self.conf.nodes.items():
+            if isinstance(node.op, Layer) and node.op.frozen:
+                lab = "__frozen__"
+                has_override = True
+            elif isinstance(node.op, Layer) and node.op.updater is not None:
+                lab = f"__{name}__"
+                per_label[lab] = node.op.updater
+                has_override = True
+            else:
+                lab = "__default__"
+            labels[name] = jax.tree_util.tree_map(lambda _: lab, self.params[name])
+        self._optimizer = build_optimizer(
+            g.updater, grad_norm=g.grad_norm, grad_norm_threshold=g.grad_norm_threshold,
+            iters_per_epoch=ipe,
+            param_labels=labels if has_override else None,
+            per_label_updaters=per_label if has_override else None)
+        self._opt_state = self._optimizer.init(self.params)
+
+    def _get_train_step(self):
+        if self._train_step is None:
+            optimizer = self._optimizer
+
+            def step(params, states, opt_state, inputs, labels, rng, fmask, lmask):
+                (loss, new_states), grads = jax.value_and_grad(
+                    self._loss, has_aux=True)(params, states, inputs, labels, rng, fmask, lmask)
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, new_states, opt_state, loss
+
+            self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._train_step
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, data, *, epochs: int = 1):
+        """fit(MultiDataSetIterator | MultiDataSet | DataSet | iterator)."""
+        from ..data.dataset import DataSet, MultiDataSet
+        if isinstance(data, (DataSet, MultiDataSet)):
+            iterator = [data]
+        else:
+            iterator = data
+        if not self.initialized:
+            first = next(iter(iterator))
+            feats = first.features if isinstance(first, MultiDataSet) else [first.features]
+            self.init([tuple(np.asarray(f).shape[1:]) for f in feats])
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        if self._optimizer is None:
+            try:
+                ipe = len(iterator)
+            except TypeError:
+                ipe = 1
+            self._build_optimizer(max(int(ipe), 1))
+        step_fn = self._get_train_step()
+        last = None
+        for _ in range(epochs):
+            for ds in iterator:
+                from ..data.dataset import MultiDataSet as MDS
+                if isinstance(ds, MDS):
+                    feats, labs = ds.features, ds.labels
+                    fmask = None if ds.features_masks is None else ds.features_masks[0]
+                    lmask = None if ds.labels_masks is None else ds.labels_masks[0]
+                else:
+                    feats, labs = [ds.features], [ds.labels]
+                    fmask, lmask = ds.features_mask, ds.labels_mask
+                inputs = {n: jnp.asarray(f) for n, f in zip(self.conf.inputs, feats)}
+                labels = {n: jnp.asarray(l) for n, l in zip(self.conf.outputs, labs)}
+                fm = None if fmask is None else jnp.asarray(fmask)
+                lm = None if lmask is None else jnp.asarray(lmask)
+                self._host_key, rng = jax.random.split(self._host_key)
+                self.params, self.states, self._opt_state, loss = step_fn(
+                    self.params, self.states, self._opt_state, inputs, labels, rng, fm, lm)
+                self._step_count += 1
+                last = loss
+                if self.listeners:
+                    lv = float(loss)
+                    for listener in self.listeners:
+                        listener.iteration_done(self, self._step_count, self.epoch_count, lv)
+            self.epoch_count += 1
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+        return None if last is None else float(last)
+
+    def score(self, ds):
+        from ..data.dataset import MultiDataSet as MDS
+        if isinstance(ds, MDS):
+            feats, labs = ds.features, ds.labels
+        else:
+            feats, labs = [ds.features], [ds.labels]
+        inputs = {n: jnp.asarray(f) for n, f in zip(self.conf.inputs, feats)}
+        labels = {n: jnp.asarray(l) for n, l in zip(self.conf.outputs, labs)}
+        loss, _ = self._loss(self.params, self.states, inputs, labels, None, None, None)
+        return float(loss)
+
+    def evaluate(self, iterator, top_n: int = 1):
+        from ..eval.classification import Evaluation
+        ev = Evaluation(top_n=top_n)
+        for ds in iterator:
+            preds = self.output(jnp.asarray(ds.features))
+            if isinstance(preds, list):
+                preds = preds[0]
+            ev.eval(jnp.asarray(ds.labels), preds)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return ev
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+
+    def num_params(self):
+        return sum(int(p.size) for p in jax.tree_util.tree_leaves(self.params))
+
+    def summary(self):
+        lines = ["=" * 72, f"{'Node':<26}{'Type':<26}{'Params':<12}", "=" * 72]
+        total = 0
+        for name in self.conf.topo_order:
+            node = self.conf.nodes[name]
+            n = sum(int(v.size) for v in jax.tree_util.tree_leaves(self.params.get(name, {})))
+            total += n
+            lines.append(f"{name:<26}{type(node.op).__name__:<26}{n:<12}")
+        lines += ["=" * 72, f"Total params: {total}", "=" * 72]
+        return "\n".join(lines)
+
+    def save(self, path, save_updater: bool = False):
+        from ..serde.model_serializer import save_model
+        save_model(self, path, save_updater=save_updater)
+
+    @staticmethod
+    def load(path):
+        from ..serde.model_serializer import load_model
+        return load_model(path)

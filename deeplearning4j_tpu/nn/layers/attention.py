@@ -1,0 +1,163 @@
+"""Attention layers — SelfAttention (MHA), LearnedSelfAttention,
+RecurrentAttention.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.{SelfAttentionLayer,
+LearnedSelfAttentionLayer, RecurrentAttentionLayer}`` (built on SameDiff
+MultiHeadDotProductAttention). TPU-first: the core is
+``jax.nn.dot_product_attention`` which XLA lowers to a fused (flash-style)
+kernel; a Pallas flash-attention path plugs in via `impl="pallas"` (see
+`deeplearning4j_tpu.kernels.flash_attention`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import Ctx, Layer, apply_time_mask
+
+
+def _mha_params(layer, key, n_in, n_out, n_heads, head_dim):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    proj = n_heads * head_dim
+    return {
+        "Wq": layer._make_weight(k1, (n_in, proj), n_in, proj),
+        "Wk": layer._make_weight(k2, (n_in, proj), n_in, proj),
+        "Wv": layer._make_weight(k3, (n_in, proj), n_in, proj),
+        "Wo": layer._make_weight(k4, (proj, n_out), proj, n_out),
+    }
+
+
+def multi_head_attention(params, q_in, kv_in, n_heads, head_dim, mask=None,
+                         is_causal=False, impl=None, dtype=None):
+    """q_in (B,Tq,C), kv_in (B,Tk,C) → (B,Tq,nOut). mask: (B,Tk) key mask."""
+    dt = dtype or q_in.dtype
+    b, tq, _ = q_in.shape
+    tk = kv_in.shape[1]
+    q = (q_in @ params["Wq"].astype(dt)).reshape(b, tq, n_heads, head_dim)
+    k = (kv_in @ params["Wk"].astype(dt)).reshape(b, tk, n_heads, head_dim)
+    v = (kv_in @ params["Wv"].astype(dt)).reshape(b, tk, n_heads, head_dim)
+    if impl == "pallas":
+        from ...kernels.flash_attention import flash_attention
+        out = flash_attention(q, k, v, causal=is_causal, kv_mask=mask)
+    else:
+        kw = {}
+        if mask is not None:
+            kw["key_value_seq_lengths"] = None
+            amask = mask[:, None, None, :].astype(bool)  # (B,1,1,Tk) -> broadcast (B,H,Tq,Tk)
+            kw["mask"] = jnp.broadcast_to(amask, (b, n_heads, tq, tk))
+        out = jax.nn.dot_product_attention(q, k, v, is_causal=is_causal, **kw)
+    out = out.reshape(b, tq, n_heads * head_dim)
+    return out @ params["Wo"].astype(dt)
+
+
+@dataclass
+class SelfAttentionLayer(Layer):
+    """Multi-head self attention over (B,T,C) [NTC]."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    project_input: bool = True
+    is_causal: bool = False
+    impl: Optional[str] = None  # None → XLA fused; "pallas" → our kernel
+
+    def _head_dim(self, n_in):
+        return self.head_size or (self.n_out or n_in) // self.n_heads
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        c = self.n_in or c
+        n_out = self.n_out or c
+        params = _mha_params(self, key, c, n_out, self.n_heads, self._head_dim(c))
+        return params, {}, (t, n_out)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        y = multi_head_attention(params, x, x, self.n_heads, self._head_dim(x.shape[-1]),
+                                 mask=ctx.mask, is_causal=self.is_causal, impl=self.impl)
+        return apply_time_mask(y, ctx.mask), state
+
+
+@dataclass
+class LearnedSelfAttentionLayer(Layer):
+    """Attention with nQueries learned query vectors → fixed-size output
+    (B, nQueries, nOut) regardless of sequence length."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    n_queries: int = 1
+    impl: Optional[str] = None
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        c = self.n_in or c
+        n_out = self.n_out or c
+        kq, kp = jax.random.split(key)
+        hd = self.head_size or n_out // self.n_heads
+        params = _mha_params(self, kp, c, n_out, self.n_heads, hd)
+        params["Q"] = self._make_weight(kq, (self.n_queries, c), c, c)
+        return params, {}, (self.n_queries, n_out)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        b = x.shape[0]
+        q = jnp.broadcast_to(params["Q"].astype(x.dtype), (b,) + params["Q"].shape)
+        hd = self.head_size or (self.n_out or x.shape[-1]) // self.n_heads
+        y = multi_head_attention(params, q, x, self.n_heads, hd, mask=ctx.mask, impl=self.impl)
+        return y, state
+
+
+@dataclass
+class RecurrentAttentionLayer(Layer):
+    """SimpleRnn cell whose input at each step is augmented with attention
+    over the full input sequence (reference RecurrentAttentionLayer)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_heads: int = 1
+    activation: Any = "tanh"
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        c = self.n_in or c
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        hd = self.n_out // self.n_heads
+        params = _mha_params(self, k1, c, self.n_out, self.n_heads, max(hd, 1))
+        params["W"] = self._make_weight(k2, (c, self.n_out), c, self.n_out)
+        params["RW"] = self._make_weight(k3, (self.n_out, self.n_out), self.n_out, self.n_out)
+        params["Wa"] = self._make_weight(k4, (self.n_out, self.n_out), self.n_out, self.n_out)
+        params["b"] = self._make_bias((self.n_out,))
+        return params, {}, (t, self.n_out)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        act = self.activation_fn()
+        hd = max(self.n_out // self.n_heads, 1)
+        # attention context per step computed from x (keys/values static per seq)
+        attn = multi_head_attention(params, x, x, self.n_heads, hd, mask=ctx.mask)
+        w, rw, wa, b = (params[k].astype(x.dtype) for k in ("W", "RW", "Wa", "b"))
+        xw = x @ w + b
+        aw = attn @ wa
+        h0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
+
+        def step(h, inp):
+            xt, at, mt = inp
+            h_new = act(xt + at + h @ rw)
+            if mt is not None:
+                h_new = jnp.where(mt[:, None] > 0, h_new, h)
+            return h_new, h_new
+
+        xs, ats = xw.swapaxes(0, 1), aw.swapaxes(0, 1)
+        if ctx.mask is None:
+            _, hs = jax.lax.scan(lambda h, i: step(h, (i[0], i[1], None)), h0, (xs, ats))
+        else:
+            _, hs = jax.lax.scan(step, h0, (xs, ats, ctx.mask.swapaxes(0, 1)))
+        y = hs.swapaxes(0, 1)
+        return apply_time_mask(y, ctx.mask), state

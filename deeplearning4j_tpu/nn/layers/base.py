@@ -1,0 +1,124 @@
+"""Layer protocol + InputType — the TPU-native redesign of DL4J's Layer API.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.Layer`` +
+``org.deeplearning4j.nn.api.Layer`` (activate/backpropGradient) and
+``InputType`` (setInputType/getOutputType shape inference).
+
+TPU-first redesign: a layer is a *config dataclass* with two pure functions —
+``init(key, input_shape) -> (params, state, output_shape)`` and
+``apply(params, state, x, ctx) -> (y, new_state)``. No backpropGradient:
+reverse-mode comes from jax.grad over the composed forward. Params/state are
+plain dicts of jax arrays (pytrees), named like the reference ("W", "b",
+"gamma", ...) so checkpoints translate 1:1.
+
+Shape convention (batch dim excluded everywhere):
+  feed-forward: (nIn,)            — DL4J InputType.feedForward(nIn)
+  recurrent:    (T, nIn)  [NTC]   — DL4J uses NCW; NTC is the TPU-native layout
+  convolutional:(H, W, C) [NHWC]  — DL4J uses NCHW; NHWC is the TPU-native layout
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import activations as _act
+from .. import weights as _winit
+
+
+@dataclass
+class Ctx:
+    """Per-call context threaded through apply(): train flag, rng, masks."""
+
+    train: bool = False
+    rng: Any = None
+    mask: Any = None          # feature/time mask (B,) or (B, T)
+    label_mask: Any = None
+
+    def split_rng(self):
+        if self.rng is None:
+            return None
+        self.rng, sub = jax.random.split(self.rng)
+        return sub
+
+
+class InputType:
+    """DL4J InputType factory — plain shape tuples + kind tags."""
+
+    @staticmethod
+    def feed_forward(n):
+        return ("ff", (int(n),))
+
+    @staticmethod
+    def recurrent(n, timesteps=None):
+        return ("rnn", (timesteps, int(n)))
+
+    @staticmethod
+    def convolutional(height, width, channels):
+        """NHWC output shape (TPU-native); accepts DL4J's (h, w, c) argument order."""
+        return ("cnn", (int(height), int(width), int(channels)))
+
+    @staticmethod
+    def convolutional_3d(d, h, w, c):
+        return ("cnn3d", (int(d), int(h), int(w), int(c)))
+
+
+@dataclass
+class Layer:
+    """Base layer config. Subclasses define init/apply; everything is pure."""
+
+    name: Optional[str] = None
+    dtype: Any = jnp.float32          # parameter dtype
+    compute_dtype: Any = None         # if set, inputs cast before apply (bf16 policy)
+    weight_init: Any = None           # None → inherit global default (xavier)
+    bias_init: float = 0.0
+    l1: float = 0.0                   # per-layer overrides picked up by the net
+    l2: float = 0.0
+    updater: Any = None               # per-layer updater override
+    frozen: bool = False
+    dropout: float = 0.0              # input dropout (DL4J layer dropOut)
+
+    # ---- to be overridden -------------------------------------------------
+    def init(self, key, input_shape):
+        """Returns (params: dict, state: dict, output_shape)."""
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return x, state
+
+    # ---- helpers ----------------------------------------------------------
+    def _winit_fn(self):
+        return _winit.get(self.weight_init or "xavier")
+
+    def _make_weight(self, key, shape, fan_in=None, fan_out=None):
+        fi, fo = _winit.compute_fans(shape)
+        fn = self._winit_fn()
+        return fn(key, shape, fan_in or fi, fan_out or fo, self.dtype)
+
+    def _make_bias(self, shape):
+        return jnp.full(shape, self.bias_init, self.dtype)
+
+    def _cast_in(self, x):
+        if self.compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(self.compute_dtype)
+        return x
+
+    def activation_fn(self):
+        return _act.get(getattr(self, "activation", "identity"))
+
+    def has_params(self):
+        return True
+
+    def n_params(self, input_shape):
+        params, _, _ = self.init(jax.random.PRNGKey(0), input_shape)
+        return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+def apply_time_mask(y, mask):
+    """Zero padded timesteps: y (B,T,C), mask (B,T) → masked y."""
+    if mask is None:
+        return y
+    return y * mask[..., None].astype(y.dtype)

@@ -1,0 +1,683 @@
+"""Convolution family — NHWC, lax.conv_general_dilated (MXU path).
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.{ConvolutionLayer,
+Convolution1DLayer, Convolution3D, Deconvolution2D, SeparableConvolution2D,
+DepthwiseConvolution2D, SubsamplingLayer, Subsampling1DLayer,
+Subsampling3DLayer, Upsampling1D/2D/3D, ZeroPaddingLayer, Cropping2D,
+SpaceToDepthLayer, DepthToSpace, LocallyConnected1D/2D}``.
+
+The reference dispatches these to cuDNN kernels (libnd4j ConvolutionUtils);
+here XLA lowers them onto the MXU directly, with bf16 inputs and f32
+accumulation (`preferred_element_type`). Layout is NHWC / HWIO — the TPU
+native layout — instead of the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Ctx, Layer
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v, v)
+
+
+def _padding(pad, kernel, mode):
+    """DL4J ConvolutionMode → lax padding. mode: 'same'|'truncate'|'valid'+explicit."""
+    if isinstance(pad, str):
+        return pad.upper()
+    if mode == "same":
+        return "SAME"
+    pads = _pair(pad) if len(kernel) == 2 else _triple(pad)
+    return tuple((p, p) for p in pads)
+
+
+def _acc_dtype(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+@dataclass
+class ConvolutionLayer(Layer):
+    """2D conv. Kernel stored HWIO ("W": (kh,kw,cin/groups,cout)), bias (cout,)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    padding: Any = 0
+    dilation: Any = (1, 1)
+    groups: int = 1
+    convolution_mode: str = "truncate"   # DL4J ConvolutionMode.{Same,Truncate}
+    activation: Any = "identity"
+    has_bias: bool = True
+
+    def _kernel_shape(self, c_in):
+        kh, kw = _pair(self.kernel_size)
+        return (kh, kw, c_in // self.groups, self.n_out)
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        c = self.n_in or c
+        kshape = self._kernel_shape(c)
+        fan_in = kshape[0] * kshape[1] * kshape[2]
+        fan_out = kshape[0] * kshape[1] * self.n_out
+        params = {"W": self._make_weight(key, kshape, fan_in, fan_out)}
+        if self.has_bias:
+            params["b"] = self._make_bias((self.n_out,))
+        oh, ow = self._out_hw(h, w)
+        return params, {}, (oh, ow, self.n_out)
+
+    def _out_hw(self, h, w):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        if self.convolution_mode == "same":
+            return -(-h // sh), -(-w // sw)
+        ph, pw = _pair(self.padding)
+        eh, ew = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+        return (h + 2 * ph - eh) // sh + 1, (w + 2 * pw - ew) // sw + 1
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        w = params["W"].astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=_pair(self.stride),
+            padding=_padding(self.padding, _pair(self.kernel_size), self.convolution_mode),
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+            preferred_element_type=_acc_dtype(x))
+        y = y.astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state
+
+
+@dataclass
+class Convolution1DLayer(Layer):
+    """1D conv over (B, T, C) [NTC]."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    padding: Any = 0
+    dilation: int = 1
+    convolution_mode: str = "same"
+    activation: Any = "identity"
+    has_bias: bool = True
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        c = self.n_in or c
+        k = self.kernel_size if not isinstance(self.kernel_size, (tuple, list)) else self.kernel_size[0]
+        kshape = (k, c, self.n_out)
+        params = {"W": self._make_weight(key, kshape, k * c, k * self.n_out)}
+        if self.has_bias:
+            params["b"] = self._make_bias((self.n_out,))
+        if self.convolution_mode == "same":
+            ot = None if t is None else -(-t // self.stride)
+        else:
+            p = self.padding if isinstance(self.padding, int) else self.padding[0]
+            e = self.dilation * (k - 1) + 1
+            ot = None if t is None else (t + 2 * p - e) // self.stride + 1
+        return params, {}, (ot, self.n_out)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        w = params["W"].astype(x.dtype)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        elif isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            p = self.padding if isinstance(self.padding, int) else self.padding[0]
+            pad = ((p, p),)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NTC", "TIO", "NTC"),
+            preferred_element_type=_acc_dtype(x))
+        y = y.astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state
+
+
+@dataclass
+class Convolution3DLayer(Layer):
+    """3D conv over (B, D, H, W, C) [NDHWC]."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: Any = (3, 3, 3)
+    stride: Any = (1, 1, 1)
+    padding: Any = 0
+    dilation: Any = (1, 1, 1)
+    convolution_mode: str = "same"
+    activation: Any = "identity"
+    has_bias: bool = True
+
+    def init(self, key, input_shape):
+        d, h, w, c = input_shape
+        c = self.n_in or c
+        kd, kh, kw = _triple(self.kernel_size)
+        kshape = (kd, kh, kw, c, self.n_out)
+        fan_in = kd * kh * kw * c
+        params = {"W": self._make_weight(key, kshape, fan_in, kd * kh * kw * self.n_out)}
+        if self.has_bias:
+            params["b"] = self._make_bias((self.n_out,))
+        sd, sh, sw = _triple(self.stride)
+        if self.convolution_mode == "same":
+            out = (-(-d // sd), -(-h // sh), -(-w // sw), self.n_out)
+        else:
+            pd, ph, pw = _triple(self.padding)
+            dd, dh, dw = _triple(self.dilation)
+            out = ((d + 2 * pd - (dd * (kd - 1) + 1)) // sd + 1,
+                   (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1,
+                   (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1, self.n_out)
+        return params, {}, out
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        w = params["W"].astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=_triple(self.stride),
+            padding=_padding(self.padding, _triple(self.kernel_size), self.convolution_mode),
+            rhs_dilation=_triple(self.dilation),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            preferred_element_type=_acc_dtype(x))
+        y = y.astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state
+
+
+@dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed conv (Deconvolution2D)."""
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        c = self.n_in or c
+        kh, kw = _pair(self.kernel_size)
+        kshape = (kh, kw, c, self.n_out)  # lax.conv_transpose uses HWIO
+        params = {"W": self._make_weight(key, kshape, kh * kw * c, kh * kw * self.n_out)}
+        if self.has_bias:
+            params["b"] = self._make_bias((self.n_out,))
+        sh, sw = _pair(self.stride)
+        if self.convolution_mode == "same":
+            out = (None if h is None else h * sh, None if w is None else w * sw, self.n_out)
+        else:
+            ph, pw = _pair(self.padding)
+            out = (None if h is None else sh * (h - 1) + kh - 2 * ph,
+                   None if w is None else sw * (w - 1) + kw - 2 * pw, self.n_out)
+        return params, {}, out
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        w = params["W"].astype(x.dtype)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            ph, pw = _pair(self.padding)
+            kh, kw = _pair(self.kernel_size)
+            pad = ((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw))
+        y = lax.conv_transpose(
+            x, w, strides=_pair(self.stride), padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=_acc_dtype(x))
+        y = y.astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state
+
+
+@dataclass
+class DepthwiseConvolution2D(Layer):
+    n_in: Optional[int] = None
+    depth_multiplier: int = 1
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    padding: Any = 0
+    convolution_mode: str = "same"
+    activation: Any = "identity"
+    has_bias: bool = True
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        c = self.n_in or c
+        kh, kw = _pair(self.kernel_size)
+        n_out = c * self.depth_multiplier
+        kshape = (kh, kw, 1, n_out)
+        params = {"W": self._make_weight(key, kshape, kh * kw, kh * kw * self.depth_multiplier)}
+        if self.has_bias:
+            params["b"] = self._make_bias((n_out,))
+        sh, sw = _pair(self.stride)
+        if self.convolution_mode == "same":
+            out = (-(-h // sh), -(-w // sw), n_out)
+        else:
+            ph, pw = _pair(self.padding)
+            out = ((h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1, n_out)
+        return params, {}, out
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        c = x.shape[-1]
+        w = params["W"].astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=_pair(self.stride),
+            padding=_padding(self.padding, _pair(self.kernel_size), self.convolution_mode),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c,
+            preferred_element_type=_acc_dtype(x))
+        y = y.astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state
+
+
+@dataclass
+class SeparableConvolution2D(Layer):
+    """Depthwise + pointwise (SeparableConvolution2D)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    depth_multiplier: int = 1
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    padding: Any = 0
+    convolution_mode: str = "same"
+    activation: Any = "identity"
+    has_bias: bool = True
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        c = self.n_in or c
+        kh, kw = _pair(self.kernel_size)
+        k1, k2 = jax.random.split(key)
+        dshape = (kh, kw, 1, c * self.depth_multiplier)
+        pshape = (1, 1, c * self.depth_multiplier, self.n_out)
+        params = {
+            "dW": self._make_weight(k1, dshape, kh * kw, kh * kw * self.depth_multiplier),
+            "pW": self._make_weight(k2, pshape, c * self.depth_multiplier, self.n_out),
+        }
+        if self.has_bias:
+            params["b"] = self._make_bias((self.n_out,))
+        sh, sw = _pair(self.stride)
+        if self.convolution_mode == "same":
+            out = (-(-h // sh), -(-w // sw), self.n_out)
+        else:
+            ph, pw = _pair(self.padding)
+            out = ((h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1, self.n_out)
+        return params, {}, out
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        c = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x, params["dW"].astype(x.dtype), window_strides=_pair(self.stride),
+            padding=_padding(self.padding, _pair(self.kernel_size), self.convolution_mode),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c,
+            preferred_element_type=_acc_dtype(x)).astype(x.dtype)
+        y = lax.conv_general_dilated(
+            y, params["pW"].astype(x.dtype), window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=_acc_dtype(x)).astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state
+
+
+class PoolingType:
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+@dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (SubsamplingLayer). NHWC."""
+
+    kernel_size: Any = (2, 2)
+    stride: Any = None
+    padding: Any = 0
+    pooling_type: str = PoolingType.MAX
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride if self.stride is not None else self.kernel_size)
+        if self.convolution_mode == "same":
+            out = (-(-h // sh), -(-w // sw), c)
+        else:
+            ph, pw = _pair(self.padding)
+            out = ((h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1, c)
+        return {}, {}, out
+
+    def apply(self, params, state, x, ctx: Ctx):
+        kh, kw = _pair(self.kernel_size)
+        stride = _pair(self.stride if self.stride is not None else self.kernel_size)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        elif isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            ph, pw = _pair(self.padding)
+            pad = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        window = (1, kh, kw, 1)
+        strides = (1, *stride, 1)
+        if self.pooling_type == PoolingType.MAX:
+            init_val = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = lax.reduce_window(x, init_val, lax.max, window, strides, pad)
+        elif self.pooling_type == PoolingType.AVG:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad) / (kh * kw)
+        elif self.pooling_type == PoolingType.SUM:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        else:  # pnorm
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, pad) ** (1.0 / p)
+        return y.astype(x.dtype), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Subsampling1DLayer(Layer):
+    kernel_size: int = 2
+    stride: int = None
+    padding: int = 0
+    pooling_type: str = PoolingType.MAX
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        k = self.kernel_size
+        s = self.stride or k
+        if t is None:
+            return {}, {}, (None, c)
+        if self.convolution_mode == "same":
+            return {}, {}, (-(-t // s), c)
+        return {}, {}, ((t + 2 * self.padding - k) // s + 1, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        k, s = self.kernel_size, self.stride or self.kernel_size
+        pad = "SAME" if self.convolution_mode == "same" else ((0, 0), (self.padding, self.padding), (0, 0))
+        window, strides = (1, k, 1), (1, s, 1)
+        if self.pooling_type == PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        elif self.pooling_type == PoolingType.PNORM:
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, pad) ** (1.0 / p)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            if self.pooling_type == PoolingType.AVG:
+                y = y / k
+        return y.astype(x.dtype), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Upsampling2D(Layer):
+    size: Any = (2, 2)
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        sh, sw = _pair(self.size)
+        return {}, {}, (None if h is None else h * sh, None if w is None else w * sw, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        sh, sw = _pair(self.size)
+        y = jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+        return y, state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Upsampling1D(Layer):
+    size: int = 2
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        return {}, {}, (None if t is None else t * self.size, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return jnp.repeat(x, self.size, axis=1), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Upsampling3D(Layer):
+    size: Any = (2, 2, 2)
+
+    def init(self, key, input_shape):
+        d, h, w, c = input_shape
+        sd, sh, sw = _triple(self.size)
+        return {}, {}, (d * sd, h * sh, w * sw, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        sd, sh, sw = _triple(self.size)
+        y = jnp.repeat(jnp.repeat(jnp.repeat(x, sd, 1), sh, 2), sw, 3)
+        return y, state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class ZeroPaddingLayer(Layer):
+    padding: Any = (1, 1)  # (ph, pw) or ((pt,pb),(pl,pr))
+
+    def _pads(self):
+        p = self.padding
+        if isinstance(p, int):
+            return (p, p), (p, p)
+        if isinstance(p[0], (tuple, list)):
+            return tuple(p[0]), tuple(p[1])
+        return (p[0], p[0]), (p[1], p[1])
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        (pt, pb), (pl, pr) = self._pads()
+        return {}, {}, (h + pt + pb, w + pl + pr, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        (pt, pb), (pl, pr) = self._pads()
+        return jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0))), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Cropping2D(Layer):
+    cropping: Any = (1, 1)
+
+    def _crops(self):
+        c = self.cropping
+        if isinstance(c, int):
+            return (c, c), (c, c)
+        if isinstance(c[0], (tuple, list)):
+            return tuple(c[0]), tuple(c[1])
+        return (c[0], c[0]), (c[1], c[1])
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        (ct, cb), (cl, cr) = self._crops()
+        return {}, {}, (h - ct - cb, w - cl - cr, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        (ct, cb), (cl, cr) = self._crops()
+        return x[:, ct:x.shape[1] - cb, cl:x.shape[2] - cr, :], state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class SpaceToDepthLayer(Layer):
+    block_size: int = 2
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        b = self.block_size
+        return {}, {}, (h // b, w // b, c * b * b)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        n, h, w, c = x.shape
+        b = self.block_size
+        y = x.reshape(n, h // b, b, w // b, b, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b, c * b * b)
+        return y, state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class DepthToSpaceLayer(Layer):
+    block_size: int = 2
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        b = self.block_size
+        return {}, {}, (h * b, w * b, c // (b * b))
+
+    def apply(self, params, state, x, ctx: Ctx):
+        n, h, w, c = x.shape
+        b = self.block_size
+        y = x.reshape(n, h, w, b, b, c // (b * b))
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, h * b, w * b, c // (b * b))
+        return y, state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial/time dims (GlobalPoolingLayer).
+
+    Supports masked mean/max for RNN inputs (B,T,C) with mask (B,T).
+    """
+
+    pooling_type: str = PoolingType.AVG
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def init(self, key, input_shape):
+        return {}, {}, (input_shape[-1],)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        axes = tuple(range(1, x.ndim - 1))
+        mask = ctx.mask
+        if mask is not None and x.ndim == 3:
+            m = mask[..., None].astype(x.dtype)
+            if self.pooling_type == PoolingType.MAX:
+                y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+            elif self.pooling_type == PoolingType.SUM:
+                y = jnp.sum(x * m, axis=1)
+            elif self.pooling_type == PoolingType.PNORM:
+                p = float(self.pnorm)
+                y = jnp.sum((jnp.abs(x) * m) ** p, axis=1) ** (1.0 / p)
+            else:
+                y = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            return y, state
+        if self.pooling_type == PoolingType.MAX:
+            y = jnp.max(x, axis=axes)
+        elif self.pooling_type == PoolingType.SUM:
+            y = jnp.sum(x, axis=axes)
+        elif self.pooling_type == PoolingType.PNORM:
+            p = float(self.pnorm)
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            y = jnp.mean(x, axis=axes)
+        return y, state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class LocallyConnected2D(Layer):
+    """Per-position filters (no weight sharing). Implemented as patch
+    extraction + per-position einsum — MXU-friendly batched matmul."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    activation: Any = "identity"
+    has_bias: bool = True
+
+    def init(self, key, input_shape):
+        h, w, c = input_shape
+        c = self.n_in or c
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        kshape = (oh, ow, kh * kw * c, self.n_out)
+        params = {"W": self._make_weight(key, kshape, kh * kw * c, self.n_out)}
+        if self.has_bias:
+            params["b"] = self._make_bias((oh, ow, self.n_out))
+        return params, {}, (oh, ow, self.n_out)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        kh, kw = _pair(self.kernel_size)
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), _pair(self.stride), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = jnp.einsum("nhwp,hwpo->nhwo", patches, params["W"].astype(x.dtype))
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state
+
+
+@dataclass
+class LocallyConnected1D(Layer):
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    activation: Any = "identity"
+    has_bias: bool = True
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        c = self.n_in or c
+        k = self.kernel_size
+        ot = (t - k) // self.stride + 1
+        params = {"W": self._make_weight(key, (ot, k * c, self.n_out), k * c, self.n_out)}
+        if self.has_bias:
+            params["b"] = self._make_bias((ot, self.n_out))
+        return params, {}, (ot, self.n_out)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        k = self.kernel_size
+        patches = lax.conv_general_dilated_patches(
+            x, (k,), (self.stride,), "VALID", dimension_numbers=("NTC", "TIO", "NTC"))
+        y = jnp.einsum("ntp,tpo->nto", patches, params["W"].astype(x.dtype))
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state

@@ -1,0 +1,355 @@
+"""Core feed-forward layers — DenseLayer, OutputLayer, Embedding, Dropout, etc.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.{DenseLayer,
+OutputLayer, RnnOutputLayer, LossLayer, EmbeddingLayer,
+EmbeddingSequenceLayer, DropoutLayer, ActivationLayer,
+ElementWiseMultiplicationLayer, PReLULayer, CenterLossOutputLayer}``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import activations as _act
+from .. import losses as _losses
+from .base import Ctx, Layer, apply_time_mask
+
+
+@dataclass
+class DenseLayer(Layer):
+    """Fully connected: y = act(x @ W + b). W: (nIn, nOut) like the reference."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    activation: Any = "identity"
+    has_bias: bool = True
+
+    def init(self, key, input_shape):
+        n_in = self.n_in or input_shape[-1]
+        params = {"W": self._make_weight(key, (n_in, self.n_out), n_in, self.n_out)}
+        if self.has_bias:
+            params["b"] = self._make_bias((self.n_out,))
+        return params, {}, input_shape[:-1] + (self.n_out,)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        w = params["W"].astype(x.dtype)
+        y = x @ w
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state
+
+
+@dataclass
+class ActivationLayer(Layer):
+    activation: Any = "relu"
+
+    def init(self, key, input_shape):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return self.activation_fn()(x), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class DropoutLayer(Layer):
+    """Inverted dropout; `rate` is KEEP probability complement?  No —
+
+    DL4J convention: `dropOut(0.5)` RETAINS with p=0.5. Here `rate` is the
+    DROP probability (modern convention); `retain_prob` accepted for parity.
+    """
+
+    rate: float = 0.5
+
+    @classmethod
+    def from_retain(cls, retain_prob):
+        return cls(rate=1.0 - retain_prob)
+
+    def init(self, key, input_shape):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        if not ctx.train or self.rate <= 0.0:
+            return x, state
+        k = ctx.split_rng()
+        keep = 1.0 - self.rate
+        m = jax.random.bernoulli(k, keep, x.shape)
+        return jnp.where(m, x / keep, 0.0).astype(x.dtype), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class GaussianDropout(Layer):
+    rate: float = 0.5
+
+    def init(self, key, input_shape):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        if not ctx.train or self.rate <= 0.0:
+            return x, state
+        k = ctx.split_rng()
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + std * jax.random.normal(k, x.shape, x.dtype)
+        return x * noise, state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class GaussianNoise(Layer):
+    stddev: float = 0.1
+
+    def init(self, key, input_shape):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        if not ctx.train:
+            return x, state
+        k = ctx.split_rng()
+        return x + self.stddev * jax.random.normal(k, x.shape, x.dtype), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class AlphaDropout(Layer):
+    """SELU-compatible dropout (keeps self-normalizing property)."""
+
+    rate: float = 0.1
+
+    def init(self, key, input_shape):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        if not ctx.train or self.rate <= 0.0:
+            return x, state
+        alpha_p = -1.7580993408473766
+        keep = 1.0 - self.rate
+        k = ctx.split_rng()
+        m = jax.random.bernoulli(k, keep, x.shape)
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        return (a * jnp.where(m, x, alpha_p) + b).astype(x.dtype), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class SpatialDropout(Layer):
+    """Drops whole channels (B,...,C). DL4J SpatialDropout."""
+
+    rate: float = 0.5
+
+    def init(self, key, input_shape):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        if not ctx.train or self.rate <= 0.0:
+            return x, state
+        k = ctx.split_rng()
+        keep = 1.0 - self.rate
+        shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        m = jax.random.bernoulli(k, keep, shape)
+        return jnp.where(m, x / keep, 0.0).astype(x.dtype), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class EmbeddingLayer(Layer):
+    """Index → vector. Input (B,) int ids; output (B, nOut)."""
+
+    n_in: Optional[int] = None   # vocab size
+    n_out: int = 0
+    has_bias: bool = False
+    activation: Any = "identity"
+
+    def init(self, key, input_shape):
+        params = {"W": self._make_weight(key, (self.n_in, self.n_out), self.n_in, self.n_out)}
+        if self.has_bias:
+            params["b"] = self._make_bias((self.n_out,))
+        return params, {}, (self.n_out,)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        ids = x.astype(jnp.int32)
+        if ids.ndim > 1 and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        y = jnp.take(params["W"], ids, axis=0)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+
+@dataclass
+class EmbeddingSequenceLayer(EmbeddingLayer):
+    """Sequence of ids (B, T) → (B, T, nOut) [NTC]."""
+
+    def init(self, key, input_shape):
+        params, state, _ = super().init(key, input_shape)
+        t = input_shape[0] if input_shape else None
+        return params, state, (t, self.n_out)
+
+
+@dataclass
+class ElementWiseMultiplicationLayer(Layer):
+    """y = act(x * w + b), elementwise learned scaling (nIn == nOut)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    activation: Any = "identity"
+
+    def init(self, key, input_shape):
+        n = self.n_out or self.n_in or input_shape[-1]
+        return ({"W": jnp.ones((n,), self.dtype), "b": self._make_bias((n,))},
+                {}, input_shape[:-1] + (n,))
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return self.activation_fn()(x * params["W"] + params["b"]), state
+
+
+@dataclass
+class PReLULayer(Layer):
+    """Parametric ReLU with learned per-feature alpha."""
+
+    alpha_init: float = 0.0
+    shared_axes: tuple = ()
+
+    def init(self, key, input_shape):
+        shape = tuple(1 if (i in self.shared_axes) else s
+                      for i, s in enumerate(input_shape))
+        return {"alpha": jnp.full(shape, self.alpha_init, self.dtype)}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        a = params["alpha"]
+        return jnp.where(x >= 0, x, a * x), state
+
+
+@dataclass
+class LossLayer(Layer):
+    """No params: applies activation + computes loss vs labels (LossLayer)."""
+
+    activation: Any = "identity"
+    loss: Any = "mse"
+
+    def init(self, key, input_shape):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return self.activation_fn()(x), state
+
+    def compute_loss(self, pre_activation, labels, mask=None):
+        lf = str(self.loss).lower() if not callable(self.loss) else None
+        if lf in _losses.LOGITS_VARIANTS and str(self.activation).lower() in ("softmax", "sigmoid"):
+            return _losses.LOGITS_VARIANTS[lf](labels, pre_activation, mask=mask)
+        fn = _losses.get(self.loss)
+        return fn(labels, self.activation_fn()(pre_activation), mask=mask)
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (org.deeplearning4j.nn.conf.layers.OutputLayer).
+
+    `apply` returns activated predictions; the training path calls
+    `pre_activation` + `compute_loss` so softmax/sigmoid losses fuse with
+    logits for numerical stability (replaces the reference's
+    LossMCXENT+softmax special-casing).
+    """
+
+    loss: Any = "mcxent"
+    activation: Any = "softmax"
+
+    def pre_activation(self, params, x):
+        y = x @ params["W"].astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+    def compute_loss(self, params, x, labels, mask=None):
+        logits = self.pre_activation(params, x)
+        lf = str(self.loss).lower() if not callable(self.loss) else None
+        if lf in _losses.LOGITS_VARIANTS and str(self.activation).lower() in ("softmax", "sigmoid"):
+            return _losses.LOGITS_VARIANTS[lf](labels, logits, mask=mask)
+        fn = _losses.get(self.loss)
+        return fn(labels, self.activation_fn()(logits), mask=mask)
+
+
+@dataclass
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep output head: (B,T,nIn) → (B,T,nOut), time-distributed.
+
+    Masking: label_mask (B,T) zeroes padded steps in the loss (reference:
+    RnnOutputLayer + LossFunction masking).
+    """
+
+    def init(self, key, input_shape):
+        params, state, _ = super().init(key, input_shape)
+        t = input_shape[0] if len(input_shape) == 2 else None
+        return params, state, (t, self.n_out)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        y, state = DenseLayer.apply(self, params, state, x, ctx)
+        return apply_time_mask(y, ctx.mask), state
+
+    def compute_loss(self, params, x, labels, mask=None):
+        logits = self.pre_activation(params, x)  # (B,T,C)
+        lf = str(self.loss).lower() if not callable(self.loss) else None
+        if lf in _losses.LOGITS_VARIANTS and str(self.activation).lower() in ("softmax", "sigmoid"):
+            b, t = logits.shape[0], logits.shape[1]
+            flat_mask = mask.reshape(b * t) if mask is not None else None
+            return _losses.LOGITS_VARIANTS[lf](
+                labels.reshape(b * t, -1) if labels.ndim == 3 else labels.reshape(b * t),
+                logits.reshape(b * t, -1), mask=flat_mask)
+        fn = _losses.get(self.loss)
+        return fn(labels, self.activation_fn()(logits), mask=mask)
+
+
+@dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss (intra-class compactness). Keeps per-class
+    centers in `state`, updated with EMA like the reference's alpha."""
+
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init(self, key, input_shape):
+        params, state, out = super().init(key, input_shape)
+        n_in = self.n_in or input_shape[-1]
+        state = dict(state)
+        state["centers"] = jnp.zeros((self.n_out, n_in), self.dtype)
+        return params, state, out
+
+    def compute_loss(self, params, x, labels, mask=None, state=None):
+        base = super().compute_loss(params, x, labels, mask)
+        if state is None:
+            return base
+        cls = jnp.argmax(labels, axis=-1)
+        centers = state["centers"]
+        diff = x - centers[cls]
+        center_loss = 0.5 * jnp.mean(jnp.sum(jnp.square(diff), axis=-1))
+        return base + self.lambda_ * center_loss
+
+    def update_state(self, state, x, labels):
+        cls = jnp.argmax(labels, axis=-1)
+        centers = state["centers"]
+        diff = centers[cls] - x
+        counts = jnp.zeros((self.n_out,), x.dtype).at[cls].add(1.0)
+        delta = jnp.zeros_like(centers).at[cls].add(diff)
+        delta = delta / (1.0 + counts)[:, None]
+        return {**state, "centers": centers - self.alpha * delta}

@@ -1,0 +1,132 @@
+"""Normalization layers — BatchNorm, LayerNorm, RMSNorm, LRN.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.BatchNormalization``
+(cuDNN BatchNormalizationHelper path → fused XLA here),
+``LocalResponseNormalization``. LayerNorm/RMSNorm are the reference's
+SameDiff ops surfaced as layers (transformer path).
+
+BatchNorm keeps running mean/var in layer `state` (the functional analogue of
+the reference's mutable global stats arrays) — threaded through train steps
+and used verbatim at inference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Ctx, Layer
+
+
+@dataclass
+class BatchNormalization(Layer):
+    """Normalizes the trailing (channel) axis — works for FF (B,C) and
+    conv NHWC (B,H,W,C) inputs alike."""
+
+    n_out: Optional[int] = None  # channels; inferred
+    decay: float = 0.9           # DL4J's `decay` for running stats EMA
+    eps: float = 1e-5
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+    lock_gamma_beta: bool = False
+    use_log_std: bool = False
+
+    def init(self, key, input_shape):
+        c = self.n_out or input_shape[-1]
+        params = {}
+        if not self.lock_gamma_beta:
+            params = {"gamma": jnp.full((c,), self.gamma_init, self.dtype),
+                      "beta": jnp.full((c,), self.beta_init, self.dtype)}
+        state = {"mean": jnp.zeros((c,), jnp.float32),
+                 "var": jnp.ones((c,), jnp.float32)}
+        return params, state, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        axes = tuple(range(x.ndim - 1))
+        if ctx.train:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x.astype(jnp.float32) - mean) * inv
+        if not self.lock_gamma_beta:
+            y = y * params["gamma"].astype(jnp.float32) + params["beta"].astype(jnp.float32)
+        return y.astype(x.dtype), new_state
+
+
+@dataclass
+class LayerNormalization(Layer):
+    """LayerNorm over the channel axis (SameDiff standardize + gain/bias)."""
+
+    eps: float = 1e-5
+    use_bias: bool = True
+
+    def init(self, key, input_shape):
+        c = input_shape[-1]
+        params = {"gamma": jnp.ones((c,), self.dtype)}
+        if self.use_bias:
+            params["beta"] = jnp.zeros((c,), self.dtype)
+        return params, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.eps)
+        y = y * params["gamma"].astype(jnp.float32)
+        if self.use_bias:
+            y = y + params["beta"].astype(jnp.float32)
+        return y.astype(x.dtype), state
+
+
+@dataclass
+class RMSNorm(Layer):
+    """RMS normalization (no mean subtraction) — transformer staple."""
+
+    eps: float = 1e-6
+
+    def init(self, key, input_shape):
+        return {"gamma": jnp.ones((input_shape[-1],), self.dtype)}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf * lax.rsqrt(ms + self.eps) * params["gamma"].astype(jnp.float32)
+        return y.astype(x.dtype), state
+
+
+@dataclass
+class LocalResponseNormalization(Layer):
+    """LRN across channels (AlexNet-era). NHWC; pure elementwise+window — XLA fuses."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def init(self, key, input_shape):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        xf = x.astype(jnp.float32)
+        sq = jnp.square(xf)
+        half = self.n // 2
+        # sum over a window of channels via padded cumulative trick
+        pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        win = sum(lax.slice_in_dim(pad, i, i + x.shape[-1], axis=x.ndim - 1)
+                  for i in range(self.n))
+        y = xf / jnp.power(self.k + self.alpha * win, self.beta)
+        return y.astype(x.dtype), state
+
+    def has_params(self):
+        return False

@@ -1,0 +1,329 @@
+"""Recurrent layers — SimpleRnn, LSTM, GravesLSTM (peepholes), GRU,
+Bidirectional, LastTimeStep.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.{LSTM, GravesLSTM,
+GravesBidirectionalLSTM, SimpleRnn, recurrent.Bidirectional,
+recurrent.LastTimeStep}``. The reference runs these through cuDNN RNN
+helpers; the TPU-native design is a single ``lax.scan`` over time with the
+input projection hoisted OUT of the scan — one big (B*T, 4H) matmul on the
+MXU up front, then only the small recurrent matmul inside the loop. Layout
+is NTC (batch, time, channels); the reference's NCW is converted at the
+data layer.
+
+Masking: `ctx.mask` (B, T) freezes hidden state on padded steps, matching
+the reference's masked RNN semantics (output at padded steps is zeroed by
+downstream mask application).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Ctx, Layer, apply_time_mask
+
+
+def _split_key(key, n):
+    return jax.random.split(key, n)
+
+
+@dataclass
+class BaseRecurrent(Layer):
+    n_in: Optional[int] = None
+    n_out: int = 0
+    activation: Any = "tanh"
+
+    def _gates(self):
+        raise NotImplementedError
+
+
+@dataclass
+class SimpleRnn(BaseRecurrent):
+    """h_t = act(x_t W + h_{t-1} R + b)."""
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        c = self.n_in or c
+        k1, k2 = _split_key(key, 2)
+        params = {
+            "W": self._make_weight(k1, (c, self.n_out), c, self.n_out),
+            "RW": self._make_weight(k2, (self.n_out, self.n_out), self.n_out, self.n_out),
+            "b": self._make_bias((self.n_out,)),
+        }
+        return params, {}, (t, self.n_out)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        act = self.activation_fn()
+        w, rw, b = (params[k].astype(x.dtype) for k in ("W", "RW", "b"))
+        xw = x @ w + b  # (B,T,H) — hoisted MXU matmul
+        mask = ctx.mask
+        h0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
+
+        def step(h, inp):
+            xt, mt = inp
+            h_new = act(xt + h @ rw)
+            if mt is not None:
+                h_new = jnp.where(mt[:, None] > 0, h_new, h)
+            return h_new, h_new
+
+        ms = mask.swapaxes(0, 1) if mask is not None else None
+        xs = xw.swapaxes(0, 1)  # (T,B,H)
+        if ms is None:
+            _, hs = lax.scan(lambda h, xt: step(h, (xt, None)), h0, xs)
+        else:
+            _, hs = lax.scan(step, h0, (xs, ms))
+        y = hs.swapaxes(0, 1)
+        return apply_time_mask(y, mask), state
+
+
+@dataclass
+class LSTM(BaseRecurrent):
+    """Standard LSTM (no peepholes) — gate order [i, f, o, g] like the reference.
+
+    forget_gate_bias: DL4J initializes forget bias to 1.0 by default.
+    """
+
+    forget_gate_bias: float = 1.0
+    gate_activation: Any = "sigmoid"
+
+    def _has_peepholes(self):
+        return False
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        c = self.n_in or c
+        k1, k2, k3 = _split_key(key, 3)
+        h = self.n_out
+        b = jnp.zeros((4 * h,), self.dtype)
+        b = b.at[h:2 * h].set(self.forget_gate_bias)
+        params = {
+            "W": self._make_weight(k1, (c, 4 * h), c, h),
+            "RW": self._make_weight(k2, (h, 4 * h), h, h),
+            "b": b,
+        }
+        if self._has_peepholes():
+            params["pI"] = jnp.zeros((h,), self.dtype)
+            params["pF"] = jnp.zeros((h,), self.dtype)
+            params["pO"] = jnp.zeros((h,), self.dtype)
+        return params, {}, (t, h)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        h = self.n_out
+        act = self.activation_fn()
+        from .. import activations as _a
+        gate_act = _a.get(self.gate_activation)
+        w, rw, b = (params[k].astype(x.dtype) for k in ("W", "RW", "b"))
+        peep = self._has_peepholes()
+        if peep:
+            pi, pf, po = (params[k].astype(x.dtype) for k in ("pI", "pF", "pO"))
+        xw = x @ w + b  # hoisted (B,T,4H) MXU matmul
+        mask = ctx.mask
+        b0 = x.shape[0]
+        carry0 = (jnp.zeros((b0, h), x.dtype), jnp.zeros((b0, h), x.dtype))
+
+        def step(carry, inp):
+            h_prev, c_prev = carry
+            xt, mt = inp
+            z = xt + h_prev @ rw
+            zi, zf, zo, zg = z[:, :h], z[:, h:2 * h], z[:, 2 * h:3 * h], z[:, 3 * h:]
+            if peep:
+                zi = zi + c_prev * pi
+                zf = zf + c_prev * pf
+            i = gate_act(zi)
+            f = gate_act(zf)
+            g = act(zg)
+            c_new = f * c_prev + i * g
+            if peep:
+                zo = zo + c_new * po
+            o = gate_act(zo)
+            h_new = o * act(c_new)
+            if mt is not None:
+                keep = mt[:, None] > 0
+                h_new = jnp.where(keep, h_new, h_prev)
+                c_new = jnp.where(keep, c_new, c_prev)
+            return (h_new, c_new), h_new
+
+        xs = xw.swapaxes(0, 1)
+        if mask is None:
+            _, hs = lax.scan(lambda cr, xt: step(cr, (xt, None)), carry0, xs)
+        else:
+            _, hs = lax.scan(step, carry0, (xs, mask.swapaxes(0, 1)))
+        y = hs.swapaxes(0, 1)
+        return apply_time_mask(y, mask), state
+
+
+@dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (Graves 2013) — the reference's
+    GravesLSTM. Same scan, plus diagonal cell→gate weights."""
+
+    def _has_peepholes(self):
+        return True
+
+
+@dataclass
+class GRU(BaseRecurrent):
+    """GRU — gate order [r, z, n]."""
+
+    gate_activation: Any = "sigmoid"
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        c = self.n_in or c
+        k1, k2 = _split_key(key, 2)
+        h = self.n_out
+        params = {
+            "W": self._make_weight(k1, (c, 3 * h), c, h),
+            "RW": self._make_weight(k2, (h, 3 * h), h, h),
+            "b": jnp.zeros((3 * h,), self.dtype),
+        }
+        return params, {}, (t, h)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        h = self.n_out
+        act = self.activation_fn()
+        from .. import activations as _a
+        gate_act = _a.get(self.gate_activation)
+        w, rw, b = (params[k].astype(x.dtype) for k in ("W", "RW", "b"))
+        xw = x @ w + b
+        mask = ctx.mask
+        h0 = jnp.zeros((x.shape[0], h), x.dtype)
+
+        def step(h_prev, inp):
+            xt, mt = inp
+            hr = h_prev @ rw
+            r = gate_act(xt[:, :h] + hr[:, :h])
+            z = gate_act(xt[:, h:2 * h] + hr[:, h:2 * h])
+            n = act(xt[:, 2 * h:] + r * hr[:, 2 * h:])
+            h_new = (1 - z) * n + z * h_prev
+            if mt is not None:
+                h_new = jnp.where(mt[:, None] > 0, h_new, h_prev)
+            return h_new, h_new
+
+        xs = xw.swapaxes(0, 1)
+        if mask is None:
+            _, hs = lax.scan(lambda hh, xt: step(hh, (xt, None)), h0, xs)
+        else:
+            _, hs = lax.scan(step, h0, (xs, mask.swapaxes(0, 1)))
+        y = hs.swapaxes(0, 1)
+        return apply_time_mask(y, mask), state
+
+
+class BidirectionalMode:
+    CONCAT = "concat"
+    ADD = "add"
+    MUL = "mul"
+    AVERAGE = "average"
+
+
+@dataclass
+class Bidirectional(Layer):
+    """Wraps any recurrent layer; runs forward + time-reversed copies.
+
+    Reference: ``recurrent.Bidirectional(Mode, layer)``. Mask-aware reversal
+    flips only the valid prefix of each sequence.
+    """
+
+    fwd: Any = None
+    mode: str = BidirectionalMode.CONCAT
+
+    def __init__(self, fwd=None, mode=BidirectionalMode.CONCAT, **kw):
+        super().__init__(**kw)
+        self.fwd = fwd
+        self.mode = mode
+
+    def init(self, key, input_shape):
+        k1, k2 = _split_key(key, 2)
+        pf, sf, out = self.fwd.init(k1, input_shape)
+        pb, sb, _ = self.fwd.init(k2, input_shape)
+        t, h = out
+        if self.mode == BidirectionalMode.CONCAT:
+            out = (t, 2 * h)
+        return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}, out
+
+    def _reverse(self, x, mask):
+        if mask is None:
+            return jnp.flip(x, axis=1)
+        # flip valid prefix: index t -> (len-1-t) for t < len
+        lengths = jnp.sum(mask > 0, axis=1).astype(jnp.int32)  # (B,)
+        t_idx = jnp.arange(x.shape[1])
+        rev_idx = jnp.clip(lengths[:, None] - 1 - t_idx[None, :], 0, x.shape[1] - 1)
+        return jnp.take_along_axis(x, rev_idx[:, :, None], axis=1)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        yf, sf = self.fwd.apply(params["fwd"], state["fwd"], x, ctx)
+        xr = self._reverse(x, ctx.mask)
+        yb, sb = self.fwd.apply(params["bwd"], state["bwd"], xr, ctx)
+        yb = self._reverse(yb, ctx.mask)
+        if self.mode == BidirectionalMode.CONCAT:
+            y = jnp.concatenate([yf, yb], axis=-1)
+        elif self.mode == BidirectionalMode.ADD:
+            y = yf + yb
+        elif self.mode == BidirectionalMode.MUL:
+            y = yf * yb
+        else:
+            y = 0.5 * (yf + yb)
+        return y, {"fwd": sf, "bwd": sb}
+
+
+@dataclass
+class GravesBidirectionalLSTM(Bidirectional):
+    """Convenience parity alias: Bidirectional(CONCAT, GravesLSTM)."""
+
+    def __init__(self, n_in=None, n_out=0, activation="tanh", **kw):
+        super().__init__(fwd=GravesLSTM(n_in=n_in, n_out=n_out, activation=activation),
+                         mode=BidirectionalMode.CONCAT, **kw)
+
+
+@dataclass
+class LastTimeStep(Layer):
+    """Wraps a recurrent layer, returning only the last (unmasked) step."""
+
+    inner: Any = None
+
+    def __init__(self, inner=None, **kw):
+        super().__init__(**kw)
+        self.inner = inner
+
+    def init(self, key, input_shape):
+        p, s, out = self.inner.init(key, input_shape)
+        return p, s, (out[-1],)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        y, s = self.inner.apply(params, state, x, ctx)
+        if ctx.mask is not None:
+            lengths = jnp.sum(ctx.mask > 0, axis=1).astype(jnp.int32)
+            idx = jnp.clip(lengths - 1, 0, y.shape[1] - 1)
+            out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0]
+        else:
+            out = y[:, -1]
+        return out, s
+
+
+@dataclass
+class TimeDistributed(Layer):
+    """Applies a feed-forward layer independently at each timestep."""
+
+    inner: Any = None
+
+    def __init__(self, inner=None, **kw):
+        super().__init__(**kw)
+        self.inner = inner
+
+    def init(self, key, input_shape):
+        t = input_shape[0]
+        p, s, out = self.inner.init(key, input_shape[1:])
+        return p, s, (t,) + out
+
+    def apply(self, params, state, x, ctx: Ctx):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        y, s = self.inner.apply(params, state, flat, ctx)
+        return y.reshape((b, t) + y.shape[1:]), s

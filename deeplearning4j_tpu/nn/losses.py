@@ -1,0 +1,267 @@
+"""Loss functions — parity with ``org.nd4j.linalg.lossfunctions.LossFunctions``.
+
+Every loss is `fn(labels, preds, weights=None, mask=None) -> scalar` plus a
+`per_example` variant returning (batch,) scores (used by masking, per-output
+weighting, and `MultiLayerNetwork.scoreExamples`). `preds` are the layer's
+*activated* outputs (DL4J convention) except the `*_with_logits` variants.
+
+DL4J reduction convention: score = sum over output units, mean over (unmasked)
+examples — matched here so numbers line up with the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _weighted(per_unit, weights):
+    if weights is not None:
+        per_unit = per_unit * weights
+    return per_unit
+
+
+def _reduce(per_unit, mask):
+    """Sum over trailing dims → per-example score. Masking of individual
+    units/timesteps already happened in _apply_mask; _mean handles the
+    example-level weighting."""
+    return per_unit.reshape(per_unit.shape[0], -1).sum(axis=1)
+
+
+def _mean(per_ex, mask):
+    if mask is None:
+        return per_ex.mean()
+    m = mask.reshape(mask.shape[0], -1).max(axis=1)  # example present at all?
+    return (per_ex * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def _apply_mask(per_unit, mask):
+    """Mask shape (B,) / (B,T) / full — broadcast against per-unit scores."""
+    if mask is None:
+        return per_unit
+    m = mask
+    while m.ndim < per_unit.ndim:
+        m = m[..., None]
+    return per_unit * m
+
+
+# --- classification --------------------------------------------------------
+
+def mcxent_per_unit(labels, preds, weights=None, mask=None):
+    p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+    per_unit = -labels * jnp.log(p)
+    return _apply_mask(_weighted(per_unit, weights), mask)
+
+
+def mcxent(labels, preds, weights=None, mask=None):
+    """Multi-class cross entropy vs softmax output (LossMCXENT)."""
+    per_unit = mcxent_per_unit(labels, preds, weights, mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+negative_log_likelihood = mcxent  # DL4J NEGATIVELOGLIKELIHOOD == MCXENT vs softmax
+
+
+def sparse_mcxent(labels, preds, weights=None, mask=None):
+    """Labels are int class ids (SparseMCXENT)."""
+    p = jnp.clip(jnp.take_along_axis(preds, labels[..., None].astype(jnp.int32), -1), _EPS, 1.0)
+    per_unit = -jnp.log(p)[..., 0]
+    if weights is not None:
+        per_unit = per_unit * jnp.take(weights, labels)
+    per_unit = _apply_mask(per_unit, mask)
+    if per_unit.ndim == 1:
+        per_ex = per_unit
+    else:
+        per_ex = per_unit.reshape(per_unit.shape[0], -1).sum(axis=1)
+    return _mean(per_ex, mask)
+
+
+def softmax_cross_entropy_with_logits(labels, logits, weights=None, mask=None):
+    """Numerically-stable fused path (what our OutputLayer actually uses)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    per_unit = _apply_mask(_weighted(-labels * logp, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def sparse_softmax_cross_entropy_with_logits(labels, logits, weights=None, mask=None):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    per_unit = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), -1)[..., 0]
+    per_unit = _apply_mask(per_unit, mask)
+    per_ex = per_unit if per_unit.ndim == 1 else per_unit.reshape(per_unit.shape[0], -1).sum(axis=1)
+    return _mean(per_ex, mask)
+
+
+def binary_xent(labels, preds, weights=None, mask=None):
+    """LossBinaryXENT vs sigmoid output."""
+    p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+    per_unit = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+    per_unit = _apply_mask(_weighted(per_unit, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def sigmoid_cross_entropy_with_logits(labels, logits, weights=None, mask=None):
+    z = jax.nn.relu(logits) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    per_unit = _apply_mask(_weighted(z, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def hinge(labels, preds, weights=None, mask=None):
+    """Labels in {-1,1} (LossHinge)."""
+    per_unit = jax.nn.relu(1.0 - labels * preds)
+    per_unit = _apply_mask(_weighted(per_unit, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def squared_hinge(labels, preds, weights=None, mask=None):
+    per_unit = jnp.square(jax.nn.relu(1.0 - labels * preds))
+    per_unit = _apply_mask(_weighted(per_unit, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def fmeasure(labels, preds, beta=1.0, weights=None, mask=None):
+    """LossFMeasure — differentiable soft-F_beta (binary). Returns 1 - F."""
+    preds = _apply_mask(preds, mask)
+    labels = _apply_mask(labels, mask)
+    tp = jnp.sum(labels * preds)
+    fp = jnp.sum((1.0 - labels) * preds)
+    fn = jnp.sum(labels * (1.0 - preds))
+    b2 = beta * beta
+    f = (1.0 + b2) * tp / jnp.maximum((1.0 + b2) * tp + b2 * fn + fp, _EPS)
+    return 1.0 - f
+
+
+# --- regression ------------------------------------------------------------
+
+def mse(labels, preds, weights=None, mask=None):
+    per_unit = _apply_mask(_weighted(jnp.square(preds - labels), weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+l2 = mse  # DL4J LossL2 = sum of squares (no mean over units); score matches via _reduce
+
+
+def rmse(labels, preds, weights=None, mask=None):
+    return jnp.sqrt(mse(labels, preds, weights, mask))
+
+
+def mae(labels, preds, weights=None, mask=None):
+    per_unit = _apply_mask(_weighted(jnp.abs(preds - labels), weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+l1 = mae
+
+
+def msle(labels, preds, weights=None, mask=None):
+    per_unit = jnp.square(jnp.log1p(jnp.maximum(preds, -1 + _EPS)) - jnp.log1p(jnp.maximum(labels, -1 + _EPS)))
+    per_unit = _apply_mask(_weighted(per_unit, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def mape(labels, preds, weights=None, mask=None):
+    per_unit = 100.0 * jnp.abs((preds - labels) / jnp.clip(jnp.abs(labels), _EPS, None))
+    per_unit = _apply_mask(_weighted(per_unit, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def kl_divergence(labels, preds, weights=None, mask=None):
+    p = jnp.clip(labels, _EPS, 1.0)
+    q = jnp.clip(preds, _EPS, 1.0)
+    per_unit = _apply_mask(_weighted(p * (jnp.log(p) - jnp.log(q)), weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def poisson(labels, preds, weights=None, mask=None):
+    per_unit = preds - labels * jnp.log(jnp.clip(preds, _EPS, None))
+    per_unit = _apply_mask(_weighted(per_unit, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def cosine_proximity(labels, preds, weights=None, mask=None):
+    ln = labels / jnp.maximum(jnp.linalg.norm(labels, axis=-1, keepdims=True), _EPS)
+    pn = preds / jnp.maximum(jnp.linalg.norm(preds, axis=-1, keepdims=True), _EPS)
+    per_unit = _apply_mask(_weighted(-ln * pn, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def wasserstein(labels, preds, weights=None, mask=None):
+    """LossWasserstein: mean(labels * preds) — critic loss for WGAN."""
+    per_unit = _apply_mask(_weighted(labels * preds, weights), mask)
+    return _mean(_reduce(per_unit, mask), mask)
+
+
+def mixture_density(labels, preds, n_mixtures, weights=None, mask=None):
+    """LossMixtureDensity: negative log-likelihood of a GMM head.
+
+    preds packs [alpha_logits(K), mu(K*D), log_sigma(K)] along the last axis.
+    """
+    d = labels.shape[-1]
+    k = n_mixtures
+    alpha = jax.nn.log_softmax(preds[..., :k], axis=-1)
+    mu = preds[..., k:k + k * d].reshape(*preds.shape[:-1], k, d)
+    log_sigma = preds[..., k + k * d:k + k * d + k]
+    y = labels[..., None, :]
+    sq = jnp.sum(jnp.square(y - mu), axis=-1)
+    log_prob = alpha - 0.5 * sq / jnp.exp(2.0 * log_sigma) \
+        - d * (log_sigma + 0.5 * jnp.log(2.0 * jnp.pi))
+    nll = -jax.scipy.special.logsumexp(log_prob, axis=-1)
+    nll = _apply_mask(_weighted(nll, weights), mask)
+    per_ex = nll if nll.ndim == 1 else nll.reshape(nll.shape[0], -1).sum(axis=1)
+    return _mean(per_ex, mask)
+
+
+class Loss:
+    """DL4J-style enum: LossFunctions.LossFunction.* (string-valued)."""
+
+    MCXENT = "mcxent"
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    SPARSE_MCXENT = "sparse_mcxent"
+    XENT = "binary_xent"  # DL4J XENT = binary cross entropy
+    MSE = "mse"
+    SQUARED_LOSS = "mse"
+    L1 = "l1"
+    MAE = "mae"
+    L2 = "l2"
+    RMSE = "rmse"
+    MSLE = "msle"
+    MAPE = "mape"
+    KL_DIVERGENCE = "kl_divergence"
+    RECONSTRUCTION_CROSSENTROPY = "binary_xent"
+    POISSON = "poisson"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    COSINE_PROXIMITY = "cosine_proximity"
+    WASSERSTEIN = "wasserstein"
+    FMEASURE = "fmeasure"
+    MIXTURE_DENSITY = "mixture_density"
+
+
+_REGISTRY = {
+    "mcxent": mcxent, "negativeloglikelihood": negative_log_likelihood,
+    "sparse_mcxent": sparse_mcxent, "binary_xent": binary_xent, "xent": binary_xent,
+    "mse": mse, "l2": l2, "rmse": rmse, "mae": mae, "l1": l1,
+    "msle": msle, "mape": mape, "kl_divergence": kl_divergence,
+    "poisson": poisson, "hinge": hinge, "squared_hinge": squared_hinge,
+    "cosine_proximity": cosine_proximity, "wasserstein": wasserstein,
+    "fmeasure": fmeasure, "mixture_density": mixture_density,
+}
+
+# losses whose stable fused-logits variant exists; OutputLayer uses these
+LOGITS_VARIANTS = {
+    "mcxent": softmax_cross_entropy_with_logits,
+    "negativeloglikelihood": softmax_cross_entropy_with_logits,
+    "sparse_mcxent": sparse_softmax_cross_entropy_with_logits,
+    "binary_xent": sigmoid_cross_entropy_with_logits,
+    "xent": sigmoid_cross_entropy_with_logits,
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown loss '{name_or_fn}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

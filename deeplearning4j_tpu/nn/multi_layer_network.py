@@ -1,0 +1,412 @@
+"""MultiLayerNetwork — the sequential-network API, redesigned for XLA.
+
+Reference parity: ``org.deeplearning4j.nn.multilayer.MultiLayerNetwork``
+(init/fit/output/score/evaluate/params/summary, listeners, masking).
+
+TPU-first redesign: instead of the reference's per-layer activate/
+backpropGradient interpreter loop with workspaces, the WHOLE training
+iteration — forward, loss, backward, updater, parameter update — is one
+jitted pure function with params/opt-state donated (HBM reuse). Gradients
+come from jax.value_and_grad over the composed forward; the updater chain is
+optax. Listeners run on host between steps.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..train.updaters import NoOp, build_optimizer, gradient_normalization
+from .conf import MultiLayerConfiguration
+from .layers.base import Ctx, Layer
+from .layers.core import LossLayer, OutputLayer
+from .preprocessors import CnnToFeedForwardPreProcessor
+
+
+def _is_ff_layer(layer: Layer) -> bool:
+    from .layers.core import (DenseLayer, ElementWiseMultiplicationLayer,
+                              EmbeddingLayer)
+    from .layers.recurrent import LastTimeStep
+    return isinstance(layer, (DenseLayer, ElementWiseMultiplicationLayer)) and \
+        not isinstance(layer, EmbeddingLayer)
+
+
+def _is_rnn_layer(layer: Layer) -> bool:
+    from .layers.attention import (RecurrentAttentionLayer, SelfAttentionLayer)
+    from .layers.core import RnnOutputLayer
+    from .layers.recurrent import BaseRecurrent, Bidirectional
+    return isinstance(layer, (BaseRecurrent, Bidirectional, SelfAttentionLayer,
+                              RecurrentAttentionLayer, RnnOutputLayer))
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers: List[Layer] = conf.layers
+        self._g = conf.globals_
+        self.params: Dict[str, dict] = {}
+        self.states: Dict[str, dict] = {}
+        self._preprocessors: Dict[int, Any] = {}
+        self._optimizer = None
+        self._opt_state = None
+        self._iters_per_epoch = 1
+        self._step_count = 0
+        self.epoch_count = 0
+        self.listeners: List[Any] = []
+        self.initialized = False
+        self._train_step = None
+        self._host_key = jax.random.PRNGKey(self._g.seed)
+
+    # ------------------------------------------------------------------ init
+    def init(self, input_shape=None):
+        """Resolve shapes layer-by-layer, create params (reference: init())."""
+        if input_shape is None:
+            if self.conf.input_type is None:
+                raise ValueError("Provide input_shape or set_input_type on the config")
+            input_shape = tuple(self.conf.input_type[1])
+        key = jax.random.PRNGKey(self._g.seed)
+        shape = tuple(input_shape)
+        for i, layer in enumerate(self.layers):
+            # auto preprocessor: conv/rnn activations into a flat FF layer
+            if _is_ff_layer(layer) and len(shape) == 3:
+                pp = CnnToFeedForwardPreProcessor()
+                self._preprocessors[i] = pp
+                shape = pp.out_shape(shape)
+            if isinstance(layer, OutputLayer) and not _is_rnn_layer(layer) and len(shape) == 3:
+                pp = CnnToFeedForwardPreProcessor()
+                self._preprocessors[i] = pp
+                shape = pp.out_shape(shape)
+            key, sub = jax.random.split(key)
+            p, s, shape = layer.init(sub, shape)
+            self.params[f"layer_{i}"] = p
+            self.states[f"layer_{i}"] = s
+        self.output_shape = shape
+        self.initialized = True
+        return self
+
+    # -------------------------------------------------------------- forward
+    def _forward(self, params, states, x, *, train, rng, fmask=None, lmask=None,
+                 stop_before_output=False):
+        """Pure forward. Returns (activation, new_states)."""
+        new_states = {}
+        h = x
+        n = len(self.layers)
+        for i, layer in enumerate(self.layers):
+            is_last = i == n - 1
+            if stop_before_output and is_last and isinstance(layer, (OutputLayer, LossLayer)):
+                new_states[f"layer_{i}"] = states[f"layer_{i}"]
+                break
+            if i in self._preprocessors:
+                h = self._preprocessors[i](h)
+            if rng is not None:
+                lrng = jax.random.fold_in(rng, i)
+            else:
+                lrng = None
+            ctx = Ctx(train=train, rng=lrng, mask=fmask, label_mask=lmask)
+            if train and layer.dropout > 0.0 and lrng is not None:
+                keep = 1.0 - layer.dropout
+                dk = jax.random.fold_in(lrng, 997)
+                m = jax.random.bernoulli(dk, keep, h.shape)
+                h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
+            h, s_new = layer.apply(params[f"layer_{i}"], states[f"layer_{i}"], h, ctx)
+            new_states[f"layer_{i}"] = s_new
+        return h, new_states
+
+    def output(self, x, train: bool = False):
+        """Inference forward (reference: output()). Jit-cached."""
+        x = jnp.asarray(x)
+        fn = self._get_infer_fn()
+        return fn(self.params, self.states, x)
+
+    def _get_infer_fn(self):
+        if not hasattr(self, "_infer_fn") or self._infer_fn is None:
+            def infer(params, states, x):
+                y, _ = self._forward(params, states, x, train=False, rng=None)
+                return y
+            self._infer_fn = jax.jit(infer)
+        return self._infer_fn
+
+    def feed_forward(self, x, train: bool = False):
+        """Per-layer activations list (reference: feedForward())."""
+        x = jnp.asarray(x)
+        acts = [x]
+        h = x
+        for i, layer in enumerate(self.layers):
+            if i in self._preprocessors:
+                h = self._preprocessors[i](h)
+            ctx = Ctx(train=train, rng=None)
+            h, _ = layer.apply(self.params[f"layer_{i}"], self.states[f"layer_{i}"], h, ctx)
+            acts.append(h)
+        return acts
+
+    # ----------------------------------------------------------------- loss
+    def _loss(self, params, states, x, y, rng, fmask, lmask):
+        h, new_states = self._forward(params, states, x, train=True, rng=rng,
+                                      fmask=fmask, lmask=lmask, stop_before_output=True)
+        out_layer = self.layers[-1]
+        i = len(self.layers) - 1
+        if isinstance(out_layer, OutputLayer):
+            if i in self._preprocessors:
+                h = self._preprocessors[i](h)
+            from .layers.core import CenterLossOutputLayer
+            if isinstance(out_layer, CenterLossOutputLayer):
+                loss = out_layer.compute_loss(params[f"layer_{i}"], h, y, mask=lmask,
+                                              state=states[f"layer_{i}"])
+                new_states[f"layer_{i}"] = out_layer.update_state(
+                    states[f"layer_{i}"], jax.lax.stop_gradient(h), y)
+            else:
+                loss = out_layer.compute_loss(params[f"layer_{i}"], h, y, mask=lmask)
+        elif isinstance(out_layer, LossLayer):
+            loss = out_layer.compute_loss(h, y, mask=lmask)
+        else:
+            raise ValueError("Last layer must be an OutputLayer or LossLayer for fit()")
+        loss = loss + self._reg_score(params)
+        return loss, new_states
+
+    def _reg_score(self, params):
+        reg = 0.0
+        for i, layer in enumerate(self.layers):
+            if layer.l1 == 0.0 and layer.l2 == 0.0:
+                continue
+            for k, w in params[f"layer_{i}"].items():
+                if k in ("b", "beta", "mean", "var"):
+                    continue
+                if layer.l1:
+                    reg = reg + layer.l1 * jnp.sum(jnp.abs(w))
+                if layer.l2:
+                    reg = reg + 0.5 * layer.l2 * jnp.sum(jnp.square(w))
+        return reg
+
+    # ------------------------------------------------------------ optimizer
+    def _param_labels(self):
+        labels = {}
+        has_override = False
+        for i, layer in enumerate(self.layers):
+            if layer.frozen:
+                lab = "__frozen__"
+                has_override = True
+            elif layer.updater is not None:
+                lab = f"__layer_{i}__"
+                has_override = True
+            else:
+                lab = "__default__"
+            labels[f"layer_{i}"] = jax.tree_util.tree_map(lambda _: lab, self.params[f"layer_{i}"])
+        return (labels if has_override else None)
+
+    def _build_optimizer(self, iters_per_epoch=1):
+        g = self._g
+        labels = self._param_labels()
+        per_label = None
+        if labels is not None:
+            per_label = {"__default__": g.updater, "__frozen__": NoOp()}
+            for i, layer in enumerate(self.layers):
+                if layer.updater is not None and not layer.frozen:
+                    per_label[f"__layer_{i}__"] = layer.updater
+        # l1/l2 handled inside loss (reg term differentiates through); don't
+        # double-apply in the optimizer chain.
+        self._optimizer = build_optimizer(
+            g.updater, grad_norm=g.grad_norm, grad_norm_threshold=g.grad_norm_threshold,
+            iters_per_epoch=iters_per_epoch,
+            param_labels=labels, per_label_updaters=per_label)
+        self._opt_state = self._optimizer.init(self.params)
+
+    def _get_train_step(self):
+        if self._train_step is None:
+            optimizer = self._optimizer
+
+            def step(params, states, opt_state, x, y, rng, fmask, lmask):
+                (loss, new_states), grads = jax.value_and_grad(
+                    self._loss, has_aux=True)(params, states, x, y, rng, fmask, lmask)
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, new_states, opt_state, loss
+
+            self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._train_step
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, data, labels=None, *, epochs: int = 1):
+        """fit(DataSetIterator) | fit(DataSet) | fit(features, labels).
+
+        Reference: MultiLayerNetwork.fit — one optimizer step per minibatch,
+        listeners invoked per iteration, epoch counter maintained.
+        """
+        from ..data.dataset import DataSet
+        if labels is not None:
+            data = DataSet(jnp.asarray(data), jnp.asarray(labels))
+        if isinstance(data, DataSet):
+            iterator = [data]
+        else:
+            iterator = data
+        if not self.initialized:
+            first = next(iter(iterator))
+            self.init(tuple(np.asarray(first.features).shape[1:]))
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        if self._optimizer is None:
+            try:
+                ipe = len(iterator)
+            except TypeError:
+                ipe = 1
+            self._iters_per_epoch = max(int(ipe), 1)
+            self._build_optimizer(self._iters_per_epoch)
+            restored = getattr(self, "_restored_opt_state", None)
+            if restored is not None:  # resume updater state from checkpoint
+                self._opt_state = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(self._opt_state),
+                    jax.tree_util.tree_leaves(restored))
+                self._restored_opt_state = None
+        step_fn = self._get_train_step()
+        last = None
+        for _ in range(epochs):
+            for ds in iterator:
+                x = jnp.asarray(ds.features)
+                y = jnp.asarray(ds.labels)
+                fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+                lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+                self._host_key, rng = jax.random.split(self._host_key)
+                self.params, self.states, self._opt_state, loss = step_fn(
+                    self.params, self.states, self._opt_state, x, y, rng, fmask, lmask)
+                self._step_count += 1
+                last = loss
+                if self.listeners:
+                    lv = float(loss)
+                    for listener in self.listeners:
+                        listener.iteration_done(self, self._step_count, self.epoch_count, lv)
+            self.epoch_count += 1
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+        return None if last is None else float(last)
+
+    # ---------------------------------------------------------------- score
+    def score(self, dataset=None):
+        """Loss (incl. regularization) on a DataSet (reference: score())."""
+        if dataset is None:
+            raise ValueError("score() requires a DataSet")
+        x = jnp.asarray(dataset.features)
+        y = jnp.asarray(dataset.labels)
+        fmask = None if dataset.features_mask is None else jnp.asarray(dataset.features_mask)
+        lmask = None if dataset.labels_mask is None else jnp.asarray(dataset.labels_mask)
+        loss, _ = self._loss(self.params, self.states, x, y, None, fmask, lmask)
+        return float(loss)
+
+    def gradient_and_score(self, dataset):
+        """(gradients pytree, score) — reference computeGradientAndScore()."""
+        x = jnp.asarray(dataset.features)
+        y = jnp.asarray(dataset.labels)
+        (loss, _), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            self.params, self.states, x, y, None, None, None)
+        return grads, float(loss)
+
+    # ------------------------------------------------------------- evaluate
+    def evaluate(self, iterator, top_n: int = 1):
+        from ..eval.classification import Evaluation
+        ev = Evaluation(top_n=top_n)
+        for ds in iterator:
+            preds = self.output(jnp.asarray(ds.features))
+            mask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+            ev.eval(jnp.asarray(ds.labels), preds, mask=mask)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return ev
+
+    def evaluate_regression(self, iterator):
+        from ..eval.regression import RegressionEvaluation
+        ev = RegressionEvaluation()
+        for ds in iterator:
+            preds = self.output(jnp.asarray(ds.features))
+            ev.eval(jnp.asarray(ds.labels), preds)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return ev
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 0):
+        from ..eval.roc import ROC
+        roc = ROC(threshold_steps)
+        for ds in iterator:
+            preds = self.output(jnp.asarray(ds.features))
+            roc.eval(jnp.asarray(ds.labels), preds)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return roc
+
+    # ------------------------------------------------------------ listeners
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+
+    # ----------------------------------------------------------- params API
+    def num_params(self) -> int:
+        return sum(int(p.size) for p in jax.tree_util.tree_leaves(self.params))
+
+    def get_param(self, layer_idx: int, name: str):
+        return self.params[f"layer_{layer_idx}"][name]
+
+    def set_param(self, layer_idx: int, name: str, value):
+        self.params[f"layer_{layer_idx}"][name] = jnp.asarray(value)
+        self._invalidate()
+
+    def params_flat(self):
+        """Single flat vector, reference INDArray params() order: layer order."""
+        leaves = jax.tree_util.tree_leaves(self.params)
+        return jnp.concatenate([l.ravel() for l in leaves]) if leaves else jnp.zeros((0,))
+
+    def set_params_flat(self, flat):
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        out, off = [], 0
+        for l in leaves:
+            n = int(l.size)
+            out.append(jnp.asarray(flat[off:off + n]).reshape(l.shape).astype(l.dtype))
+            off += n
+        self.params = jax.tree_util.tree_unflatten(treedef, out)
+        self._invalidate()
+
+    def _invalidate(self):
+        self._infer_fn = None
+        self._train_step = None
+
+    def clone(self):
+        import copy
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        if self.initialized:
+            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            net.states = jax.tree_util.tree_map(lambda a: a, self.states)
+            net._preprocessors = dict(self._preprocessors)
+            net.output_shape = self.output_shape
+            net.initialized = True
+        return net
+
+    # -------------------------------------------------------------- summary
+    def summary(self) -> str:
+        lines = ["=" * 72,
+                 f"{'LayerName (idx)':<28}{'Output Shape':<20}{'Param Count':<12}",
+                 "=" * 72]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            p = self.params.get(f"layer_{i}", {})
+            n = sum(int(v.size) for v in jax.tree_util.tree_leaves(p))
+            total += n
+            name = layer.name or type(layer).__name__
+            lines.append(f"{name + f' ({i})':<28}{'-':<20}{n:<12}")
+        lines += ["=" * 72, f"Total params: {total}", "=" * 72]
+        return "\n".join(lines)
+
+    # ----------------------------------------------------------------- save
+    def save(self, path, save_updater: bool = False):
+        from ..serde.model_serializer import save_model
+        save_model(self, path, save_updater=save_updater)
+
+    @staticmethod
+    def load(path):
+        from ..serde.model_serializer import load_model
+        return load_model(path)

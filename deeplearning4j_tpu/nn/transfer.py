@@ -1,0 +1,138 @@
+"""Transfer learning — parity with ``org.deeplearning4j.nn.transferlearning``.
+
+``TransferLearning.Builder(net)``: fine_tune_configuration,
+set_feature_extractor (freeze up to layer), nout_replace, remove_output_layer,
+remove_layers_from_output, add_layer. Frozen layers get zero updates via the
+optimizer's multi_transform (no FrozenLayer wrapper interpreting at runtime —
+the freeze is free at train time under jit).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, List, Optional
+
+import jax
+
+from .conf import GlobalConf, MultiLayerConfiguration, resolve_layer_defaults
+from .layers.base import Layer
+from .multi_layer_network import MultiLayerNetwork
+
+
+class FineTuneConfiguration:
+    """Subset of global config overridable at transfer time."""
+
+    def __init__(self, updater=None, seed=None, l1=None, l2=None,
+                 dropout=None, weight_init=None):
+        self.updater = updater
+        self.seed = seed
+        self.l1 = l1
+        self.l2 = l2
+        self.dropout = dropout
+        self.weight_init = weight_init
+
+    def apply_to(self, g: GlobalConf):
+        if self.updater is not None:
+            g.updater = self.updater
+        if self.seed is not None:
+            g.seed = self.seed
+        if self.l1 is not None:
+            g.l1 = self.l1
+        if self.l2 is not None:
+            g.l2 = self.l2
+        if self.dropout is not None:
+            g.dropout = self.dropout
+        if self.weight_init is not None:
+            g.weight_init = self.weight_init
+
+
+class TransferLearning:
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            if not net.initialized:
+                raise ValueError("source network must be initialized")
+            self._src = net
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._nout_replace: List = []
+            self._remove_from: Optional[int] = None
+            self._added: List[Layer] = []
+            self._input_shape = None
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] (reference setFeatureExtractor)."""
+            self._freeze_until = layer_idx
+            return self
+
+        def nout_replace(self, layer_idx: int, n_out: int, weight_init=None):
+            self._nout_replace.append((layer_idx, n_out, weight_init))
+            return self
+
+        def remove_output_layer(self):
+            self._remove_from = len(self._src.layers) - 1
+            return self
+
+        def remove_layers_from_output(self, n: int):
+            self._remove_from = len(self._src.layers) - n
+            return self
+
+        def add_layer(self, layer: Layer):
+            self._added.append(layer)
+            return self
+
+        def set_input_shape(self, shape):
+            self._input_shape = tuple(shape)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            src = self._src
+            g = copy.deepcopy(src.conf.globals_)
+            if self._fine_tune is not None:
+                self._fine_tune.apply_to(g)
+            keep_n = self._remove_from if self._remove_from is not None else len(src.layers)
+            layers = [copy.deepcopy(l) for l in src.layers[:keep_n]]
+            replaced_from = len(layers)  # layers >= this index get fresh params
+            for idx, n_out, winit in self._nout_replace:
+                layers[idx] = dataclasses.replace(layers[idx], n_out=n_out)
+                if winit is not None:
+                    layers[idx].weight_init = winit
+                replaced_from = min(replaced_from, idx)
+            for i, lyr in enumerate(layers):
+                if self._freeze_until is not None and i <= self._freeze_until:
+                    lyr.frozen = True
+                resolve_layer_defaults(lyr, g)
+            new_layers = layers + [copy.deepcopy(l) for l in self._added]
+            for lyr in new_layers[len(layers):]:
+                resolve_layer_defaults(lyr, g)
+            conf = MultiLayerConfiguration(g, new_layers, src.conf.input_type)
+            net = MultiLayerNetwork(conf)
+            in_shape = self._input_shape
+            if in_shape is None and src.conf.input_type is not None:
+                in_shape = tuple(src.conf.input_type[1])
+            if in_shape is None:
+                raise ValueError("set_input_shape() required when source conf has no input type")
+            net.init(in_shape)
+            # copy weights for retained, un-replaced layers (nOut change at
+            # idx invalidates idx and idx+1 like the reference)
+            invalid = set()
+            for idx, _, _ in self._nout_replace:
+                invalid.add(idx)
+                invalid.add(idx + 1)
+            for i in range(keep_n):
+                if i in invalid:
+                    continue
+                src_p = src.params[f"layer_{i}"]
+                dst_p = net.params[f"layer_{i}"]
+                if jax.tree_util.tree_structure(src_p) == jax.tree_util.tree_structure(dst_p):
+                    ok = all(a.shape == b.shape for a, b in zip(
+                        jax.tree_util.tree_leaves(src_p), jax.tree_util.tree_leaves(dst_p)))
+                    if ok:
+                        net.params[f"layer_{i}"] = jax.tree_util.tree_map(lambda a: a, src_p)
+                        net.states[f"layer_{i}"] = jax.tree_util.tree_map(
+                            lambda a: a, src.states[f"layer_{i}"])
+            return net

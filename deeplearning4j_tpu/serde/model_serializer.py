@@ -1,0 +1,150 @@
+"""ModelSerializer — zip checkpoints (config + params + updater + normalizer).
+
+Reference parity: ``org.deeplearning4j.util.ModelSerializer``
+(writeModel/restoreMultiLayerNetwork, addNormalizerToModel). Format here:
+a zip holding ``conf.pkl`` (config object), ``params.npz`` / ``states.npz``
+(flattened pytrees with path-encoded keys), optional ``updater.npz`` and
+``normalizer.pkl``. For sharded/distributed checkpoints use
+``deeplearning4j_tpu.serde.orbax_ckpt`` instead.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import zipfile
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SEP = "|"
+
+
+def _flatten_with_paths(tree):
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = SEP.join(_path_str(p) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _path_str(p):
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return f"#{p.idx}"
+    return str(p)
+
+
+def _save_npz(zf: zipfile.ZipFile, name: str, tree):
+    buf = io.BytesIO()
+    flat = _flatten_with_paths(tree)
+    # bfloat16 isn't a numpy-native dtype for savez; view as uint16 + marker
+    packed = {}
+    for k, v in flat.items():
+        if v.dtype == jnp.bfloat16:
+            packed["__bf16__" + k] = v.view(np.uint16)
+        else:
+            packed[k] = v
+    np.savez(buf, **packed)
+    zf.writestr(name, buf.getvalue())
+
+
+def _load_npz(zf: zipfile.ZipFile, name: str):
+    with zf.open(name) as f:
+        z = np.load(io.BytesIO(f.read()))
+        out = {}
+        for k in z.files:
+            if k.startswith("__bf16__"):
+                out[k[len("__bf16__"):]] = z[k].view(jnp.bfloat16)
+            else:
+                out[k] = z[k]
+        return out
+
+
+def _unflatten_into(template, flat):
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = SEP.join(_path_str(p) for p in path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing param {key}")
+        leaves.append(jnp.asarray(flat[key]).astype(leaf.dtype).reshape(leaf.shape))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_model(model, path, save_updater: bool = False, normalizer=None):
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("conf.pkl", pickle.dumps({
+            "kind": type(model).__name__,
+            "conf": model.conf,
+            "preprocessors": getattr(model, "_preprocessors", {}),
+            "epoch_count": getattr(model, "epoch_count", 0),
+            "step_count": getattr(model, "_step_count", 0),
+        }))
+        _save_npz(zf, "params.npz", model.params)
+        _save_npz(zf, "states.npz", model.states)
+        if save_updater and getattr(model, "_opt_state", None) is not None:
+            zf.writestr("updater.pkl", pickle.dumps(
+                jax.tree_util.tree_map(lambda a: np.asarray(a), model._opt_state)))
+        if normalizer is not None:
+            zf.writestr("normalizer.pkl", pickle.dumps(normalizer))
+
+
+def load_model(path):
+    from ..nn.computation_graph import ComputationGraph
+    from ..nn.multi_layer_network import MultiLayerNetwork
+    with zipfile.ZipFile(path) as zf:
+        meta = pickle.loads(zf.read("conf.pkl"))
+        cls = {"MultiLayerNetwork": MultiLayerNetwork,
+               "ComputationGraph": ComputationGraph}[meta["kind"]]
+        model = cls(meta["conf"])
+        conf = meta["conf"]
+        if getattr(conf, "input_type", None) is not None or \
+                getattr(conf, "input_types", None) is not None:
+            model.init()
+        if not model.initialized:
+            # need shapes: rebuild params directly from the file
+            model.params = {}
+            model.states = {}
+        flat_p = _load_npz(zf, "params.npz")
+        flat_s = _load_npz(zf, "states.npz")
+        if model.initialized:
+            model.params = _unflatten_into(model.params, flat_p)
+            if jax.tree_util.tree_leaves(model.states):
+                model.states = _unflatten_into(model.states, flat_s)
+        else:
+            model.params = _nest(flat_p)
+            model.states = _nest(flat_s)
+            model.initialized = True
+        model._preprocessors = meta.get("preprocessors", {})
+        model.epoch_count = meta.get("epoch_count", 0)
+        model._step_count = meta.get("step_count", 0)
+        if "updater.pkl" in zf.namelist():
+            raw = pickle.loads(zf.read("updater.pkl"))
+            model._restored_opt_state = jax.tree_util.tree_map(jnp.asarray, raw)
+        if "normalizer.pkl" in zf.namelist():
+            model.normalizer = pickle.loads(zf.read("normalizer.pkl"))
+    return model
+
+
+def restore_normalizer(path):
+    with zipfile.ZipFile(path) as zf:
+        if "normalizer.pkl" in zf.namelist():
+            return pickle.loads(zf.read("normalizer.pkl"))
+    return None
+
+
+def _nest(flat):
+    out = {}
+    for key, v in flat.items():
+        parts = key.split(SEP)
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = jnp.asarray(v)
+    return out

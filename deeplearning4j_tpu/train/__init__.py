@@ -1,0 +1,10 @@
+"""deeplearning4j_tpu.train — updaters, schedules, gradient handling."""
+
+from .schedules import (CycleSchedule, ExponentialSchedule, FixedSchedule,
+                        InverseSchedule, MapSchedule, PolySchedule, Schedule,
+                        ScheduleType, SigmoidSchedule, StepSchedule,
+                        WarmupCosineSchedule)
+from .updaters import (AMSGrad, AdaDelta, AdaGrad, AdaMax, Adam, AdamW,
+                       GradientNormalization, Lamb, Lion, Momentum, Nadam,
+                       Nesterovs, NoOp, RmsProp, Sgd, Updater,
+                       build_optimizer, gradient_normalization)

@@ -1,0 +1,163 @@
+"""Learning-rate schedules — parity with ``org.nd4j.linalg.schedule.ISchedule``.
+
+Each schedule is a dataclass with ``value_at(iteration, epoch)`` (the DL4J
+contract) and ``__call__(step)`` so it plugs straight into optax as a scalar
+schedule. DL4J schedules may key on ITERATION or EPOCH (`ScheduleType`);
+`to_optax(iters_per_epoch)` converts epoch-typed schedules to step-based.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+
+class ScheduleType:
+    ITERATION = "iteration"
+    EPOCH = "epoch"
+
+
+@dataclass
+class Schedule:
+    schedule_type: str = ScheduleType.ITERATION
+
+    def value_at(self, iteration, epoch):
+        t = iteration if self.schedule_type == ScheduleType.ITERATION else epoch
+        return self._value(t)
+
+    def _value(self, t):  # pragma: no cover — abstract
+        raise NotImplementedError
+
+    def to_optax(self, iters_per_epoch: int = 1):
+        if self.schedule_type == ScheduleType.EPOCH:
+            return lambda step: self._value(step // iters_per_epoch)
+        return lambda step: self._value(step)
+
+    def __call__(self, step):
+        return self.to_optax()(step)
+
+
+@dataclass
+class FixedSchedule(Schedule):
+    value: float = 1e-3
+
+    def _value(self, t):
+        return self.value
+
+
+@dataclass
+class StepSchedule(Schedule):
+    """lr * decay^floor(t / step)."""
+
+    initial_value: float = 1e-3
+    decay_rate: float = 0.1
+    step: float = 1000.0
+
+    def _value(self, t):
+        return self.initial_value * self.decay_rate ** jnp.floor(t / self.step)
+
+
+@dataclass
+class ExponentialSchedule(Schedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+
+    def _value(self, t):
+        return self.initial_value * self.gamma ** t
+
+
+@dataclass
+class InverseSchedule(Schedule):
+    """lr / (1 + gamma*t)^power."""
+
+    initial_value: float = 1e-3
+    gamma: float = 0.001
+    power: float = 1.0
+
+    def _value(self, t):
+        return self.initial_value / (1.0 + self.gamma * t) ** self.power
+
+
+@dataclass
+class PolySchedule(Schedule):
+    """lr * (1 - t/maxIter)^power."""
+
+    initial_value: float = 1e-3
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def _value(self, t):
+        frac = jnp.clip(t / self.max_iter, 0.0, 1.0)
+        return self.initial_value * (1.0 - frac) ** self.power
+
+
+@dataclass
+class SigmoidSchedule(Schedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.01
+    step_size: int = 1000
+
+    def _value(self, t):
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (t - self.step_size)))
+
+
+@dataclass
+class MapSchedule(Schedule):
+    """Piecewise-constant: {t: lr}; value holds from each key onward."""
+
+    values: dict = field(default_factory=dict)
+
+    def _value(self, t):
+        keys = sorted(self.values)
+        out = jnp.asarray(self.values[keys[0]], jnp.float32)
+        for k in keys:
+            out = jnp.where(t >= k, self.values[k], out)
+        return out
+
+
+@dataclass
+class CycleSchedule(Schedule):
+    """1cycle: warmup to max_lr, anneal down, final decay (DL4J CycleSchedule)."""
+
+    initial_value: float = 1e-4
+    max_value: float = 1e-2
+    cycle_length: int = 1000
+    annealing_start_fraction: float = 0.9
+    annealing_decay: float = 0.1
+
+    def _value(self, t):
+        up = self.cycle_length * (1 - self.annealing_start_fraction) / 2
+        ann_start = self.cycle_length * self.annealing_start_fraction
+        t = jnp.asarray(t, jnp.float32)
+        lr_up = self.initial_value + (self.max_value - self.initial_value) * (t / jnp.maximum(up, 1))
+        lr_down = self.max_value - (self.max_value - self.initial_value) * jnp.clip(
+            (t - up) / jnp.maximum(ann_start - up, 1), 0, 1)
+        lr_ann = self.initial_value * self.annealing_decay ** jnp.clip(
+            (t - ann_start) / jnp.maximum(self.cycle_length - ann_start, 1), 0, 1)
+        return jnp.where(t < up, lr_up, jnp.where(t < ann_start, lr_down, lr_ann))
+
+
+@dataclass
+class WarmupCosineSchedule(Schedule):
+    """TPU-era staple (not in DL4J): linear warmup → cosine decay."""
+
+    peak_value: float = 1e-3
+    warmup_steps: int = 1000
+    total_steps: int = 10000
+    end_value: float = 0.0
+
+    def _value(self, t):
+        t = jnp.asarray(t, jnp.float32)
+        warm = self.peak_value * t / max(self.warmup_steps, 1)
+        frac = jnp.clip((t - self.warmup_steps) / max(self.total_steps - self.warmup_steps, 1), 0, 1)
+        cos = self.end_value + 0.5 * (self.peak_value - self.end_value) * (1 + jnp.cos(math.pi * frac))
+        return jnp.where(t < self.warmup_steps, warm, cos)
+
+
+def resolve(lr_or_schedule, iters_per_epoch: int = 1):
+    """float → constant; Schedule → optax-compatible callable."""
+    if isinstance(lr_or_schedule, Schedule):
+        return lr_or_schedule.to_optax(iters_per_epoch)
+    return lr_or_schedule

@@ -1,0 +1,39 @@
+"""ZooModel API — parity with ``org.deeplearning4j.zoo.ZooModel`` /
+``org.deeplearning4j.zoo.model.*``.
+
+Each model class exposes ``conf()`` (the network configuration),
+``init() -> network`` and ``init_pretrained(path)`` (local weights — the
+sandbox has no egress, so pretrained loading reads a local checkpoint rather
+than downloading like the reference's initPretrained()).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+
+@dataclass
+class ZooModel:
+    num_classes: int = 1000
+    seed: int = 123
+    input_shape: Tuple = ()          # (H, W, C) NHWC or model-specific
+    updater: Any = None
+    compute_dtype: Any = None        # e.g. jnp.bfloat16
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        raise NotImplementedError
+
+    def init_pretrained(self, path):
+        """Load weights from a local ModelSerializer zip (offline analogue
+        of the reference's pretrained-download path)."""
+        from ..serde.model_serializer import load_model
+        return load_model(path)
+
+    def meta_data(self) -> dict:
+        net = self.init()
+        return {"name": type(self).__name__, "num_params": net.num_params(),
+                "input_shape": self.input_shape, "num_classes": self.num_classes}

@@ -1,0 +1,107 @@
+"""ResNet-50 — the headline benchmark model (BASELINE.json config 2).
+
+Reference parity: ``org.deeplearning4j.zoo.model.ResNet50`` (ImageNet
+ComputationGraph; cuDNN conv path). TPU-first build: NHWC bf16 convs with
+f32 accumulation on the MXU, fused BN+ReLU (XLA fuses the elementwise chain
+into the conv epilogue), identity/projection bottleneck blocks as graph
+vertices. The same topology is also exposed as a pure-functional
+``resnet50_fn`` for bench/parallel use (single jaxpr, scan-free).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from ..nn.computation_graph import ComputationGraph
+from ..nn.conf import NeuralNetConfiguration
+from ..nn.layers.base import InputType
+from ..nn.layers.conv import (ConvolutionLayer, GlobalPoolingLayer,
+                              SubsamplingLayer, ZeroPaddingLayer)
+from ..nn.layers.core import ActivationLayer, OutputLayer
+from ..nn.layers.norm import BatchNormalization
+from ..nn.vertices import ElementWiseVertex
+from ..train.updaters import Adam
+from .base import ZooModel
+
+
+@dataclass
+class ResNet50(ZooModel):
+    num_classes: int = 1000
+    input_shape: Tuple = (224, 224, 3)
+
+    # (n_blocks, filters) per stage; first block of stages 2-4 downsamples
+    STAGES = ((3, (64, 64, 256)), (4, (128, 128, 512)),
+              (6, (256, 256, 1024)), (3, (512, 512, 2048)))
+
+    def conf(self):
+        b = NeuralNetConfiguration.builder().seed(self.seed)
+        b.updater(self.updater or Adam(1e-3))
+        if self.compute_dtype is not None:
+            b.data_type(jnp.float32, self.compute_dtype)
+        g = b.graph_builder().add_inputs("in")
+
+        def conv_bn(name, inp, n_out, k, stride=1, act="relu"):
+            g.add_layer(f"{name}_conv",
+                        ConvolutionLayer(n_out=n_out, kernel_size=(k, k),
+                                         stride=(stride, stride),
+                                         convolution_mode="same",
+                                         activation="identity", has_bias=False), inp)
+            g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+            if act is None:
+                return f"{name}_bn"
+            g.add_layer(f"{name}_act", ActivationLayer(activation=act), f"{name}_bn")
+            return f"{name}_act"
+
+        def bottleneck(name, inp, f1, f2, f3, stride, project):
+            x = conv_bn(f"{name}_a", inp, f1, 1, stride)
+            x = conv_bn(f"{name}_b", x, f2, 3, 1)
+            x = conv_bn(f"{name}_c", x, f3, 1, 1, act=None)
+            if project:
+                sc = conv_bn(f"{name}_sc", inp, f3, 1, stride, act=None)
+            else:
+                sc = inp
+            g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, sc)
+            g.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
+            return f"{name}_out"
+
+        x = conv_bn("stem", "in", 64, 7, 2)
+        g.add_layer("stem_pool", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                                  convolution_mode="same"), x)
+        x = "stem_pool"
+        for si, (n_blocks, (f1, f2, f3)) in enumerate(self.STAGES):
+            for bi in range(n_blocks):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                x = bottleneck(f"s{si}b{bi}", x, f1, f2, f3, stride, project=(bi == 0))
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("out", OutputLayer(n_in=self.STAGES[-1][1][2],
+                                       n_out=self.num_classes,
+                                       activation="softmax", loss="mcxent"), "gap")
+        g.set_outputs("out")
+        g.set_input_types(InputType.convolutional(*self.input_shape))
+        return g.build()
+
+    def init(self):
+        return ComputationGraph(self.conf()).init()
+
+
+# --------------------------------------------------------------------------
+# Pure-functional ResNet-50 (bench / parallel path) — identical topology,
+# but params as a flat dict and a single apply fn; lets bench.py and the
+# data-parallel trainer jit/donate without the class machinery.
+# --------------------------------------------------------------------------
+
+def resnet50_init(key, num_classes=1000, dtype=jnp.float32):
+    model = ResNet50(num_classes=num_classes)
+    net = ComputationGraph(model.conf())
+    net._g.seed = int(jnp.asarray(0))  # deterministic; key unused by init()
+    net.init()
+    return net
+
+
+def resnet50_apply(net, params, states, x, train=False, rng=None):
+    acts, _, new_states = net._forward(params, states, {"in": x},
+                                       train=train, rng=rng)
+    return acts["out"], new_states

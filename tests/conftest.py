@@ -1,0 +1,34 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Must set env BEFORE jax initializes (SURVEY.md §4): multi-chip sharding
+tests use the 8 virtual CPU devices; the real TPU is reserved for bench.py.
+"""
+
+import os
+
+# FORCE cpu: the sandbox env pins JAX_PLATFORMS=axon (the real TPU tunnel)
+# and the axon sitecustomize calls jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter start — so BOTH the env var and the config must
+# be overridden or the whole suite runs on (and can wedge) the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running convergence test")

@@ -1,0 +1,246 @@
+"""Layer forward shape/value tests + central-difference gradient checks
+(SURVEY.md §4 — mirrors the reference's GradientCheckUtil strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers.attention import (LearnedSelfAttentionLayer,
+                                                    SelfAttentionLayer)
+from deeplearning4j_tpu.nn.layers.base import Ctx
+from deeplearning4j_tpu.nn.layers.conv import (ConvolutionLayer, Cropping2D,
+                                               Deconvolution2D,
+                                               DepthToSpaceLayer,
+                                               DepthwiseConvolution2D,
+                                               GlobalPoolingLayer,
+                                               LocallyConnected2D,
+                                               SeparableConvolution2D,
+                                               SpaceToDepthLayer,
+                                               SubsamplingLayer, Upsampling2D,
+                                               ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.layers.core import (DenseLayer, DropoutLayer,
+                                               EmbeddingSequenceLayer,
+                                               OutputLayer, PReLULayer)
+from deeplearning4j_tpu.nn.layers.norm import (BatchNormalization,
+                                               LayerNormalization,
+                                               LocalResponseNormalization,
+                                               RMSNorm)
+from deeplearning4j_tpu.nn.layers.recurrent import (GRU, LSTM, Bidirectional,
+                                                    GravesLSTM, LastTimeStep,
+                                                    SimpleRnn)
+
+KEY = jax.random.PRNGKey(0)
+CTX = Ctx(train=False)
+
+
+def _run(layer, input_shape, batch=2, seed=0):
+    params, state, out_shape = layer.init(KEY, input_shape)
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((batch,) + tuple(input_shape)).astype(np.float32))
+    y, new_state = layer.apply(params, state, x, Ctx(train=False))
+    return params, x, y, out_shape
+
+
+def central_diff_grad_check(layer, input_shape, batch=2, eps=1e-3, tol=6e-2):
+    """Analytic grads (jax.grad) vs central differences on a scalar loss."""
+    params, state, _ = layer.init(KEY, input_shape)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((batch,) + tuple(input_shape)).astype(np.float32))
+
+    @jax.jit
+    def loss(p):
+        y, _ = layer.apply(p, state, x, Ctx(train=False))
+        return jnp.sum(jnp.square(y))
+
+    analytic = jax.grad(loss)(params)
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = jax.tree_util.tree_leaves(analytic)
+    for leaf_i, (p, g) in enumerate(zip(flat_p, flat_g)):
+        flat = np.asarray(p).ravel()
+        idxs = np.random.default_rng(2).choice(flat.size, size=min(4, flat.size), replace=False)
+        for i in idxs:
+            fp = flat.copy()
+            fp[i] += eps
+            fm = flat.copy()
+            fm[i] -= eps
+            def rebuild(vals):
+                leaves = [np.asarray(q).copy() for q in flat_p]
+                leaves[leaf_i] = vals.reshape(p.shape)
+                return jax.tree_util.tree_unflatten(treedef, [jnp.asarray(l) for l in leaves])
+            num = (float(loss(rebuild(fp))) - float(loss(rebuild(fm)))) / (2 * eps)
+            ana = float(np.asarray(g).ravel()[i])
+            denom = max(abs(num), abs(ana), 1e-2)
+            assert abs(num - ana) / denom < tol, \
+                f"grad mismatch leaf{leaf_i}[{i}]: num={num} ana={ana}"
+
+
+# ---------------------------------------------------------------- shapes
+
+def test_dense_shapes():
+    _, x, y, out = _run(DenseLayer(n_in=8, n_out=16, activation="relu"), (8,))
+    assert y.shape == (2, 16) and out == (16,)
+    assert float(jnp.min(y)) >= 0.0
+
+
+def test_conv_shapes():
+    _, _, y, out = _run(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                         convolution_mode="same"), (8, 8, 3))
+    assert y.shape == (2, 8, 8, 4) and out == (8, 8, 4)
+    _, _, y2, out2 = _run(ConvolutionLayer(n_out=4, kernel_size=(3, 3), stride=(2, 2),
+                                           padding=1, convolution_mode="truncate"), (8, 8, 3))
+    assert y2.shape == (2, 4, 4, 4) and out2 == (4, 4, 4)
+
+
+def test_pool_upsample_pad_crop():
+    _, _, y, _ = _run(SubsamplingLayer(kernel_size=(2, 2)), (8, 8, 3))
+    assert y.shape == (2, 4, 4, 3)
+    _, _, y, _ = _run(Upsampling2D(size=2), (4, 4, 3))
+    assert y.shape == (2, 8, 8, 3)
+    _, _, y, _ = _run(ZeroPaddingLayer(padding=(1, 2)), (4, 4, 3))
+    assert y.shape == (2, 6, 8, 3)
+    _, _, y, _ = _run(Cropping2D(cropping=1), (6, 6, 3))
+    assert y.shape == (2, 4, 4, 3)
+
+
+def test_space_depth_roundtrip():
+    s2d = SpaceToDepthLayer(block_size=2)
+    d2s = DepthToSpaceLayer(block_size=2)
+    params, state, _ = s2d.init(KEY, (4, 4, 3))
+    x = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+    y, _ = s2d.apply({}, {}, x, CTX)
+    assert y.shape == (2, 2, 2, 12)
+    back, _ = d2s.apply({}, {}, y, CTX)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_special_convs():
+    _, _, y, _ = _run(DepthwiseConvolution2D(depth_multiplier=2, kernel_size=(3, 3),
+                                             convolution_mode="same"), (6, 6, 3))
+    assert y.shape == (2, 6, 6, 6)
+    _, _, y, _ = _run(SeparableConvolution2D(n_out=5, kernel_size=(3, 3),
+                                             convolution_mode="same"), (6, 6, 3))
+    assert y.shape == (2, 6, 6, 5)
+    _, _, y, _ = _run(Deconvolution2D(n_out=4, kernel_size=(2, 2), stride=(2, 2),
+                                      convolution_mode="same"), (4, 4, 3))
+    assert y.shape == (2, 8, 8, 4)
+    _, _, y, _ = _run(LocallyConnected2D(n_out=4, kernel_size=(3, 3)), (6, 6, 3))
+    assert y.shape == (2, 4, 4, 4)
+
+
+def test_global_pooling_masked():
+    gp = GlobalPoolingLayer(pooling_type="avg")
+    x = jnp.ones((2, 5, 3))
+    mask = jnp.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+    y, _ = gp.apply({}, {}, x * jnp.arange(1, 6, dtype=jnp.float32)[None, :, None],
+                    Ctx(train=False, mask=mask))
+    np.testing.assert_allclose(np.asarray(y)[0], [2.0, 2.0, 2.0], rtol=1e-5)  # mean(1,2,3)
+    np.testing.assert_allclose(np.asarray(y)[1], [3.0, 3.0, 3.0], rtol=1e-5)
+
+
+def test_norm_layers():
+    bn = BatchNormalization()
+    params, state, _ = bn.init(KEY, (8,))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((16, 8)).astype(np.float32)) * 5 + 3
+    y, new_state = bn.apply(params, state, x, Ctx(train=True))
+    assert abs(float(jnp.mean(y))) < 1e-4
+    assert abs(float(jnp.std(y)) - 1.0) < 0.05
+    assert float(jnp.max(jnp.abs(new_state["mean"]))) > 0.0  # stats updated
+    ln = LayerNormalization()
+    p2, s2, _ = ln.init(KEY, (8,))
+    y2, _ = ln.apply(p2, s2, x, CTX)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y2, -1)), np.zeros(16), atol=1e-4)
+    rms = RMSNorm()
+    p3, s3, _ = rms.init(KEY, (8,))
+    y3, _ = rms.apply(p3, s3, x, CTX)
+    assert y3.shape == x.shape
+    _, _, y4, _ = _run(LocalResponseNormalization(), (4, 4, 8))
+    assert y4.shape == (2, 4, 4, 8)
+
+
+def test_rnn_shapes_and_masking():
+    for cls in (SimpleRnn, LSTM, GravesLSTM, GRU):
+        layer = cls(n_in=6, n_out=5)
+        params, state, out = layer.init(KEY, (7, 6))
+        assert out == (7, 5)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((3, 7, 6)).astype(np.float32))
+        y, _ = layer.apply(params, state, x, CTX)
+        assert y.shape == (3, 7, 5)
+        # masking: padded steps produce zeros and don't affect earlier state
+        mask = jnp.asarray(np.array([[1, 1, 1, 1, 0, 0, 0]] * 3, np.float32))
+        ym, _ = layer.apply(params, state, x, Ctx(train=False, mask=mask))
+        np.testing.assert_allclose(np.asarray(ym[:, 4:]), 0.0, atol=1e-6)
+        # prefix equality: truncated input gives same prefix outputs
+        y_short, _ = layer.apply(params, state, x[:, :4], CTX)
+        np.testing.assert_allclose(np.asarray(ym[:, :4]), np.asarray(y_short),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_and_last_step():
+    bi = Bidirectional(fwd=LSTM(n_in=4, n_out=3))
+    params, state, out = bi.init(KEY, (5, 4))
+    assert out == (5, 6)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 5, 4)).astype(np.float32))
+    y, _ = bi.apply(params, state, x, CTX)
+    assert y.shape == (2, 5, 6)
+    lts = LastTimeStep(inner=LSTM(n_in=4, n_out=3))
+    p2, s2, out2 = lts.init(KEY, (5, 4))
+    assert out2 == (3,)
+    y2, _ = lts.apply(p2, s2, x, CTX)
+    assert y2.shape == (2, 3)
+    # with mask, last step == step at length-1
+    mask = jnp.asarray(np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32))
+    full, _ = lts.inner.apply(p2, s2, x, Ctx(train=False, mask=mask))
+    picked, _ = lts.apply(p2, s2, x, Ctx(train=False, mask=mask))
+    np.testing.assert_allclose(np.asarray(picked[0]), np.asarray(full[0, 2]), rtol=1e-5)
+
+
+def test_attention_shapes():
+    sa = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2)
+    params, state, out = sa.init(KEY, (6, 8))
+    assert out == (6, 8)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 6, 8)).astype(np.float32))
+    y, _ = sa.apply(params, state, x, CTX)
+    assert y.shape == (2, 6, 8)
+    lsa = LearnedSelfAttentionLayer(n_in=8, n_out=8, n_heads=2, n_queries=3)
+    p2, s2, out2 = lsa.init(KEY, (6, 8))
+    assert out2 == (3, 8)
+    y2, _ = lsa.apply(p2, s2, x, CTX)
+    assert y2.shape == (2, 3, 8)
+
+
+def test_embedding_sequence():
+    emb = EmbeddingSequenceLayer(n_in=50, n_out=8)
+    params, state, out = emb.init(KEY, (7,))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 50, (3, 7)))
+    y, _ = emb.apply(params, state, ids, CTX)
+    assert y.shape == (3, 7, 8)
+
+
+def test_dropout_train_vs_infer():
+    do = DropoutLayer(rate=0.5)
+    x = jnp.ones((4, 100))
+    y_inf, _ = do.apply({}, {}, x, Ctx(train=False))
+    np.testing.assert_allclose(np.asarray(y_inf), np.asarray(x))
+    y_tr, _ = do.apply({}, {}, x, Ctx(train=True, rng=jax.random.PRNGKey(0)))
+    arr = np.asarray(y_tr)
+    assert ((arr == 0) | (np.isclose(arr, 2.0))).all()
+    assert 0.3 < (arr == 0).mean() < 0.7
+
+
+# ------------------------------------------------------------ grad checks
+
+@pytest.mark.parametrize("layer,shape", [
+    (DenseLayer(n_in=5, n_out=4, activation="tanh"), (5,)),
+    (ConvolutionLayer(n_out=3, kernel_size=(3, 3), convolution_mode="same",
+                      activation="sigmoid"), (5, 5, 2)),
+    (LSTM(n_in=4, n_out=3), (5, 4)),
+    (GravesLSTM(n_in=4, n_out=3), (5, 4)),
+    (GRU(n_in=4, n_out=3), (5, 4)),
+    (SimpleRnn(n_in=4, n_out=3), (5, 4)),
+    (LayerNormalization(), (6,)),
+    (SelfAttentionLayer(n_in=6, n_out=6, n_heads=2), (4, 6)),
+    (PReLULayer(alpha_init=0.1), (6,)),
+])
+def test_gradient_check(layer, shape):
+    central_diff_grad_check(layer, shape)

@@ -1,0 +1,141 @@
+"""Loss + evaluation metrics vs hand-computed oracles (SURVEY.md §4)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.eval import (ROC, Evaluation, EvaluationBinary,
+                                     RegressionEvaluation)
+from deeplearning4j_tpu.nn import activations, losses, weights
+
+
+def test_mcxent_matches_hand():
+    labels = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+    preds = jnp.asarray([[0.8, 0.2], [0.3, 0.7]])
+    want = -(math.log(0.8) + math.log(0.7)) / 2
+    got = float(losses.mcxent(labels, preds))
+    assert abs(got - want) < 1e-5
+
+
+def test_logits_variant_matches_probs_path():
+    logits = jnp.asarray([[2.0, -1.0, 0.5], [0.1, 0.2, -0.3]])
+    labels = jnp.asarray([[1.0, 0, 0], [0, 1.0, 0]])
+    a = float(losses.softmax_cross_entropy_with_logits(labels, logits))
+    b = float(losses.mcxent(labels, jnp.asarray(jnp.exp(logits) / jnp.sum(jnp.exp(logits), -1, keepdims=True))))
+    assert abs(a - b) < 1e-5
+
+
+def test_binary_xent_and_mse():
+    labels = jnp.asarray([[1.0], [0.0]])
+    preds = jnp.asarray([[0.9], [0.2]])
+    want = -(math.log(0.9) + math.log(0.8)) / 2
+    assert abs(float(losses.binary_xent(labels, preds)) - want) < 1e-5
+    assert abs(float(losses.mse(labels, preds)) - ((0.1 ** 2 + 0.2 ** 2) / 2)) < 1e-6
+
+
+def test_masked_loss():
+    labels = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]])
+    preds = jnp.asarray([[0.8, 0.2], [0.3, 0.7], [0.5, 0.5]])
+    mask = jnp.asarray([1.0, 1.0, 0.0])
+    want = -(math.log(0.8) + math.log(0.7)) / 2  # third example masked out
+    assert abs(float(losses.mcxent(labels, preds, mask=mask)) - want) < 1e-5
+
+
+def test_hinge_kld_poisson_cosine():
+    y = jnp.asarray([[1.0, -1.0]])
+    p = jnp.asarray([[0.5, 0.5]])
+    assert abs(float(losses.hinge(y, p)) - (0.5 + 1.5)) < 1e-5
+    lab = jnp.asarray([[0.5, 0.5]])
+    pred = jnp.asarray([[0.25, 0.75]])
+    want = 0.5 * math.log(2.0) + 0.5 * math.log(0.5 / 0.75)
+    assert abs(float(losses.kl_divergence(lab, pred)) - want) < 1e-5
+    lam = jnp.asarray([[2.0]])
+    cnt = jnp.asarray([[3.0]])
+    assert abs(float(losses.poisson(cnt, lam)) - (2.0 - 3.0 * math.log(2.0))) < 1e-5
+    a = jnp.asarray([[1.0, 0.0]])
+    assert abs(float(losses.cosine_proximity(a, a)) - (-1.0)) < 1e-5
+
+
+def test_activation_registry():
+    x = jnp.asarray([-2.0, 0.0, 2.0])
+    assert np.asarray(activations.get("relu")(x)).tolist() == [0.0, 0.0, 2.0]
+    np.testing.assert_allclose(np.asarray(activations.get("hardtanh")(x)), [-1, 0, 1])
+    assert len(activations.names()) >= 21
+    got = np.asarray(activations.get("cube")(x))
+    np.testing.assert_allclose(got, [-8, 0, 8])
+
+
+def test_weight_init_stats():
+    import jax
+    k = jax.random.PRNGKey(0)
+    w = weights.get("xavier")(k, (200, 300), 200, 300, jnp.float32)
+    std = float(np.asarray(w).std())
+    assert abs(std - math.sqrt(2.0 / 500)) < 0.01
+    he = weights.get("relu")(k, (200, 300), 200, 300, jnp.float32)
+    assert abs(float(np.asarray(he).std()) - math.sqrt(2.0 / 200)) < 0.01
+    q = weights.get("orthogonal")(k, (64, 64), 64, 64, jnp.float32)
+    np.testing.assert_allclose(np.asarray(q @ q.T), np.eye(64), atol=1e-4)
+
+
+def test_evaluation_metrics_hand():
+    ev = Evaluation()
+    labels = np.array([[1, 0], [1, 0], [0, 1], [0, 1]], np.float32)
+    preds = np.array([[0.9, 0.1], [0.4, 0.6], [0.2, 0.8], [0.7, 0.3]], np.float32)
+    ev.eval(labels, preds)
+    # confusion: class0: 1 right 1 wrong; class1: 1 right 1 wrong
+    assert ev.accuracy() == 0.5
+    assert abs(ev.precision(0) - 0.5) < 1e-9
+    assert abs(ev.recall(0) - 0.5) < 1e-9
+    assert abs(ev.f1(0) - 0.5) < 1e-9
+    m = ev.confusion
+    assert m[0, 0] == 1 and m[0, 1] == 1 and m[1, 0] == 1 and m[1, 1] == 1
+    # merging two evaluations == evaluating all at once
+    e1, e2, eall = Evaluation(), Evaluation(), Evaluation()
+    e1.eval(labels[:2], preds[:2])
+    e2.eval(labels[2:], preds[2:])
+    eall.eval(labels, preds)
+    e1.merge(e2)
+    assert (e1.confusion == eall.confusion).all()
+
+
+def test_topn_accuracy():
+    ev = Evaluation(top_n=2)
+    labels = np.array([[0, 1, 0], [1, 0, 0]], np.float32)
+    preds = np.array([[0.5, 0.4, 0.1], [0.3, 0.5, 0.2]], np.float32)
+    ev.eval(labels, preds)
+    assert ev.accuracy() == 0.0
+    assert ev.top_n_accuracy() == 1.0
+
+
+def test_regression_eval():
+    ev = RegressionEvaluation()
+    y = np.array([[1.0], [2.0], [3.0]])
+    p = np.array([[1.1], [1.9], [3.2]])
+    ev.eval(y, p)
+    want_mse = np.mean((p - y) ** 2)
+    assert abs(ev.mean_squared_error(0) - want_mse) < 1e-9
+    assert abs(ev.mean_absolute_error(0) - np.mean(np.abs(p - y))) < 1e-9
+    assert ev.pearson_correlation(0) > 0.99
+    assert 0.9 < ev.r_squared(0) <= 1.0
+
+
+def test_roc_auc():
+    roc = ROC()
+    labels = np.array([0, 0, 1, 1])
+    scores = np.array([0.1, 0.4, 0.35, 0.8])
+    roc.eval(labels, scores[:, None])
+    assert abs(roc.calculate_auc() - 0.75) < 1e-6
+    # histogram mode approximates
+    roc_h = ROC(threshold_steps=100)
+    roc_h.eval(labels, scores[:, None])
+    assert abs(roc_h.calculate_auc() - 0.75) < 0.05
+
+
+def test_evaluation_binary():
+    ev = EvaluationBinary()
+    labels = np.array([[1, 0], [1, 1], [0, 1]], np.float32)
+    preds = np.array([[0.9, 0.2], [0.3, 0.8], [0.1, 0.6]], np.float32)
+    ev.eval(labels, preds)
+    assert abs(ev.recall(0) - 0.5) < 1e-9  # out0: tp=1 fn=1
+    assert abs(ev.precision(1) - 1.0) < 1e-9

@@ -1,0 +1,129 @@
+"""Tensor-layer op semantics vs the numpy oracle (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nd
+
+
+def test_creation():
+    assert nd.zeros(3, 4).shape == (3, 4)
+    assert nd.ones((2, 5)).shape == (2, 5)
+    np.testing.assert_allclose(np.asarray(nd.full((2, 2), 7.0)), np.full((2, 2), 7.0))
+    np.testing.assert_allclose(np.asarray(nd.eye(3)), np.eye(3))
+    np.testing.assert_allclose(np.asarray(nd.arange(5)), np.arange(5))
+    np.testing.assert_allclose(np.asarray(nd.linspace(0, 1, 5)), np.linspace(0, 1, 5))
+    v = nd.value_array_of((3,), 2.5)
+    np.testing.assert_allclose(np.asarray(v), [2.5, 2.5, 2.5])
+
+
+def test_mmul_and_reductions():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 5)).astype(np.float32)
+    b = rng.standard_normal((5, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(nd.mmul(a, b)), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(float(nd.norm1(a)), np.abs(a).sum(), rtol=1e-5)
+    np.testing.assert_allclose(float(nd.norm2(a)), np.linalg.norm(a), rtol=1e-5)
+    np.testing.assert_allclose(float(nd.normmax(a)), np.abs(a).max(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(nd.mean(a, axis=0)), a.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(nd.std(a, axis=1)), a.std(1), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(nd.argmax(a, axis=1)), a.argmax(1))
+    np.testing.assert_allclose(np.asarray(nd.cumsum(a, axis=0)), a.cumsum(0), rtol=1e-5)
+
+
+def test_tensor_mmul():
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((2, 3, 4)).astype(np.float32)
+    b = rng.standard_normal((4, 3, 5)).astype(np.float32)
+    got = np.asarray(nd.tensor_mmul(a, b, axes=([1, 2], [1, 0])))
+    want = np.tensordot(a, b, axes=([1, 2], [1, 0]))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_shape_ops():
+    a = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    assert nd.permute(a, 2, 0, 1).shape == (4, 2, 3)
+    assert nd.reshape(a, 6, 4).shape == (6, 4)
+    assert nd.expand_dims(a, 0).shape == (1, 2, 3, 4)
+    parts = nd.split(a, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    st = nd.stack([a, a], axis=0)
+    assert st.shape == (2, 2, 3, 4)
+    us = nd.unstack(st, axis=0)
+    assert len(us) == 2 and us[0].shape == (2, 3, 4)
+    np.testing.assert_allclose(np.asarray(nd.flip(a, 1)), np.flip(a, 1))
+    assert nd.tile(a, (1, 2, 1)).shape == (2, 6, 4)
+
+
+def test_indexing():
+    from deeplearning4j_tpu.ndarray import indexing as ix
+    a = np.arange(20).reshape(4, 5).astype(np.float32)
+    got = ix.get(a, ix.interval(1, 3), ix.all())
+    np.testing.assert_allclose(np.asarray(got), a[1:3, :])
+    got = ix.get(a, ix.point(2), ix.interval(0, 4, 2))
+    np.testing.assert_allclose(np.asarray(got), a[2, 0:4:2])
+    put = ix.put(a, ix.point(0), ix.all(), 9.0)
+    assert float(np.asarray(put)[0, 0]) == 9.0
+    # boolean indexing
+    rep = ix.replace_where(a, 0.0, a > 10)
+    assert np.asarray(rep).max() == 10.0
+    assert int(ix.first_index(a > 10)) == 11
+    assert int(ix.last_index(a > 10)) == 19
+    assert int(ix.first_index(a > 1000)) == -1
+
+
+def test_random_explicit_keys():
+    from deeplearning4j_tpu.ndarray import random as rnd
+    k = rnd.key(42)
+    u = rnd.uniform(k, (1000,))
+    assert 0.0 <= float(np.asarray(u).min()) and float(np.asarray(u).max()) <= 1.0
+    n = rnd.normal(k, (10000,), std=2.0)
+    assert abs(float(np.asarray(n).std()) - 2.0) < 0.1
+    # stateful facade reproducibility
+    rnd.set_seed(7)
+    a = np.asarray(rnd.randn(5))
+    rnd.set_seed(7)
+    b = np.asarray(rnd.randn(5))
+    np.testing.assert_allclose(a, b)
+
+
+def test_linalg():
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((4, 4)).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    c = np.asarray(nd.linalg.cholesky(spd))
+    np.testing.assert_allclose(c @ c.T, spd, rtol=1e-3, atol=1e-3)
+    x = np.asarray(nd.linalg.solve(spd, np.ones(4, np.float32)))
+    np.testing.assert_allclose(spd @ x, np.ones(4), rtol=1e-3, atol=1e-3)
+
+
+def test_sort_topk_onehot():
+    a = np.array([3.0, 1.0, 2.0], np.float32)
+    np.testing.assert_allclose(np.asarray(nd.sort(a)), [1, 2, 3])
+    np.testing.assert_allclose(np.asarray(nd.sort(a, descending=True)), [3, 2, 1])
+    v, i = nd.top_k(a, 2)
+    np.testing.assert_allclose(np.asarray(v), [3, 2])
+    oh = np.asarray(nd.one_hot(np.array([0, 2]), 3))
+    np.testing.assert_allclose(oh, [[1, 0, 0], [0, 0, 1]])
+
+
+def test_im2col_col2im_roundtrip():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 6, 6, 3)).astype(np.float32)
+    cols = nd.im2col(x, (2, 2), stride=(2, 2))
+    assert cols.shape == (2, 3, 3, 12)
+    back = nd.col2im(np.asarray(cols), x.shape, (2, 2), stride=(2, 2))
+    np.testing.assert_allclose(np.asarray(back), x, rtol=1e-5)
+
+
+def test_conv_pool_primitives():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((1, 8, 8, 2)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 2, 4)).astype(np.float32)
+    y = nd.conv2d(x, w, padding="SAME")
+    assert y.shape == (1, 8, 8, 4)
+    p = nd.max_pool2d(x, (2, 2))
+    assert p.shape == (1, 4, 4, 2)
+    ap = nd.avg_pool2d(x, (2, 2))
+    np.testing.assert_allclose(float(np.asarray(ap)[0, 0, 0, 0]),
+                               x[0, :2, :2, 0].mean(), rtol=1e-5)
