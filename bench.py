@@ -1,0 +1,76 @@
+"""Headline bench: ResNet-50 ImageNet fit() samples/sec/chip (BASELINE.json).
+
+Runs on the real TPU chip (axon). Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+vs_baseline divides by the DL4J V100 cuDNN reference (360 img/s — see
+BASELINE.md). Synthetic ImageNet-shaped data (zero-egress sandbox); bf16
+NHWC convs (MXU accumulates in f32 on TPU); steady-state timing excludes
+compile.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_SAMPLES_PER_SEC = 360.0  # DL4J ResNet-50 V100 cuDNN (BASELINE.md)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+    from deeplearning4j_tpu.zoo.resnet import ResNet50
+    net = ResNet50(num_classes=1000, compute_dtype=jnp.bfloat16).init()
+
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(net.params)
+
+    def train_step(params, states, opt_state, x, y):
+        def loss_fn(p, s):
+            acts, pre, new_s = net._forward(p, s, {"in": x}, train=True, rng=None,
+                                            stop_at_output_preact=True)
+            out_layer = net.conf.nodes["out"].op
+            loss = out_layer.compute_loss(p["out"], pre["out"], y)
+            return loss, new_s
+
+        (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, states)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_states, opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((batch, 224, 224, 3), np.float32), jnp.bfloat16)
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, batch)])
+
+    params, states, ostate = net.params, net.states, opt_state
+    # warmup / compile
+    params, states, ostate, loss = step(params, states, ostate, x, y)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, states, ostate, loss = step(params, states, ostate, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    sps = batch * steps / dt
+    print(json.dumps({
+        "metric": "MultiLayerNetwork.fit() samples/sec/chip (ResNet-50 ImageNet)",
+        "value": round(sps, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

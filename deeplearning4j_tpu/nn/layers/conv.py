@@ -7,8 +7,9 @@ Subsampling3DLayer, Upsampling1D/2D/3D, ZeroPaddingLayer, Cropping2D,
 SpaceToDepthLayer, DepthToSpace, LocallyConnected1D/2D}``.
 
 The reference dispatches these to cuDNN kernels (libnd4j ConvolutionUtils);
-here XLA lowers them onto the MXU directly, with bf16 inputs and f32
-accumulation (`preferred_element_type`). Layout is NHWC / HWIO — the TPU
+here XLA lowers them onto the MXU directly with bf16 inputs (the MXU
+accumulates products in f32 internally on TPU; on non-TPU backends bf16
+convs accumulate at native precision). Layout is NHWC / HWIO — the TPU
 native layout — instead of the reference's NCHW.
 """
 
@@ -40,10 +41,6 @@ def _padding(pad, kernel, mode):
         return "SAME"
     pads = _pair(pad) if len(kernel) == 2 else _triple(pad)
     return tuple((p, p) for p in pads)
-
-
-def _acc_dtype(x):
-    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
 
 
 @dataclass
@@ -95,8 +92,7 @@ class ConvolutionLayer(Layer):
             padding=_padding(self.padding, _pair(self.kernel_size), self.convolution_mode),
             rhs_dilation=_pair(self.dilation),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=self.groups,
-            preferred_element_type=_acc_dtype(x))
+            feature_group_count=self.groups)
         y = y.astype(x.dtype)
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
@@ -146,8 +142,7 @@ class Convolution1DLayer(Layer):
         y = lax.conv_general_dilated(
             x, w, window_strides=(self.stride,), padding=pad,
             rhs_dilation=(self.dilation,),
-            dimension_numbers=("NTC", "TIO", "NTC"),
-            preferred_element_type=_acc_dtype(x))
+            dimension_numbers=("NTC", "TIO", "NTC"))
         y = y.astype(x.dtype)
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
@@ -195,8 +190,7 @@ class Convolution3DLayer(Layer):
             x, w, window_strides=_triple(self.stride),
             padding=_padding(self.padding, _triple(self.kernel_size), self.convolution_mode),
             rhs_dilation=_triple(self.dilation),
-            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-            preferred_element_type=_acc_dtype(x))
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
         y = y.astype(x.dtype)
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
@@ -235,8 +229,7 @@ class Deconvolution2D(ConvolutionLayer):
             pad = ((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw))
         y = lax.conv_transpose(
             x, w, strides=_pair(self.stride), padding=pad,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=_acc_dtype(x))
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
         y = y.astype(x.dtype)
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
@@ -279,8 +272,7 @@ class DepthwiseConvolution2D(Layer):
             x, w, window_strides=_pair(self.stride),
             padding=_padding(self.padding, _pair(self.kernel_size), self.convolution_mode),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=c,
-            preferred_element_type=_acc_dtype(x))
+            feature_group_count=c)
         y = y.astype(x.dtype)
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
@@ -328,12 +320,10 @@ class SeparableConvolution2D(Layer):
         y = lax.conv_general_dilated(
             x, params["dW"].astype(x.dtype), window_strides=_pair(self.stride),
             padding=_padding(self.padding, _pair(self.kernel_size), self.convolution_mode),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c,
-            preferred_element_type=_acc_dtype(x)).astype(x.dtype)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c).astype(x.dtype)
         y = lax.conv_general_dilated(
             y, params["pW"].astype(x.dtype), window_strides=(1, 1), padding="VALID",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=_acc_dtype(x)).astype(x.dtype)
+            dimension_numbers=("NHWC", "HWIO", "NHWC")).astype(x.dtype)
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
         return self.activation_fn()(y), state

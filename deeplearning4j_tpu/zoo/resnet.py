@@ -1,8 +1,8 @@
 """ResNet-50 — the headline benchmark model (BASELINE.json config 2).
 
 Reference parity: ``org.deeplearning4j.zoo.model.ResNet50`` (ImageNet
-ComputationGraph; cuDNN conv path). TPU-first build: NHWC bf16 convs with
-f32 accumulation on the MXU, fused BN+ReLU (XLA fuses the elementwise chain
+ComputationGraph; cuDNN conv path). TPU-first build: NHWC bf16 convs on
+the MXU (f32 internal accumulation), fused BN+ReLU (XLA fuses the elementwise chain
 into the conv epilogue), identity/projection bottleneck blocks as graph
 vertices. The same topology is also exposed as a pure-functional
 ``resnet50_fn`` for bench/parallel use (single jaxpr, scan-free).

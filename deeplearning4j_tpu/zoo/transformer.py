@@ -1,0 +1,361 @@
+"""Transformer-LM — flagship model for distributed training (tp/sp/ep/dp).
+
+Reference counterpart: DL4J's transformer story is BERT via SameDiff TF
+import (attention assembled from SameDiff ops, run per-op on cuDNN). The
+TPU-native redesign is a pure-functional GPT-style LM engineered for SPMD:
+
+- params for all L blocks are STACKED (leading L axis) and the blocks run
+  under ``lax.scan`` — one compile of one block instead of L inlined copies
+  (compile time O(1) in depth; XLA still pipelines the unrolled loop).
+- Megatron-style tensor parallel: qkv/mlp-in weights column-sharded over
+  'tp', out-proj/mlp-out row-sharded; XLA inserts the two psums per block.
+- Sequence parallel: activations sharded over 'sp' on the time axis; the
+  attention inner either all-gathers k/v (XLA default) or runs the ring
+  kernel (`parallel/ring_attention.py`) when `use_ring_attention`.
+- Expert parallel: optional MoE MLP (top-k router, capacity factor,
+  einsum dispatch) with experts sharded over 'ep'.
+- bf16 activations/f32 params & optimizer; `jax.checkpoint` on each block
+  (remat) so long sequences fit HBM.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 8
+    d_ff: int = 2048
+    max_seq: int = 1024
+    n_experts: int = 0          # 0 → dense MLP
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16   # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    use_ring_attention: bool = False
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------- params
+
+def init_params(key, cfg: TransformerConfig):
+    """Stacked-block params. Names are stable for checkpoints/sharding."""
+    k = jax.random.split(key, 12)
+    d, f, h, L = cfg.d_model, cfg.d_ff, cfg.n_heads * cfg.head_dim, cfg.n_layers
+    pd = cfg.param_dtype
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, pd) / math.sqrt(fan_in))
+
+    params = {
+        "embed": norm(k[0], (cfg.vocab_size, d), d),  # scaled-init embedding
+        "pos_embed": 0.02 * jax.random.normal(k[1], (cfg.max_seq, d), pd),
+        "blocks": {
+            "ln1": jnp.ones((L, d), pd),
+            "wqkv": norm(k[2], (L, d, 3 * h), d),
+            "wo": norm(k[3], (L, h, d), h),
+            "ln2": jnp.ones((L, d), pd),
+        },
+        "ln_f": jnp.ones((d,), pd),
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        params["blocks"]["router"] = norm(k[4], (L, d, E), d)
+        params["blocks"]["we_in"] = norm(k[5], (L, E, d, f), d)
+        params["blocks"]["we_out"] = norm(k[6], (L, E, f, d), f)
+    else:
+        params["blocks"]["w_in"] = norm(k[7], (L, d, f), d)
+        params["blocks"]["w_out"] = norm(k[8], (L, f, d), f)
+    if not cfg.tie_embeddings:
+        params["head"] = norm(k[9], (d, cfg.vocab_size), d)
+    return params
+
+
+def param_pspecs(cfg: TransformerConfig):
+    """PartitionSpecs per param (tp/ep sharding; fsdp composes on top)."""
+    specs = {
+        "embed": P("tp", None),          # vocab-sharded embedding
+        "pos_embed": P(),
+        "blocks": {
+            "ln1": P(),
+            "wqkv": P(None, None, "tp"),   # column parallel
+            "wo": P(None, "tp", None),     # row parallel
+            "ln2": P(),
+        },
+        "ln_f": P(),
+    }
+    if cfg.n_experts:
+        specs["blocks"]["router"] = P()
+        specs["blocks"]["we_in"] = P(None, "ep", None, "tp")
+        specs["blocks"]["we_out"] = P(None, "ep", "tp", None)
+    else:
+        specs["blocks"]["w_in"] = P(None, None, "tp")
+        specs["blocks"]["w_out"] = P(None, "tp", None)
+    if not cfg.tie_embeddings:
+        specs["head"] = P(None, "tp")
+    return specs
+
+
+def shardings_for(mesh: Mesh, cfg: TransformerConfig, params_like=None):
+    specs = param_pspecs(cfg)
+
+    def to_sh(spec):
+        spec = P(*(a if (a is None or a in mesh.axis_names) else None
+                   for a in spec))
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map(to_sh, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+# ---------------------------------------------------------------- forward
+
+def _constrain(x, *spec):
+    """with_sharding_constraint that silently no-ops outside jit/mesh."""
+    try:
+        return lax.with_sharding_constraint(x, P(*spec))
+    except (ValueError, RuntimeError):
+        return x
+
+
+def _attention(cfg, q, k, v, mask_bias=None):
+    b, t = q.shape[0], q.shape[1]
+    q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
+    if cfg.use_ring_attention:
+        from ..parallel.ring_attention import ring_attention_inner
+        out = ring_attention_inner(q, k, v, causal=True)
+    else:
+        out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    return out.reshape(b, t, cfg.n_heads * cfg.head_dim)
+
+
+def _rmsnorm(x, scale, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(ms + eps) * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _dense_mlp(cfg, x, w_in, w_out):
+    h = jnp.einsum("btd,df->btf", x, w_in.astype(x.dtype))
+    h = _constrain(h, "dp", "sp", "tp")
+    h = jax.nn.gelu(h)
+    o = jnp.einsum("btf,fd->btd", h, w_out.astype(x.dtype))
+    return o
+
+
+def _moe_mlp(cfg, x, router, we_in, we_out):
+    """Top-k routed MoE with capacity; einsum dispatch (expert axis 'ep').
+
+    Dispatch/combine are one-hot einsums — dense matmuls the MXU likes —
+    with all_to_all inserted by XLA from the sharding constraints.
+    """
+    b, t, d = x.shape
+    E = cfg.n_experts
+    tokens = x.reshape(b * t, d)
+    logits = jnp.einsum("nd,de->ne", tokens.astype(jnp.float32),
+                        router.astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)
+    topv, topi = lax.top_k(gates, cfg.expert_top_k)             # (N, K)
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    cap = max(1, int(cfg.capacity_factor * (b * t) * cfg.expert_top_k / E))
+    # position of each token within its expert's buffer
+    onehot = jax.nn.one_hot(topi, E, dtype=jnp.float32)          # (N, K, E)
+    pos = jnp.cumsum(onehot.reshape(-1, E), axis=0).reshape(b * t, -1, E) - 1.0
+    keep = (pos < cap) & (onehot > 0)
+    disp = (onehot * keep).astype(x.dtype)                       # (N, K, E)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=x.dtype) * disp[..., None]
+    # dispatch: (N,K,E,C) x (N,d) → (E,C,d)
+    expert_in = jnp.einsum("nkec,nd->ecd", pos_oh, tokens)
+    expert_in = _constrain(expert_in, "ep", None, None)
+    h = jnp.einsum("ecd,edf->ecf", expert_in, we_in.astype(x.dtype))
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, we_out.astype(x.dtype))
+    expert_out = _constrain(expert_out, "ep", None, None)
+    combine = (pos_oh * topv[:, :, None, None].astype(x.dtype))
+    out = jnp.einsum("nkec,ecd->nd", combine, expert_out)
+    # aux load-balancing loss (Switch-style)
+    density = onehot.reshape(-1, E).mean(0)
+    density_proxy = gates.mean(0)
+    aux = E * jnp.sum(density * density_proxy)
+    return out.reshape(b, t, d), aux.astype(jnp.float32)
+
+
+def embed(params, cfg: TransformerConfig, ids):
+    """ids (B,T) → embedded activations (B,T,d) in compute dtype."""
+    t = ids.shape[1]
+    x = jnp.take(params["embed"], ids, axis=0).astype(cfg.dtype)
+    x = x * math.sqrt(cfg.d_model)
+    x = x + params["pos_embed"][:t].astype(cfg.dtype)
+    return _constrain(x, "dp", "sp", None)
+
+
+def head_logits(params, cfg: TransformerConfig, x):
+    """Final norm + LM head → f32 logits."""
+    x = _rmsnorm(x, params["ln_f"])
+    head = params.get("head", params["embed"].T if cfg.tie_embeddings else None)
+    logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype))
+    return _constrain(logits, "dp", "sp", "tp").astype(jnp.float32)
+
+
+def apply_blocks(blocks, cfg: TransformerConfig, x):
+    """Scan the stacked transformer blocks over x. Returns (x, aux_sum)."""
+
+    def block(x, blk):
+        h = _rmsnorm(x, blk["ln1"])
+        qkv = jnp.einsum("btd,dz->btz", h, blk["wqkv"].astype(h.dtype))
+        qkv = _constrain(qkv, "dp", "sp", "tp")
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        a = _attention(cfg, q, k, v)
+        a = jnp.einsum("bth,hd->btd", a, blk["wo"].astype(h.dtype))
+        x = x + _constrain(a, "dp", "sp", None)
+        h2 = _rmsnorm(x, blk["ln2"])
+        if cfg.n_experts:
+            m, aux = _moe_mlp(cfg, h2, blk["router"], blk["we_in"], blk["we_out"])
+        else:
+            m, aux = _dense_mlp(cfg, h2, blk["w_in"], blk["w_out"]), 0.0
+        x = x + _constrain(m, "dp", "sp", None)
+        return x, aux
+
+    blk_fn = jax.checkpoint(block) if cfg.remat else block
+
+    def scan_body(carry, blk):
+        x = carry
+        x, aux = blk_fn(x, blk)
+        return x, aux
+
+    x, auxes = lax.scan(scan_body, x, blocks)
+    return x, jnp.sum(auxes)
+
+
+def forward(params, cfg: TransformerConfig, ids, *, train=False, rng=None):
+    """ids (B, T) int32 → logits (B, T, vocab). Returns (logits, aux_loss)."""
+    x = embed(params, cfg, ids)
+    x, aux = apply_blocks(params["blocks"], cfg, x)
+    return head_logits(params, cfg, x), aux
+
+
+def lm_loss(params, cfg: TransformerConfig, ids, targets, *, aux_weight=1e-2):
+    logits, aux = forward(params, cfg, ids, train=True)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), -1)[..., 0]
+    return nll.mean() + aux_weight * aux
+
+
+def make_train_step(cfg: TransformerConfig, optimizer):
+    """One jitted step: grads → optax update → new params. Shard via the
+    caller's jit(in_shardings=...) or run as-is on one device."""
+
+    def step(params, opt_state, ids, targets):
+        loss, grads = jax.value_and_grad(lm_loss)(params, cfg, ids, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax as _optax
+        params = _optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+# ------------------------------------------------------------- BERT family
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_seq: int = 512
+    type_vocab: int = 2
+    num_labels: int = 2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+def bert_init(key, cfg: BertConfig):
+    """BERT-base encoder (reference: SameDiff TF-import BERT path —
+    BASELINE.json config 4). Bidirectional attention, learned positions,
+    pooler + classification head for fine-tune."""
+    k = jax.random.split(key, 8)
+    d, f, h, L = cfg.d_model, cfg.d_ff, cfg.d_model, cfg.n_layers
+
+    def norm(key, shape, fan_in):
+        return jax.random.normal(key, shape, cfg.param_dtype) / math.sqrt(fan_in)
+
+    return {
+        "embed": norm(k[0], (cfg.vocab_size, d), d),
+        "pos_embed": 0.02 * jax.random.normal(k[1], (cfg.max_seq, d), cfg.param_dtype),
+        "type_embed": 0.02 * jax.random.normal(k[2], (cfg.type_vocab, d), cfg.param_dtype),
+        "blocks": {
+            "ln1": jnp.ones((L, d), cfg.param_dtype),
+            "wqkv": norm(k[3], (L, d, 3 * h), d),
+            "wo": norm(k[4], (L, h, d), h),
+            "ln2": jnp.ones((L, d), cfg.param_dtype),
+            "w_in": norm(k[5], (L, d, f), d),
+            "w_out": norm(k[6], (L, f, d), f),
+        },
+        "pooler": norm(k[7], (d, d), d),
+        "cls": jnp.zeros((d, cfg.num_labels), cfg.param_dtype),
+    }
+
+
+def bert_forward(params, cfg: BertConfig, ids, type_ids=None, attn_mask=None):
+    b, t = ids.shape
+    x = jnp.take(params["embed"], ids, axis=0).astype(cfg.dtype)
+    x = x + params["pos_embed"][:t].astype(cfg.dtype)
+    if type_ids is not None:
+        x = x + jnp.take(params["type_embed"], type_ids, axis=0).astype(cfg.dtype)
+    nh = cfg.n_heads
+    hd = cfg.d_model // nh
+    bias = None
+    if attn_mask is not None:
+        bias = jnp.where(attn_mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+
+    def block(x, blk):
+        h = _rmsnorm(x, blk["ln1"])
+        qkv = jnp.einsum("btd,dz->btz", h, blk["wqkv"].astype(h.dtype))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, nh, hd)
+        k = k.reshape(b, t, nh, hd)
+        v = v.reshape(b, t, nh, hd)
+        kw = {}
+        if bias is not None:
+            kw["bias"] = jnp.broadcast_to(bias, (b, nh, t, t))
+        a = jax.nn.dot_product_attention(q, k, v, **kw).reshape(b, t, nh * hd)
+        x = x + jnp.einsum("bth,hd->btd", a, blk["wo"].astype(h.dtype))
+        h2 = _rmsnorm(x, blk["ln2"])
+        m = jnp.einsum("btf,fd->btd",
+                       jax.nn.gelu(jnp.einsum("btd,df->btf", h2,
+                                              blk["w_in"].astype(h2.dtype))),
+                       blk["w_out"].astype(h2.dtype))
+        return x + m, 0.0
+
+    x, _ = lax.scan(block, x, params["blocks"])
+    pooled = jnp.tanh(x[:, 0] @ params["pooler"].astype(x.dtype))
+    logits = pooled @ params["cls"].astype(x.dtype)
+    return logits.astype(jnp.float32), x
+
+
+def bert_classifier_loss(params, cfg: BertConfig, ids, labels, type_ids=None,
+                         attn_mask=None):
+    logits, _ = bert_forward(params, cfg, ids, type_ids, attn_mask)
+    logp = jax.nn.log_softmax(logits, -1)
+    return -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), -1).mean()
