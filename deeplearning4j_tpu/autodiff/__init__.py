@@ -1,0 +1,3 @@
+"""deeplearning4j_tpu.autodiff — SameDiff graph API (whole-graph XLA)."""
+
+from .samediff import SameDiff, SDVariable, TrainingConfig

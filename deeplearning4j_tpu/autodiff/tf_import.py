@@ -1,0 +1,306 @@
+"""TF frozen-GraphDef import → SameDiff graph (the reference's BERT path).
+
+Reference parity: ``org.nd4j.imports.graphmapper.tf.TFGraphMapper`` /
+``samediff-import-tensorflow`` — DL4J runs BERT by importing a frozen TF
+graph into SameDiff. Here the GraphDef is parsed (tensorflow is baked into
+the image; only the proto reader is used — no TF execution) and mapped onto
+our SameDiff, which then jits the WHOLE graph through XLA instead of the
+reference's per-op interpreter.
+
+Supported op subset covers transformer/BERT-style graphs: matmul/bias/
+elementwise chains, reshapes/transposes, softmax, layer-norm primitive
+chains, gather (embeddings), batched matmul, one_hot, reductions, and the
+shape plumbing ops. Unknown ops raise with the op name so coverage gaps are
+loud, not silent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .samediff import SameDiff, SDVariable
+
+
+def _tensor_to_np(tensor_proto):
+    from tensorflow.python.framework import tensor_util
+    return tensor_util.MakeNdarray(tensor_proto)
+
+
+def _axes(v):
+    return tuple(int(a) for a in np.asarray(v).ravel())
+
+
+class TFImporter:
+    def __init__(self):
+        self.handlers = {
+            "Const": None, "Placeholder": None, "Identity": self._identity,
+            "IdentityN": self._identity, "NoOp": None,
+            "MatMul": self._matmul, "BatchMatMul": self._batch_matmul,
+            "BatchMatMulV2": self._batch_matmul,
+            "BiasAdd": lambda i, n: i[0] + i[1],
+            "Add": lambda i, n: i[0] + i[1], "AddV2": lambda i, n: i[0] + i[1],
+            "AddN": lambda i, n: sum(i),
+            "Sub": lambda i, n: i[0] - i[1], "Mul": lambda i, n: i[0] * i[1],
+            "RealDiv": lambda i, n: i[0] / i[1], "Div": lambda i, n: i[0] / i[1],
+            "Maximum": lambda i, n: jnp.maximum(i[0], i[1]),
+            "Minimum": lambda i, n: jnp.minimum(i[0], i[1]),
+            "Pow": lambda i, n: jnp.power(i[0], i[1]),
+            "SquaredDifference": lambda i, n: jnp.square(i[0] - i[1]),
+            "Square": lambda i, n: jnp.square(i[0]),
+            "Sqrt": lambda i, n: jnp.sqrt(i[0]),
+            "Rsqrt": lambda i, n: lax.rsqrt(i[0]),
+            "Exp": lambda i, n: jnp.exp(i[0]), "Log": lambda i, n: jnp.log(i[0]),
+            "Neg": lambda i, n: -i[0], "Abs": lambda i, n: jnp.abs(i[0]),
+            "Tanh": lambda i, n: jnp.tanh(i[0]),
+            "Sigmoid": lambda i, n: jax.nn.sigmoid(i[0]),
+            "Relu": lambda i, n: jax.nn.relu(i[0]),
+            "Relu6": lambda i, n: jax.nn.relu6(i[0]),
+            "Elu": lambda i, n: jax.nn.elu(i[0]),
+            "Selu": lambda i, n: jax.nn.selu(i[0]),
+            "Softplus": lambda i, n: jax.nn.softplus(i[0]),
+            "Erf": lambda i, n: jax.scipy.special.erf(i[0]),
+            "Softmax": lambda i, n: jax.nn.softmax(i[0], axis=-1),
+            "LogSoftmax": lambda i, n: jax.nn.log_softmax(i[0], axis=-1),
+            "Reshape": lambda i, n: jnp.reshape(i[0], _axes(i[1])),
+            "Transpose": lambda i, n: jnp.transpose(i[0], _axes(i[1])),
+            "ExpandDims": lambda i, n: jnp.expand_dims(i[0], int(np.asarray(i[1]))),
+            "Squeeze": self._squeeze,
+            "ConcatV2": lambda i, n: jnp.concatenate(i[:-1], axis=int(np.asarray(i[-1]))),
+            "Pack": self._pack, "Unpack": self._unpack,
+            "Split": self._split, "SplitV": self._splitv,
+            "StridedSlice": self._strided_slice,
+            "Slice": self._slice,
+            "GatherV2": self._gather, "Gather": self._gather,
+            "OneHot": self._one_hot,
+            "Cast": self._cast,
+            "Mean": self._mean, "Sum": self._sum, "Max": self._rmax,
+            "Min": self._rmin, "Prod": self._prod,
+            "ArgMax": lambda i, n: jnp.argmax(i[0], axis=int(np.asarray(i[1]))),
+            "Shape": lambda i, n: jnp.asarray(i[0].shape, jnp.int32),
+            "Rank": lambda i, n: jnp.asarray(np.ndim(i[0]), jnp.int32),
+            "Fill": lambda i, n: jnp.full(_axes(i[0]), i[1]),
+            "ZerosLike": lambda i, n: jnp.zeros_like(i[0]),
+            "OnesLike": lambda i, n: jnp.ones_like(i[0]),
+            "Tile": lambda i, n: jnp.tile(i[0], _axes(i[1])),
+            "StopGradient": lambda i, n: lax.stop_gradient(i[0]),
+            "Rsub": lambda i, n: i[1] - i[0],
+            "FusedBatchNorm": self._fused_bn, "FusedBatchNormV3": self._fused_bn,
+            "Conv2D": self._conv2d, "MaxPool": self._maxpool,
+            "AvgPool": self._avgpool,
+            "Greater": lambda i, n: jnp.greater(i[0], i[1]),
+            "GreaterEqual": lambda i, n: jnp.greater_equal(i[0], i[1]),
+            "Less": lambda i, n: jnp.less(i[0], i[1]),
+            "Equal": lambda i, n: jnp.equal(i[0], i[1]),
+            "NotEqual": lambda i, n: jnp.not_equal(i[0], i[1]),
+            "Select": lambda i, n: jnp.where(i[0], i[1], i[2]),
+            "SelectV2": lambda i, n: jnp.where(i[0], i[1], i[2]),
+            "Tanh_": lambda i, n: jnp.tanh(i[0]),
+        }
+
+    # --- handlers needing node attrs ---------------------------------------
+    def _identity(self, i, n):
+        return i[0]
+
+    def _matmul(self, i, n):
+        a, b = i[0], i[1]
+        if n.attr["transpose_a"].b:
+            a = a.T
+        if n.attr["transpose_b"].b:
+            b = b.T
+        return a @ b
+
+    def _batch_matmul(self, i, n):
+        a, b = i[0], i[1]
+        if n.attr["adj_x"].b:
+            a = jnp.swapaxes(a, -1, -2)
+        if n.attr["adj_y"].b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    def _squeeze(self, i, n):
+        dims = tuple(n.attr["squeeze_dims"].list.i)
+        return jnp.squeeze(i[0], axis=dims if dims else None)
+
+    def _pack(self, i, n):
+        return jnp.stack(i, axis=n.attr["axis"].i)
+
+    def _unpack(self, i, n):
+        ax = n.attr["axis"].i
+        num = n.attr["num"].i
+        return [jnp.squeeze(s, ax) for s in jnp.split(i[0], num, axis=ax)]
+
+    def _split(self, i, n):
+        ax = int(np.asarray(i[0]))
+        return jnp.split(i[1], n.attr["num_split"].i, axis=ax)
+
+    def _splitv(self, i, n):
+        sizes = _axes(i[1])
+        ax = int(np.asarray(i[2]))
+        idx = np.cumsum(sizes)[:-1].tolist()
+        return jnp.split(i[0], idx, axis=ax)
+
+    def _strided_slice(self, i, n):
+        x, begin, end, strides = i[0], _axes(i[1]), _axes(i[2]), _axes(i[3])
+        bm = n.attr["begin_mask"].i
+        em = n.attr["end_mask"].i
+        sm = n.attr["shrink_axis_mask"].i
+        nm = n.attr["new_axis_mask"].i
+        em_ellipsis = n.attr["ellipsis_mask"].i
+        idx = []
+        for d in range(len(begin)):
+            if em_ellipsis & (1 << d):
+                idx.append(Ellipsis)
+            elif nm & (1 << d):
+                idx.append(None)
+            elif sm & (1 << d):
+                idx.append(begin[d])
+            else:
+                b = None if (bm & (1 << d)) else begin[d]
+                e = None if (em & (1 << d)) else end[d]
+                idx.append(slice(b, e, strides[d]))
+        return x[tuple(idx)]
+
+    def _slice(self, i, n):
+        begin = _axes(i[1])
+        size = _axes(i[2])
+        # TF convention: size -1 → everything from begin to the end of the dim
+        size = tuple(d - b if s == -1 else s
+                     for b, s, d in zip(begin, size, i[0].shape))
+        return lax.dynamic_slice(i[0], begin, size)
+
+    def _gather(self, i, n):
+        ax = int(np.asarray(i[2])) if len(i) > 2 else 0
+        return jnp.take(i[0], i[1].astype(jnp.int32), axis=ax)
+
+    def _one_hot(self, i, n):
+        depth = int(np.asarray(i[1]))
+        on = i[2] if len(i) > 2 else 1.0
+        off = i[3] if len(i) > 3 else 0.0
+        oh = jax.nn.one_hot(i[0].astype(jnp.int32), depth)
+        return oh * on + (1 - oh) * off
+
+    _TF_DTYPES = {1: jnp.float32, 2: jnp.float64, 3: jnp.int32, 9: jnp.int64,
+                  10: jnp.bool_, 14: jnp.bfloat16, 19: jnp.float16}
+
+    def _cast(self, i, n):
+        return i[0].astype(self._TF_DTYPES.get(n.attr["DstT"].type, jnp.float32))
+
+    def _mean(self, i, n):
+        return jnp.mean(i[0], axis=_axes(i[1]), keepdims=n.attr["keep_dims"].b)
+
+    def _sum(self, i, n):
+        return jnp.sum(i[0], axis=_axes(i[1]), keepdims=n.attr["keep_dims"].b)
+
+    def _rmax(self, i, n):
+        return jnp.max(i[0], axis=_axes(i[1]), keepdims=n.attr["keep_dims"].b)
+
+    def _rmin(self, i, n):
+        return jnp.min(i[0], axis=_axes(i[1]), keepdims=n.attr["keep_dims"].b)
+
+    def _prod(self, i, n):
+        return jnp.prod(i[0], axis=_axes(i[1]), keepdims=n.attr["keep_dims"].b)
+
+    def _fused_bn(self, i, n):
+        x, gamma, beta, mean, var = i[:5]
+        eps = n.attr["epsilon"].f or 1e-3
+        return (x - mean) * lax.rsqrt(var + eps) * gamma + beta
+
+    def _conv2d(self, i, n):
+        strides = tuple(n.attr["strides"].list.i)[1:3]
+        pad = n.attr["padding"].s.decode()
+        return lax.conv_general_dilated(
+            i[0], i[1], strides, pad, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def _maxpool(self, i, n):
+        k = tuple(n.attr["ksize"].list.i)
+        s = tuple(n.attr["strides"].list.i)
+        pad = n.attr["padding"].s.decode()
+        return lax.reduce_window(i[0], -jnp.inf, lax.max, k, s, pad)
+
+    def _avgpool(self, i, n):
+        k = tuple(n.attr["ksize"].list.i)
+        s = tuple(n.attr["strides"].list.i)
+        pad = n.attr["padding"].s.decode()
+        total = lax.reduce_window(i[0], 0.0, lax.add, k, s, pad)
+        if pad == "SAME":
+            # TF excludes padding from the denominator at the borders
+            ones = jnp.ones_like(i[0])
+            count = lax.reduce_window(ones, 0.0, lax.add, k, s, pad)
+            return total / count
+        return total / (k[1] * k[2])
+
+    # ------------------------------------------------------------------ main
+    def import_graph(self, graph_def, sd: SameDiff | None = None) -> SameDiff:
+        """Map a tf.compat.v1.GraphDef onto a SameDiff graph."""
+        sd = sd or SameDiff.create()
+        produced: Dict[str, Any] = {}   # tf tensor name → SDVariable | list
+
+        def tensor_ref(name) -> SDVariable:
+            base, _, idx = name.partition(":")
+            base = base.lstrip("^")
+            v = produced[base]
+            if isinstance(v, list):
+                return v[int(idx) if idx else 0]
+            return v
+
+        for node in graph_def.node:
+            op = node.op
+            if op == "Const":
+                arr = _tensor_to_np(node.attr["value"].tensor)
+                produced[node.name] = sd.constant(node.name, jnp.asarray(arr))
+                continue
+            if op in ("Placeholder", "PlaceholderWithDefault"):
+                shape = None
+                if node.attr["shape"].shape.dim:
+                    shape = tuple(d.size if d.size > 0 else None
+                                  for d in node.attr["shape"].shape.dim)
+                produced[node.name] = sd.placeholder(node.name, shape)
+                continue
+            if op == "NoOp":
+                continue
+            handler = self.handlers.get(op)
+            if handler is None:
+                raise NotImplementedError(
+                    f"TF op '{op}' (node '{node.name}') not mapped; "
+                    f"supported: {sorted(k for k, v in self.handlers.items() if v)}")
+            ins = [tensor_ref(i) for i in node.input if not i.startswith("^")]
+
+            def make_fn(h=handler, nd=node, multi=op in ("Split", "SplitV", "Unpack")):
+                def fn(*vals):
+                    return h(list(vals), nd)
+                return fn
+
+            if op in ("Split", "SplitV", "Unpack"):
+                # multi-output: materialize as tuple node + index views
+                tup = sd._op(node.name + "_tuple", make_fn(), ins)
+                count = (node.attr["num_split"].i if op in ("Split", "SplitV")
+                         else node.attr["num"].i)
+                outs = []
+                for j in range(count):
+                    outs.append(sd._op(f"{node.name}_{j}",
+                                       (lambda jj: lambda t: t[jj])(j), [tup]))
+                produced[node.name] = outs
+            else:
+                v = sd._op(node.name + "_op", make_fn(), ins)
+                v.rename(node.name)
+                produced[node.name] = v
+        return sd
+
+
+def import_frozen_graph(path_or_graphdef, outputs: List[str] | None = None):
+    """Load a frozen .pb (or an in-memory GraphDef) → (SameDiff, outputs)."""
+    if isinstance(path_or_graphdef, (str, bytes)):
+        from tensorflow.core.framework import graph_pb2
+        gd = graph_pb2.GraphDef()
+        with open(path_or_graphdef, "rb") as f:
+            gd.ParseFromString(f.read())
+    else:
+        gd = path_or_graphdef
+    sd = TFImporter().import_graph(gd)
+    outs = [sd.get_variable(o) for o in outputs] if outputs else None
+    return sd, outs

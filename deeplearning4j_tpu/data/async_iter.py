@@ -1,0 +1,158 @@
+"""AsyncDataSetIterator — background-thread prefetch over any iterator.
+
+Reference parity: ``org.deeplearning4j.datasets.iterator.AsyncDataSetIterator``
+(worker thread + bounded queue so host ETL overlaps device compute).
+Backing store is the native SPSC ring (`native/dl4j_tpu_native.cpp`) when the
+lib is available — batches are serialized into fixed byte slots, so the
+producer thread never holds the GIL during the copy — with a pure-Python
+queue fallback. Either way the consumer API is a normal DataSetIterator.
+
+reset() swaps in a FRESH ring/queue generation before restarting the
+producer: an old producer blocked on a full buffer keeps writing (and
+sentinel-ing) only its own abandoned generation, so a stale sentinel can
+never truncate the next epoch.
+"""
+
+from __future__ import annotations
+
+import io
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .dataset import DataSet
+
+_SENTINEL = b"__END__"
+
+
+def _pack(ds: DataSet) -> bytes:
+    buf = io.BytesIO()
+    parts = {"features": ds.features, "labels": ds.labels}
+    if ds.features_mask is not None:
+        parts["features_mask"] = ds.features_mask
+    if ds.labels_mask is not None:
+        parts["labels_mask"] = ds.labels_mask
+    np.savez(buf, **parts)
+    return buf.getvalue()
+
+
+def _unpack(raw: bytes) -> DataSet:
+    with np.load(io.BytesIO(raw)) as z:
+        return DataSet(z["features"], z["labels"],
+                       z["features_mask"] if "features_mask" in z else None,
+                       z["labels_mask"] if "labels_mask" in z else None)
+
+
+class AsyncDataSetIterator:
+    def __init__(self, inner, queue_size: int = 4, use_native: bool = True,
+                 slot_size: int = 64 << 20):
+        self.inner = inner
+        self.queue_size = queue_size
+        self.use_native = use_native
+        self.slot_size = slot_size
+        self.batch_size = getattr(inner, "batch_size", None)
+        self._ring = None
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._start()
+
+    def _make_buffers(self):
+        self._ring = None
+        if self.use_native:
+            try:
+                from ..utils.native import NativeRing
+                self._ring = NativeRing(self.slot_size, self.queue_size)
+            except Exception:  # noqa: BLE001 — fall back to queue
+                self._ring = None
+        self._q = queue.Queue(maxsize=self.queue_size)
+
+    # ------------------------------------------------------------- producer
+    def _start(self):
+        self._make_buffers()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, args=(self._ring, self._q, self._stop),
+            daemon=True)
+        self._thread.start()
+
+    def _produce(self, ring, q, stop):
+        """Writes ONLY to the generation's own (ring, q, stop) — after reset()
+        these are abandoned objects and nothing here touches the live ones."""
+        try:
+            for ds in self.inner:
+                payload = _pack(ds) if ring is not None else ds
+                while not stop.is_set():
+                    if ring is not None:
+                        if ring.push(payload):
+                            break
+                        stop.wait(0.001)
+                    else:
+                        try:
+                            q.put(payload, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                if stop.is_set():
+                    return
+        finally:
+            while not stop.is_set():
+                if ring is not None:
+                    if ring.push(_SENTINEL):
+                        break
+                    stop.wait(0.001)
+                else:
+                    try:
+                        q.put(_SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+    # ------------------------------------------------------------- consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> DataSet:
+        ring, q = self._ring, self._q
+        while True:
+            if ring is not None:
+                raw = ring.pop()
+                if raw is None:
+                    self._stop.wait(0.001)
+                    continue
+                if raw == _SENTINEL:
+                    raise StopIteration
+                return _unpack(raw)
+            item = q.get()
+            if isinstance(item, bytes) and item == _SENTINEL:
+                raise StopIteration
+            return item
+
+    def __len__(self):
+        return len(self.inner)
+
+    def reset(self):
+        self._stop.set()
+        old_thread, old_ring = self._thread, self._ring
+        if old_thread is not None:
+            old_thread.join(timeout=5)
+        if hasattr(self.inner, "reset"):
+            self.inner.reset()
+        self._start()  # fresh generation: new ring/queue/stop event
+        # free the old ring ONLY if its producer actually exited (a live
+        # producer pushing into freed memory would be use-after-free)
+        if old_ring is not None and (old_thread is None or not old_thread.is_alive()):
+            old_ring.close()
+
+    def total_outcomes(self):
+        return getattr(self.inner, "total_outcomes", lambda: -1)()
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None

@@ -1,0 +1,212 @@
+"""Keras .h5 model import — Sequential + Functional subset.
+
+Reference parity: ``deeplearning4j-modelimport``
+(``KerasModelImport.importKerasSequentialModelAndWeights`` /
+``importKerasModelAndWeights``). Reads the HDF5 `model_config` JSON and
+weight groups directly with h5py (no TF/Keras execution), builds our
+MultiLayerNetwork (Sequential) or ComputationGraph (Functional), and maps
+weights with the layout conversions:
+
+- Dense kernel (in, out) → ours (in, out) as-is
+- Conv2D kernel (kh, kw, cin, cout) → HWIO as-is (both NHWC)
+- LSTM kernels: keras gate order [i, f, c, o] → ours [i, f, o, g(c)]
+- BatchNorm: gamma/beta/moving_mean/moving_variance → params + state
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.conf import NeuralNetConfiguration
+from ..nn.layers.base import InputType
+from ..nn.layers.conv import (ConvolutionLayer, GlobalPoolingLayer,
+                              SubsamplingLayer, Upsampling2D, ZeroPaddingLayer)
+from ..nn.layers.core import (ActivationLayer, DenseLayer, DropoutLayer,
+                              EmbeddingSequenceLayer, OutputLayer)
+from ..nn.layers.norm import BatchNormalization, LayerNormalization
+from ..nn.layers.recurrent import GRU, LSTM, Bidirectional
+from ..nn.multi_layer_network import MultiLayerNetwork
+
+_ACT = {"relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh",
+        "softmax": "softmax", "linear": "identity", "elu": "elu",
+        "selu": "selu", "gelu": "gelu", "softplus": "softplus",
+        "softsign": "softsign", "swish": "swish", "silu": "swish",
+        "hard_sigmoid": "hardsigmoid", "leaky_relu": "leakyrelu",
+        "relu6": "relu6", "mish": "mish", "exponential": "identity"}
+
+
+def _act(cfg):
+    return _ACT.get(cfg.get("activation", "linear"), "identity")
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _map_layer(kcfg: dict):
+    """keras layer config dict → our layer (or None for structural layers)."""
+    cls = kcfg["class_name"]
+    c = kcfg["config"]
+    if cls == "Dense":
+        return DenseLayer(n_out=c["units"], activation=_act(c),
+                          has_bias=c.get("use_bias", True))
+    if cls == "Conv2D":
+        pad = c.get("padding", "valid")
+        return ConvolutionLayer(
+            n_out=c["filters"], kernel_size=_pair(c["kernel_size"]),
+            stride=_pair(c.get("strides", 1)),
+            dilation=_pair(c.get("dilation_rate", 1)),
+            convolution_mode="same" if pad == "same" else "truncate",
+            padding=0, activation=_act(c), has_bias=c.get("use_bias", True))
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        pad = c.get("padding", "valid")
+        return SubsamplingLayer(
+            kernel_size=_pair(c.get("pool_size", 2)),
+            stride=_pair(c.get("strides") or c.get("pool_size", 2)),
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            convolution_mode="same" if pad == "same" else "truncate")
+    if cls in ("GlobalAveragePooling2D", "GlobalAveragePooling1D"):
+        return GlobalPoolingLayer(pooling_type="avg")
+    if cls in ("GlobalMaxPooling2D", "GlobalMaxPooling1D"):
+        return GlobalPoolingLayer(pooling_type="max")
+    if cls == "UpSampling2D":
+        return Upsampling2D(size=_pair(c.get("size", 2)))
+    if cls == "ZeroPadding2D":
+        return ZeroPaddingLayer(padding=c.get("padding", (1, 1)))
+    if cls == "Dropout":
+        return DropoutLayer(rate=c["rate"])
+    if cls == "Activation":
+        return ActivationLayer(activation=_act(c))
+    if cls == "ReLU":
+        return ActivationLayer(activation="relu")
+    if cls == "LeakyReLU":
+        return ActivationLayer(activation="leakyrelu")
+    if cls == "BatchNormalization":
+        return BatchNormalization(eps=c.get("epsilon", 1e-3),
+                                  decay=c.get("momentum", 0.99))
+    if cls == "LayerNormalization":
+        return LayerNormalization(eps=c.get("epsilon", 1e-3))
+    if cls == "Embedding":
+        return EmbeddingSequenceLayer(n_in=c["input_dim"], n_out=c["output_dim"])
+    if cls == "LSTM":
+        return LSTM(n_out=c["units"], activation=_act({"activation": c.get("activation", "tanh")}),
+                    gate_activation=_ACT.get(c.get("recurrent_activation", "sigmoid"), "sigmoid"),
+                    forget_gate_bias=0.0)
+    if cls == "GRU":
+        return GRU(n_out=c["units"])
+    if cls == "Bidirectional":
+        inner = _map_layer(c["layer"])
+        return Bidirectional(fwd=inner, mode=c.get("merge_mode", "concat"))
+    if cls == "Flatten":
+        return None  # auto preprocessor inserts the reshape
+    if cls in ("InputLayer",):
+        return None
+    raise NotImplementedError(f"Keras layer '{cls}' not mapped yet")
+
+
+def _keras_input_type(kcfg):
+    c = kcfg["config"]
+    shape = c.get("batch_input_shape") or c.get("batch_shape")
+    if shape is None:
+        return None
+    dims = tuple(d for d in shape[1:])
+    if len(dims) == 3:
+        return InputType.convolutional(*dims)
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    return None
+
+
+def _lstm_reorder(k, units):
+    """keras [i, f, c, o] gate columns → ours [i, f, o, g]."""
+    i, f, cc, o = (k[:, j * units:(j + 1) * units] for j in range(4))
+    return np.concatenate([i, f, o, cc], axis=1)
+
+
+def _assign_weights(net: MultiLayerNetwork, model_weights, layer_names_in_order):
+    """Copy weight arrays from the h5 group into net params/states."""
+    import h5py
+
+    def arrays_for(lname):
+        grp = model_weights[lname]
+        names = [n.decode() if isinstance(n, bytes) else n
+                 for n in grp.attrs.get("weight_names", [])]
+        if names:
+            return [np.asarray(grp[n]) for n in names]
+        # keras3 style: nested 'vars' datasets
+        out = []
+
+        def visit(_, obj):
+            if isinstance(obj, h5py.Dataset):
+                out.append(np.asarray(obj))
+        grp.visititems(visit)
+        return out
+
+    for i, (layer, lname) in enumerate(zip(net.layers, layer_names_in_order)):
+        if lname is None:
+            continue
+        ws = arrays_for(lname)
+        if not ws:
+            continue
+        key = f"layer_{i}"
+        if isinstance(layer, (DenseLayer,)):
+            layer_params = {"W": jnp.asarray(ws[0])}
+            if layer.has_bias and len(ws) > 1:
+                layer_params["b"] = jnp.asarray(ws[1])
+            net.params[key].update(layer_params)
+        elif isinstance(layer, ConvolutionLayer):
+            net.params[key]["W"] = jnp.asarray(ws[0])
+            if layer.has_bias and len(ws) > 1:
+                net.params[key]["b"] = jnp.asarray(ws[1])
+        elif isinstance(layer, BatchNormalization):
+            gamma, beta, mean, var = ws[:4]
+            net.params[key]["gamma"] = jnp.asarray(gamma)
+            net.params[key]["beta"] = jnp.asarray(beta)
+            net.states[key]["mean"] = jnp.asarray(mean)
+            net.states[key]["var"] = jnp.asarray(var)
+        elif isinstance(layer, LSTM):
+            units = layer.n_out
+            kernel, rec, bias = ws[:3]
+            net.params[key]["W"] = jnp.asarray(_lstm_reorder(kernel, units))
+            net.params[key]["RW"] = jnp.asarray(_lstm_reorder(rec, units))
+            net.params[key]["b"] = jnp.asarray(
+                _lstm_reorder(bias[None, :], units)[0])
+        elif isinstance(layer, EmbeddingSequenceLayer):
+            net.params[key]["W"] = jnp.asarray(ws[0])
+    net._invalidate()
+
+
+def import_keras_sequential(path, input_shape=None):
+    """KerasModelImport.importKerasSequentialModelAndWeights analogue."""
+    import h5py
+    with h5py.File(path, "r") as f:
+        raw = f.attrs["model_config"]
+        cfg = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+        if cfg["class_name"] != "Sequential":
+            raise ValueError("use import_keras_model for Functional models")
+        layer_cfgs = cfg["config"]["layers"] if isinstance(cfg["config"], dict) \
+            else cfg["config"]
+        b = NeuralNetConfiguration.builder().list()
+        names = []
+        itype = None
+        for kc in layer_cfgs:
+            if itype is None:
+                itype = _keras_input_type(kc)
+            lyr = _map_layer(kc)
+            if lyr is not None:
+                b.layer(lyr)
+                names.append(kc["config"]["name"])
+        if itype is not None:
+            b.set_input_type(itype)
+        net = MultiLayerNetwork(b.build())
+        net.init(tuple(itype[1]) if itype else tuple(input_shape))
+        wg = f["model_weights"] if "model_weights" in f else f
+        present = set(wg.keys())
+        _assign_weights(net, wg, [n if n in present else None for n in names])
+    return net

@@ -15,7 +15,9 @@ import jax
 import jax.numpy as jnp
 
 _lock = threading.Lock()
-_state = {"key": jax.random.PRNGKey(0)}
+# lazy: creating a PRNGKey at import time would initialize (and on this
+# sandbox, claim) the default device backend for EVERY package import
+_state = {"key": None}
 
 
 def set_seed(seed: int) -> None:
@@ -27,6 +29,8 @@ def set_seed(seed: int) -> None:
 def next_key():
     """Split and return a fresh subkey from the host-side stream."""
     with _lock:
+        if _state["key"] is None:
+            _state["key"] = jax.random.PRNGKey(0)
         _state["key"], sub = jax.random.split(_state["key"])
     return sub
 

@@ -120,6 +120,15 @@ def load_model(path):
         else:
             model.params = _nest(flat_p)
             model.states = _nest(flat_s)
+            # empty per-layer dicts produce no leaves when flattened — restore
+            # the containers so forward can index every layer/node
+            if hasattr(model, "layers"):
+                keys = [f"layer_{i}" for i in range(len(model.layers))]
+            else:
+                keys = list(conf.nodes)
+            for k in keys:
+                model.params.setdefault(k, {})
+                model.states.setdefault(k, {})
             model.initialized = True
         model._preprocessors = meta.get("preprocessors", {})
         model.epoch_count = meta.get("epoch_count", 0)

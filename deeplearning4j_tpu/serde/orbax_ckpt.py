@@ -1,0 +1,148 @@
+"""Orbax-backed distributed checkpointing — sharded, async, with retention.
+
+Reference counterpart: DL4J's CheckpointListener + ModelSerializer write a
+zip from host memory on one node. The TPU-native path must checkpoint
+SHARDED params (fsdp/tp/pp) without gathering to one host and without
+stalling the step loop — exactly what orbax provides (per-shard tensorstore
+writes, async commit). This wraps orbax with the framework's param/state
+pytrees and a CheckpointListener-compatible retention policy, and powers
+preemption resume (SURVEY.md §2.8 elastic/failure handling).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+
+class OrbaxCheckpointer:
+    def __init__(self, directory, max_to_keep: int = 3, async_: bool = True,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_)
+        self.manager = ocp.CheckpointManager(str(self.directory), options=options)
+
+    # ------------------------------------------------------------------ save
+    def save(self, step: int, params, states=None, opt_state=None,
+             metadata: Optional[dict] = None, force: bool = False) -> bool:
+        """Async sharded save; returns False if skipped by save_interval."""
+        ocp = self._ocp
+        items = {"params": ocp.args.StandardSave(params)}
+        if states is not None and jax.tree_util.tree_leaves(states):
+            items["states"] = ocp.args.StandardSave(states)
+        if opt_state is not None and jax.tree_util.tree_leaves(opt_state):
+            items["opt_state"] = ocp.args.StandardSave(opt_state)
+        if metadata:
+            items["metadata"] = ocp.args.JsonSave(metadata)
+        return self.manager.save(step, args=ocp.args.Composite(**items),
+                                 force=force)
+
+    def wait(self):
+        self.manager.wait_until_finished()
+
+    # --------------------------------------------------------------- restore
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, step: Optional[int] = None, params_like=None,
+                states_like=None, opt_state_like=None):
+        """Restore (params, states, opt_state, metadata); `*_like` trees give
+        target shardings/dtypes so shards land directly on their devices."""
+        ocp = self._ocp
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        kw = {}
+        if params_like is not None:
+            kw["params"] = ocp.args.StandardRestore(params_like)
+        else:
+            kw["params"] = ocp.args.StandardRestore()
+        saved = set()
+        try:
+            saved = set(self.manager.item_metadata(step).keys())
+        except Exception:  # noqa: BLE001 — older orbax
+            pass
+        if not saved or "states" in saved:
+            kw["states"] = ocp.args.StandardRestore(states_like)
+        if not saved or "opt_state" in saved:
+            kw["opt_state"] = ocp.args.StandardRestore(opt_state_like)
+        if not saved or "metadata" in saved:
+            kw["metadata"] = ocp.args.JsonRestore()
+        try:
+            out = self.manager.restore(step, args=ocp.args.Composite(**kw))
+        except Exception:
+            # retry with params only (checkpoint without optional items)
+            out = self.manager.restore(step, args=ocp.args.Composite(
+                params=kw["params"]))
+        get = lambda k: out.get(k) if hasattr(out, "get") else getattr(out, k, None)
+        return get("params"), get("states"), get("opt_state"), get("metadata")
+
+    def close(self):
+        self.manager.close()
+
+
+class PreemptionWatchdog:
+    """Elastic/failure handling: checkpoint on a deadline so preemption
+    (SIGTERM with grace period, maintenance events) never loses more than
+    `interval_s` of work. Reference counterpart: Spark/Aeron trainers
+    restarting from the last ModelSerializer write."""
+
+    def __init__(self, checkpointer: OrbaxCheckpointer, interval_s: float = 300.0):
+        self.ckpt = checkpointer
+        self.interval_s = interval_s
+        self._last = time.monotonic()
+        self._installed = False
+
+    def maybe_save(self, step: int, params, states=None, opt_state=None) -> bool:
+        now = time.monotonic()
+        if now - self._last >= self.interval_s:
+            self.ckpt.save(step, params, states, opt_state, force=True)
+            self._last = now
+            return True
+        return False
+
+    def install_signal_handler(self, get_state_fn):
+        """On SIGTERM: synchronously save `get_state_fn() -> (step, params,
+        states, opt_state)` before the process dies."""
+        import signal
+
+        def handler(signum, frame):
+            step, params, states, opt_state = get_state_fn()
+            self.ckpt.save(step, params, states, opt_state, force=True)
+            self.ckpt.wait()
+            raise SystemExit(143)
+
+        signal.signal(signal.SIGTERM, handler)
+        self._installed = True
+
+
+class CheckpointingTrainerMixin:
+    """Glue for MultiLayerNetwork/ComputationGraph: resume_or_init()."""
+
+    @staticmethod
+    def resume(net, checkpointer: OrbaxCheckpointer):
+        step = checkpointer.latest_step()
+        if step is None:
+            return 0
+        params, states, opt_state, meta = checkpointer.restore(
+            params_like=net.params,
+            states_like=net.states if jax.tree_util.tree_leaves(net.states) else None,
+            opt_state_like=net._opt_state)
+        net.params = params
+        if states is not None:
+            net.states = states
+        if opt_state is not None:
+            net._opt_state = opt_state
+        if meta:
+            net._step_count = meta.get("step_count", step)
+            net.epoch_count = meta.get("epoch_count", 0)
+        return step

@@ -1,0 +1,170 @@
+"""ctypes bindings for the native runtime (native/dl4j_tpu_native.cpp).
+
+Builds the .so on first use if g++ is available; every caller has a pure-
+Python fallback, so the framework works without the native lib (slower
+pipeline, same results).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libdl4j_tpu_native.so"
+_lib = None
+_tried = False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not _LIB_PATH.exists():
+        try:
+            subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:  # noqa: BLE001 — fall back to pure python
+            return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError:
+        return None
+    lib.ring_create.restype = ctypes.c_void_p
+    lib.ring_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.ring_destroy.argtypes = [ctypes.c_void_p]
+    lib.ring_push.restype = ctypes.c_int
+    lib.ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.ring_pop.restype = ctypes.c_int64
+    lib.ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+    lib.ring_size.restype = ctypes.c_uint64
+    lib.ring_size.argtypes = [ctypes.c_void_p]
+    lib.threshold_encode.restype = ctypes.c_int64
+    lib.threshold_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_float,
+        ctypes.c_void_p, ctypes.c_int64]
+    lib.threshold_decode.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_float,
+        ctypes.c_void_p, ctypes.c_int64]
+    lib.parse_csv_floats.restype = ctypes.c_int64
+    lib.parse_csv_floats.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
+    lib.f32_to_bf16.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    _lib = lib
+    return _lib
+
+
+def has_native() -> bool:
+    return load() is not None
+
+
+class NativeRing:
+    """SPSC ring of byte slots (AsyncDataSetIterator backing store)."""
+
+    def __init__(self, slot_size: int, n_slots: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native lib unavailable")
+        self._lib = lib
+        self._ptr = lib.ring_create(slot_size, n_slots)
+        if not self._ptr:
+            raise MemoryError("ring_create failed")
+        self.slot_size = slot_size
+
+    def push(self, payload: bytes) -> bool:
+        rc = self._lib.ring_push(self._ptr, payload, len(payload))
+        if rc == -1:
+            raise ValueError(f"payload {len(payload)} > slot {self.slot_size}")
+        return rc == 1
+
+    def pop(self) -> Optional[bytes]:
+        buf = ctypes.create_string_buffer(self.slot_size)
+        n = self._lib.ring_pop(self._ptr, buf, self.slot_size)
+        if n <= 0:
+            return None
+        return buf.raw[:n]
+
+    def __len__(self):
+        return int(self._lib.ring_size(self._ptr))
+
+    def close(self):
+        if self._ptr:
+            self._lib.ring_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def threshold_encode(grad: np.ndarray, residual: np.ndarray, threshold: float,
+                     max_out: Optional[int] = None):
+    """Returns int64 token array; residual updated IN PLACE (error feedback)."""
+    g = np.ascontiguousarray(grad, np.float32).ravel()
+    assert residual.dtype == np.float32 and residual.size == g.size
+    cap = max_out or g.size
+    lib = load()
+    if lib is not None:
+        out = np.empty(cap, np.int64)
+        n = lib.threshold_encode(
+            g.ctypes.data, residual.ctypes.data, g.size,
+            ctypes.c_float(threshold), out.ctypes.data, cap)
+        return out[:n]
+    # pure python fallback
+    acc = g + residual
+    pos = acc >= threshold
+    neg = acc <= -threshold
+    idx = np.nonzero(pos | neg)[0][:cap]
+    sel_pos = pos[idx]
+    residual[:] = acc
+    residual[idx[sel_pos]] -= threshold
+    residual[idx[~sel_pos]] += threshold
+    return ((idx.astype(np.int64) << 1) | (~sel_pos).astype(np.int64))
+
+
+def threshold_decode(tokens: np.ndarray, threshold: float, n: int) -> np.ndarray:
+    out = np.zeros(n, np.float32)
+    lib = load()
+    if lib is not None and tokens.size:
+        t = np.ascontiguousarray(tokens, np.int64)
+        lib.threshold_decode(t.ctypes.data, t.size,
+                             ctypes.c_float(threshold), out.ctypes.data, n)
+        return out
+    if tokens.size:
+        idx = tokens >> 1
+        sign = np.where((tokens & 1) == 1, -1.0, 1.0).astype(np.float32)
+        np.add.at(out, idx, sign * threshold)
+    return out
+
+
+def parse_csv_floats(text: bytes, max_out: int) -> np.ndarray:
+    lib = load()
+    if lib is not None:
+        out = np.empty(max_out, np.float32)
+        n = lib.parse_csv_floats(text, len(text), out.ctypes.data, max_out)
+        return out[:n]
+    import re
+    vals = re.split(rb"[,\s;]+", text.strip())
+    return np.asarray([float(v) for v in vals if v], np.float32)[:max_out]
+
+
+def f32_to_bf16(arr: np.ndarray) -> np.ndarray:
+    a = np.ascontiguousarray(arr, np.float32)
+    lib = load()
+    out = np.empty(a.size, np.uint16)
+    if lib is not None:
+        lib.f32_to_bf16(a.ctypes.data, out.ctypes.data, a.size)
+    else:
+        bits = a.view(np.uint32).ravel()
+        lsb = (bits >> 16) & 1
+        out = ((bits + 0x7FFF + lsb) >> 16).astype(np.uint16)
+    import jax.numpy as jnp
+    return out.reshape(arr.shape).view(jnp.bfloat16)

@@ -1,0 +1,174 @@
+// dl4j_tpu_native — host-side native runtime for the TPU framework.
+//
+// Reference counterpart: libnd4j's C++ host runtime. The TPU compute path is
+// XLA; what remains native here is what stays on the host in the reference
+// too: the async data-pipeline ring buffer (DL4J AsyncDataSetIterator's
+// queue + pinned staging), the threshold-encoding gradient codec
+// (EncodedGradientsAccumulator / threshold compression used by gradient
+// sharing over DCN), and fast CSV/float parsing for the ETL layer.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// SPSC ring buffer of fixed-size slots (lock-free; producer thread = Python
+// worker filling batches, consumer = training loop). Slots are raw bytes —
+// the Python side memcpy's numpy batch payloads in and out without the GIL
+// (ctypes releases it during the call).
+// ---------------------------------------------------------------------------
+
+struct Ring {
+    uint8_t*  data;
+    uint64_t  slot_size;
+    uint64_t  n_slots;
+    std::atomic<uint64_t> head;   // next slot to write
+    std::atomic<uint64_t> tail;   // next slot to read
+    uint64_t* sizes;              // payload size per slot
+};
+
+Ring* ring_create(uint64_t slot_size, uint64_t n_slots) {
+    Ring* r = new (std::nothrow) Ring();
+    if (!r) return nullptr;
+    r->data = static_cast<uint8_t*>(std::malloc(slot_size * n_slots));
+    r->sizes = static_cast<uint64_t*>(std::calloc(n_slots, sizeof(uint64_t)));
+    if (!r->data || !r->sizes) {
+        std::free(r->data);
+        std::free(r->sizes);
+        delete r;
+        return nullptr;
+    }
+    r->slot_size = slot_size;
+    r->n_slots = n_slots;
+    r->head.store(0);
+    r->tail.store(0);
+    return r;
+}
+
+void ring_destroy(Ring* r) {
+    if (!r) return;
+    std::free(r->data);
+    std::free(r->sizes);
+    delete r;
+}
+
+// returns 1 on success, 0 if full
+int ring_push(Ring* r, const uint8_t* payload, uint64_t size) {
+    if (size > r->slot_size) return -1;
+    uint64_t head = r->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->tail.load(std::memory_order_acquire);
+    if (head - tail >= r->n_slots) return 0;  // full
+    uint64_t slot = head % r->n_slots;
+    std::memcpy(r->data + slot * r->slot_size, payload, size);
+    r->sizes[slot] = size;
+    r->head.store(head + 1, std::memory_order_release);
+    return 1;
+}
+
+// returns payload size on success, 0 if empty, -1 if out_cap too small
+int64_t ring_pop(Ring* r, uint8_t* out, uint64_t out_cap) {
+    uint64_t tail = r->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->head.load(std::memory_order_acquire);
+    if (tail == head) return 0;  // empty
+    uint64_t slot = tail % r->n_slots;
+    uint64_t size = r->sizes[slot];
+    if (size > out_cap) return -1;
+    std::memcpy(out, r->data + slot * r->slot_size, size);
+    r->tail.store(tail + 1, std::memory_order_release);
+    return static_cast<int64_t>(size);
+}
+
+uint64_t ring_size(Ring* r) {
+    return r->head.load(std::memory_order_acquire)
+         - r->tail.load(std::memory_order_acquire);
+}
+
+// ---------------------------------------------------------------------------
+// Threshold-encoding gradient codec (gradient sharing / DCN compression).
+// Encoding: for |g[i]| >= threshold emit int32 token (i<<1 | sign) and
+// subtract ±threshold into the residual (error feedback). Matches the
+// reference's semantics: quantize-to-±threshold sparse updates.
+// ---------------------------------------------------------------------------
+
+// returns number of encoded tokens (<= max_out); residual updated in place.
+// tokens are int64 (i<<1 | sign) so vectors beyond 2^30 params don't overflow
+int64_t threshold_encode(const float* grad, float* residual, int64_t n,
+                         float threshold, int64_t* out_idx, int64_t max_out) {
+    int64_t count = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grad[i] + residual[i];
+        if (g >= threshold) {
+            if (count < max_out) {
+                out_idx[count++] = i << 1;
+                residual[i] = g - threshold;
+            } else {
+                residual[i] = g;  // buffer full: keep in residual
+            }
+        } else if (g <= -threshold) {
+            if (count < max_out) {
+                out_idx[count++] = (i << 1) | 1;
+                residual[i] = g + threshold;
+            } else {
+                residual[i] = g;
+            }
+        } else {
+            residual[i] = g;
+        }
+    }
+    return count;
+}
+
+// decode tokens into dense accumulator: out[i] += ±threshold
+void threshold_decode(const int64_t* tokens, int64_t count, float threshold,
+                      float* out, int64_t n) {
+    for (int64_t t = 0; t < count; ++t) {
+        int64_t tok = tokens[t];
+        int64_t i = tok >> 1;
+        if (i < 0 || i >= n) continue;
+        out[i] += (tok & 1) ? -threshold : threshold;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Fast float CSV parser: parses `text` (len bytes) of comma/space-separated
+// floats with newlines into out (row-major), returns count parsed.
+// ---------------------------------------------------------------------------
+
+int64_t parse_csv_floats(const char* text, int64_t len, float* out,
+                         int64_t max_out) {
+    int64_t count = 0;
+    const char* p = text;
+    const char* end = text + len;
+    while (p < end && count < max_out) {
+        // skip separators
+        while (p < end && (*p == ',' || *p == ' ' || *p == '\n' ||
+                           *p == '\r' || *p == '\t' || *p == ';')) ++p;
+        if (p >= end) break;
+        char* next = nullptr;
+        float v = std::strtof(p, &next);
+        if (next == p) { ++p; continue; }  // unparseable char; skip
+        out[count++] = v;
+        p = next;
+    }
+    return count;
+}
+
+// elementwise f32 → bf16 (round-to-nearest-even) staging conversion
+void f32_to_bf16(const float* in, uint16_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t bits;
+        std::memcpy(&bits, &in[i], 4);
+        uint32_t lsb = (bits >> 16) & 1;
+        uint32_t rounded = bits + 0x7FFF + lsb;
+        out[i] = static_cast<uint16_t>(rounded >> 16);
+    }
+}
+
+}  // extern "C"

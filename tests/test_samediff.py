@@ -1,0 +1,78 @@
+"""SameDiff graph API tests (SURVEY.md §2.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.data import IrisDataSetIterator
+from deeplearning4j_tpu.train import Adam
+
+
+def _mlp(sd):
+    x = sd.placeholder("input", (None, 4))
+    y = sd.placeholder("label", (None, 3))
+    w0 = sd.var("w0", (4, 16))
+    b0 = sd.var("b0", value=jnp.zeros(16))
+    w1 = sd.var("w1", (16, 3))
+    b1 = sd.var("b1", value=jnp.zeros(3))
+    h = sd.nn.relu(sd.nn.linear(x, w0, b0))
+    logits = sd.nn.linear(h, w1, b1).rename("logits")
+    sd.nn.softmax(logits).rename("out")
+    sd.loss.softmax_cross_entropy(y, logits).rename("loss")
+    return sd
+
+
+def test_eval_and_arithmetic():
+    sd = SameDiff.create()
+    a = sd.var("a", value=jnp.asarray([1.0, 2.0, 3.0]))
+    b = sd.var("b", value=jnp.asarray([4.0, 5.0, 6.0]))
+    c = (a * b + 2.0).rename("c")
+    np.testing.assert_allclose(np.asarray(sd.eval(c)), [6.0, 12.0, 20.0])
+    d = a.mmul(b.reshape(3, 1))
+    assert np.asarray(sd.eval(d))[0] == 32.0
+    s = a.sum()
+    assert float(sd.eval(s)) == 6.0
+
+
+def test_grad_matches_manual():
+    sd = SameDiff.create()
+    w = sd.var("w", value=jnp.asarray([2.0]))
+    x = sd.placeholder("x")
+    loss = ((w * x) ** 2.0).sum().rename("loss")
+    g = sd.grad(loss, feeds={"x": jnp.asarray([3.0])})
+    # d/dw (w*x)^2 = 2*w*x^2 = 2*2*9 = 36
+    np.testing.assert_allclose(np.asarray(g["w"]), [36.0], rtol=1e-6)
+
+
+def test_fit_iris():
+    sd = _mlp(SameDiff.create())
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["input"],
+        data_set_label_mapping=["label"]))
+    it = IrisDataSetIterator(batch_size=50)
+    sd.fit(iterator=it, epochs=90)
+    feats, labels = it._features, it._labels
+    out = np.asarray(sd.eval(sd.get_variable("out"), {"input": feats}))
+    acc = (out.argmax(1) == labels.argmax(1)).mean()
+    assert acc > 0.9, acc
+
+
+def test_control_flow():
+    sd = SameDiff.create()
+    x = sd.var("x", value=jnp.asarray(1.0))
+    # while x < 100: x *= 2
+    w = sd.while_loop(lambda v: v < 100.0, lambda v: v * 2.0, x)
+    assert float(sd.eval(w)) == 128.0
+    c = sd.cond(sd.constant("p", True), lambda v: v + 1, lambda v: v - 1,
+                sd.constant("o", 10.0))
+    assert float(sd.eval(c)) == 11.0
+
+
+def test_stablehlo_export():
+    sd = _mlp(SameDiff.create())
+    hlo = sd.to_stablehlo(sd.get_variable("out"), {"input": (2, 4), "label": (2, 3)})
+    assert "dot_general" in hlo or "dot " in hlo
+    jaxpr = sd.to_jaxpr(sd.get_variable("out"), {"input": (2, 4), "label": (2, 3)})
+    assert "dot_general" in str(jaxpr)

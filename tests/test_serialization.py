@@ -1,0 +1,79 @@
+"""Serialization round-trips (SURVEY.md §4): bit-exact params + resume."""
+
+import pathlib
+import tempfile
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.data import IrisDataSetIterator
+from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers.base import InputType
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+from deeplearning4j_tpu.train import Adam
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(9).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init((4,))
+
+
+def test_mln_roundtrip_bit_exact(tmp_path):
+    net = _net()
+    it = IrisDataSetIterator(batch_size=50)
+    net.fit(it, epochs=3)
+    p = tmp_path / "m.zip"
+    net.save(p)
+    net2 = MultiLayerNetwork.load(p)
+    for k in net.params:
+        for name in net.params[k]:
+            np.testing.assert_array_equal(np.asarray(net.params[k][name]),
+                                          np.asarray(net2.params[k][name]))
+    x = next(iter(it)).features
+    np.testing.assert_array_equal(np.asarray(net.output(x)),
+                                  np.asarray(net2.output(x)))
+
+
+def test_updater_state_resume(tmp_path):
+    net = _net()
+    it = IrisDataSetIterator(batch_size=50)
+    net.fit(it, epochs=2)
+    p = tmp_path / "m.zip"
+    net.save(p, save_updater=True)
+    # resumed net continues from saved Adam moments: one more epoch on each
+    net.fit(it, epochs=1)
+    net2 = MultiLayerNetwork.load(p)
+    net2.fit(it, epochs=1)
+    for k in net.params:
+        for name in net.params[k]:
+            np.testing.assert_allclose(np.asarray(net.params[k][name]),
+                                       np.asarray(net2.params[k][name]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_cg_roundtrip(tmp_path):
+    g = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("a", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+         .add_layer("b", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+         .add_vertex("s", ElementWiseVertex(op="add"), "a", "b")
+         .add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                       loss="mcxent"), "s")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4)))
+    net = ComputationGraph(g.build()).init()
+    it = IrisDataSetIterator(batch_size=50)
+    net.fit(it, epochs=2)
+    p = tmp_path / "cg.zip"
+    net.save(p)
+    net2 = ComputationGraph.load(p)
+    x = next(iter(it)).features
+    np.testing.assert_array_equal(np.asarray(net.output(x)),
+                                  np.asarray(net2.output(x)))
