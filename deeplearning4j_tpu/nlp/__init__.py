@@ -1,0 +1,11 @@
+"""deeplearning4j_tpu.nlp — Word2Vec/ParagraphVectors + tokenizers
+(DL4J deeplearning4j-nlp analogue)."""
+
+from .tokenizers import (BasicLineIterator, BPETokenizer, CharTokenizer,
+                         CollectionSentenceIterator, CommonPreprocessor,
+                         DefaultTokenizerFactory, LowCasePreProcessor,
+                         NGramTokenizer, RegexTokenizer, SentenceIterator,
+                         StemmingPreprocessor, TokenizerFactory,
+                         WhitespaceTokenizer)
+from .vocab import VocabCache
+from .word2vec import ParagraphVectors, Word2Vec

@@ -1,0 +1,245 @@
+"""Tokenizers + preprocessors — parity with DL4J's
+``org.deeplearning4j.text.tokenization.tokenizerfactory.*`` /
+``...tokenization.tokenizer.*`` (DefaultTokenizerFactory,
+TokenPreProcess, NGramTokenizerFactory) plus a byte-pair-encoding
+subset (the reference ships BertWordPieceTokenizer; BPE is the
+modern equivalent surface).
+
+Tokenizers here are plain-Python host-side code: tokenization is ETL,
+not compute, so it never enters jit. The TPU sees only integer id
+batches produced by :class:`~deeplearning4j_tpu.nlp.vocab.VocabCache`.
+"""
+
+from __future__ import annotations
+
+import re
+import string
+from collections import Counter
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+
+# ---------------------------------------------------------------- preprocess
+class TokenPreProcess:
+    """Reference ``TokenPreProcess`` — a pure str→str hook."""
+
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+    def __call__(self, token: str) -> str:
+        return self.pre_process(token)
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Reference ``CommonPreprocessor``: lowercase + strip punctuation/digits."""
+
+    _strip = re.compile(r"[\d" + re.escape(string.punctuation) + r"]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._strip.sub("", token.lower())
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class StemmingPreprocessor(TokenPreProcess):
+    """Tiny suffix-stripping stemmer (Porter-lite) — reference uses lucene's."""
+
+    _suffixes = ("ingly", "edly", "ing", "ed", "ly", "ies", "es", "s")
+
+    def pre_process(self, token: str) -> str:
+        t = token.lower()
+        for suf in self._suffixes:
+            if t.endswith(suf) and len(t) - len(suf) >= 3:
+                return t[: -len(suf)]
+        return t
+
+
+# ---------------------------------------------------------------- tokenizers
+class Tokenizer:
+    """Reference ``Tokenizer`` — iteration over tokens of ONE string."""
+
+    def __init__(self, text: str, pre: Optional[TokenPreProcess] = None):
+        self._tokens = self._split(text)
+        if pre is not None:
+            self._tokens = [p for p in (pre(t) for t in self._tokens) if p]
+
+    def _split(self, text: str) -> List[str]:
+        raise NotImplementedError
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+    def __iter__(self):
+        return iter(self._tokens)
+
+
+class WhitespaceTokenizer(Tokenizer):
+    """Reference ``DefaultTokenizer`` (whitespace/StringTokenizer based)."""
+
+    def _split(self, text):
+        return text.split()
+
+
+class CharTokenizer(Tokenizer):
+    """Character tokenizer — the TextGenerationLSTM / char-RNN path."""
+
+    def _split(self, text):
+        return list(text)
+
+
+class RegexTokenizer(Tokenizer):
+    """Reference ``PosUimaTokenizer``-class flexibility via a regex."""
+
+    pattern = re.compile(r"\w+|[^\w\s]")
+
+    def _split(self, text):
+        return self.pattern.findall(text)
+
+
+class NGramTokenizer(Tokenizer):
+    """Reference ``NGramTokenizerFactory`` — emits n-grams of base tokens."""
+
+    def __init__(self, text, n_min=1, n_max=2, pre=None):
+        self.n_min, self.n_max = n_min, n_max
+        super().__init__(text, pre)
+
+    def _split(self, text):
+        base = text.split()
+        out = []
+        for n in range(self.n_min, self.n_max + 1):
+            out += [" ".join(base[i:i + n]) for i in range(len(base) - n + 1)]
+        return out
+
+
+class TokenizerFactory:
+    """Reference ``TokenizerFactory`` — create(text) → Tokenizer."""
+
+    def __init__(self, cls=WhitespaceTokenizer, pre: Optional[TokenPreProcess] = None,
+                 **kw):
+        self._cls, self._pre, self._kw = cls, pre, kw
+
+    def set_token_pre_processor(self, pre: TokenPreProcess):
+        self._pre = pre
+        return self
+
+    def create(self, text: str) -> Tokenizer:
+        return self._cls(text, pre=self._pre, **self._kw)
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    def __init__(self, pre: Optional[TokenPreProcess] = None):
+        super().__init__(WhitespaceTokenizer, pre)
+
+
+# ---------------------------------------------------------------- BPE subset
+class BPETokenizer:
+    """Byte-pair encoding: ``train`` learns merges from a corpus, ``encode``/
+    ``decode`` round-trip text. Greedy rank-based merging (GPT-2 style,
+    simplified: no byte fallback — unknown chars become <unk>).
+    """
+
+    UNK = "<unk>"
+    EOW = "</w>"
+
+    def __init__(self, vocab_size: int = 1000):
+        self.vocab_size = vocab_size
+        self.merges: Dict[Tuple[str, str], int] = {}
+        self.token_to_id: Dict[str, int] = {}
+        self.id_to_token: List[str] = []
+
+    # -- training -----------------------------------------------------------
+    def train(self, corpus: Iterable[str]):
+        word_freq: Counter = Counter()
+        for line in corpus:
+            word_freq.update(line.split())
+        # each word is a tuple of symbols, last symbol carries EOW
+        words = {tuple(w[:-1]) + (w[-1] + self.EOW,): c
+                 for w, c in word_freq.items() if w}
+        alphabet = sorted({s for w in words for s in w})
+        vocab = [self.UNK] + alphabet
+        while len(vocab) < self.vocab_size:
+            pairs: Counter = Counter()
+            for w, c in words.items():
+                for a, b in zip(w, w[1:]):
+                    pairs[(a, b)] += c
+            if not pairs:
+                break
+            best = max(pairs, key=lambda p: (pairs[p], p))
+            self.merges[best] = len(self.merges)
+            merged = best[0] + best[1]
+            vocab.append(merged)
+            words = {self._merge_word(w, best, merged): c for w, c in words.items()}
+        self.id_to_token = vocab
+        self.token_to_id = {t: i for i, t in enumerate(vocab)}
+        return self
+
+    @staticmethod
+    def _merge_word(word, pair, merged):
+        out, i = [], 0
+        while i < len(word):
+            if i + 1 < len(word) and (word[i], word[i + 1]) == pair:
+                out.append(merged)
+                i += 2
+            else:
+                out.append(word[i])
+                i += 1
+        return tuple(out)
+
+    # -- encode/decode ------------------------------------------------------
+    def _bpe(self, word: str) -> List[str]:
+        syms = list(word[:-1]) + [word[-1] + self.EOW] if word else []
+        while len(syms) > 1:
+            ranked = [(self.merges.get((a, b)), i)
+                      for i, (a, b) in enumerate(zip(syms, syms[1:]))]
+            ranked = [(r, i) for r, i in ranked if r is not None]
+            if not ranked:
+                break
+            _, i = min(ranked)
+            syms = syms[:i] + [syms[i] + syms[i + 1]] + syms[i + 2:]
+        return syms
+
+    def encode(self, text: str) -> List[int]:
+        unk = self.token_to_id[self.UNK]
+        ids = []
+        for w in text.split():
+            ids += [self.token_to_id.get(s, unk) for s in self._bpe(w)]
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        toks = [self.id_to_token[i] for i in ids]
+        return "".join(toks).replace(self.EOW, " ").strip()
+
+
+# ---------------------------------------------------------- sentence sources
+class SentenceIterator:
+    """Reference ``SentenceIterator`` — restartable stream of sentences."""
+
+    def __iter__(self) -> Iterable[str]:
+        raise NotImplementedError
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: List[str]):
+        self._sent = list(sentences)
+
+    def __iter__(self):
+        return iter(self._sent)
+
+
+class BasicLineIterator(SentenceIterator):
+    """Reference ``BasicLineIterator`` — one sentence per file line."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self):
+        with open(self.path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line

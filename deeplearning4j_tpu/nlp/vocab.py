@@ -1,0 +1,96 @@
+"""Vocabulary cache — parity with DL4J's
+``org.deeplearning4j.models.word2vec.wordstore.VocabCache`` /
+``AbstractCache`` + the unigram negative-sampling table that the
+reference builds inside Word2Vec's lookup table.
+
+Host-side structure; it ships int32 id arrays to the device.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class VocabCache:
+    """word ↔ index with frequency accounting and a sampling table.
+
+    Index 0 is always the UNK token (reference uses "UNK" literally).
+    """
+
+    UNK = "UNK"
+
+    def __init__(self, min_word_frequency: int = 1):
+        self.min_word_frequency = min_word_frequency
+        self.word_counts: Counter = Counter()
+        self.word_to_index: Dict[str, int] = {}
+        self.index_to_word: List[str] = []
+        self.total_word_count = 0
+        self._neg_table: Optional[np.ndarray] = None
+        self._keep_prob: Optional[np.ndarray] = None
+
+    # -- building -----------------------------------------------------------
+    def fit(self, token_stream: Iterable[List[str]]):
+        for tokens in token_stream:
+            self.word_counts.update(tokens)
+        self.finish()
+        return self
+
+    def finish(self):
+        kept = [(w, c) for w, c in self.word_counts.most_common()
+                if c >= self.min_word_frequency]
+        self.index_to_word = [self.UNK] + [w for w, _ in kept]
+        self.word_to_index = {w: i for i, w in enumerate(self.index_to_word)}
+        self.total_word_count = sum(c for _, c in kept)
+        self._neg_table = None
+        self._keep_prob = None
+
+    # -- queries (reference VocabCache surface) -----------------------------
+    def num_words(self) -> int:
+        return len(self.index_to_word)
+
+    def contains_word(self, word: str) -> bool:
+        return word in self.word_to_index
+
+    def index_of(self, word: str) -> int:
+        return self.word_to_index.get(word, 0)
+
+    def word_at_index(self, idx: int) -> str:
+        return self.index_to_word[idx]
+
+    def word_frequency(self, word: str) -> int:
+        return self.word_counts.get(word, 0)
+
+    def words(self) -> List[str]:
+        return list(self.index_to_word)
+
+    def encode(self, tokens: List[str]) -> np.ndarray:
+        return np.asarray([self.index_of(t) for t in tokens], dtype=np.int32)
+
+    # -- sampling machinery -------------------------------------------------
+    def negative_table(self, power: float = 0.75) -> np.ndarray:
+        """Unigram^0.75 distribution as per-word probabilities (we sample on
+        device with jax.random.choice rather than the reference's 100M-slot
+        table — same distribution, O(V) memory)."""
+        if self._neg_table is None:
+            freqs = np.asarray(
+                [self.word_counts.get(w, 1) for w in self.index_to_word],
+                dtype=np.float64) ** power
+            freqs[0] = 0.0  # never sample UNK as a negative
+            self._neg_table = (freqs / freqs.sum()).astype(np.float32)
+        return self._neg_table
+
+    def subsample_keep_prob(self, t: float = 1e-3) -> np.ndarray:
+        """Mikolov frequent-word subsampling: keep prob per word index."""
+        if self._keep_prob is None:
+            tot = max(self.total_word_count, 1)
+            f = np.asarray(
+                [self.word_counts.get(w, 0) / tot for w in self.index_to_word],
+                dtype=np.float64)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                p = np.sqrt(t / np.maximum(f, 1e-12)) + t / np.maximum(f, 1e-12)
+            self._keep_prob = np.clip(np.nan_to_num(p, nan=1.0), 0.0, 1.0
+                                      ).astype(np.float32)
+        return self._keep_prob

@@ -1,0 +1,292 @@
+"""Word2Vec + ParagraphVectors — parity with DL4J's
+``org.deeplearning4j.models.word2vec.Word2Vec`` (skip-gram, negative
+sampling, frequent-word subsampling, linear lr decay, wordsNearest /
+similarity surface) and ``org.deeplearning4j.models.paragraphvectors
+.ParagraphVectors`` (PV-DBOW + inferVector).
+
+TPU-first redesign: the reference trains with per-pair Hogwild SGD
+across threads. Here a whole batch of (center, context) pairs is one
+jitted SGNS step — negatives are sampled *inside* jit from the
+unigram^0.75 distribution, the loss is
+``-logσ(u·v⁺) - Σ logσ(-u·v⁻)``, and XLA turns the embedding-gather
+gradients into scatter-adds. One program, MXU-friendly, no locks —
+the batch plays the role the reference's threads did.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tokenizers import DefaultTokenizerFactory, SentenceIterator, TokenizerFactory
+from .vocab import VocabCache
+
+
+def _log_sigmoid(x):
+    return -jax.nn.softplus(-x)
+
+
+def sgns_loss(params, center, context, neg):
+    """Skip-gram negative-sampling loss, SUMMED over the batch.
+
+    center (B,), context (B,), neg (B, K) int32 → scalar. ``syn0`` is the
+    input (word) table, ``syn1`` the output table — names match the
+    reference's lookup-table fields. The sum (not mean) makes one jitted
+    batch step equivalent to the reference's B sequential per-pair SGD
+    updates at the same learning rate (modulo within-batch staleness).
+    """
+    u = params["syn0"][center]                    # (B, D)
+    v_pos = params["syn1"][context]               # (B, D)
+    v_neg = params["syn1"][neg]                   # (B, K, D)
+    pos = jnp.einsum("bd,bd->b", u, v_pos)
+    negs = jnp.einsum("bd,bkd->bk", u, v_neg)
+    return -(_log_sigmoid(pos).sum()
+             + _log_sigmoid(-negs).sum())
+
+
+@dataclass
+class Word2Vec:
+    """Skip-gram/NS word embeddings with the reference's Builder knobs."""
+
+    layer_size: int = 100            # reference layerSize
+    window_size: int = 5
+    negative: int = 5                # negative samples per pair
+    min_word_frequency: int = 5
+    learning_rate: float = 0.025
+    min_learning_rate: float = 1e-4
+    subsample: float = 1e-3          # 0 disables frequent-word subsampling
+    batch_size: int = 2048
+    epochs: int = 1
+    seed: int = 42
+    tokenizer_factory: TokenizerFactory = field(default_factory=DefaultTokenizerFactory)
+
+    vocab: Optional[VocabCache] = None
+    syn0: Optional[np.ndarray] = None            # (V, D) trained vectors
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, sentences: Iterable[str]):
+        sentences = list(sentences)
+        tok = [self.tokenizer_factory.create(s).get_tokens() for s in sentences]
+        self.vocab = VocabCache(self.min_word_frequency).fit(tok)
+        ids = [self.vocab.encode(t) for t in tok]
+
+        centers, contexts = self._build_pairs(ids)
+        if len(centers) == 0:
+            raise ValueError("no training pairs — corpus too small for vocab settings")
+
+        V, D = self.vocab.num_words(), self.layer_size
+        key = jax.random.PRNGKey(self.seed)
+        k0, key = jax.random.split(key)
+        params = {
+            "syn0": (jax.random.uniform(k0, (V, D), jnp.float32) - 0.5) / D,
+            "syn1": jnp.zeros((V, D), jnp.float32),
+        }
+        neg_logits = jnp.log(jnp.asarray(self.vocab.negative_table()) + 1e-30)
+
+        @jax.jit
+        def step(params, key, center, context, lr):
+            nkey, key = jax.random.split(key)
+            neg = jax.random.categorical(
+                nkey, neg_logits[None, :], shape=(center.shape[0], self.negative))
+            loss, grads = jax.value_and_grad(sgns_loss)(params, center, context, neg)
+            # Per-row occurrence normalisation: a row hit k times in the batch
+            # takes the AVERAGE of its k per-pair gradients at full lr. With a
+            # large vocab k≈1 and this is exactly the reference's per-pair
+            # SGD; with heavy collisions it stays stable where a raw sum
+            # diverges (the reference is safe only because it's sequential).
+            c0 = jnp.zeros(V).at[center].add(1.0)
+            c1 = (jnp.zeros(V).at[context].add(1.0)
+                  .at[neg.ravel()].add(1.0))
+            params = {
+                "syn0": params["syn0"] - lr * grads["syn0"] / jnp.maximum(c0, 1.0)[:, None],
+                "syn1": params["syn1"] - lr * grads["syn1"] / jnp.maximum(c1, 1.0)[:, None],
+            }
+            return params, key, loss / center.shape[0]
+
+        n = len(centers)
+        steps_total = max(1, self.epochs * ((n + self.batch_size - 1) // self.batch_size))
+        step_i, rng = 0, np.random.default_rng(self.seed)
+        last_loss = 0.0
+        for _ in range(self.epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n - self.batch_size + 1, self.batch_size):
+                idx = perm[s:s + self.batch_size]
+                frac = step_i / steps_total
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - frac))
+                params, key, last_loss = step(
+                    params, key, jnp.asarray(centers[idx]),
+                    jnp.asarray(contexts[idx]), lr)
+                step_i += 1
+            if n < self.batch_size:   # tiny corpora: one padded batch per epoch
+                idx = rng.integers(0, n, size=self.batch_size)
+                params, key, last_loss = step(
+                    params, key, jnp.asarray(centers[idx]),
+                    jnp.asarray(contexts[idx]),
+                    max(self.min_learning_rate, self.learning_rate * (1 - step_i / steps_total)))
+                step_i += 1
+        self.syn0 = np.asarray(params["syn0"])
+        self._last_loss = float(last_loss)
+        return self
+
+    def _build_pairs(self, ids: List[np.ndarray]):
+        rng = np.random.default_rng(self.seed)
+        keep = self.vocab.subsample_keep_prob(self.subsample) if self.subsample else None
+        cs, xs = [], []
+        for sent in ids:
+            sent = sent[sent > 0]                        # drop UNK
+            if keep is not None and len(sent):
+                sent = sent[rng.random(len(sent)) < keep[sent]]
+            L = len(sent)
+            for i in range(L):
+                b = rng.integers(1, self.window_size + 1)  # reference's shrinking window
+                lo, hi = max(0, i - b), min(L, i + b + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        cs.append(sent[i])
+                        xs.append(sent[j])
+        return (np.asarray(cs, np.int32), np.asarray(xs, np.int32))
+
+    # -------------------------------------------------------------- queries
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self.syn0[self.vocab.index_of(word)]
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab.contains_word(word)
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        denom = (np.linalg.norm(a) * np.linalg.norm(b)) or 1e-12
+        return float(a @ b / denom)
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        v = (self.get_word_vector(word_or_vec)
+             if isinstance(word_or_vec, str) else np.asarray(word_or_vec))
+        M = self.syn0 / (np.linalg.norm(self.syn0, axis=1, keepdims=True) + 1e-12)
+        sims = M @ (v / (np.linalg.norm(v) + 1e-12))
+        order = np.argsort(-sims)
+        skip = {0}
+        if isinstance(word_or_vec, str):
+            skip.add(self.vocab.index_of(word_or_vec))
+        out = [self.vocab.word_at_index(i) for i in order if i not in skip]
+        return out[:top_n]
+
+    # ---------------------------------------------------------------- serde
+    def save(self, path: str):
+        """WordVectorSerializer analogue: json header + npy matrix."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.save(path + ".npy", self.syn0)
+        with open(path + ".json", "w") as f:
+            json.dump({"layer_size": self.layer_size,
+                       "words": self.vocab.words()}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "Word2Vec":
+        with open(path + ".json") as f:
+            meta = json.load(f)
+        m = cls(layer_size=meta["layer_size"])
+        m.vocab = VocabCache()
+        m.vocab.index_to_word = meta["words"]
+        m.vocab.word_to_index = {w: i for i, w in enumerate(meta["words"])}
+        m.syn0 = np.load(path + ".npy")
+        return m
+
+
+@dataclass
+class ParagraphVectors(Word2Vec):
+    """PV-DBOW: a document-embedding table trained to predict the words of
+    its document via negative sampling (reference ParagraphVectors with
+    ``sequenceLearningAlgorithm = DBOW``). ``infer_vector`` gradient-descends
+    a fresh doc vector with the word tables frozen.
+    """
+
+    doc_vectors: Optional[np.ndarray] = None
+    _labels: List[str] = field(default_factory=list)
+
+    def fit(self, documents: Sequence[str], labels: Optional[Sequence[str]] = None):
+        docs = list(documents)
+        self._labels = list(labels) if labels else [f"DOC_{i}" for i in range(len(docs))]
+        super().fit(docs)  # trains word tables + vocab
+
+        tokf = self.tokenizer_factory
+        ids = [self.vocab.encode(tokf.create(d).get_tokens()) for d in docs]
+        Nd, D = len(docs), self.layer_size
+        key = jax.random.PRNGKey(self.seed + 1)
+        dvec = (jax.random.uniform(key, (Nd, D)) - 0.5) / D
+        syn1 = jnp.asarray(self.syn0)  # predict into trained word space
+        neg_logits = jnp.log(jnp.asarray(self.vocab.negative_table()) + 1e-30)
+
+        doc_idx, word_idx = [], []
+        for di, sent in enumerate(ids):
+            for w in sent[sent > 0]:
+                doc_idx.append(di)
+                word_idx.append(w)
+        doc_idx = np.asarray(doc_idx, np.int32)
+        word_idx = np.asarray(word_idx, np.int32)
+
+        def loss_fn(dvec, d, w, neg):
+            return sgns_loss({"syn0": dvec, "syn1": syn1}, d, w, neg)
+
+        @jax.jit
+        def step(dvec, key, d, w, lr):
+            nkey, key = jax.random.split(key)
+            neg = jax.random.categorical(nkey, neg_logits[None, :],
+                                         shape=(d.shape[0], self.negative))
+            loss, g = jax.value_and_grad(loss_fn)(dvec, d, w, neg)
+            cnt = jnp.zeros(Nd).at[d].add(1.0)
+            return dvec - lr * g / jnp.maximum(cnt, 1.0)[:, None], key, loss
+
+        rng = np.random.default_rng(self.seed)
+        n = len(doc_idx)
+        bs = min(self.batch_size, max(n, 1))
+        for e in range(max(self.epochs, 5)):
+            idx = rng.integers(0, n, size=bs)
+            dvec, key, _ = step(dvec, key, jnp.asarray(doc_idx[idx]),
+                                jnp.asarray(word_idx[idx]), self.learning_rate)
+        self.doc_vectors = np.asarray(dvec)
+        return self
+
+    def get_doc_vector(self, label: str) -> np.ndarray:
+        return self.doc_vectors[self._labels.index(label)]
+
+    def infer_vector(self, text: str, steps: int = 50, lr: float = 0.05) -> np.ndarray:
+        ids = self.vocab.encode(self.tokenizer_factory.create(text).get_tokens())
+        ids = ids[ids > 0]
+        if len(ids) == 0:
+            return np.zeros(self.layer_size, np.float32)
+        syn1 = jnp.asarray(self.syn0)
+        neg_logits = jnp.log(jnp.asarray(self.vocab.negative_table()) + 1e-30)
+        w = jnp.asarray(ids)
+        d = jnp.zeros((len(ids),), jnp.int32)
+
+        def loss_fn(v, neg):
+            return sgns_loss({"syn0": v[None, :], "syn1": syn1}, d, w, neg)
+
+        @jax.jit
+        def run(v, key):
+            def body(carry, _):
+                v, key = carry
+                nkey, key = jax.random.split(key)
+                neg = jax.random.categorical(nkey, neg_logits[None, :],
+                                             shape=(len(ids), self.negative))
+                g = jax.grad(loss_fn)(v, neg)
+                return (v - lr * g / len(ids), key), None
+            (v, _), _ = jax.lax.scan(body, (v, key), None, length=steps)
+            return v
+
+        key = jax.random.PRNGKey(abs(hash(text)) % (2 ** 31))
+        v0 = (jax.random.uniform(key, (self.layer_size,)) - 0.5) / self.layer_size
+        return np.asarray(run(v0, key))
+
+    def nearest_labels(self, text: str, top_n: int = 5) -> List[str]:
+        v = self.infer_vector(text)
+        M = self.doc_vectors / (np.linalg.norm(self.doc_vectors, axis=1,
+                                               keepdims=True) + 1e-12)
+        sims = M @ (v / (np.linalg.norm(v) + 1e-12))
+        return [self._labels[i] for i in np.argsort(-sims)[:top_n]]

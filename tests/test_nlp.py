@@ -1,0 +1,98 @@
+"""NLP stack tests — tokenizers, vocab, Word2Vec/ParagraphVectors.
+
+Mirrors the reference's Word2VecTests (similarity structure on a toy
+corpus) and tokenizer factory tests.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (BPETokenizer, CharTokenizer,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory, NGramTokenizer,
+                                    ParagraphVectors, VocabCache, Word2Vec)
+
+
+def test_tokenizers():
+    t = DefaultTokenizerFactory().create("Hello TPU world")
+    assert t.get_tokens() == ["Hello", "TPU", "world"]
+    assert t.count_tokens() == 3
+
+    t = DefaultTokenizerFactory(CommonPreprocessor()).create("Hello, World! 42")
+    assert t.get_tokens() == ["hello", "world"]
+
+    assert CharTokenizer("abc").get_tokens() == ["a", "b", "c"]
+
+    ng = NGramTokenizer("a b c", n_min=1, n_max=2)
+    assert "a b" in ng.get_tokens() and "c" in ng.get_tokens()
+
+
+def test_bpe_roundtrip():
+    corpus = ["low lower lowest", "new newer newest", "wide wider widest"] * 5
+    bpe = BPETokenizer(vocab_size=60).train(corpus)
+    ids = bpe.encode("lower newest")
+    assert all(isinstance(i, int) for i in ids)
+    assert bpe.decode(ids) == "lower newest"
+    # merges learned: frequent words should compress below char count
+    assert len(bpe.encode("lowest")) < len("lowest")
+
+
+def test_vocab_cache():
+    v = VocabCache(min_word_frequency=2).fit([
+        ["a", "b", "a", "c"], ["a", "b", "d"]])
+    assert v.contains_word("a") and v.contains_word("b")
+    assert not v.contains_word("c")          # freq 1 < min 2
+    assert v.index_of("zzz") == 0            # UNK
+    assert v.word_at_index(v.index_of("a")) == "a"
+    p = v.negative_table()
+    assert p[0] == 0.0 and abs(p.sum() - 1.0) < 1e-5
+
+
+def _toy_corpus():
+    # two clusters: day-words co-occur, night-words co-occur
+    day = "sun day light morning bright sky"
+    night = "moon night dark evening stars sky"
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(200):
+        w = rng.permutation(day.split())
+        out.append(" ".join(w))
+        w = rng.permutation(night.split())
+        out.append(" ".join(w))
+    return out
+
+
+@pytest.mark.slow
+def test_word2vec_learns_cooccurrence():
+    w2v = Word2Vec(layer_size=32, window_size=3, negative=5,
+                   min_word_frequency=5, epochs=60, batch_size=256,
+                   learning_rate=0.1, subsample=0.0, seed=7).fit(_toy_corpus())
+    assert w2v.has_word("sun") and w2v.has_word("moon")
+    # in-cluster similarity beats cross-cluster
+    assert w2v.similarity("sun", "morning") > w2v.similarity("sun", "stars")
+    near = w2v.words_nearest("night", top_n=4)
+    assert any(w in near for w in ("moon", "dark", "evening", "stars"))
+
+
+def test_word2vec_save_load(tmp_path):
+    w2v = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1,
+                   batch_size=64, subsample=0.0).fit(
+        ["alpha beta gamma delta"] * 30)
+    p = str(tmp_path / "w2v")
+    w2v.save(p)
+    w2 = Word2Vec.load(p)
+    np.testing.assert_allclose(w2.get_word_vector("alpha"),
+                               w2v.get_word_vector("alpha"))
+
+
+@pytest.mark.slow
+def test_paragraph_vectors_infer():
+    docs = (["the cat sat on the mat with another cat"] * 10
+            + ["stocks market trading profit finance money"] * 10)
+    labels = [f"cat_{i}" for i in range(10)] + [f"fin_{i}" for i in range(10)]
+    pv = ParagraphVectors(layer_size=16, min_word_frequency=1, epochs=10,
+                          negative=3, batch_size=256, subsample=0.0,
+                          seed=3).fit(docs, labels)
+    assert pv.doc_vectors.shape == (20, 16)
+    v = pv.infer_vector("cat on a mat")
+    assert v.shape == (16,) and np.isfinite(v).all()
