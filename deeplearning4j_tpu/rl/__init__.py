@@ -1,0 +1,8 @@
+"""deeplearning4j_tpu.rl — RL4J-lite: DQN/DoubleDQN, A2C, replay, envs."""
+
+from .a2c import A2C, A2CConfiguration
+from .dqn import DQN, QLearningConfiguration
+from .env import (CartPoleEnv, Environment, VectorizedCartPole, cartpole_init,
+                  cartpole_step)
+from .networks import build_actor_critic, build_mlp
+from .replay import ReplayBuffer

@@ -1,0 +1,144 @@
+"""DQN / Double-DQN — parity with RL4J's
+``org.deeplearning4j.rl4j.learning.sync.qlearning.discrete.QLearningDiscrete``
+(+ ``QLearningConfiguration``: gamma, epsilon annealing, target-network
+sync, replay warmup, reward clipping).
+
+TPU-first: the TD update — forward both nets, build targets, Huber loss,
+grads, optimizer — is ONE jitted function with donated params/opt-state.
+Action selection jits the Q-forward; the env/replay loop stays on host
+(that part is inherently sequential IO, exactly like the reference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .env import Environment
+from .networks import build_mlp
+from .replay import ReplayBuffer
+
+
+@dataclass
+class QLearningConfiguration:
+    """Reference QLearningConfiguration surface."""
+
+    gamma: float = 0.99
+    learning_rate: float = 1e-3
+    batch_size: int = 64
+    buffer_size: int = 10000
+    warmup_steps: int = 500          # reference expRepPlay start size
+    target_update_freq: int = 250    # reference targetDqnUpdateFreq
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_steps: int = 3000      # linear anneal (reference epsilonNbStep)
+    double_dqn: bool = True
+    reward_clip: Optional[float] = None  # reference rewardFactor/clip
+    max_episode_steps: int = 500
+    seed: int = 0
+    hidden: Sequence[int] = (64, 64)
+
+
+class DQN:
+    """Synchronous deep Q-learning over a discrete-action Environment."""
+
+    def __init__(self, env: Environment, config: QLearningConfiguration = None):
+        self.env = env
+        self.cfg = config or QLearningConfiguration()
+        cfg = self.cfg
+        obs_dim = int(np.prod(env.observation_shape))
+        self._init_fn, self._q_fn = build_mlp(
+            (obs_dim, *cfg.hidden, env.action_space_size))
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = self._init_fn(key)
+        self.target_params = jax.tree_util.tree_map(lambda a: a, self.params)
+        self._opt = optax.adam(cfg.learning_rate)
+        self._opt_state = self._opt.init(self.params)
+        self.buffer = ReplayBuffer(cfg.buffer_size, (obs_dim,), seed=cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._steps = 0
+        self.episode_rewards: List[float] = []
+
+        q_fn, opt, gamma, double = self._q_fn, self._opt, cfg.gamma, cfg.double_dqn
+
+        def td_loss(params, target_params, batch):
+            q = q_fn(params, batch["obs"])                              # (B, A)
+            q_sel = jnp.take_along_axis(q, batch["actions"][:, None], 1)[:, 0]
+            q_next_t = q_fn(target_params, batch["next_obs"])           # (B, A)
+            if double:
+                a_star = jnp.argmax(q_fn(params, batch["next_obs"]), axis=1)
+                q_next = jnp.take_along_axis(q_next_t, a_star[:, None], 1)[:, 0]
+            else:
+                q_next = q_next_t.max(axis=1)
+            target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+                jax.lax.stop_gradient(q_next)
+            return optax.huber_loss(q_sel, target).mean()
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch):
+            loss, grads = jax.value_and_grad(td_loss)(params, target_params, batch)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = update
+        self._q_jit = jax.jit(lambda p, x: q_fn(p, x))
+
+    # ------------------------------------------------------------------ api
+    def epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self._steps / max(cfg.eps_decay_steps, 1))
+        return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
+
+    def act(self, obs, greedy: bool = False) -> int:
+        if not greedy and self._rng.random() < self.epsilon():
+            return int(self._rng.integers(self.env.action_space_size))
+        q = self._q_jit(self.params, jnp.asarray(obs)[None, :])
+        return int(jnp.argmax(q[0]))
+
+    def train(self, episodes: int, callback: Optional[Callable] = None) -> List[float]:
+        """Reference QLearningDiscrete.train — returns per-episode rewards."""
+        cfg = self.cfg
+        for _ in range(episodes):
+            obs = self.env.reset().ravel()
+            ep_reward, done, t = 0.0, False, 0
+            while not done and t < cfg.max_episode_steps:
+                a = self.act(obs)
+                nxt, r, done, info = self.env.step(a)
+                nxt = np.asarray(nxt).ravel()
+                ep_reward += r
+                if cfg.reward_clip is not None:
+                    r = float(np.clip(r, -cfg.reward_clip, cfg.reward_clip))
+                # truncation is not failure: don't bootstrap-terminate on it
+                store_done = done and not info.get("truncated", False)
+                self.buffer.add(obs, a, r, nxt, store_done)
+                obs = nxt
+                self._steps += 1
+                t += 1
+                if len(self.buffer) >= cfg.warmup_steps:
+                    batch = {k: jnp.asarray(v)
+                             for k, v in self.buffer.sample(cfg.batch_size).items()}
+                    self.params, self._opt_state, _ = self._update(
+                        self.params, self.target_params, self._opt_state, batch)
+                if self._steps % cfg.target_update_freq == 0:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda a: a, self.params)
+            self.episode_rewards.append(ep_reward)
+            if callback:
+                callback(self, ep_reward)
+        return self.episode_rewards
+
+    def play(self, max_steps: int = 500) -> float:
+        """One greedy episode (reference Policy.play)."""
+        obs = self.env.reset().ravel()
+        total, done, t = 0.0, False, 0
+        while not done and t < max_steps:
+            obs, r, done, _ = self.env.step(self.act(obs, greedy=True))
+            obs = np.asarray(obs).ravel()
+            total += r
+            t += 1
+        return total

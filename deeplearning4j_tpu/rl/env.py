@@ -1,0 +1,122 @@
+"""RL environments — parity with RL4J's ``org.deeplearning4j.rl4j.mdp.MDP``
+protocol (reset/step/isDone, discrete action space) and its CartPole family
+of toy control tasks.
+
+TPU-first redesign: the physics is a *pure jax function*
+``(state, action) -> (state, reward, done)`` so whole rollouts run
+on-device under ``lax.scan`` and across ``vmap``-vectorised env batches —
+RL4J steps one Java env object per thread; we step N envs per XLA program.
+A small gym-like host wrapper keeps the familiar imperative API.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Environment:
+    """Gym/RL4J-style protocol: reset() → obs; step(a) → (obs, r, done, info)."""
+
+    observation_shape: Tuple[int, ...] = ()
+    action_space_size: int = 0
+
+    def reset(self):
+        raise NotImplementedError
+
+    def step(self, action):
+        raise NotImplementedError
+
+
+# ------------------------------------------------------------------ cartpole
+# Classic control constants (match the canonical CartPole-v1 task RL4J wraps).
+_GRAVITY = 9.8
+_MASS_CART = 1.0
+_MASS_POLE = 0.1
+_TOTAL_MASS = _MASS_CART + _MASS_POLE
+_LENGTH = 0.5
+_POLEMASS_LENGTH = _MASS_POLE * _LENGTH
+_FORCE_MAG = 10.0
+_TAU = 0.02
+_THETA_LIMIT = 12 * 2 * np.pi / 360
+_X_LIMIT = 2.4
+
+
+def cartpole_init(key) -> jnp.ndarray:
+    """Uniform(-0.05, 0.05) start state (x, x_dot, theta, theta_dot)."""
+    return jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+
+
+def cartpole_step(state: jnp.ndarray, action) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pure Euler-integrated cartpole step. action ∈ {0, 1}.
+
+    Returns (next_state, reward, done). Jit/vmap/scan-safe: no Python
+    branching, `done` is a bool array the caller folds into its rollout.
+    """
+    x, x_dot, theta, theta_dot = state[0], state[1], state[2], state[3]
+    force = jnp.where(action == 1, _FORCE_MAG, -_FORCE_MAG)
+    cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+    temp = (force + _POLEMASS_LENGTH * theta_dot ** 2 * sin_t) / _TOTAL_MASS
+    theta_acc = (_GRAVITY * sin_t - cos_t * temp) / (
+        _LENGTH * (4.0 / 3.0 - _MASS_POLE * cos_t ** 2 / _TOTAL_MASS))
+    x_acc = temp - _POLEMASS_LENGTH * theta_acc * cos_t / _TOTAL_MASS
+    x = x + _TAU * x_dot
+    x_dot = x_dot + _TAU * x_acc
+    theta = theta + _TAU * theta_dot
+    theta_dot = theta_dot + _TAU * theta_acc
+    nxt = jnp.stack([x, x_dot, theta, theta_dot])
+    done = (jnp.abs(x) > _X_LIMIT) | (jnp.abs(theta) > _THETA_LIMIT)
+    return nxt, jnp.asarray(1.0, nxt.dtype), done
+
+
+class CartPoleEnv(Environment):
+    """Host wrapper over the pure physics — RL4J's CartPole MDP analogue."""
+
+    observation_shape = (4,)
+    action_space_size = 2
+
+    def __init__(self, seed: int = 0, max_steps: int = 500):
+        self._key = jax.random.PRNGKey(seed)
+        self.max_steps = max_steps
+        self._t = 0
+        self._state = None
+        self._step_jit = jax.jit(cartpole_step)
+
+    def reset(self):
+        self._key, sub = jax.random.split(self._key)
+        self._state = cartpole_init(sub)
+        self._t = 0
+        return np.asarray(self._state)
+
+    def step(self, action):
+        nxt, r, done = self._step_jit(self._state, jnp.asarray(action))
+        self._state = nxt
+        self._t += 1
+        # a step that physically terminates is NOT a truncation, even at the cap
+        trunc = (not bool(done)) and self._t >= self.max_steps
+        return np.asarray(nxt), float(r), bool(done) or trunc, {"truncated": trunc}
+
+
+@dataclass
+class VectorizedCartPole:
+    """N independent cartpoles as ONE on-device batch — the TPU-native env.
+
+    ``reset(key) -> states (N,4)``; ``step(states, actions) ->
+    (states', rewards, dones)`` with auto-reset of finished envs, all pure,
+    so an entire A2C rollout is a single ``lax.scan``.
+    """
+
+    n_envs: int = 8
+
+    def reset(self, key):
+        return jax.vmap(cartpole_init)(jax.random.split(key, self.n_envs))
+
+    def step(self, states, actions, key):
+        nxt, r, done = jax.vmap(cartpole_step)(states, actions)
+        fresh = jax.vmap(cartpole_init)(jax.random.split(key, self.n_envs))
+        nxt = jnp.where(done[:, None], fresh, nxt)   # auto-reset
+        return nxt, r, done

@@ -1,0 +1,70 @@
+"""Small policy/value MLPs built from the framework's own DenseLayer —
+the analogue of RL4J's ``DQNFactoryStdDense`` / ``ActorCriticFactory
+SeparateStdDense`` (RL4J builds DL4J MultiLayerNetworks; we build a
+pure (init, apply) pair over the same layer objects).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layers.base import Ctx
+from ..nn.layers.core import DenseLayer
+
+
+def build_mlp(sizes: Sequence[int], activation: str = "relu",
+              final_activation: str = "identity"):
+    """sizes = (in, h1, ..., out) → (init(key) -> params, apply(params, x) -> y)."""
+    layers = []
+    for i, (a, b) in enumerate(zip(sizes, sizes[1:])):
+        act = activation if i < len(sizes) - 2 else final_activation
+        layers.append(DenseLayer(n_in=a, n_out=b, activation=act))
+
+    def init(key):
+        params = []
+        shape = (sizes[0],)
+        for layer in layers:
+            key, sub = jax.random.split(key)
+            p, _, shape = layer.init(sub, shape)
+            params.append(p)
+        return params
+
+    def apply(params, x):
+        h = x
+        ctx = Ctx(train=False, rng=None)
+        for layer, p in zip(layers, params):
+            h, _ = layer.apply(p, {}, h, ctx)
+        return h
+
+    return init, apply
+
+
+def build_actor_critic(obs_dim: int, n_actions: int,
+                       hidden: Sequence[int] = (64, 64)):
+    """Shared torso, two heads: (init, policy_logits_fn, value_fn combined).
+
+    apply(params, obs) -> (logits (B, A), value (B,)).
+    """
+    torso_sizes = (obs_dim, *hidden)
+    t_init, t_apply = build_mlp(torso_sizes, final_activation="tanh",
+                                activation="tanh")
+    p_head = DenseLayer(n_in=hidden[-1], n_out=n_actions, activation="identity")
+    v_head = DenseLayer(n_in=hidden[-1], n_out=1, activation="identity")
+
+    def init(key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        pp, _, _ = p_head.init(k2, (hidden[-1],))
+        vp, _, _ = v_head.init(k3, (hidden[-1],))
+        return {"torso": t_init(k1), "pi": pp, "v": vp}
+
+    def apply(params, obs):
+        h = t_apply(params["torso"], obs)
+        ctx = Ctx(train=False, rng=None)
+        logits, _ = p_head.apply(params["pi"], {}, h, ctx)
+        value, _ = v_head.apply(params["v"], {}, h, ctx)
+        return logits, value[..., 0]
+
+    return init, apply

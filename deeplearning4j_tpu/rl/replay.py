@@ -1,0 +1,46 @@
+"""Experience replay — parity with RL4J's
+``org.deeplearning4j.rl4j.learning.sync.ExpReplay`` (circular buffer,
+uniform sampling).
+
+Host-side by design: replay is IO/memory plumbing, not compute. The
+buffer is pre-allocated numpy; ``sample`` returns device-ready arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, obs_shape: Tuple[int, ...],
+                 obs_dtype=np.float32, seed: int = 0):
+        self.capacity = int(capacity)
+        self.obs = np.zeros((capacity, *obs_shape), obs_dtype)
+        self.next_obs = np.zeros((capacity, *obs_shape), obs_dtype)
+        self.actions = np.zeros((capacity,), np.int32)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.float32)
+        self._i = 0
+        self._full = False
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return self.capacity if self._full else self._i
+
+    def add(self, obs, action, reward, next_obs, done):
+        i = self._i
+        self.obs[i] = obs
+        self.actions[i] = action
+        self.rewards[i] = reward
+        self.next_obs[i] = next_obs
+        self.dones[i] = float(done)
+        self._i = (i + 1) % self.capacity
+        self._full = self._full or self._i == 0
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, len(self), size=batch_size)
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx], "next_obs": self.next_obs[idx],
+                "dones": self.dones[idx]}
