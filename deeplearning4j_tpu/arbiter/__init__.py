@@ -1,0 +1,9 @@
+"""deeplearning4j_tpu.arbiter — Arbiter-lite hyperparameter search."""
+
+from .runner import (BestScoreCondition, CandidateResult,
+                     MaxCandidatesCondition, MaxTimeCondition,
+                     OptimizationRunner, TerminationCondition)
+from .space import (CandidateGenerator, ContinuousParameterSpace,
+                    DiscreteParameterSpace, FixedValue,
+                    GridSearchCandidateGenerator, IntegerParameterSpace,
+                    ParameterSpace, RandomSearchGenerator)

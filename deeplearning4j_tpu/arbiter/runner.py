@@ -1,0 +1,117 @@
+"""Optimization runner — parity with Arbiter's
+``OptimizationConfiguration`` + ``LocalOptimizationRunner`` (execute a
+candidate generator against a score function, track results, stop on
+termination conditions) and its ``TerminationCondition`` family.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .space import CandidateGenerator
+
+
+# ----------------------------------------------------- termination conditions
+class TerminationCondition:
+    def initialize(self, runner: "OptimizationRunner"):
+        pass
+
+    def terminate(self, runner: "OptimizationRunner") -> bool:
+        raise NotImplementedError
+
+
+class MaxCandidatesCondition(TerminationCondition):
+    def __init__(self, max_candidates: int):
+        self.max_candidates = max_candidates
+
+    def terminate(self, runner):
+        return len(runner.results) >= self.max_candidates
+
+
+class MaxTimeCondition(TerminationCondition):
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+        self._t0 = None
+
+    def initialize(self, runner):
+        self._t0 = time.time()
+
+    def terminate(self, runner):
+        return time.time() - self._t0 >= self.seconds
+
+
+class BestScoreCondition(TerminationCondition):
+    """Stop once the best score crosses a threshold."""
+
+    def __init__(self, threshold: float):
+        self.threshold = threshold
+
+    def terminate(self, runner):
+        best = runner.best_result()
+        if best is None:
+            return False
+        return (best.score <= self.threshold if runner.minimize
+                else best.score >= self.threshold)
+
+
+# --------------------------------------------------------------- result record
+@dataclass
+class CandidateResult:
+    index: int
+    candidate: Dict[str, Any]
+    score: float
+    duration_s: float
+    extra: Any = None
+
+
+class OptimizationRunner:
+    """execute(): pull candidates, score them, keep results + the best.
+
+    ``score_fn(candidate: dict) -> float`` or ``-> (float, extra)`` — the
+    user's train-and-evaluate closure (Arbiter's ScoreFunction + TaskCreator
+    collapsed into one callable).
+    """
+
+    def __init__(self, generator: CandidateGenerator,
+                 score_fn: Callable[[Dict[str, Any]], Any],
+                 minimize: bool = True,
+                 termination_conditions: Optional[List[TerminationCondition]] = None,
+                 on_result: Optional[Callable[[CandidateResult], None]] = None):
+        from .space import RandomSearchGenerator
+        self.generator = generator
+        self.score_fn = score_fn
+        self.minimize = minimize
+        self.conditions = termination_conditions or []
+        self.on_result = on_result
+        self.results: List[CandidateResult] = []
+        if (not self.conditions and isinstance(generator, RandomSearchGenerator)
+                and generator.max_candidates is None):
+            raise ValueError(
+                "unbounded RandomSearchGenerator needs a termination condition "
+                "(or set max_candidates)")
+
+    def execute(self) -> Optional[CandidateResult]:
+        for c in self.conditions:
+            c.initialize(self)
+        for i, candidate in enumerate(self.generator):
+            if any(c.terminate(self) for c in self.conditions):
+                break
+            t0 = time.time()
+            out = self.score_fn(candidate)
+            score, extra = out if isinstance(out, tuple) else (out, None)
+            res = CandidateResult(i, candidate, float(score),
+                                  time.time() - t0, extra)
+            self.results.append(res)
+            if self.on_result:
+                self.on_result(res)
+        return self.best_result()
+
+    def best_result(self) -> Optional[CandidateResult]:
+        import math
+        valid = [r for r in self.results if not math.isnan(r.score)]
+        if not valid:
+            return None
+        key = (lambda r: r.score) if self.minimize else (lambda r: -r.score)
+        return min(valid, key=key)

@@ -1,0 +1,126 @@
+"""Hyperparameter spaces — parity with Arbiter's
+``org.deeplearning4j.arbiter.optimize.api.ParameterSpace`` family
+(ContinuousParameterSpace, IntegerParameterSpace, DiscreteParameterSpace)
+and the grid/random candidate generators.
+
+A search space is a flat dict ``name -> ParameterSpace``; a candidate is
+the sampled dict. Model-construction stays a user callable (the lite
+replacement for Arbiter's MultiLayerSpace config-template machinery).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class ParameterSpace:
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    def grid(self, n: int) -> List[Any]:
+        """n representative values for grid search."""
+        raise NotImplementedError
+
+
+class ContinuousParameterSpace(ParameterSpace):
+    def __init__(self, low: float, high: float, log_scale: bool = False):
+        if log_scale and low <= 0:
+            raise ValueError("log_scale requires low > 0")
+        self.low, self.high, self.log_scale = float(low), float(high), log_scale
+
+    def sample(self, rng):
+        if self.log_scale:
+            return float(np.exp(rng.uniform(math.log(self.low), math.log(self.high))))
+        return float(rng.uniform(self.low, self.high))
+
+    def grid(self, n):
+        if self.log_scale:
+            return [float(v) for v in np.exp(np.linspace(
+                math.log(self.low), math.log(self.high), n))]
+        return [float(v) for v in np.linspace(self.low, self.high, n)]
+
+
+class IntegerParameterSpace(ParameterSpace):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = int(low), int(high)
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high + 1))
+
+    def grid(self, n):
+        vals = np.unique(np.linspace(self.low, self.high, n).round().astype(int))
+        return [int(v) for v in vals]
+
+
+class DiscreteParameterSpace(ParameterSpace):
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(len(self.values)))]
+
+    def grid(self, n):
+        return list(self.values)
+
+
+class FixedValue(ParameterSpace):
+    def __init__(self, value: Any):
+        self.value = value
+
+    def sample(self, rng):
+        return self.value
+
+    def grid(self, n):
+        return [self.value]
+
+
+# -------------------------------------------------------------- generators
+class CandidateGenerator:
+    """Yields candidate dicts; exhausted generators stop iteration."""
+
+    def __init__(self, space: Dict[str, ParameterSpace]):
+        self.space = dict(space)
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class RandomSearchGenerator(CandidateGenerator):
+    """Reference ``RandomSearchGenerator`` — endless iid samples."""
+
+    def __init__(self, space, seed: int = 0, max_candidates: Optional[int] = None):
+        super().__init__(space)
+        self.seed = seed
+        self.max_candidates = max_candidates
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed)
+        n = 0
+        while self.max_candidates is None or n < self.max_candidates:
+            yield {k: s.sample(rng) for k, s in self.space.items()}
+            n += 1
+
+
+class GridSearchCandidateGenerator(CandidateGenerator):
+    """Reference ``GridSearchCandidateGenerator`` — cartesian product of
+    per-dimension grids; order 'sequential' or 'random' (shuffled)."""
+
+    def __init__(self, space, discretization_count: int = 5,
+                 mode: str = "sequential", seed: int = 0):
+        super().__init__(space)
+        self.discretization_count = discretization_count
+        self.mode = mode
+        self.seed = seed
+
+    def __iter__(self):
+        keys = list(self.space)
+        axes = [self.space[k].grid(self.discretization_count) for k in keys]
+        combos = list(itertools.product(*axes))
+        if self.mode == "random":
+            np.random.default_rng(self.seed).shuffle(combos)
+        for combo in combos:
+            yield dict(zip(keys, combo))

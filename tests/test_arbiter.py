@@ -1,0 +1,104 @@
+"""Arbiter-lite tests: spaces, generators, runner + termination, and an
+end-to-end search that tunes a real (tiny) network's learning rate —
+mirrors Arbiter's MLPTestCase hyperparameter-optimization flow.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (BestScoreCondition,
+                                        ContinuousParameterSpace,
+                                        DiscreteParameterSpace,
+                                        GridSearchCandidateGenerator,
+                                        IntegerParameterSpace,
+                                        MaxCandidatesCondition,
+                                        MaxTimeCondition, OptimizationRunner,
+                                        RandomSearchGenerator)
+
+
+def test_spaces_sample_and_grid():
+    rng = np.random.default_rng(0)
+    c = ContinuousParameterSpace(1e-4, 1e-1, log_scale=True)
+    vals = [c.sample(rng) for _ in range(100)]
+    assert all(1e-4 <= v <= 1e-1 for v in vals)
+    # log-uniform: median far below arithmetic midpoint
+    assert np.median(vals) < 0.02
+    assert c.grid(3)[0] == pytest.approx(1e-4)
+
+    i = IntegerParameterSpace(2, 5)
+    assert set(i.grid(10)) == {2, 3, 4, 5}
+    assert all(2 <= i.sample(rng) <= 5 for _ in range(20))
+
+    d = DiscreteParameterSpace(["relu", "tanh"])
+    assert d.grid(99) == ["relu", "tanh"]
+
+
+def test_grid_generator_cartesian():
+    gen = GridSearchCandidateGenerator(
+        {"lr": ContinuousParameterSpace(0.1, 0.3),
+         "units": DiscreteParameterSpace([8, 16])},
+        discretization_count=3)
+    combos = list(gen)
+    assert len(combos) == 6
+    assert {c["units"] for c in combos} == {8, 16}
+
+
+def test_runner_max_candidates_and_best():
+    gen = RandomSearchGenerator({"x": ContinuousParameterSpace(-2, 2)}, seed=1)
+    runner = OptimizationRunner(
+        gen, lambda c: (c["x"] - 0.5) ** 2, minimize=True,
+        termination_conditions=[MaxCandidatesCondition(40)])
+    best = runner.execute()
+    assert len(runner.results) == 40
+    assert abs(best.candidate["x"] - 0.5) < 0.5
+
+
+def test_runner_best_score_stops_early():
+    gen = RandomSearchGenerator({"x": ContinuousParameterSpace(0, 1)}, seed=2)
+    runner = OptimizationRunner(
+        gen, lambda c: c["x"], minimize=True,
+        termination_conditions=[MaxCandidatesCondition(500),
+                                BestScoreCondition(0.05)])
+    runner.execute()
+    assert len(runner.results) < 500
+    assert runner.best_result().score <= 0.05
+
+
+def test_runner_max_time():
+    import itertools
+    gen = RandomSearchGenerator({"x": ContinuousParameterSpace(0, 1)}, seed=3)
+    import time
+    runner = OptimizationRunner(
+        gen, lambda c: time.sleep(0.02) or c["x"],
+        termination_conditions=[MaxTimeCondition(0.15)])
+    runner.execute()
+    assert 1 <= len(runner.results) <= 20
+
+
+@pytest.mark.slow
+def test_search_tunes_real_network_lr():
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(X @ w, axis=1)]
+
+    def score(cand):
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(cand["lr"])).list()
+                .layer(DenseLayer(n_in=8, n_out=cand["units"], activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init((8,))
+        loss = net.fit(X, y, epochs=30)
+        return loss
+
+    gen = GridSearchCandidateGenerator(
+        {"lr": DiscreteParameterSpace([1e-5, 3e-3]),
+         "units": DiscreteParameterSpace([16])})
+    best = OptimizationRunner(gen, score, minimize=True).execute()
+    # sane lr must beat the degenerate one
+    assert best.candidate["lr"] == pytest.approx(3e-3)
