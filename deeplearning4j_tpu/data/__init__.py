@@ -1,6 +1,10 @@
 """deeplearning4j_tpu.data — datasets, iterators, normalizers."""
 
 from .dataset import DataSet, MultiDataSet
+from .datavec import (CSVRecordReader, CollectionRecordReader,
+                      LineRecordReader, RecordReader,
+                      RecordReaderDataSetIterator, Schema, TransformProcess,
+                      make_image_augmenter, resize_images)
 from .iterators import (ArrayDataSetIterator, BaseDatasetIterator,
                         Cifar10DataSetIterator, EmnistDataSetIterator,
                         IrisDataSetIterator, KFoldIterator,

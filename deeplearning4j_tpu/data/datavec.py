@@ -1,0 +1,357 @@
+"""DataVec-lite ETL — parity with the DataVec modules the reference trains
+from: record readers (``org.datavec.api.records.reader.impl.csv
+.CSVRecordReader``, ``LineRecordReader``, ``CollectionRecordReader``),
+``Schema`` + ``TransformProcess`` (categorical→onehot/integer, filters,
+derived/removed columns, normalization) and the
+``RecordReaderDataSetIterator`` bridge into DataSet batches.
+
+Host-side by design (ETL feeds the device); the image-augmentation ops at
+the bottom are the exception — they are jax/jit batched functions so
+augmentation runs on-device, replacing DataVec's per-image OpenCV
+ImageTransform chain with one vectorised XLA program.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import DataSet
+from .iterators import ArrayDataSetIterator
+
+
+# ------------------------------------------------------------ record readers
+class RecordReader:
+    """Reference RecordReader: iterate records (lists of values)."""
+
+    def __iter__(self) -> Iterable[List[Any]]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionRecordReader(RecordReader):
+    def __init__(self, records: Sequence[Sequence[Any]]):
+        self._records = [list(r) for r in records]
+
+    def __iter__(self):
+        return iter(self._records)
+
+
+class LineRecordReader(RecordReader):
+    """One record per line, single string value."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self):
+        with open(self.path, "r", encoding="utf-8") as f:
+            for line in f:
+                yield [line.rstrip("\n")]
+
+
+class CSVRecordReader(RecordReader):
+    """Reference CSVRecordReader(skipNumLines, delimiter). Values parsed to
+    float when possible, else kept as strings."""
+
+    def __init__(self, path: Optional[str] = None, skip_lines: int = 0,
+                 delimiter: str = ",", text: Optional[str] = None):
+        self.path, self.text = path, text
+        self.skip_lines, self.delimiter = skip_lines, delimiter
+
+    @staticmethod
+    def _parse(v: str):
+        v = v.strip()
+        try:
+            f = float(v)
+            return int(f) if f.is_integer() and "." not in v and "e" not in v.lower() else f
+        except ValueError:
+            return v
+
+    def __iter__(self):
+        if self.text is not None:
+            src = io.StringIO(self.text)
+        else:
+            src = open(self.path, "r", encoding="utf-8", newline="")
+        try:
+            for i, row in enumerate(_csv.reader(src, delimiter=self.delimiter)):
+                if i < self.skip_lines or not row:
+                    continue
+                yield [self._parse(v) for v in row]
+        finally:
+            src.close()
+
+
+# -------------------------------------------------------------------- schema
+@dataclass
+class Column:
+    name: str
+    kind: str                       # 'numeric' | 'integer' | 'categorical' | 'string'
+    categories: Optional[List[str]] = None
+
+
+class Schema:
+    """Reference ``org.datavec.api.transform.schema.Schema`` (builder)."""
+
+    def __init__(self, columns: Optional[List[Column]] = None):
+        self.columns = columns or []
+
+    class Builder:
+        def __init__(self):
+            self._cols: List[Column] = []
+
+        def add_column_double(self, name):
+            self._cols.append(Column(name, "numeric"))
+            return self
+
+        add_column_float = add_column_double
+
+        def add_column_integer(self, name):
+            self._cols.append(Column(name, "integer"))
+            return self
+
+        def add_column_categorical(self, name, categories):
+            self._cols.append(Column(name, "categorical", list(categories)))
+            return self
+
+        def add_column_string(self, name):
+            self._cols.append(Column(name, "string"))
+            return self
+
+        def build(self) -> "Schema":
+            return Schema(self._cols)
+
+    @staticmethod
+    def builder() -> "Schema.Builder":
+        return Schema.Builder()
+
+    def names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(name)
+
+    def column(self, name: str) -> Column:
+        return self.columns[self.index_of(name)]
+
+
+# ----------------------------------------------------------- transform steps
+class TransformProcess:
+    """Reference ``TransformProcess`` — an ordered pipeline over records.
+
+    Built via ``TransformProcess.builder(schema)``; ``execute(records)``
+    runs every step; the post-transform schema is ``final_schema()``.
+    """
+
+    def __init__(self, initial_schema: Schema, steps: List[Callable]):
+        self.initial_schema = initial_schema
+        self._steps = steps
+
+    class Builder:
+        def __init__(self, schema: Schema):
+            self._schema = Schema(list(schema.columns))
+            self._steps: List[Callable] = []
+
+        # each builder method appends (fn(records, schema) -> (records, schema))
+        def categorical_to_integer(self, name):
+            def step(records, schema):
+                i = schema.index_of(name)
+                cats = schema.columns[i].categories
+                lut = {c: j for j, c in enumerate(cats)}
+                for r in records:
+                    r[i] = lut[r[i]]
+                schema.columns[i] = Column(name, "integer")
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def categorical_to_one_hot(self, name):
+            def step(records, schema):
+                i = schema.index_of(name)
+                cats = schema.columns[i].categories
+                lut = {c: j for j, c in enumerate(cats)}
+                for r in records:
+                    onehot = [0.0] * len(cats)
+                    onehot[lut[r[i]]] = 1.0
+                    r[i:i + 1] = onehot
+                schema.columns[i:i + 1] = [Column(f"{name}[{c}]", "numeric")
+                                           for c in cats]
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def remove_columns(self, *names):
+            def step(records, schema):
+                idx = sorted((schema.index_of(n) for n in names), reverse=True)
+                for r in records:
+                    for i in idx:
+                        del r[i]
+                for i in idx:
+                    del schema.columns[i]
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def filter_rows(self, predicate: Callable[[Dict[str, Any]], bool]):
+            """Keep rows where predicate(dict row) is True (reference
+            FilterInvalidValues / ConditionFilter analogue)."""
+            def step(records, schema):
+                names = schema.names()
+                records = [r for r in records
+                           if predicate(dict(zip(names, r)))]
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def add_derived_column(self, name: str, fn: Callable[[Dict[str, Any]], Any],
+                               kind: str = "numeric"):
+            def step(records, schema):
+                names = schema.names()
+                for r in records:
+                    r.append(fn(dict(zip(names, r))))
+                schema.columns.append(Column(name, kind))
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def normalize_min_max(self, name, new_min=0.0, new_max=1.0):
+            # Stats are fit on the FIRST non-empty execute() and reused for
+            # later calls (so train-fitted stats apply to the test split,
+            # like DataVec's DataAnalysis-derived normalizers).
+            stats = {}
+
+            def step(records, schema):
+                i = schema.index_of(name)
+                if "lo" not in stats:
+                    if not records:
+                        return records, schema
+                    vals = np.asarray([r[i] for r in records], np.float64)
+                    stats["lo"], stats["hi"] = vals.min(), vals.max()
+                lo, hi = stats["lo"], stats["hi"]
+                span = (hi - lo) or 1.0
+                for r in records:
+                    r[i] = float((r[i] - lo) / span * (new_max - new_min) + new_min)
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def normalize_standardize(self, name):
+            stats = {}
+
+            def step(records, schema):
+                i = schema.index_of(name)
+                if "mu" not in stats:
+                    if not records:
+                        return records, schema
+                    vals = np.asarray([r[i] for r in records], np.float64)
+                    stats["mu"], stats["sd"] = vals.mean(), vals.std() or 1.0
+                for r in records:
+                    r[i] = float((r[i] - stats["mu"]) / stats["sd"])
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self._schema, self._steps)
+
+    @staticmethod
+    def builder(schema: Schema) -> "TransformProcess.Builder":
+        return TransformProcess.Builder(schema)
+
+    def execute(self, records: Iterable[Sequence[Any]]):
+        recs = [list(r) for r in records]
+        schema = Schema([Column(c.name, c.kind, c.categories)
+                         for c in self.initial_schema.columns])
+        for step in self._steps:
+            recs, schema = step(recs, schema)
+        self._final_schema = schema
+        return recs
+
+    def final_schema(self) -> Schema:
+        if not hasattr(self, "_final_schema"):
+            self.execute([])
+        return self._final_schema
+
+
+# ------------------------------------------------- reader → DataSet iterator
+class RecordReaderDataSetIterator(ArrayDataSetIterator):
+    """Reference ``RecordReaderDataSetIterator(reader, batch, labelIdx,
+    numClasses)`` — materialises the reader, splits label column, one-hots
+    classification labels; ``regression=True`` keeps labels continuous."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int = -1, num_classes: Optional[int] = None,
+                 regression: bool = False,
+                 transform: Optional[TransformProcess] = None):
+        records = list(reader)
+        if transform is not None:
+            records = transform.execute(records)
+        rows = np.asarray(records, np.float32)
+        if label_index < 0:
+            label_index = rows.shape[1] + label_index
+        y = rows[:, label_index]
+        X = np.delete(rows, label_index, axis=1)
+        if regression:
+            labels = y[:, None].astype(np.float32)
+        else:
+            if num_classes is None:
+                num_classes = int(y.max()) + 1
+            labels = np.eye(num_classes, dtype=np.float32)[y.astype(int)]
+        super().__init__(X, labels, batch_size)
+
+
+# ---------------------------------------------------- on-device image pipeline
+def make_image_augmenter(*, crop_padding: int = 0, flip_horizontal: bool = False,
+                         mean: Optional[Sequence[float]] = None,
+                         std: Optional[Sequence[float]] = None):
+    """Build a jitted ``augment(key, images (B,H,W,C)) -> images`` pipeline.
+
+    The TPU-native replacement for DataVec's per-image ImageTransform chain
+    (CropImageTransform/FlipImageTransform/NormalizeImageTransform): the
+    whole batch is augmented in one XLA program on device.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    mean_a = None if mean is None else jnp.asarray(mean, jnp.float32)
+    std_a = None if std is None else jnp.asarray(std, jnp.float32)
+
+    def augment(key, images):
+        B, H, W, C = images.shape
+        if crop_padding:
+            p = crop_padding
+            padded = jnp.pad(images, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect")
+            key, k = jax.random.split(key)
+            offs = jax.random.randint(k, (B, 2), 0, 2 * p + 1)
+
+            def crop_one(img, off):
+                return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (H, W, C))
+            images = jax.vmap(crop_one)(padded, offs)
+        if flip_horizontal:
+            key, k = jax.random.split(key)
+            do = jax.random.bernoulli(k, 0.5, (B,))
+            images = jnp.where(do[:, None, None, None], images[:, :, ::-1, :], images)
+        if mean_a is not None:
+            images = images - mean_a
+        if std_a is not None:
+            images = images / std_a
+        return images
+
+    return jax.jit(augment)
+
+
+def resize_images(images, height: int, width: int, method: str = "bilinear"):
+    """Batched on-device resize (DataVec ResizeImageTransform analogue)."""
+    import jax
+    import jax.numpy as jnp
+    images = jnp.asarray(images)
+    B, _, _, C = images.shape
+    return jax.image.resize(images, (B, height, width, C), method=method)

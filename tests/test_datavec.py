@@ -1,0 +1,129 @@
+"""DataVec-lite tests: readers, schema/transforms, reader→DataSet bridge,
+on-device image augmentation. Mirrors DataVec's CSVRecordReaderTest /
+TransformProcessTest behaviors.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.data.datavec import (CollectionRecordReader,
+                                             CSVRecordReader, LineRecordReader,
+                                             RecordReaderDataSetIterator,
+                                             Schema, TransformProcess,
+                                             make_image_augmenter,
+                                             resize_images)
+
+CSV = "a,1.5,red\nb,2.5,blue\nc,3.5,red\nd,4.5,green\n"
+
+
+def test_csv_reader_parses_types(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("h1,h2\n1,2.5\n3,x\n")
+    rows = list(CSVRecordReader(str(p), skip_lines=1))
+    assert rows == [[1, 2.5], [3, "x"]]
+    # text mode
+    rows = list(CSVRecordReader(text=CSV))
+    assert rows[0] == ["a", 1.5, "red"]
+
+
+def test_line_and_collection_readers(tmp_path):
+    p = tmp_path / "lines.txt"
+    p.write_text("one\ntwo\n")
+    assert list(LineRecordReader(str(p))) == [["one"], ["two"]]
+    crr = CollectionRecordReader([[1, 2], [3, 4]])
+    assert list(crr) == [[1, 2], [3, 4]]
+    assert list(crr) == [[1, 2], [3, 4]]  # restartable
+
+
+def test_transform_process_pipeline():
+    schema = (Schema.builder()
+              .add_column_string("id")
+              .add_column_double("value")
+              .add_column_categorical("color", ["red", "blue", "green"])
+              .build())
+    tp = (TransformProcess.builder(schema)
+          .remove_columns("id")
+          .filter_rows(lambda r: r["value"] < 4.0)
+          .add_derived_column("value_sq", lambda r: r["value"] ** 2)
+          .categorical_to_one_hot("color")
+          .normalize_min_max("value")
+          .build())
+    out = tp.execute(list(CSVRecordReader(text=CSV)))
+    # 3 rows survive the filter; columns: value, color[3x], value_sq
+    assert len(out) == 3
+    names = tp.final_schema().names()
+    assert names == ["value", "color[red]", "color[blue]", "color[green]", "value_sq"]
+    vals = [r[0] for r in out]
+    assert min(vals) == 0.0 and max(vals) == 1.0
+    assert out[0][1:4] == [1.0, 0.0, 0.0]          # red
+    assert out[0][4] == pytest.approx(1.5 ** 2)
+
+
+def test_categorical_to_integer():
+    schema = (Schema.builder()
+              .add_column_categorical("c", ["x", "y"]).build())
+    tp = TransformProcess.builder(schema).categorical_to_integer("c").build()
+    assert tp.execute([["y"], ["x"]]) == [[1], [0]]
+    assert tp.final_schema().column("c").kind == "integer"
+
+
+def test_record_reader_dataset_iterator_classification():
+    # iris-like: 2 features + integer class label
+    rows = [[0.1, 0.2, 0], [0.3, 0.1, 1], [0.5, 0.9, 2], [0.2, 0.4, 1]]
+    it = RecordReaderDataSetIterator(CollectionRecordReader(rows),
+                                     batch_size=2, label_index=-1, num_classes=3)
+    ds = it.next()
+    assert ds.features.shape == (2, 2)
+    assert ds.labels.shape == (2, 3)
+    assert ds.labels[1].tolist() == [0.0, 1.0, 0.0]
+    assert it.total_outcomes() == 3
+
+
+def test_record_reader_dataset_iterator_regression():
+    rows = [[1.0, 2.0, 3.5], [2.0, 3.0, 5.5]]
+    it = RecordReaderDataSetIterator(CollectionRecordReader(rows),
+                                     batch_size=2, regression=True)
+    ds = it.next()
+    assert ds.labels.shape == (2, 1)
+    assert ds.labels[0, 0] == pytest.approx(3.5)
+
+
+def test_transform_into_network_fit():
+    """End-to-end: CSV → transform → iterator → fit (the DataVec use case)."""
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(64):
+        x1, x2 = rng.normal(), rng.normal()
+        lines.append(f"{x1:.4f},{x2:.4f},{'pos' if x1 + x2 > 0 else 'neg'}")
+    schema = (Schema.builder().add_column_double("x1").add_column_double("x2")
+              .add_column_categorical("y", ["neg", "pos"]).build())
+    tp = (TransformProcess.builder(schema)
+          .categorical_to_integer("y").build())
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(text="\n".join(lines)), batch_size=16,
+        label_index=2, num_classes=2, transform=tp)
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_in=2, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init((2,))
+    first = net.fit(it, epochs=1)
+    last = net.fit(it, epochs=25)
+    assert last < first
+
+
+def test_image_augmenter_shapes_and_flip():
+    key = jax.random.PRNGKey(0)
+    imgs = jax.random.uniform(key, (4, 8, 8, 3))
+    aug = make_image_augmenter(crop_padding=2, flip_horizontal=True,
+                               mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25))
+    out = aug(key, imgs)
+    assert out.shape == (4, 8, 8, 3)
+    # normalization applied: mean-subtracted range
+    assert float(out.min()) < 0.0
+    out2 = resize_images(imgs, 16, 16)
+    assert out2.shape == (4, 16, 16, 3)
