@@ -52,7 +52,7 @@ class LineRecordReader(RecordReader):
     def __iter__(self):
         with open(self.path, "r", encoding="utf-8") as f:
             for line in f:
-                yield [line.rstrip("\n")]
+                yield [line.rstrip("\r\n")]
 
 
 class CSVRecordReader(RecordReader):
@@ -68,8 +68,11 @@ class CSVRecordReader(RecordReader):
     def _parse(v: str):
         v = v.strip()
         try:
-            f = float(v)
-            return int(f) if f.is_integer() and "." not in v and "e" not in v.lower() else f
+            return int(v)          # exact — no float round-trip for big ints
+        except ValueError:
+            pass
+        try:
+            return float(v)
         except ValueError:
             return v
 
@@ -294,6 +297,9 @@ class RecordReaderDataSetIterator(ArrayDataSetIterator):
         records = list(reader)
         if transform is not None:
             records = transform.execute(records)
+        if not records:
+            raise ValueError("record reader produced no records "
+                             "(empty source or filter removed every row)")
         rows = np.asarray(records, np.float32)
         if label_index < 0:
             label_index = rows.shape[1] + label_index
@@ -304,7 +310,12 @@ class RecordReaderDataSetIterator(ArrayDataSetIterator):
         else:
             if num_classes is None:
                 num_classes = int(y.max()) + 1
-            labels = np.eye(num_classes, dtype=np.float32)[y.astype(int)]
+            yi = y.astype(int)
+            if (yi != y).any() or yi.min() < 0 or yi.max() >= num_classes:
+                raise ValueError(
+                    f"class labels must be integers in [0, {num_classes}); "
+                    f"got range [{y.min()}, {y.max()}]")
+            labels = np.eye(num_classes, dtype=np.float32)[yi]
         super().__init__(X, labels, batch_size)
 
 

@@ -1,0 +1,184 @@
+"""ONNX import tests: torch.onnx.export real models, import into SameDiff,
+compare outputs vs torch to 1e-4. Mirrors the reference's onnx-import
+round-trip tests (nd4j samediff-import-onnx).
+"""
+
+import io
+import sys
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+# torch's torchscript exporter imports `onnx` only to splice in onnxscript
+# custom-function protos; with no custom ops it returns the bytes unchanged.
+# The image has no onnx package, so satisfy the import with an empty-graph
+# stub (test-only — the importer under test parses the wire format itself).
+if "onnx" not in sys.modules:
+    _stub = types.ModuleType("onnx")
+
+    class _StubGraph:
+        node = ()
+
+    class _StubModel:
+        graph = _StubGraph()
+
+    _stub.load_model_from_string = lambda b: _StubModel()
+    sys.modules["onnx"] = _stub
+
+from deeplearning4j_tpu.autodiff.onnx_import import import_onnx, parse_onnx
+
+
+def _export(model, args, **kw):
+    buf = io.BytesIO()
+    model.eval()
+    torch.onnx.export(model, args, buf, opset_version=13, dynamo=False, **kw)
+    return buf.getvalue()
+
+
+def test_parse_onnx_structure():
+    model = torch.nn.Linear(4, 3)
+    data = _export(model, torch.randn(2, 4),
+                   input_names=["x"], output_names=["y"])
+    g = parse_onnx(data)
+    assert g.outputs == ["y"]
+    assert any(t.shape == (3, 4) for t in g.initializers.values())
+    assert {n.op_type for n in g.nodes} <= {"Gemm", "MatMul", "Add"}
+
+
+def test_mlp_roundtrip():
+    model = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(),
+        torch.nn.Linear(16, 5), torch.nn.Softmax(dim=-1))
+    x = torch.randn(4, 8)
+    data = _export(model, x, input_names=["input"], output_names=["out"])
+    sd, outs = import_onnx(data)
+    got = np.asarray(outs[0].eval({"input": x.numpy()}))
+    want = model(x).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_cnn_roundtrip():
+    model = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 8, 3, padding=1),
+        torch.nn.BatchNorm2d(8),
+        torch.nn.ReLU(),
+        torch.nn.MaxPool2d(2),
+        torch.nn.Conv2d(8, 4, 3, stride=2),
+        torch.nn.Flatten(),
+        torch.nn.Linear(4 * 3 * 3, 10))
+    x = torch.randn(2, 3, 16, 16)
+    data = _export(model, x, input_names=["input"], output_names=["out"])
+    sd, outs = import_onnx(data)
+    got = np.asarray(outs[0].eval({"input": x.numpy()}))
+    want = model(x).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_attention_block_roundtrip():
+    class Block(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.ln = torch.nn.LayerNorm(16)
+            self.q = torch.nn.Linear(16, 16)
+            self.k = torch.nn.Linear(16, 16)
+            self.v = torch.nn.Linear(16, 16)
+
+        def forward(self, x):
+            h = self.ln(x)
+            q, k, v = self.q(h), self.k(h), self.v(h)
+            att = torch.softmax(q @ k.transpose(-1, -2) / 4.0, dim=-1)
+            return x + att @ v
+
+    x = torch.randn(2, 6, 16)
+    model = Block()
+    model.eval()
+    data = _export(model, x, input_names=["input"], output_names=["out"])
+    sd, outs = import_onnx(data)
+    got = np.asarray(outs[0].eval({"input": x.numpy()}))
+    want = model(x).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_elementwise_and_reduce_ops():
+    class M(torch.nn.Module):
+        def forward(self, x):
+            y = torch.exp(x) + torch.sqrt(torch.abs(x)) * 2.0
+            y = torch.clamp(y, 0.0, 5.0)
+            return y.mean(dim=1)
+
+    x = torch.randn(3, 7)
+    m = M()
+    data = _export(m, x, input_names=["x"], output_names=["y"])
+    sd, outs = import_onnx(data)
+    got = np.asarray(outs[0].eval({"x": x.numpy()}))
+    np.testing.assert_allclose(got, m(x).numpy(), atol=1e-5)
+
+
+def test_clip_max_only_optional_input():
+    """torch.clamp(x, max=...) exports Clip('x', '', max) — the empty min
+    slot must not shift max into min position."""
+    class M(torch.nn.Module):
+        def forward(self, x):
+            return torch.clamp(x, max=0.5)
+
+    x = torch.randn(3, 4)
+    m = M()
+    data = _export(m, x, input_names=["x"], output_names=["y"])
+    sd, outs = import_onnx(data)
+    got = np.asarray(outs[0].eval({"x": x.numpy()}))
+    np.testing.assert_allclose(got, m(x).numpy(), atol=1e-6)
+
+
+def test_split_with_constant_sizes():
+    class M(torch.nn.Module):
+        def forward(self, x):
+            a, b = torch.split(x, [2, 3], dim=1)
+            return a.sum(dim=1) + b.mean(dim=1)
+
+    x = torch.randn(4, 5)
+    m = M()
+    data = _export(m, x, input_names=["x"], output_names=["y"])
+    sd, outs = import_onnx(data)
+    got = np.asarray(outs[0].eval({"x": x.numpy()}))
+    np.testing.assert_allclose(got, m(x).numpy(), atol=1e-5)
+
+
+def test_unsqueeze_negative_axes_output_rank():
+    from deeplearning4j_tpu.autodiff.onnx_import import _unsqueeze
+    import jax.numpy as jnp
+    x = jnp.zeros((5, 7))
+    assert _unsqueeze(x, [0, -1]).shape == (1, 5, 7, 1)
+    assert _unsqueeze(x, [-1]).shape == (5, 7, 1)
+    assert _unsqueeze(x, [1]).shape == (5, 1, 7)
+
+
+def _pb_key(fnum, wtype):
+    return bytes([(fnum << 3) | wtype])
+
+
+def _pb_varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out += bytes([b | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def _pb_str(fnum, s):
+    data = s.encode() if isinstance(s, str) else s
+    return _pb_key(fnum, 2) + _pb_varint(len(data)) + data
+
+
+def test_unknown_op_is_loud():
+    # hand-encoded ModelProto: graph with one node of an unmapped op type
+    node = _pb_str(1, "x") + _pb_str(2, "y") + _pb_str(4, "FancyCustomOp")
+    vi_x = _pb_str(1, "x")
+    graph = _pb_str(1, node) + _pb_str(11, vi_x) + _pb_str(12, _pb_str(1, "y"))
+    model = _pb_str(7, graph)
+    with pytest.raises(NotImplementedError, match="FancyCustomOp"):
+        import_onnx(model)
