@@ -95,6 +95,15 @@ class Msg:
                 out.append(struct.unpack("<f", struct.pack("<i", v))[0])
         return out
 
+    def doubles(self, f) -> List[float]:
+        out = []
+        for v in self.fields.get(f, []):
+            if isinstance(v, bytes):          # packed repeated fixed64
+                out.extend(struct.unpack(f"<{len(v) // 8}d", v))
+            else:                             # fixed64 read as int (<q)
+                out.append(struct.unpack("<d", struct.pack("<q", v))[0])
+        return out
+
     def float(self, f, default=0.0) -> float:
         vals = self.floats(f)
         return vals[0] if vals else default
@@ -151,8 +160,8 @@ def _tensor_to_np(t: Msg) -> np.ndarray:
         arr = np.asarray(t.ints(7), np.int64)
     elif t.ints(5):
         arr = np.asarray(t.ints(5), _ONNX_DTYPES.get(dtype_code, np.int32))
-    elif t.floats(10):
-        arr = np.asarray(t.floats(10), np.float64)
+    elif t.doubles(10):
+        arr = np.asarray(t.doubles(10), np.float64)
     else:
         arr = np.zeros(0, _ONNX_DTYPES.get(dtype_code, np.float32))
     return arr.reshape(dims) if dims else arr.reshape(())
@@ -252,7 +261,7 @@ def _conv(i, n):
     y = lax.conv_general_dilated(x, w, strides, pad, rhs_dilation=dil,
                                  dimension_numbers=spec,
                                  feature_group_count=groups)
-    if len(i) > 2:
+    if len(i) > 2 and i[2] is not None:
         y = y + i[2].reshape((1, -1) + (1,) * rank)
     return y
 
@@ -260,6 +269,12 @@ def _conv(i, n):
 def _pool(i, n, reducer, init, average=False):
     x = i[0]
     rank = x.ndim - 2
+    if n.ai("ceil_mode", 0):
+        raise NotImplementedError(
+            "onnx_import: ceil_mode=1 pooling is not supported (floor-mode "
+            "reduce_window would silently change the output shape)")
+    if n.aints("dilations", [1] * rank) != [1] * rank:
+        raise NotImplementedError("onnx_import: pooling dilations unsupported")
     k = tuple(n.aints("kernel_shape"))
     strides = tuple(n.aints("strides", [1] * rank))
     ap = n.astr("auto_pad", "NOTSET")
@@ -278,6 +293,22 @@ def _pool(i, n, reducer, init, average=False):
     return y
 
 
+def _static(v):
+    """Materialise an op input that must be a compile-time constant.
+
+    Raises a clear error instead of JAX's TracerArrayConversionError when a
+    model feeds a dynamic Shape->...->Reshape chain (e.g. torch dynamic_axes
+    exports) into a shape-consuming op.
+    """
+    if isinstance(v, jax.core.Tracer):
+        raise NotImplementedError(
+            "onnx_import: this op needs a compile-time-constant input, but got "
+            "a traced (data-dependent) value — dynamic shape chains like "
+            "Shape->Gather->Reshape are not supported; re-export the model "
+            "with static shapes")
+    return np.asarray(v)
+
+
 def _gemm(i, n):
     a, b = i[0], i[1]
     if n.ai("transA"):
@@ -285,13 +316,13 @@ def _gemm(i, n):
     if n.ai("transB"):
         b = b.T
     y = n.af("alpha", 1.0) * (a @ b)
-    if len(i) > 2:
+    if len(i) > 2 and i[2] is not None:
         y = y + n.af("beta", 1.0) * i[2]
     return y
 
 
 def _reshape(i, n):
-    x, shape = i[0], np.asarray(i[1]).astype(np.int64).tolist()
+    x, shape = i[0], _static(i[1]).astype(np.int64).tolist()
     out = []
     for d, s in enumerate(shape):
         out.append(x.shape[d] if s == 0 and n.ai("allowzero", 0) == 0 else s)
@@ -300,11 +331,11 @@ def _reshape(i, n):
 
 def _slice_op(i, n):
     x = i[0]
-    starts = np.asarray(i[1]).ravel().tolist()
-    ends = np.asarray(i[2]).ravel().tolist()
-    axes = (np.asarray(i[3]).ravel().tolist() if len(i) > 3
+    starts = _static(i[1]).ravel().tolist()
+    ends = _static(i[2]).ravel().tolist()
+    axes = (_static(i[3]).ravel().tolist() if len(i) > 3
             else list(range(len(starts))))
-    steps = np.asarray(i[4]).ravel().tolist() if len(i) > 4 else [1] * len(starts)
+    steps = _static(i[4]).ravel().tolist() if len(i) > 4 else [1] * len(starts)
     idx = [slice(None)] * x.ndim
     for s, e, a, st in zip(starts, ends, axes, steps):
         a = a % x.ndim
@@ -329,7 +360,7 @@ def _cast(i, n):
 def _reduce(fn, axes_as_input=False):
     def h(i, n):
         if axes_as_input and len(i) > 1:
-            axes = tuple(np.asarray(i[1]).ravel().astype(int).tolist())
+            axes = tuple(_static(i[1]).ravel().astype(int).tolist())
         else:
             axes = tuple(n.aints("axes")) or None
         return fn(i[0], axis=axes, keepdims=bool(n.ai("keepdims", 1)))
@@ -338,13 +369,13 @@ def _reduce(fn, axes_as_input=False):
 
 def _pad_op(i, n):
     x = i[0]
-    pads = np.asarray(i[1]).ravel().astype(int).tolist() if len(i) > 1 \
+    pads = _static(i[1]).ravel().astype(int).tolist() if len(i) > 1 \
         else n.aints("pads")
     k = x.ndim
     cfg = tuple((pads[d], pads[d + k]) for d in range(k))
     mode = n.astr("mode", "constant")
     if mode == "constant":
-        cval = float(np.asarray(i[2])) if len(i) > 2 and i[2] is not None else 0.0
+        cval = float(_static(i[2])) if len(i) > 2 and i[2] is not None else 0.0
         return jnp.pad(x, cfg, constant_values=cval)
     return jnp.pad(x, cfg, mode={"reflect": "reflect", "edge": "edge"}[mode])
 
@@ -408,10 +439,10 @@ HANDLERS: Dict[str, Any] = {
     "Transpose": lambda i, n: jnp.transpose(
         i[0], n.aints("perm") or None),
     "Squeeze": lambda i, n: jnp.squeeze(
-        i[0], tuple(np.asarray(i[1]).ravel().astype(int).tolist())
+        i[0], tuple(_static(i[1]).ravel().astype(int).tolist())
         if len(i) > 1 else None),
     "Unsqueeze": lambda i, n: _unsqueeze(
-        i[0], np.asarray(i[1]).ravel().astype(int).tolist()
+        i[0], _static(i[1]).ravel().astype(int).tolist()
         if len(i) > 1 else n.aints("axes")),
     "Concat": lambda i, n: jnp.concatenate(i, axis=n.ai("axis", 0)),
     "Split": None,                            # handled specially (multi-output)
@@ -421,9 +452,9 @@ HANDLERS: Dict[str, Any] = {
     "GatherElements": lambda i, n: jnp.take_along_axis(
         i[0], i[1].astype(jnp.int32), axis=n.ai("axis", 0)),
     "Expand": lambda i, n: jnp.broadcast_to(
-        i[0], np.broadcast_shapes(tuple(np.asarray(i[1]).astype(int).tolist()),
+        i[0], np.broadcast_shapes(tuple(_static(i[1]).astype(int).tolist()),
                                   i[0].shape)),
-    "Tile": lambda i, n: jnp.tile(i[0], tuple(np.asarray(i[1]).astype(int).tolist())),
+    "Tile": lambda i, n: jnp.tile(i[0], tuple(_static(i[1]).astype(int).tolist())),
     "Shape": lambda i, n: jnp.asarray(i[0].shape, jnp.int64),
     "Size": lambda i, n: jnp.asarray(i[0].size, jnp.int64),
     "Pad": _pad_op,
@@ -448,11 +479,11 @@ HANDLERS: Dict[str, Any] = {
     "ArgMax": lambda i, n: _argminmax(jnp.argmax, i, n),
     "ArgMin": lambda i, n: _argminmax(jnp.argmin, i, n),
     "ConstantOfShape": lambda i, n: jnp.full(
-        tuple(np.asarray(i[0]).astype(int).tolist()),
+        tuple(_static(i[0]).astype(int).tolist()),
         _tensor_to_np(n.attrs["value"].t).item() if "value" in n.attrs else 0.0),
-    "Range": lambda i, n: jnp.arange(np.asarray(i[0]).item(),
-                                     np.asarray(i[1]).item(),
-                                     np.asarray(i[2]).item()),
+    "Range": lambda i, n: jnp.arange(_static(i[0]).item(),
+                                     _static(i[1]).item(),
+                                     _static(i[2]).item()),
 }
 
 

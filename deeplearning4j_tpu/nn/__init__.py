@@ -13,12 +13,21 @@ from .layers.conv import (Convolution1DLayer, Convolution3DLayer,
                           SeparableConvolution2D, SpaceToDepthLayer,
                           Subsampling1DLayer, SubsamplingLayer, Upsampling1D,
                           Upsampling2D, Upsampling3D, ZeroPaddingLayer)
+from .layers.capsule import (CapsuleLayer, CapsuleStrengthLayer,
+                             PrimaryCapsules)
 from .layers.core import (ActivationLayer, AlphaDropout,
-                          CenterLossOutputLayer, DenseLayer, DropoutLayer,
-                          ElementWiseMultiplicationLayer, EmbeddingLayer,
-                          EmbeddingSequenceLayer, GaussianDropout,
-                          GaussianNoise, LossLayer, OutputLayer, PReLULayer,
-                          RnnOutputLayer, SpatialDropout)
+                          CenterLossOutputLayer, CnnLossLayer, DenseLayer,
+                          DropoutLayer, ElementWiseMultiplicationLayer,
+                          EmbeddingLayer, EmbeddingSequenceLayer,
+                          GaussianDropout, GaussianNoise, LossLayer,
+                          OutputLayer, PReLULayer, RnnOutputLayer,
+                          SpatialDropout)
+from .layers.objdetect import (DetectedObject, Yolo2OutputLayer,
+                               get_predicted_objects, nms)
+from .layers.variational import VariationalAutoencoder
+from .layers.wrappers import (FrozenLayer, FrozenLayerWithBackprop,
+                              MaskZeroLayer, RepeatVector,
+                              TimeDistributedLayer)
 from .layers.norm import (BatchNormalization, LayerNormalization,
                           LocalResponseNormalization, RMSNorm)
 from .layers.recurrent import (GRU, LSTM, BaseRecurrent, Bidirectional,

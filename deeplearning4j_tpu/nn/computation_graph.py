@@ -19,6 +19,7 @@ import optax
 from ..train.updaters import NoOp, build_optimizer
 from .graph import ComputationGraphConfiguration
 from .layers.base import Ctx, Layer
+from .layers.wrappers import unwrap
 from .layers.core import LossLayer, OutputLayer
 from .preprocessors import CnnToFeedForwardPreProcessor
 from .vertices import GraphVertex
@@ -56,7 +57,7 @@ class ComputationGraph:
             if isinstance(node.op, Layer):
                 from .multi_layer_network import _is_ff_layer
                 s = in_shapes[0]
-                if (_is_ff_layer(node.op) or isinstance(node.op, OutputLayer)) \
+                if (_is_ff_layer(node.op) or isinstance(unwrap(node.op), OutputLayer)) \
                         and len(s) == 3:
                     pp = CnnToFeedForwardPreProcessor()
                     self._preprocessors[name] = pp
@@ -94,7 +95,7 @@ class ComputationGraph:
                     m = jax.random.bernoulli(jax.random.fold_in(lrng, 997), keep, h.shape)
                     h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
                 if stop_at_output_preact and name in self.conf.outputs and \
-                        isinstance(node.op, (OutputLayer, LossLayer)):
+                        isinstance(unwrap(node.op), (OutputLayer, LossLayer)):
                     pre_acts[name] = h
                     new_states[name] = states[name]
                     acts[name] = h
@@ -124,13 +125,13 @@ class ComputationGraph:
             stop_at_output_preact=True)
         total = 0.0
         for name in self.conf.outputs:
-            node = self.conf.nodes[name]
+            op = unwrap(self.conf.nodes[name].op)
             y = labels[name]
             w = self.output_loss_weights.get(name, 1.0)
-            if isinstance(node.op, OutputLayer):
-                total = total + w * node.op.compute_loss(params[name], pre_acts[name], y, mask=lmask)
-            elif isinstance(node.op, LossLayer):
-                total = total + w * node.op.compute_loss(pre_acts[name], y, mask=lmask)
+            if isinstance(op, OutputLayer):
+                total = total + w * op.compute_loss(params[name], pre_acts[name], y, mask=lmask)
+            elif isinstance(op, LossLayer):
+                total = total + w * op.compute_loss(pre_acts[name], y, mask=lmask)
             else:
                 raise ValueError(f"output node '{name}' is not an output/loss layer")
         total = total + self._reg_score(params)

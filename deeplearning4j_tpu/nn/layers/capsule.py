@@ -1,0 +1,99 @@
+"""Capsule network layers — primary capsules, dynamic-routing capsules,
+capsule strength.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.{CapsuleLayer,
+PrimaryCapsules, CapsuleStrengthLayer}`` (the reference implements these as
+SameDiff layers; here they are plain jax — routing is a statically-unrolled
+3-iteration loop, fully fused by XLA).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import Ctx, Layer
+from .conv import ConvolutionLayer
+
+
+def squash(s, axis=-1, eps=1e-9):
+    """v = |s|^2/(1+|s|^2) * s/|s| — the capsule nonlinearity."""
+    sq = jnp.sum(jnp.square(s), axis=axis, keepdims=True)
+    return (sq / (1.0 + sq)) * s / jnp.sqrt(sq + eps)
+
+
+@dataclass
+class PrimaryCapsules(Layer):
+    """Conv2D -> reshape to (B, nCaps, capDim) -> squash (PrimaryCapsules)."""
+
+    capsules: int = 8            # capsule channels (conv filters = capsules*cap_dim)
+    capsule_dimensions: int = 8
+    kernel_size: Tuple = (9, 9)
+    stride: Tuple = (2, 2)
+
+    def init(self, key, input_shape):
+        self._conv = ConvolutionLayer(
+            n_out=self.capsules * self.capsule_dimensions,
+            kernel_size=self.kernel_size, stride=self.stride,
+            convolution_mode="truncate", activation="identity",
+            dtype=self.dtype, weight_init=self.weight_init)
+        params, state, (h, w, c) = self._conv.init(key, input_shape)
+        self._n_caps = h * w * self.capsules
+        return params, state, (self._n_caps, self.capsule_dimensions)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        y, state = self._conv.apply(params, state, x, ctx)
+        b = y.shape[0]
+        y = y.reshape(b, -1, self.capsule_dimensions)
+        return squash(y), state
+
+
+@dataclass
+class CapsuleLayer(Layer):
+    """Fully-connected capsules with dynamic routing (CapsuleLayer).
+
+    Input (B, nIn, dIn) -> predictions u_hat via per-pair weight tensor ->
+    ``routings`` iterations of softmax agreement routing -> (B, nOut, dOut).
+    """
+
+    capsules: int = 10
+    capsule_dimensions: int = 16
+    routings: int = 3
+
+    def init(self, key, input_shape):
+        n_in, d_in = input_shape
+        w = jax.random.normal(key, (1, n_in, self.capsules,
+                                    self.capsule_dimensions, d_in),
+                              self.dtype) * 0.01
+        return {"W": w}, {}, (self.capsules, self.capsule_dimensions)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        # u_hat[b,i,o,:] = W[i,o] @ x[b,i]; W[0]: (nIn,nOut,dOut,dIn), x: (B,nIn,dIn)
+        u_hat = jnp.einsum("iokd,bid->biok", params["W"][0], x)
+        logits = jnp.zeros(u_hat.shape[:3], u_hat.dtype)   # (B, nIn, nOut)
+        u_detached = jax.lax.stop_gradient(u_hat)
+        v = None
+        for r in range(self.routings):
+            c = jax.nn.softmax(logits, axis=2)[..., None]
+            uh = u_hat if r == self.routings - 1 else u_detached
+            v = squash(jnp.sum(c * uh, axis=1))            # (B, nOut, dOut)
+            if r < self.routings - 1:
+                logits = logits + jnp.sum(u_detached * v[:, None], axis=-1)
+        return v, state
+
+
+@dataclass
+class CapsuleStrengthLayer(Layer):
+    """(B, nCaps, dim) -> per-capsule L2 norm (B, nCaps) (CapsuleStrengthLayer)."""
+
+    def init(self, key, input_shape):
+        return {}, {}, (input_shape[0],)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=-1) + 1e-9), state
+
+    def has_params(self):
+        return False

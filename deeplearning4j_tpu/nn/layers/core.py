@@ -263,6 +263,22 @@ class LossLayer(Layer):
 
 
 @dataclass
+class CnnLossLayer(LossLayer):
+    """Per-pixel loss over (B,H,W,C) activations, no params (CnnLossLayer).
+
+    Labels are (B,H,W,C); mask (B,H,W) zeroes excluded pixels. The loss
+    flattens space into the batch dim so every loss fn sees (N, C).
+    """
+
+    def compute_loss(self, pre_activation, labels, mask=None):
+        c = pre_activation.shape[-1]
+        flat = pre_activation.reshape(-1, c)
+        flat_labels = labels.reshape(-1, labels.shape[-1])
+        flat_mask = mask.reshape(-1) if mask is not None else None
+        return super().compute_loss(flat, flat_labels, mask=flat_mask)
+
+
+@dataclass
 class OutputLayer(DenseLayer):
     """Dense + loss head (org.deeplearning4j.nn.conf.layers.OutputLayer).
 

@@ -1,0 +1,113 @@
+"""Wrapper layers — frozen, time-distributed, mask-zero, repeat.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.misc.{FrozenLayer,
+FrozenLayerWithBackprop}``, ``...recurrent.TimeDistributed``,
+``...util.MaskZeroLayer``, ``...RepeatVector``.
+
+TPU-first: freezing = ``lax.stop_gradient`` on the wrapped params plus a NoOp
+updater label (the nets already route ``frozen`` params to NoOp); no separate
+"backprop vs not" machinery is needed because reverse-mode is derived from the
+forward function.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .base import Ctx, Layer
+
+
+def unwrap(layer):
+    """Peel wrapper layers to the innermost config (for type dispatch)."""
+    while isinstance(layer, BaseWrapperLayer):
+        layer = layer.layer
+    return layer
+
+
+@dataclass
+class BaseWrapperLayer(Layer):
+    """Delegates init/apply to ``layer``; subclasses adjust in/out."""
+
+    layer: Any = None
+
+    def init(self, key, input_shape):
+        return self.layer.init(key, input_shape)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return self.layer.apply(params, state, x, ctx)
+
+    def has_params(self):
+        return self.layer.has_params()
+
+    def activation_fn(self):
+        return self.layer.activation_fn()
+
+
+@dataclass
+class FrozenLayer(BaseWrapperLayer):
+    """Wrapped layer runs forward but its params get no gradient and no
+    updates (FrozenLayer / FrozenLayerWithBackprop — with jax.grad the
+    distinction vanishes: upstream gradients always flow through)."""
+
+    def __post_init__(self):
+        self.frozen = True
+        if self.layer is not None:
+            self.layer.frozen = True
+
+    def apply(self, params, state, x, ctx: Ctx):
+        params = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        return self.layer.apply(params, state, x, ctx)
+
+
+# Alias: with functional autodiff the two reference classes coincide.
+FrozenLayerWithBackprop = FrozenLayer
+
+
+@dataclass
+class TimeDistributedLayer(BaseWrapperLayer):
+    """Applies a feed-forward layer independently per timestep:
+    (B,T,C) -> flatten to (B*T,C) -> layer -> (B,T,C') (TimeDistributed)."""
+
+    def init(self, key, input_shape):
+        t, n = input_shape
+        params, state, out = self.layer.init(key, (n,))
+        return params, state, (t, out[-1] if isinstance(out, tuple) else out)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        b, t = x.shape[0], x.shape[1]
+        y, state = self.layer.apply(params, state, x.reshape(b * t, -1), ctx)
+        return y.reshape(b, t, -1), state
+
+
+@dataclass
+class MaskZeroLayer(BaseWrapperLayer):
+    """Zeroes masked timesteps on the way *into* the wrapped recurrent layer
+    (MaskZeroLayer); mask comes from ctx.mask (B,T)."""
+
+    mask_value: float = 0.0
+
+    def apply(self, params, state, x, ctx: Ctx):
+        if ctx.mask is not None:
+            keep = ctx.mask[..., None].astype(x.dtype)
+            x = x * keep + self.mask_value * (1.0 - keep)
+        return self.layer.apply(params, state, x, ctx)
+
+
+@dataclass
+class RepeatVector(Layer):
+    """(B,C) -> (B,T,C), repeating the input T times (RepeatVector)."""
+
+    n: int = 1
+
+    def init(self, key, input_shape):
+        return {}, {}, (self.n, input_shape[-1])
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+    def has_params(self):
+        return False

@@ -24,6 +24,7 @@ import optax
 from ..train.updaters import NoOp, build_optimizer, gradient_normalization
 from .conf import MultiLayerConfiguration
 from .layers.base import Ctx, Layer
+from .layers.wrappers import unwrap
 from .layers.core import LossLayer, OutputLayer
 from .preprocessors import CnnToFeedForwardPreProcessor
 
@@ -32,6 +33,7 @@ def _is_ff_layer(layer: Layer) -> bool:
     from .layers.core import (DenseLayer, ElementWiseMultiplicationLayer,
                               EmbeddingLayer)
     from .layers.recurrent import LastTimeStep
+    layer = unwrap(layer)
     return isinstance(layer, (DenseLayer, ElementWiseMultiplicationLayer)) and \
         not isinstance(layer, EmbeddingLayer)
 
@@ -40,6 +42,7 @@ def _is_rnn_layer(layer: Layer) -> bool:
     from .layers.attention import (RecurrentAttentionLayer, SelfAttentionLayer)
     from .layers.core import RnnOutputLayer
     from .layers.recurrent import BaseRecurrent, Bidirectional
+    layer = unwrap(layer)
     return isinstance(layer, (BaseRecurrent, Bidirectional, SelfAttentionLayer,
                               RecurrentAttentionLayer, RnnOutputLayer))
 
@@ -66,9 +69,13 @@ class MultiLayerNetwork:
     def init(self, input_shape=None):
         """Resolve shapes layer-by-layer, create params (reference: init())."""
         if input_shape is None:
-            if self.conf.input_type is None:
-                raise ValueError("Provide input_shape or set_input_type on the config")
-            input_shape = tuple(self.conf.input_type[1])
+            if self.conf.input_type is not None:
+                input_shape = tuple(self.conf.input_type[1])
+            else:
+                n_in = getattr(unwrap(self.layers[0]), "n_in", None)
+                if not n_in:
+                    raise ValueError("Provide input_shape or set_input_type on the config")
+                input_shape = (int(n_in),)
         key = jax.random.PRNGKey(self._g.seed)
         shape = tuple(input_shape)
         for i, layer in enumerate(self.layers):
@@ -77,7 +84,7 @@ class MultiLayerNetwork:
                 pp = CnnToFeedForwardPreProcessor()
                 self._preprocessors[i] = pp
                 shape = pp.out_shape(shape)
-            if isinstance(layer, OutputLayer) and not _is_rnn_layer(layer) and len(shape) == 3:
+            if isinstance(unwrap(layer), OutputLayer) and not _is_rnn_layer(layer) and len(shape) == 3:
                 pp = CnnToFeedForwardPreProcessor()
                 self._preprocessors[i] = pp
                 shape = pp.out_shape(shape)
@@ -98,7 +105,7 @@ class MultiLayerNetwork:
         n = len(self.layers)
         for i, layer in enumerate(self.layers):
             is_last = i == n - 1
-            if stop_before_output and is_last and isinstance(layer, (OutputLayer, LossLayer)):
+            if stop_before_output and is_last and isinstance(unwrap(layer), (OutputLayer, LossLayer)):
                 new_states[f"layer_{i}"] = states[f"layer_{i}"]
                 break
             if i in self._preprocessors:
@@ -148,7 +155,7 @@ class MultiLayerNetwork:
     def _loss(self, params, states, x, y, rng, fmask, lmask):
         h, new_states = self._forward(params, states, x, train=True, rng=rng,
                                       fmask=fmask, lmask=lmask, stop_before_output=True)
-        out_layer = self.layers[-1]
+        out_layer = unwrap(self.layers[-1])
         i = len(self.layers) - 1
         if isinstance(out_layer, OutputLayer):
             if i in self._preprocessors:
