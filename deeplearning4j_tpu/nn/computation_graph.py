@@ -176,6 +176,20 @@ class ComputationGraph:
             per_label_updaters=per_label if has_override else None)
         self._opt_state = self._optimizer.init(self.params)
 
+    def _apply_constraints(self, params):
+        from ..train.constraints import apply_constraints
+        for name, node in self.conf.nodes.items():
+            op = node.op
+            if not isinstance(op, Layer) or op.frozen:
+                continue
+            if op.constraints:
+                params[name] = apply_constraints(params[name], op.constraints,
+                                                 weights=True)
+            if op.bias_constraints:
+                params[name] = apply_constraints(params[name], op.bias_constraints,
+                                                 weights=False, biases=True)
+        return params
+
     def _get_train_step(self):
         if self._train_step is None:
             optimizer = self._optimizer
@@ -184,7 +198,7 @@ class ComputationGraph:
                 (loss, new_states), grads = jax.value_and_grad(
                     self._loss, has_aux=True)(params, states, inputs, labels, rng, fmask, lmask)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                params = self._apply_constraints(optax.apply_updates(params, updates))
                 return params, new_states, opt_state, loss
 
             self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))

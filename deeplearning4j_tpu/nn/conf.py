@@ -41,6 +41,8 @@ class GlobalConf:
     compute_dtype: Any = None         # e.g. jnp.bfloat16 for mixed precision
     mini_batch: bool = True
     max_num_line_search_iterations: int = 5  # accepted for config parity; unused
+    weight_constraints: Any = None    # constrainWeights(...)
+    bias_constraints: Any = None      # constrainBias(...)
 
 
 class NeuralNetConfiguration:
@@ -112,6 +114,19 @@ class Builder:
         self._g.mini_batch = bool(b)
         return self
 
+    def constrain_weights(self, *constraints):
+        self._g.weight_constraints = list(constraints)
+        return self
+
+    def constrain_bias(self, *constraints):
+        self._g.bias_constraints = list(constraints)
+        return self
+
+    def constrain_all_parameters(self, *constraints):
+        self._g.weight_constraints = list(constraints)
+        self._g.bias_constraints = list(constraints)
+        return self
+
     # no-op parity shims (accepted, irrelevant under XLA)
     def optimization_algo(self, *_):
         return self
@@ -172,6 +187,10 @@ def resolve_layer_defaults(layer: Layer, g: GlobalConf) -> Layer:
         layer.l2 = g.l2
     if layer.dropout == 0.0 and g.dropout and layer.has_params():
         layer.dropout = g.dropout
+    if layer.constraints is None and g.weight_constraints:
+        layer.constraints = list(g.weight_constraints)
+    if layer.bias_constraints is None and g.bias_constraints:
+        layer.bias_constraints = list(g.bias_constraints)
     layer.dtype = g.param_dtype if layer.dtype is jnp.float32 else layer.dtype
     if layer.compute_dtype is None and g.compute_dtype is not None:
         layer.compute_dtype = g.compute_dtype

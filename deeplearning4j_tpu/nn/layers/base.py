@@ -80,6 +80,8 @@ class Layer:
     updater: Any = None               # per-layer updater override
     frozen: bool = False
     dropout: float = 0.0              # input dropout (DL4J layer dropOut)
+    constraints: Any = None           # weight constraints (constrainWeights)
+    bias_constraints: Any = None      # bias constraints (constrainBias)
 
     # ---- to be overridden -------------------------------------------------
     def init(self, key, input_shape):

@@ -222,6 +222,20 @@ class MultiLayerNetwork:
             param_labels=labels, per_label_updaters=per_label)
         self._opt_state = self._optimizer.init(self.params)
 
+    def _apply_constraints(self, params):
+        from ..train.constraints import apply_constraints
+        for i, layer in enumerate(self.layers):
+            if layer.frozen:      # frozen params must stay bit-identical
+                continue
+            if layer.constraints:
+                params[f"layer_{i}"] = apply_constraints(
+                    params[f"layer_{i}"], layer.constraints, weights=True)
+            if layer.bias_constraints:
+                params[f"layer_{i}"] = apply_constraints(
+                    params[f"layer_{i}"], layer.bias_constraints,
+                    weights=False, biases=True)
+        return params
+
     def _get_train_step(self):
         if self._train_step is None:
             optimizer = self._optimizer
@@ -230,7 +244,7 @@ class MultiLayerNetwork:
                 (loss, new_states), grads = jax.value_and_grad(
                     self._loss, has_aux=True)(params, states, x, y, rng, fmask, lmask)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                params = self._apply_constraints(optax.apply_updates(params, updates))
                 return params, new_states, opt_state, loss
 
             self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))

@@ -1,5 +1,8 @@
 """deeplearning4j_tpu.train — updaters, schedules, gradient handling."""
 
+from .constraints import (MaxNormConstraint, MinMaxNormConstraint,
+                          NonNegativeConstraint, UnitNormConstraint,
+                          apply_constraints)
 from .schedules import (CycleSchedule, ExponentialSchedule, FixedSchedule,
                         InverseSchedule, MapSchedule, PolySchedule, Schedule,
                         ScheduleType, SigmoidSchedule, StepSchedule,

@@ -1,0 +1,97 @@
+"""Parameter constraints — applied to weights after each updater step.
+
+Reference parity: ``org.deeplearning4j.nn.conf.constraint.{MaxNormConstraint,
+MinMaxNormConstraint, NonNegativeConstraint, UnitNormConstraint}`` and the
+``Builder.constrainWeights/constrainBias/constrainAllParameters`` plumbing.
+
+TPU-first: a constraint is a pure ``apply(w) -> w`` clamp that runs inside
+the jitted train step right after ``optax.apply_updates`` — no host round
+trip, fused into the update program by XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence, Union
+
+import jax.numpy as jnp
+
+# param keys treated as biases / norm-statistics, excluded by constrain-weights
+NON_WEIGHT_KEYS = ("b", "bias", "beta", "gamma", "mean", "var", "centers")
+
+
+def _norm(w, dims):
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=dims, keepdims=True) + 1e-12)
+
+
+@dataclass
+class BaseConstraint:
+    """dims: axes reduced when computing the per-unit norm (reference
+    BaseConstraint.dimensions; default 0 = fan-in axis of a (nIn,nOut) W)."""
+
+    dims: Union[int, Sequence[int]] = 0
+
+    def apply(self, w):  # pragma: no cover — abstract
+        raise NotImplementedError
+
+
+@dataclass
+class MaxNormConstraint(BaseConstraint):
+    max_norm: float = 1.0
+
+    def __init__(self, max_norm=1.0, dims=0):
+        self.max_norm = float(max_norm)
+        self.dims = dims
+
+    def apply(self, w):
+        n = _norm(w, self.dims)
+        return w * jnp.minimum(n, self.max_norm) / n
+
+
+@dataclass
+class MinMaxNormConstraint(BaseConstraint):
+    min_norm: float = 0.0
+    max_norm: float = 1.0
+    rate: float = 1.0
+
+    def __init__(self, min_norm=0.0, max_norm=1.0, rate=1.0, dims=0):
+        self.min_norm = float(min_norm)
+        self.max_norm = float(max_norm)
+        self.rate = float(rate)
+        self.dims = dims
+
+    def apply(self, w):
+        n = _norm(w, self.dims)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1.0 - self.rate) * n
+        return w * target / n
+
+
+@dataclass
+class NonNegativeConstraint(BaseConstraint):
+    def apply(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+@dataclass
+class UnitNormConstraint(BaseConstraint):
+    def apply(self, w):
+        return w / _norm(w, self.dims)
+
+
+def apply_constraints(layer_params: dict, constraints, *, weights=True,
+                      biases=False):
+    """Apply each constraint to the matching params of one layer's dict."""
+    if not constraints:
+        return layer_params
+    out = {}
+    for k, w in layer_params.items():
+        is_bias = k in NON_WEIGHT_KEYS
+        if isinstance(w, dict):
+            out[k] = apply_constraints(w, constraints, weights=weights, biases=biases)
+            continue
+        if (is_bias and biases) or (not is_bias and weights):
+            for c in constraints:
+                w = c.apply(w)
+        out[k] = w
+    return out

@@ -1,0 +1,13 @@
+"""deeplearning4j_tpu.zoo — model zoo (org.deeplearning4j.zoo parity)."""
+
+from .base import ZooModel
+from .cnn_simple import (AlexNet, Darknet19, LeNet, SimpleCNN, SqueezeNet,
+                         TextGenerationLSTM, VGG16, VGG19)
+from .detection import TINY_YOLO_ANCHORS, YOLO2, YOLO2_ANCHORS, TinyYOLO
+from .inception import FaceNetNN4Small2, InceptionResNetV1, Xception
+from .nasnet import NASNet
+from .resnet import ResNet50
+from .unet import UNet
+from .transformer import (BertConfig, TransformerConfig, bert_forward,
+                          bert_init, forward as transformer_forward,
+                          init_params as transformer_init)

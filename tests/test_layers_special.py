@@ -198,3 +198,56 @@ def test_cnn_loss_layer():
     mask = jnp.zeros((b, h, w))
     masked = layer.compute_loss(x, labels, mask=mask)
     assert float(masked) == 0.0
+
+
+# ---------------------------------------------------------- constraints ----
+def test_weight_constraints_applied_after_update():
+    from deeplearning4j_tpu.nn import (NeuralNetConfiguration, DenseLayer,
+                                       OutputLayer, MultiLayerNetwork)
+    from deeplearning4j_tpu.train import Adam, MaxNormConstraint, \
+        NonNegativeConstraint, UnitNormConstraint
+    from deeplearning4j_tpu.data import DataSet
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(5e-2))
+            .constrain_weights(MaxNormConstraint(0.5, dims=0))
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 6)).astype(np.float32)
+    y = jax.nn.one_hot(jnp.asarray(rng.integers(0, 3, 32)), 3)
+    for _ in range(5):
+        net.fit(DataSet(jnp.asarray(x), y))
+    for key in ("layer_0", "layer_1"):
+        w = np.asarray(net.params[key]["W"])
+        col_norms = np.linalg.norm(w, axis=0)
+        assert np.all(col_norms <= 0.5 + 1e-5), (key, col_norms.max())
+
+    # unit-norm + non-negative direct application
+    w = jnp.asarray(np.random.default_rng(1).standard_normal((4, 5)).astype(np.float32))
+    un = UnitNormConstraint(dims=0).apply(w)
+    assert np.allclose(np.linalg.norm(np.asarray(un), axis=0), 1.0, atol=1e-5)
+    nn_ = NonNegativeConstraint().apply(w)
+    assert np.all(np.asarray(nn_) >= 0)
+
+
+def test_frozen_layer_immune_to_global_constraints():
+    from deeplearning4j_tpu.nn import (NeuralNetConfiguration, DenseLayer,
+                                       OutputLayer, MultiLayerNetwork, FrozenLayer)
+    from deeplearning4j_tpu.train import Adam, MaxNormConstraint
+    from deeplearning4j_tpu.data import DataSet
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .constrain_weights(MaxNormConstraint(0.1, dims=0))
+            .list()
+            .layer(FrozenLayer(layer=DenseLayer(n_in=4, n_out=6, activation="relu")))
+            .layer(OutputLayer(n_in=6, n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    w0 = np.asarray(net.params["layer_0"]["W"]).copy()
+    rng = np.random.default_rng(0)
+    y = jax.nn.one_hot(jnp.asarray(rng.integers(0, 2, 8)), 2)
+    net.fit(DataSet(jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32)), y))
+    assert np.array_equal(w0, np.asarray(net.params["layer_0"]["W"]))

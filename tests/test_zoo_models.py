@@ -1,0 +1,85 @@
+"""Zoo model smoke tests (SURVEY.md §2.7): every model builds, forwards
+with the right output shape at reduced input size, and the detection /
+segmentation heads train a step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import zoo
+
+
+def _forward(model, x):
+    net = model.init()
+    return net, net.output(jnp.asarray(x))
+
+
+def test_tiny_yolo_builds_and_fits():
+    m = zoo.TinyYOLO(num_classes=3, input_shape=(64, 64, 3))
+    net = m.init()
+    x = np.random.default_rng(0).standard_normal((1, 64, 64, 3)).astype(np.float32)
+    y = net.output(jnp.asarray(x))
+    # 64 -> /32 = 2x2 grid, 5 anchors * (5+3)
+    assert y.shape == (1, 2, 2, 5 * 8)
+    lab = np.zeros((1, 2, 2, 4 + 3), np.float32)
+    lab[0, 1, 1, :4] = [1.1, 1.2, 1.9, 1.8]
+    lab[0, 1, 1, 4] = 1.0
+    from deeplearning4j_tpu.data import DataSet
+    l0 = net.fit(DataSet(jnp.asarray(x), jnp.asarray(lab)))
+    assert np.isfinite(l0)
+
+
+def test_yolo2_passthrough_shapes():
+    m = zoo.YOLO2(num_classes=4, input_shape=(64, 64, 3))
+    net, y = _forward(m, np.zeros((1, 64, 64, 3), np.float32))
+    assert y.shape == (1, 2, 2, 5 * (5 + 4))
+
+
+def test_unet_shapes_and_fit():
+    m = zoo.UNet(input_shape=(64, 64, 3))
+    net = m.init()
+    x = np.random.default_rng(0).standard_normal((1, 64, 64, 3)).astype(np.float32)
+    y = net.output(jnp.asarray(x))
+    assert y.shape == (1, 64, 64, 1)
+    assert np.all((np.asarray(y) >= 0) & (np.asarray(y) <= 1))  # sigmoid
+    from deeplearning4j_tpu.data import DataSet
+    mask = (np.random.default_rng(1).random((1, 64, 64, 1)) > 0.5).astype(np.float32)
+    l0 = net.fit(DataSet(jnp.asarray(x), jnp.asarray(mask)))
+    assert np.isfinite(l0)
+
+
+def test_xception_small():
+    m = zoo.Xception(num_classes=7, input_shape=(71, 71, 3))
+    net, y = _forward(m, np.zeros((1, 71, 71, 3), np.float32))
+    assert y.shape == (1, 7)
+    assert np.allclose(np.asarray(y).sum(), 1.0, atol=1e-4)
+
+
+def test_inception_resnet_v1_small():
+    m = zoo.InceptionResNetV1(num_classes=5, input_shape=(64, 64, 3),
+                              blocks_a=1, blocks_b=1, blocks_c=1)
+    net, y = _forward(m, np.zeros((1, 64, 64, 3), np.float32))
+    assert y.shape == (1, 5)
+
+
+def test_facenet_nn4_small():
+    m = zoo.FaceNetNN4Small2(num_classes=5, input_shape=(64, 64, 3))
+    net, y = _forward(m, np.zeros((1, 64, 64, 3), np.float32))
+    assert y.shape == (1, 5)
+
+
+def test_nasnet_small():
+    m = zoo.NASNet(num_classes=6, input_shape=(32, 32, 3),
+                   penultimate_filters=96, cells_per_stack=1)
+    net, y = _forward(m, np.zeros((1, 32, 32, 3), np.float32))
+    assert y.shape == (1, 6)
+
+
+def test_squeezenet_and_darknet_build():
+    net, y = _forward(zoo.SqueezeNet(num_classes=4, input_shape=(67, 67, 3)),
+                      np.zeros((1, 67, 67, 3), np.float32))
+    assert y.shape == (1, 4)
+    net, y = _forward(zoo.Darknet19(num_classes=4, input_shape=(64, 64, 3)),
+                      np.zeros((1, 64, 64, 3), np.float32))
+    assert y.shape == (1, 4)
