@@ -1,0 +1,3 @@
+"""deeplearning4j_tpu.kernels — pallas TPU kernels for the hot ops."""
+
+from .flash_attention import flash_attention, mha_reference

@@ -1,0 +1,285 @@
+"""Flash attention — pallas TPU kernel (FlashAttention-2 schedule).
+
+Replaces the reference's cuDNN/libnd4j fused-attention path
+(``org.deeplearning4j.nn.layers.recurrent/attention``, libnd4j
+``multiHeadDotProductAttention``) with a TPU-native kernel: online-softmax
+tiling keeps the (T, T) score matrix out of HBM, MXU matmuls accumulate in
+f32, and the backward pass recomputes probabilities per tile (two passes:
+dQ over query tiles, dK/dV over key tiles) instead of materialising them.
+
+Shapes: q, k, v are (B, H, T, D); output (B, H, T, D). ``causal`` applies a
+lower-triangular mask. Falls back to interpreter mode off-TPU so the same
+code path is unit-testable on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is only importable with a TPU-capable jaxlib; guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(t: int, d: int, block_q: int, block_k: int):
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    while t % bq:
+        bq //= 2
+    while t % bk:
+        bk //= 2
+    return max(bq, 1), max(bk, 1)
+
+
+# ---------------------------------------------------------------- forward --
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale           # (bq, d)
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    nk = t // block_k
+
+    def body(kj, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_idx > q_idx, NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq,), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+    if causal:
+        # only key blocks up to (and including) this query block contribute
+        nk_eff = ((qi + 1) * bq + block_k - 1) // block_k
+        nk_eff = jnp.minimum(nk_eff, nk)
+        acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc, m, l))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m, l))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse broadcast over a small lane dim so the block shape is TPU-tileable
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l_safe))[:, None], (bq, 8))
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    bq, bk = _block_sizes(t, d, block_q, block_k)
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    grid = (b * h, t // bq)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_k=bk),
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+                  pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+                  pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0))],
+        out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+                   pl.BlockSpec((1, bq, 8), lambda bh, i: (bh, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, t, 8), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d), lse[:, :, 0].reshape(b, h, t)
+
+
+# --------------------------------------------------------------- backward --
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0]
+    delta = delta_ref[0][:, 0]
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    nk = t // block_k
+
+    def body(kj, dq):
+        k = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_idx > q_idx, NEG_INF, s)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jnp.zeros((bq, d), jnp.float32)
+    if causal:  # skip fully-masked key blocks, mirroring the forward
+        nk_eff = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk)
+        dq = jax.lax.fori_loop(0, nk_eff, body, dq)
+    else:
+        dq = jax.lax.fori_loop(0, nk, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q):
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    t = q_ref.shape[1]
+    nq = t // block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_idx > q_idx, NEG_INF, s)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+    if causal:  # first query block that can attend to this key block
+        qi_start = (kj * bk) // block_q
+        dk, dv = jax.lax.fori_loop(qi_start, nq, body, (dk, dv))
+    else:
+        dk, dv = jax.lax.fori_loop(0, nq, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------- public api --
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, scale: Optional[float] = None,
+                    causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Fused scaled-dot-product attention. q/k/v: (B, H, T, D) → (B, H, T, D)."""
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = _interpret_default()
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    if interpret is None:
+        interpret = _interpret_default()
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    b, h, t, d = q.shape
+    bq, bk = _block_sizes(t, d, block_q, block_k)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    flat = lambda x: x.reshape(b * h, t, -1)
+    qf, kf, vf, dof = flat(q), flat(k), flat(v), flat(g)
+    lsef = jnp.broadcast_to(lse.reshape(b * h, t)[:, :, None], (b * h, t, 8))
+    deltaf = jnp.broadcast_to(delta.reshape(b * h, t)[:, :, None], (b * h, t, 8))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, block_k=bk),
+        grid=(b * h, t // bq),
+        in_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+                  pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+                  pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+                  pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+                  pl.BlockSpec((1, bq, 8), lambda bh, i: (bh, i, 0)),
+                  pl.BlockSpec((1, bq, 8), lambda bh, i: (bh, i, 0))],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq),
+        grid=(b * h, t // bk),
+        in_specs=[pl.BlockSpec((1, t, d), lambda bh, j: (bh, 0, 0)),
+                  pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+                  pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+                  pl.BlockSpec((1, t, d), lambda bh, j: (bh, 0, 0)),
+                  pl.BlockSpec((1, t, 8), lambda bh, j: (bh, 0, 0)),
+                  pl.BlockSpec((1, t, 8), lambda bh, j: (bh, 0, 0))],
+        out_specs=[pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+                   pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, t, d), q.dtype)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    shape = (b, h, t, d)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_ntc(q, k, v, causal=False, interpret=None):
+    """(B, T, H, D)-layout adapter around :func:`flash_attention` — the
+    layout the nn layers and the transformer use."""
+    out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3), None, causal, 128, 128,
+                          interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def mha_reference(q, k, v, scale=None, causal=False):
+    """Plain-XLA oracle used by tests and as a fallback."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -40,9 +40,15 @@ def multi_head_attention(params, q_in, kv_in, n_heads, head_dim, mask=None,
     q = (q_in @ params["Wq"].astype(dt)).reshape(b, tq, n_heads, head_dim)
     k = (kv_in @ params["Wk"].astype(dt)).reshape(b, tk, n_heads, head_dim)
     v = (kv_in @ params["Wv"].astype(dt)).reshape(b, tk, n_heads, head_dim)
-    if impl == "pallas":
-        from ...kernels.flash_attention import flash_attention
-        out = flash_attention(q, k, v, causal=is_causal, kv_mask=mask)
+    # pallas kernel needs self-attention (Tq == Tk), no key mask, and real TPU
+    # hardware ("pallas_interpret" forces interpreter mode for tests/debug)
+    use_pallas = (impl == "pallas_interpret"
+                  or (impl == "pallas" and jax.default_backend() == "tpu"))
+    if use_pallas and mask is None and tq == tk:
+        from ...kernels.flash_attention import flash_attention_ntc
+        out = flash_attention_ntc(
+            q, k, v, causal=is_causal,
+            interpret=True if impl == "pallas_interpret" else None)
     else:
         kw = {}
         if mask is not None:

@@ -46,6 +46,7 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     use_ring_attention: bool = False
+    use_flash_attention: bool = True   # pallas kernel when running on TPU
     tie_embeddings: bool = False
 
     @property
@@ -143,6 +144,12 @@ def _attention(cfg, q, k, v, mask_bias=None):
     if cfg.use_ring_attention:
         from ..parallel.ring_attention import ring_attention_inner
         out = ring_attention_inner(q, k, v, causal=True)
+    elif (cfg.use_flash_attention and jax.default_backend() == "tpu"
+          and jax.device_count() == 1):
+        # single-chip only: pallas_call has no SPMD partitioning rule, so a
+        # tp/sp-sharded mesh must keep the XLA fused path (which shards)
+        from ..kernels.flash_attention import flash_attention_ntc
+        out = flash_attention_ntc(q, k, v, causal=True)
     else:
         out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
     return out.reshape(b, t, cfg.n_heads * cfg.head_dim)

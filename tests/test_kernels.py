@@ -1,0 +1,72 @@
+"""Pallas kernel tests — run in interpreter mode on the CPU mesh, checked
+against plain-XLA oracles (SURVEY.md §7 R2 item, pulled into R1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.kernels.flash_attention import (flash_attention,
+                                                        mha_reference)
+
+RNG = np.random.default_rng(7)
+
+
+def _qkv(b=2, h=3, t=64, d=16, dtype=np.float32):
+    return tuple(jnp.asarray(RNG.standard_normal((b, h, t, d)).astype(dtype))
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference_forward(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, None, causal, 32, 16)
+    ref = mha_reference(q, k, v, None, causal)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference_grads(causal):
+    q, k, v = _qkv(t=32, d=8)
+    w = jnp.cos(jnp.arange(8))
+
+    def f(impl):
+        def loss(q_, k_, v_):
+            o = (flash_attention(q_, k_, v_, None, causal, 16, 16) if impl
+                 else mha_reference(q_, k_, v_, None, causal))
+            return jnp.sum(o * w)
+        return loss
+
+    g = jax.grad(f(True), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f(False), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+def test_flash_odd_seq_falls_back_to_smaller_blocks():
+    # t=48 not divisible by 32 → block sizes shrink to 16
+    q, k, v = _qkv(t=48)
+    out = flash_attention(q, k, v, None, True, 32, 32)
+    ref = mha_reference(q, k, v, None, True)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+def test_flash_bf16_inputs():
+    q, k, v = _qkv(t=32, d=16)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, None, False, 16, 16)
+    ref = mha_reference(q, k, v, None, False)
+    assert out.dtype == jnp.bfloat16
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref))) < 2e-2
+
+
+def test_self_attention_layer_pallas_impl_matches_xla():
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.base import Ctx
+    x = jnp.asarray(RNG.standard_normal((2, 16, 32)).astype(np.float32))
+    base = SelfAttentionLayer(n_in=32, n_out=32, n_heads=4)
+    params, state, _ = base.init(jax.random.PRNGKey(0), (16, 32))
+    y_xla, _ = base.apply(params, state, x, Ctx())
+    pall = SelfAttentionLayer(n_in=32, n_out=32, n_heads=4, impl="pallas_interpret")
+    y_pal, _ = pall.apply(params, state, x, Ctx())
+    assert float(jnp.max(jnp.abs(y_xla - y_pal))) < 1e-4
