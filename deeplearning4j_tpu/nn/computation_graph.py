@@ -194,15 +194,34 @@ class ComputationGraph:
         if self._train_step is None:
             optimizer = self._optimizer
 
+            with_stats = getattr(self, "_anomaly_detector", None) is not None
+
             def step(params, states, opt_state, inputs, labels, rng, fmask, lmask):
                 (loss, new_states), grads = jax.value_and_grad(
                     self._loss, has_aux=True)(params, states, inputs, labels, rng, fmask, lmask)
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-                params = self._apply_constraints(optax.apply_updates(params, updates))
-                return params, new_states, opt_state, loss
+                updates, new_opt_state = optimizer.update(grads, opt_state, params)
+                new_params = self._apply_constraints(
+                    optax.apply_updates(params, updates))
+                stats = None
+                if with_stats:
+                    from ..train.anomaly import stats_and_gate
+                    stats, new_params, new_opt_state, new_states = stats_and_gate(
+                        grads, params, new_params, opt_state, new_opt_state,
+                        states, new_states)
+                return new_params, new_states, new_opt_state, loss, stats
 
             self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
         return self._train_step
+
+    def enable_gradient_anomaly_detection(self, detector=None):
+        """See MultiLayerNetwork.enable_gradient_anomaly_detection."""
+        from ..train.anomaly import GradientAnomalyDetector
+        if detector is False:
+            self._anomaly_detector = None
+        else:
+            self._anomaly_detector = detector or GradientAnomalyDetector()
+        self._train_step = None
+        return self
 
     # ------------------------------------------------------------------ fit
     def fit(self, data, *, epochs: int = 1):
@@ -226,6 +245,10 @@ class ComputationGraph:
             self._build_optimizer(max(int(ipe), 1))
         step_fn = self._get_train_step()
         last = None
+        anomaly_check = None
+        if getattr(self, "_anomaly_detector", None) is not None:
+            from ..train.anomaly import DelayedAnomalyCheck
+            anomaly_check = DelayedAnomalyCheck(self._anomaly_detector)
         for _ in range(epochs):
             for ds in iterator:
                 from ..data.dataset import MultiDataSet as MDS
@@ -241,9 +264,11 @@ class ComputationGraph:
                 fm = None if fmask is None else jnp.asarray(fmask)
                 lm = None if lmask is None else jnp.asarray(lmask)
                 self._host_key, rng = jax.random.split(self._host_key)
-                self.params, self.states, self._opt_state, loss = step_fn(
+                self.params, self.states, self._opt_state, loss, gstats = step_fn(
                     self.params, self.states, self._opt_state, inputs, labels, rng, fm, lm)
                 self._step_count += 1
+                if anomaly_check is not None and gstats is not None:
+                    anomaly_check.push(gstats, self._step_count)
                 last = loss
                 if self.listeners:
                     lv = float(loss)
@@ -255,6 +280,8 @@ class ComputationGraph:
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(self)
+        if anomaly_check is not None:
+            anomaly_check.flush()
         return None if last is None else float(last)
 
     def score(self, ds):

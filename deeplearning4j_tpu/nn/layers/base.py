@@ -83,6 +83,15 @@ class Layer:
     constraints: Any = None           # weight constraints (constrainWeights)
     bias_constraints: Any = None      # bias constraints (constrainBias)
 
+    def __post_init__(self):
+        # Fail fast on config typos — apply-time is too late to learn an
+        # activation or weight-init name is wrong.
+        act = getattr(self, "activation", None)
+        if act is not None:
+            _act.get(act)
+        if self.weight_init is not None:
+            _winit.get(self.weight_init)
+
     # ---- to be overridden -------------------------------------------------
     def init(self, key, input_shape):
         """Returns (params: dict, state: dict, output_shape)."""

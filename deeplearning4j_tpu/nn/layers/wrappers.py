@@ -54,6 +54,7 @@ class FrozenLayer(BaseWrapperLayer):
     distinction vanishes: upstream gradients always flow through)."""
 
     def __post_init__(self):
+        super().__post_init__()
         self.frozen = True
         if self.layer is not None:
             self.layer.frozen = True

@@ -239,16 +239,40 @@ class MultiLayerNetwork:
     def _get_train_step(self):
         if self._train_step is None:
             optimizer = self._optimizer
+            with_stats = getattr(self, "_anomaly_detector", None) is not None
 
             def step(params, states, opt_state, x, y, rng, fmask, lmask):
                 (loss, new_states), grads = jax.value_and_grad(
                     self._loss, has_aux=True)(params, states, x, y, rng, fmask, lmask)
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-                params = self._apply_constraints(optax.apply_updates(params, updates))
-                return params, new_states, opt_state, loss
+                updates, new_opt_state = optimizer.update(grads, opt_state, params)
+                new_params = self._apply_constraints(
+                    optax.apply_updates(params, updates))
+                stats = None
+                if with_stats:
+                    # A non-finite batch becomes a whole-step no-op (params,
+                    # opt state, BN running stats) so the detector can raise
+                    # without the run already being poisoned.
+                    from ..train.anomaly import stats_and_gate
+                    stats, new_params, new_opt_state, new_states = stats_and_gate(
+                        grads, params, new_params, opt_state, new_opt_state,
+                        states, new_states)
+                return new_params, new_states, new_opt_state, loss, stats
 
             self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
         return self._train_step
+
+    def enable_gradient_anomaly_detection(self, detector=None):
+        """Failure detection (SURVEY §2.9): per-layer gradient stats computed
+        inside the jitted step, checked host-side each iteration. Pass a
+        configured ``train.anomaly.GradientAnomalyDetector`` or None for
+        defaults. Call with detector=False to disable."""
+        from ..train.anomaly import GradientAnomalyDetector
+        if detector is False:
+            self._anomaly_detector = None
+        else:
+            self._anomaly_detector = detector or GradientAnomalyDetector()
+        self._train_step = None  # rebuild with/without stats
+        return self
 
     # ------------------------------------------------------------------ fit
     def fit(self, data, labels=None, *, epochs: int = 1):
@@ -284,6 +308,10 @@ class MultiLayerNetwork:
                 self._restored_opt_state = None
         step_fn = self._get_train_step()
         last = None
+        anomaly_check = None
+        if getattr(self, "_anomaly_detector", None) is not None:
+            from ..train.anomaly import DelayedAnomalyCheck
+            anomaly_check = DelayedAnomalyCheck(self._anomaly_detector)
         for _ in range(epochs):
             for ds in iterator:
                 x = jnp.asarray(ds.features)
@@ -291,9 +319,11 @@ class MultiLayerNetwork:
                 fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
                 lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
                 self._host_key, rng = jax.random.split(self._host_key)
-                self.params, self.states, self._opt_state, loss = step_fn(
+                self.params, self.states, self._opt_state, loss, gstats = step_fn(
                     self.params, self.states, self._opt_state, x, y, rng, fmask, lmask)
                 self._step_count += 1
+                if anomaly_check is not None and gstats is not None:
+                    anomaly_check.push(gstats, self._step_count)
                 last = loss
                 if self.listeners:
                     lv = float(loss)
@@ -305,6 +335,8 @@ class MultiLayerNetwork:
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(self)
+        if anomaly_check is not None:
+            anomaly_check.flush()
         return None if last is None else float(last)
 
     # ---------------------------------------------------------------- score

@@ -1,0 +1,22 @@
+"""Parallelism — reference `deeplearning4j-scaleout` rethought for TPU:
+one mesh + named shardings + XLA collectives instead of replicated workers
+over NCCL/Aeron. See SURVEY.md §2.8."""
+
+from .grad_sharing import AdaptiveThreshold, GradientSharingAccumulator
+from .mesh import (MeshSpec, batch_sharding, bootstrap_distributed,
+                   data_parallel_mesh, hybrid_mesh_2d, make_mesh, replicated,
+                   shard_params_fsdp)
+from .pipeline import (make_pipeline_loss, make_pipeline_train_step,
+                       place_params_for_pipeline)
+from .ring_attention import (ring_attention, ring_attention_inner,
+                             ring_attention_sharded)
+from .wrapper import ParallelInference, ParallelWrapper
+
+__all__ = [
+    "AdaptiveThreshold", "GradientSharingAccumulator", "MeshSpec",
+    "batch_sharding", "bootstrap_distributed", "data_parallel_mesh",
+    "hybrid_mesh_2d", "make_mesh", "replicated", "shard_params_fsdp",
+    "make_pipeline_loss", "make_pipeline_train_step",
+    "place_params_for_pipeline", "ring_attention", "ring_attention_inner",
+    "ring_attention_sharded", "ParallelInference", "ParallelWrapper",
+]

@@ -60,13 +60,21 @@ class ParallelWrapper:
                 lambda a: jax.device_put(a, self._rep), self.net._opt_state)
         optimizer = self.net._optimizer
         net = self.net
+        with_stats = getattr(net, "_anomaly_detector", None) is not None
+        self._step_with_stats = with_stats
 
         def step(params, states, opt_state, x, y, rng, fmask, lmask):
             (loss, new_states), grads = jax.value_and_grad(
                 net._loss, has_aux=True)(params, states, x, y, rng, fmask, lmask)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, new_states, opt_state, loss
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            stats = None
+            if with_stats:  # same failure-detection path as single-device fit
+                from ..train.anomaly import stats_and_gate
+                stats, new_params, new_opt_state, new_states = stats_and_gate(
+                    grads, params, new_params, opt_state, new_opt_state,
+                    states, new_states)
+            return new_params, new_states, new_opt_state, loss, stats
 
         self._step = jax.jit(
             step, donate_argnums=(0, 1, 2),
@@ -80,24 +88,41 @@ class ParallelWrapper:
 
     def fit(self, iterator, *, epochs: int = 1):
         net = self.net
+        want_stats = getattr(net, "_anomaly_detector", None) is not None
+        if self._step is not None and getattr(self, "_step_with_stats", None) != want_stats:
+            self._step = None  # detector toggled since compile — rebuild
         step_fn = self._step or self._build_step()
         last = None
         n = self.mesh.size
+        anomaly_check = None
+        if getattr(net, "_anomaly_detector", None) is not None:
+            from ..train.anomaly import DelayedAnomalyCheck
+            anomaly_check = DelayedAnomalyCheck(net._anomaly_detector)
         for _ in range(epochs):
             for ds in iterator:
                 x = np.asarray(ds.features)
                 y = np.asarray(ds.labels)
+                fmask = None if ds.features_mask is None else np.asarray(ds.features_mask)
+                lmask = None if ds.labels_mask is None else np.asarray(ds.labels_mask)
                 if x.shape[0] % n:   # pad final partial batch to divide mesh
                     pad = n - x.shape[0] % n
                     x = np.concatenate([x, np.repeat(x[-1:], pad, 0)])
                     y = np.concatenate([y, np.repeat(y[-1:], pad, 0)])
-                fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-                lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+                    if fmask is not None:  # padded rows masked out entirely
+                        fmask = np.concatenate(
+                            [fmask, np.zeros((pad,) + fmask.shape[1:], fmask.dtype)])
+                    if lmask is not None:
+                        lmask = np.concatenate(
+                            [lmask, np.zeros((pad,) + lmask.shape[1:], lmask.dtype)])
+                fmask = None if fmask is None else jnp.asarray(fmask)
+                lmask = None if lmask is None else jnp.asarray(lmask)
                 net._host_key, rng = jax.random.split(net._host_key)
-                net.params, net.states, net._opt_state, loss = step_fn(
+                net.params, net.states, net._opt_state, loss, gstats = step_fn(
                     net.params, net.states, net._opt_state,
                     jnp.asarray(x), jnp.asarray(y), rng, fmask, lmask)
                 net._step_count += 1
+                if anomaly_check is not None and gstats is not None:
+                    anomaly_check.push(gstats, net._step_count)
                 last = loss
                 if net.listeners:
                     lv = float(loss)
@@ -106,6 +131,8 @@ class ParallelWrapper:
             net.epoch_count += 1
             if hasattr(iterator, "reset"):
                 iterator.reset()
+        if anomaly_check is not None:
+            anomaly_check.flush()
         return None if last is None else float(last)
 
 

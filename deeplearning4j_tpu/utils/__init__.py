@@ -1,0 +1,5 @@
+"""Aux subsystems: tracing/profiling, race detection, native bindings."""
+
+from . import race, tracing
+
+__all__ = ["race", "tracing"]

@@ -1,0 +1,219 @@
+"""Race-detection analogue — donation/aliasing + async-pipeline auditing.
+
+Reference counterpart: DL4J's workspace validation
+(``MemoryWorkspace`` leak/scope checks, ``DebugMode``) and the async
+iterator's queue invariants — the JVM relies on the workspace manager to
+catch a buffer used outside its lifecycle. On TPU the analogous hazards are:
+
+1. **Buffer donation**: ``jit(..., donate_argnums=...)`` lets XLA reuse input
+   HBM for outputs. Passing the SAME array in a donated and a non-donated
+   slot (or twice in donated slots), or touching a donated array after the
+   call, is the TPU's use-after-free.
+2. **Async prefetch**: the native SPSC ring hands byte slots between a
+   producer thread and the consumer; a slot overwritten while still being
+   read is a torn batch (silent data corruption, not a crash).
+
+This module makes both failure modes loud and testable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# Donation / aliasing checks.
+# --------------------------------------------------------------------------
+
+@dataclass
+class AliasViolation:
+    kind: str          # "dup-donated" | "donated-aliases-kept" | "use-after-donate"
+    detail: str
+
+    def __str__(self):
+        return f"[{self.kind}] {self.detail}"
+
+
+def _buffer_key(leaf) -> Optional[int]:
+    """Identity key for a device buffer; None for non-array leaves."""
+    if isinstance(leaf, jax.Array):
+        return id(leaf)
+    return None
+
+
+def check_donation_aliasing(args: Sequence[Any],
+                            donate_argnums: Sequence[int]) -> List[AliasViolation]:
+    """Static check BEFORE a donated call: no buffer may appear both in a
+    donated argument and anywhere else. XLA would either refuse the alias or
+    silently copy; either way the program is wrong about its memory model."""
+    donate = set(donate_argnums)
+    donated_ids, kept_ids = {}, {}
+    out: List[AliasViolation] = []
+    for i, arg in enumerate(args):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(arg)[0]:
+            key = _buffer_key(leaf)
+            if key is None:
+                continue
+            label = f"arg{i}{jax.tree_util.keystr(path)}"
+            if i in donate:
+                if key in donated_ids:
+                    out.append(AliasViolation(
+                        "dup-donated",
+                        f"{label} and {donated_ids[key]} are the same buffer, "
+                        f"both donated"))
+                else:
+                    donated_ids[key] = label
+            else:
+                kept_ids.setdefault(key, label)
+    for key, label in donated_ids.items():
+        if key in kept_ids:
+            out.append(AliasViolation(
+                "donated-aliases-kept",
+                f"{label} (donated) is the same buffer as {kept_ids[key]} (kept)"))
+    return out
+
+
+def assert_live(tree, name: str = "tree") -> None:
+    """Raise if any leaf was donated (deleted) by a previous jit call —
+    the explicit use-after-donate probe."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if isinstance(leaf, jax.Array) and leaf.is_deleted():
+            raise RuntimeError(
+                f"use-after-donate: {name}{jax.tree_util.keystr(path)} was "
+                f"donated to a previous jitted call and its buffer is gone")
+
+
+class DonationGuard:
+    """Wrap a jitted-with-donation step function; every call first runs the
+    aliasing check and a liveness check on donated inputs, then records what
+    was donated so later misuse raises with a helpful message.
+
+        step = jax.jit(train_step, donate_argnums=(0, 1))
+        guarded = DonationGuard(step, donate_argnums=(0, 1))
+        params, opt_state = guarded(params, opt_state, batch)
+    """
+
+    def __init__(self, fn: Callable, donate_argnums: Sequence[int],
+                 strict: bool = True):
+        self.fn = fn
+        self.donate_argnums = tuple(donate_argnums)
+        self.strict = strict
+        self.violations: List[AliasViolation] = []
+
+    def __call__(self, *args, **kwargs):
+        for i in self.donate_argnums:
+            if i < len(args):
+                try:
+                    assert_live(args[i], name=f"arg{i}")
+                except RuntimeError as e:
+                    self.violations.append(AliasViolation("use-after-donate", str(e)))
+                    if self.strict:
+                        raise
+        found = check_donation_aliasing(args, self.donate_argnums)
+        self.violations.extend(found)
+        if found and self.strict:
+            raise RuntimeError("donation aliasing violation(s):\n  " +
+                               "\n  ".join(map(str, found)))
+        return self.fn(*args, **kwargs)
+
+
+# --------------------------------------------------------------------------
+# Async-pipeline (ring buffer) auditing.
+# --------------------------------------------------------------------------
+
+class RaceCheckedRing:
+    """Wrap any SPSC ring exposing push(bytes)->bool / pop()->bytes|None with
+    shadow sequence + checksum tracking. Detects, at pop time:
+
+    - **reorder**: payloads coming out in a different order than pushed
+    - **corruption/torn read**: checksum mismatch (slot overwritten while
+      being read, or partial copy)
+    - **phantom**: a pop that was never pushed
+
+    Shadow state lives host-side under a lock; the wrapped ring keeps its
+    lock-free fast path (the audit is for tests/debug runs, like the
+    reference's workspace DebugMode, not production).
+    """
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._lock = threading.Lock()
+        self._expected: deque[Tuple[int, bytes]] = deque()
+        self._seq = 0
+        self.errors: List[str] = []
+
+    @staticmethod
+    def _digest(payload: bytes) -> bytes:
+        return hashlib.blake2b(payload, digest_size=16).digest()
+
+    def push(self, payload: bytes) -> bool:
+        ok = self.inner.push(payload)
+        if ok:
+            with self._lock:
+                self._expected.append((self._seq, self._digest(payload)))
+                self._seq += 1
+        return ok
+
+    def pop(self):
+        raw = self.inner.pop()
+        if raw is None:
+            return None
+        with self._lock:
+            if not self._expected:
+                self.errors.append("phantom pop: ring returned data never pushed")
+                return raw
+            seq, digest = self._expected.popleft()
+            if self._digest(raw) != digest:
+                self.errors.append(
+                    f"payload {seq}: checksum mismatch — slot overwritten or "
+                    f"torn read (got {len(raw)} bytes)")
+        return raw
+
+    def close(self):
+        return self.inner.close()
+
+    def assert_clean(self):
+        if self.errors:
+            raise RuntimeError("ring race audit failed:\n  " + "\n  ".join(self.errors))
+
+
+def audit_async_iterator(make_inner: Callable[[], Any], *, queue_size: int = 4,
+                         use_native: bool = True, epochs: int = 2) -> None:
+    """End-to-end race audit of AsyncDataSetIterator: run `epochs` epochs
+    async and verify every epoch yields exactly the serial iterator's batches
+    (count + content). Raises on loss, duplication, reordering or corruption.
+
+    The serial oracle run is what the reference's tests do with
+    AsyncDataSetIterator vs its wrapped iterator.
+    """
+    from ..data.async_iter import AsyncDataSetIterator
+
+    oracle = [(np.asarray(ds.features).copy(), np.asarray(ds.labels).copy())
+              for ds in make_inner()]
+
+    it = AsyncDataSetIterator(make_inner(), queue_size=queue_size,
+                              use_native=use_native)
+    try:
+        for epoch in range(epochs):
+            got = [(np.asarray(ds.features), np.asarray(ds.labels)) for ds in it]
+            if len(got) != len(oracle):
+                raise RuntimeError(
+                    f"epoch {epoch}: async yielded {len(got)} batches, "
+                    f"serial oracle has {len(oracle)} (lost/duplicated batch)")
+            for i, ((gf, gl), (of, ol)) in enumerate(zip(got, oracle)):
+                if gf.shape != of.shape or not np.array_equal(gf, of):
+                    raise RuntimeError(f"epoch {epoch} batch {i}: features "
+                                       f"corrupted or reordered")
+                if not np.array_equal(gl, ol):
+                    raise RuntimeError(f"epoch {epoch} batch {i}: labels "
+                                       f"corrupted or reordered")
+            it.reset()
+    finally:
+        it.close()
