@@ -90,6 +90,22 @@ class CSVRecordReader(RecordReader):
             src.close()
 
 
+def read_csv_matrix(path: Optional[str] = None, n_cols: int = 0,
+                    text: Optional[bytes] = None) -> "np.ndarray":
+    """All-numeric CSV → (rows, n_cols) float32 via the native parser
+    (native/dl4j_tpu_native.cpp parse_csv_matrix; pure-numpy fallback).
+    The bulk-load fast path behind CSVRecordReader for numeric datasets —
+    reference counterpart: CSVRecordReader + RecordConverter.toMatrix.
+    Header/blank/ragged lines are skipped."""
+    from ..utils.native import parse_csv_matrix
+    if text is None:
+        with open(path, "rb") as f:
+            text = f.read()
+    elif isinstance(text, str):
+        text = text.encode()
+    return parse_csv_matrix(text, n_cols)
+
+
 # -------------------------------------------------------------------- schema
 @dataclass
 class Column:

@@ -157,26 +157,90 @@ class NanScoreWatchdog(TrainingListener):
 
 
 class StatsListener(TrainingListener):
-    """Training-UI analogue: writes scalars to TensorBoard if available,
-    else JSONL (the terminal `/ui` reads this)."""
+    """Training-UI analogue (reference StatsListener + UIServer): score,
+    learning rate and per-layer update:param ratios — DL4J's headline
+    training-health chart. Writes TensorBoard scalars when available AND
+    always a JSONL stream that ``deeplearning4j_tpu.ui`` renders in the
+    terminal. Ratio computation snapshots params every `frequency` steps
+    (off the hot path; a few tiny reductions per report)."""
 
-    def __init__(self, log_dir="runs/dl4j_tpu", frequency: int = 10):
+    def __init__(self, log_dir="runs/dl4j_tpu", frequency: int = 10,
+                 report_ratios: bool = True, tensorboard: bool = True):
         self.frequency = max(1, frequency)
+        self.report_ratios = report_ratios
         self.log_dir = Path(log_dir)
         self.log_dir.mkdir(parents=True, exist_ok=True)
         self._writer = None
+        if tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter  # torch cpu baked in
+                self._writer = SummaryWriter(str(self.log_dir))
+            except Exception:  # noqa: BLE001
+                pass
+        self._jsonl = open(self.log_dir / "stats.jsonl", "a")
+        self._prev_params = None
+
+    @staticmethod
+    def _current_lr(model, iteration):
         try:
-            from torch.utils.tensorboard import SummaryWriter  # torch cpu is baked in
-            self._writer = SummaryWriter(str(self.log_dir))
-        except Exception:  # noqa: BLE001
-            self._jsonl = open(self.log_dir / "stats.jsonl", "a")
+            upd = model._g.updater
+            lr = upd._lr(getattr(model, "_iters_per_epoch", 1) or 1)
+            return float(lr(iteration)) if callable(lr) else float(lr)
+        except Exception:  # noqa: BLE001 — lr is best-effort decoration
+            return None
+
+    def _ratios(self, model):
+        """Per-layer ||Δparam|| / ||param|| since the previous report.
+
+        The snapshot is copied to HOST: the train step donates params, so
+        holding the device arrays across a step is use-after-donate (see
+        utils/race.py) — their buffers die with the next dispatch."""
+        import jax
+        import numpy as _np
+        params = jax.device_get(model.params)
+        if self._prev_params is None:
+            self._prev_params = params
+            return None
+        out = {}
+        for group, sub in params.items():
+            prev = self._prev_params.get(group)
+            if prev is None:
+                continue
+            leaves_n = jax.tree_util.tree_leaves(sub)
+            leaves_p = jax.tree_util.tree_leaves(prev)
+            if not leaves_n:
+                continue
+            dn = sum(float(_np.sum(_np.square(
+                _np.asarray(n, _np.float32) - _np.asarray(p, _np.float32))))
+                for n, p in zip(leaves_n, leaves_p))
+            pn = sum(float(_np.sum(_np.square(_np.asarray(p, _np.float32))))
+                     for p in leaves_p)
+            out[str(group)] = dn ** 0.5 / (pn ** 0.5 + 1e-12)
+        self._prev_params = params
+        return out or None
 
     def iteration_done(self, model, iteration, epoch, score):
         if iteration % self.frequency:
             return
+        rec = {"iter": iteration, "epoch": epoch, "score": score,
+               "ts": time.time()}
+        lr = self._current_lr(model, iteration)
+        if lr is not None:
+            rec["lr"] = lr
+        if self.report_ratios and hasattr(model, "params"):
+            ratios = self._ratios(model)
+            if ratios:
+                rec["update_ratios"] = ratios
         if self._writer is not None:
             self._writer.add_scalar("score", score, iteration)
-        else:
-            self._jsonl.write(json.dumps({"iter": iteration, "epoch": epoch,
-                                          "score": score, "ts": time.time()}) + "\n")
-            self._jsonl.flush()
+            if lr is not None:
+                self._writer.add_scalar("lr", lr, iteration)
+            for layer, v in rec.get("update_ratios", {}).items():
+                self._writer.add_scalar(f"update_ratio/{layer}", v, iteration)
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+        self._jsonl.close()

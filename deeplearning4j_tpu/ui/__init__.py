@@ -1,0 +1,6 @@
+"""Training UI (SURVEY §2.9): TensorBoard via nn.listeners.StatsListener,
+terminal dashboard via this package (`python -m deeplearning4j_tpu.ui`)."""
+
+from .dashboard import load_stats, render, sparkline, watch
+
+__all__ = ["load_stats", "render", "sparkline", "watch"]

@@ -1,0 +1,125 @@
+"""Terminal training dashboard — the training-UI analogue (SURVEY §2.9).
+
+Reference counterpart: DL4J's browser training UI (`deeplearning4j-ui`,
+``UIServer.getInstance()`` + StatsListener) showing score-vs-iteration,
+update:param ratios, layer histograms and system stats. TPU-native stance:
+the heavyweight charts belong to TensorBoard (StatsListener writes TB
+scalars when torch's SummaryWriter is importable); this module covers the
+"glance at the run from a shell" half with a zero-dependency ANSI dashboard
+over the StatsListener JSONL fallback stream.
+
+Usage:
+    python -m deeplearning4j_tpu.ui runs/dl4j_tpu           # one snapshot
+    python -m deeplearning4j_tpu.ui runs/dl4j_tpu --watch   # live refresh
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float], width: int = 60) -> str:
+    """Unicode sparkline, downsampled to `width` points."""
+    if not values:
+        return ""
+    if len(values) > width:
+        stride = len(values) / width
+        values = [values[int(i * stride)] for i in range(width)]
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return "".join(_BLOCKS[int((v - lo) / span * (len(_BLOCKS) - 1))]
+                   for v in values)
+
+
+def load_stats(log_dir) -> List[Dict]:
+    """Parse the StatsListener JSONL stream (skips torn trailing writes)."""
+    path = Path(log_dir) / "stats.jsonl"
+    if not path.exists():
+        return []
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn write at the tail of a live file
+    return records
+
+
+def render(records: List[Dict], width: int = 72) -> str:
+    """One dashboard frame as a string (pure — testable without a tty)."""
+    if not records:
+        return "no stats yet (is a StatsListener attached and writing JSONL?)"
+    scores = [r["score"] for r in records if "score" in r]
+    iters = [r["iter"] for r in records if "iter" in r]
+    lines = ["┌" + "─" * width + "┐"]
+
+    def row(text=""):
+        lines.append("│ " + text[:width - 2].ljust(width - 2) + " │")
+
+    last = records[-1]
+    row(f"deeplearning4j_tpu training — iter {last.get('iter', '?')} "
+        f"epoch {last.get('epoch', '?')}")
+    row("─" * (width - 2))
+    if scores:
+        row(f"score  last {scores[-1]:.5f}   best {min(scores):.5f}   "
+            f"first {scores[0]:.5f}")
+        row(sparkline(scores, width - 2))
+    ts = [r["ts"] for r in records if "ts" in r]
+    if len(ts) >= 2 and len(iters) >= 2 and ts[-1] > ts[0]:
+        ips = (iters[-1] - iters[0]) / (ts[-1] - ts[0])
+        row(f"throughput  {ips:.2f} it/s   span {ts[-1] - ts[0]:.0f}s   "
+            f"{len(records)} records")
+    lrs = [r["lr"] for r in records if "lr" in r]
+    if lrs:
+        row(f"lr  {lrs[-1]:.2e}")
+        row(sparkline(lrs, width - 2))
+    # per-layer update:param ratio (DL4J's headline training-health chart;
+    # healthy range is famously ~1e-3)
+    ratios = [r for r in records if "update_ratios" in r]
+    if ratios:
+        row("update:param ratios (last):")
+        for layer, val in ratios[-1]["update_ratios"].items():
+            flag = "" if 1e-5 < val < 1e-1 else "  ⚠"
+            row(f"  {layer:<24} {val:.2e}{flag}")
+    lines.append("└" + "─" * width + "┘")
+    return "\n".join(lines)
+
+
+def watch(log_dir, interval_s: float = 2.0, frames: Optional[int] = None):
+    """Live-refresh the dashboard (frames=None → until Ctrl-C)."""
+    shown = 0
+    try:
+        while frames is None or shown < frames:
+            frame = render(load_stats(log_dir))
+            print("\x1b[2J\x1b[H" + frame, flush=True)
+            shown += 1
+            if frames is None or shown < frames:
+                time.sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description="terminal training dashboard")
+    ap.add_argument("log_dir", nargs="?", default="runs/dl4j_tpu")
+    ap.add_argument("--watch", action="store_true")
+    ap.add_argument("--interval", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    if args.watch:
+        watch(args.log_dir, args.interval)
+    else:
+        print(render(load_stats(args.log_dir)))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,9 +42,16 @@ class AliasViolation:
 
 
 def _buffer_key(leaf) -> Optional[int]:
-    """Identity key for a device buffer; None for non-array leaves."""
+    """Identity key for a device BUFFER (not the Python wrapper): two
+    distinct jax.Array objects can alias one buffer (no-copy device_put,
+    tree re-wraps), so id(leaf) would miss exactly the aliases that
+    matter. Falls back to id() where the pointer is unavailable
+    (committed multi-device arrays, tracers)."""
     if isinstance(leaf, jax.Array):
-        return id(leaf)
+        try:
+            return leaf.unsafe_buffer_pointer()
+        except Exception:  # noqa: BLE001
+            return id(leaf)
     return None
 
 

@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <new>
 
 extern "C" {
@@ -169,6 +170,215 @@ void f32_to_bf16(const float* in, uint16_t* out, int64_t n) {
         uint32_t rounded = bits + 0x7FFF + lsb;
         out[i] = static_cast<uint16_t>(rounded >> 16);
     }
+}
+
+// ---------------------------------------------------------------------------
+// Staging arena — the pinned-host allocator analogue (reference: libnd4j's
+// memory workspaces + cudaHostAlloc staging for H2D copies). TPU hosts have
+// no cudaHostAlloc; what matters is (a) page-aligned long-lived buffers the
+// runtime can DMA from without bounce copies, (b) zero malloc/free churn in
+// the steady-state input pipeline, (c) first-touch NUMA locality (pages land
+// on the socket of the worker thread that first writes them — we touch them
+// from the allocating thread at creation). Fixed-size blocks + LIFO freelist.
+// ---------------------------------------------------------------------------
+
+// Freelist is mutex-guarded: borrow/release happen per BATCH (thousands of
+// times slower cadence than the per-payload SPSC ring above, which stays
+// lock-free), so correctness beats lock-freedom here. The bitmap rejects
+// double-free and misaligned/foreign pointers outright.
+
+struct Arena {
+    uint8_t*  base;        // one aligned slab: block_size * n_blocks
+    uint64_t  block_size;
+    uint64_t  n_blocks;
+    int64_t*  freelist;    // stack of free block indices
+    uint8_t*  allocated;   // per-block allocation bitmap
+    int64_t   top;         // freelist top (count of free blocks)
+    uint64_t  in_use;
+    uint64_t  peak;
+    std::mutex lock;
+};
+
+Arena* arena_create(uint64_t block_size, uint64_t n_blocks) {
+    // round block size up to 4 KiB pages so every block is page-aligned
+    const uint64_t page = 4096;
+    block_size = (block_size + page - 1) / page * page;
+    Arena* a = new (std::nothrow) Arena();
+    if (!a) return nullptr;
+    void* mem = nullptr;
+    if (posix_memalign(&mem, page, block_size * n_blocks) != 0) {
+        delete a;
+        return nullptr;
+    }
+    a->base = static_cast<uint8_t*>(mem);
+    a->freelist = static_cast<int64_t*>(std::malloc(n_blocks * sizeof(int64_t)));
+    a->allocated = static_cast<uint8_t*>(std::calloc(n_blocks, 1));
+    if (!a->freelist || !a->allocated) {
+        std::free(mem);
+        std::free(a->freelist);
+        std::free(a->allocated);
+        delete a;
+        return nullptr;
+    }
+    // first-touch every page from THIS thread so NUMA placement follows the
+    // pipeline worker that owns the arena; also warms the TLB.
+    std::memset(a->base, 0, block_size * n_blocks);
+    a->block_size = block_size;
+    a->n_blocks = n_blocks;
+    for (uint64_t i = 0; i < n_blocks; ++i)
+        a->freelist[i] = static_cast<int64_t>(n_blocks - 1 - i);
+    a->top = static_cast<int64_t>(n_blocks);
+    a->in_use = 0;
+    a->peak = 0;
+    return a;
+}
+
+void arena_destroy(Arena* a) {
+    if (!a) return;
+    std::free(a->base);
+    std::free(a->freelist);
+    std::free(a->allocated);
+    delete a;
+}
+
+// returns block pointer or nullptr if exhausted (caller falls back to malloc)
+uint8_t* arena_alloc(Arena* a) {
+    std::lock_guard<std::mutex> g(a->lock);
+    if (a->top <= 0) return nullptr;
+    int64_t idx = a->freelist[--a->top];
+    a->allocated[idx] = 1;
+    ++a->in_use;
+    if (a->in_use > a->peak) a->peak = a->in_use;
+    return a->base + idx * a->block_size;
+}
+
+// returns 1 on success; 0 for foreign, misaligned or double-freed pointers
+int arena_free(Arena* a, uint8_t* p) {
+    if (p < a->base || p >= a->base + a->block_size * a->n_blocks) return 0;
+    if ((p - a->base) % static_cast<int64_t>(a->block_size) != 0) return 0;
+    int64_t idx = (p - a->base) / a->block_size;
+    std::lock_guard<std::mutex> g(a->lock);
+    if (!a->allocated[idx]) return 0;  // double free
+    a->allocated[idx] = 0;
+    a->freelist[a->top++] = idx;
+    --a->in_use;
+    return 1;
+}
+
+uint64_t arena_block_size(Arena* a) { return a->block_size; }
+uint64_t arena_in_use(Arena* a) {
+    std::lock_guard<std::mutex> g(a->lock);
+    return a->in_use;
+}
+uint64_t arena_peak(Arena* a) {
+    std::lock_guard<std::mutex> g(a->lock);
+    return a->peak;
+}
+
+// ---------------------------------------------------------------------------
+// NPY header parser (v1.0/2.0) — fast path for DataVec-lite record storage:
+// parse shape/dtype/offset without Python, then the caller mmaps or memcpys
+// the payload straight into a staging block.
+// Returns 0 on success, negative error code otherwise.
+// ---------------------------------------------------------------------------
+
+int npy_parse_header(const uint8_t* buf, int64_t len,
+                     int64_t* shape_out /*cap 8*/, int32_t* ndim_out,
+                     char* dtype_char_out, int32_t* itemsize_out,
+                     int64_t* data_offset_out, int32_t* fortran_out) {
+    if (len < 10 || std::memcmp(buf, "\x93NUMPY", 6) != 0) return -1;
+    uint8_t major = buf[6];
+    uint64_t hlen, hstart;
+    if (major == 1) {
+        hlen = buf[8] | (uint64_t(buf[9]) << 8);
+        hstart = 10;
+    } else if (major == 2) {
+        if (len < 12) return -1;
+        hlen = buf[8] | (uint64_t(buf[9]) << 8) |
+               (uint64_t(buf[10]) << 16) | (uint64_t(buf[11]) << 24);
+        hstart = 12;
+    } else {
+        return -2;
+    }
+    if (hstart + hlen > static_cast<uint64_t>(len)) return -3;
+    const char* h = reinterpret_cast<const char*>(buf + hstart);
+    const char* hend = h + hlen;
+    // descr: find "'descr':" then the quoted dtype like '<f4'
+    const char* d = std::strstr(h, "descr");
+    if (!d || d >= hend) return -4;
+    d = std::strchr(d, ':');
+    if (!d) return -4;
+    while (d < hend && *d != '\'' && *d != '"') ++d;
+    if (d >= hend) return -4;
+    ++d;                       // inside quote: e.g. <f4, |u1, <i8
+    char endian = *d;
+    if (endian == '<' || endian == '>' || endian == '|' || endian == '=') ++d;
+    if (endian == '>') return -5;  // big-endian unsupported on TPU hosts
+    *dtype_char_out = *d;
+    *itemsize_out = std::atoi(d + 1);
+    // fortran_order
+    const char* f = std::strstr(h, "fortran_order");
+    *fortran_out = (f && std::strstr(f, "True") &&
+                    std::strstr(f, "True") < hend) ? 1 : 0;
+    // shape tuple
+    const char* s = std::strstr(h, "shape");
+    if (!s || s >= hend) return -6;
+    s = std::strchr(s, '(');
+    if (!s) return -6;
+    ++s;
+    int32_t nd = 0;
+    while (s < hend && *s != ')' && nd < 8) {
+        while (s < hend && (*s == ' ' || *s == ',')) ++s;
+        if (*s == ')') break;
+        char* next = nullptr;
+        long long v = std::strtoll(s, &next, 10);
+        if (next == s) break;
+        shape_out[nd++] = v;
+        s = next;
+    }
+    *ndim_out = nd;
+    *data_offset_out = static_cast<int64_t>(hstart + hlen);
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// CSV matrix parser: text → row-major f32 with a fixed column count.
+// Rows with a different column count are skipped (header lines, blanks).
+// Returns rows parsed (<= max_rows).
+// ---------------------------------------------------------------------------
+
+int64_t parse_csv_matrix(const char* text, int64_t len, int64_t n_cols,
+                         float* out, int64_t max_rows) {
+    const char* p = text;
+    const char* end = text + len;
+    int64_t rows = 0;
+    float* rowbuf = static_cast<float*>(std::malloc(n_cols * sizeof(float)));
+    if (!rowbuf) return 0;
+    while (p < end && rows < max_rows) {
+        const char* line_end = static_cast<const char*>(
+            std::memchr(p, '\n', end - p));
+        if (!line_end) line_end = end;
+        int64_t c = 0;
+        const char* q = p;
+        while (q < line_end && c <= n_cols) {
+            while (q < line_end && (*q == ',' || *q == ' ' || *q == '\t' ||
+                                    *q == ';' || *q == '\r')) ++q;
+            if (q >= line_end) break;
+            char* next = nullptr;
+            float v = std::strtof(q, &next);
+            if (next == q || next > line_end) { c = -1; break; }  // non-numeric
+            if (c < n_cols) rowbuf[c] = v;
+            ++c;
+            q = next;
+        }
+        if (c == n_cols) {
+            std::memcpy(out + rows * n_cols, rowbuf, n_cols * sizeof(float));
+            ++rows;
+        }
+        p = line_end + 1;
+    }
+    std::free(rowbuf);
+    return rows;
 }
 
 }  // extern "C"

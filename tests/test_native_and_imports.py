@@ -129,3 +129,172 @@ def test_keras_import_sequential(tmp_path):
     net = import_keras_sequential(str(p))
     got = np.asarray(net.output(x))
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_staging_arena_alloc_release():
+    arena = native.StagingArena(block_size=1000, n_blocks=4)
+    try:
+        if arena._ptr:  # native: block size rounded to 4KiB pages
+            assert arena.block_size == 4096
+        blocks = [arena.borrow() for _ in range(4)]
+        assert all(b is not None for b in blocks)
+        assert arena.borrow() is None  # exhausted
+        assert arena.in_use == 4 and arena.peak == 4
+        for b in blocks:
+            b[:8] = np.arange(8, dtype=np.uint8)  # writable
+            arena.release(b)
+        assert arena.in_use == 0
+        again = arena.borrow()  # blocks recycle
+        assert again is not None
+        arena.release(again)
+    finally:
+        arena.close()
+
+
+def test_staging_arena_rejects_foreign_block():
+    arena = native.StagingArena(block_size=64, n_blocks=1)
+    try:
+        if not arena._ptr:
+            pytest.skip("native lib unavailable")
+        foreign = np.zeros(64, np.uint8)
+        with pytest.raises(ValueError):
+            arena.release(foreign)
+    finally:
+        arena.close()
+
+
+@pytest.mark.parametrize("arr", [
+    np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+    np.arange(10, dtype=np.int64),
+    np.asfortranarray(np.arange(12, dtype=np.float64).reshape(3, 4)),
+    np.array(3.5, dtype=np.float32),  # 0-d
+    np.arange(6, dtype=np.uint8).reshape(2, 3),
+])
+def test_npy_header_and_load_roundtrip(arr):
+    import io
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    raw = buf.getvalue()
+    shape, dtype, off, fortran = native.npy_header(raw)
+    assert shape == arr.shape
+    assert dtype == arr.dtype
+    assert fortran == np.isfortran(arr)
+    out = native.load_npy(raw)
+    assert np.array_equal(out, arr)
+
+
+def test_npy_header_matches_numpy_parser_offset():
+    import io
+    buf = io.BytesIO()
+    np.save(buf, np.zeros((5, 5), np.float32))
+    raw = buf.getvalue()
+    _, _, off, _ = native.npy_header(raw)
+    assert raw[off - 1:off] == b"\n"  # npy headers end with newline padding
+
+
+def test_parse_csv_matrix_skips_ragged_and_header():
+    text = b"a,b,c\n1,2,3\n4,5\n6,7,8\n\n9.5,-1,2e2\n"
+    m = native.parse_csv_matrix(text, 3)
+    expect = np.array([[1, 2, 3], [6, 7, 8], [9.5, -1, 200.0]], np.float32)
+    assert np.array_equal(m, expect)
+
+
+def test_read_csv_matrix_file(tmp_path):
+    from deeplearning4j_tpu.data.datavec import read_csv_matrix
+    p = tmp_path / "d.csv"
+    rows = np.random.default_rng(0).random((50, 4)).astype(np.float32)
+    np.savetxt(p, rows, delimiter=",", fmt="%.6f")
+    m = read_csv_matrix(str(p), 4)
+    assert m.shape == (50, 4)
+    assert np.allclose(m, rows, atol=1e-5)
+
+
+def test_native_and_fallback_csv_agree():
+    text = b"1,2\n3,4\nxx,5\n6,7,8\n9,10\n"
+    fast = native.parse_csv_matrix(text, 2)
+    # force fallback
+    lib, native._lib = native._lib, None
+    tried = native._tried
+    native._tried = True
+    try:
+        slow = native.parse_csv_matrix(text, 2)
+    finally:
+        native._lib, native._tried = lib, tried
+    assert np.array_equal(fast, slow)
+
+
+def test_staging_arena_rejects_double_free_and_slices():
+    arena = native.StagingArena(block_size=64, n_blocks=2)
+    try:
+        if not arena._ptr:
+            pytest.skip("native lib unavailable")
+        b1, b2 = arena.borrow(), arena.borrow()
+        arena.release(b1)
+        with pytest.raises(ValueError):   # double free
+            arena.release(b1)
+        assert arena.in_use == 1
+        with pytest.raises(ValueError):   # misaligned slice
+            arena.release(b2[8:])
+        arena.release(b2)
+        # freelist intact after the rejected frees: both blocks borrowable,
+        # and they are DISTINCT
+        c1, c2 = arena.borrow(), arena.borrow()
+        assert c1.ctypes.data != c2.ctypes.data
+        arena.release(c1)
+        arena.release(c2)
+    finally:
+        arena.close(force=True)
+
+
+def test_staging_arena_close_guards_outstanding():
+    arena = native.StagingArena(block_size=64, n_blocks=2)
+    if not arena._ptr:
+        pytest.skip("native lib unavailable")
+    b = arena.borrow()
+    with pytest.raises(RuntimeError, match="borrowed"):
+        arena.close()
+    arena.release(b)
+    arena.close()  # clean close once returned
+
+
+def test_staging_arena_views_keep_slab_alive():
+    import gc
+    import weakref
+    arena = native.StagingArena(block_size=64, n_blocks=1)
+    if not arena._ptr:
+        pytest.skip("native lib unavailable")
+    block = arena.borrow()
+    ref = weakref.ref(arena)
+    del arena
+    gc.collect()
+    assert ref() is not None          # live view pins the arena
+    block[:4] = [1, 2, 3, 4]          # safe: slab cannot have been freed
+    ref().release(block)
+    del block
+    gc.collect()
+    assert ref() is None              # last view gone → arena collectable
+
+
+def test_staging_arena_fallback_peak():
+    arena = native.StagingArena(block_size=32, n_blocks=3)
+    lib_was = arena._ptr
+    if lib_was:
+        pytest.skip("covered by native branch")
+    a, b = arena.borrow(), arena.borrow()
+    arena.release(a)
+    arena.release(b)
+    assert arena.peak == 2 and arena.in_use == 0
+
+
+def test_csv_matrix_space_delimited_parity():
+    text = b"1 2,3\n4,5,6\n"
+    fast = native.parse_csv_matrix(text, 3)
+    lib, native._lib = native._lib, None
+    tried = native._tried
+    native._tried = True
+    try:
+        slow = native.parse_csv_matrix(text, 3)
+    finally:
+        native._lib, native._tried = lib, tried
+    assert np.array_equal(fast, slow)
+    assert np.array_equal(fast, np.array([[1, 2, 3], [4, 5, 6]], np.float32))

@@ -1,0 +1,85 @@
+"""Training UI tests: StatsListener JSONL stream + terminal dashboard
+(SURVEY §2.9 training-UI analogue)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import load_stats, render, sparkline
+
+
+def test_sparkline_shape_and_range():
+    s = sparkline([0, 1, 2, 3, 4, 5, 6, 7], width=8)
+    assert len(s) == 8
+    assert s[0] == "▁" and s[-1] == "█"
+    assert sparkline([], width=10) == ""
+    assert len(sparkline(list(range(1000)), width=40)) == 40
+    assert sparkline([5.0, 5.0, 5.0]) == "▁▁▁"  # constant series no crash
+
+
+def test_load_stats_skips_torn_lines(tmp_path):
+    p = tmp_path / "stats.jsonl"
+    p.write_text(json.dumps({"iter": 1, "score": 0.5, "ts": 1.0}) + "\n"
+                 + json.dumps({"iter": 2, "score": 0.4, "ts": 2.0}) + "\n"
+                 + '{"iter": 3, "scor')  # torn tail of a live file
+    recs = load_stats(tmp_path)
+    assert [r["iter"] for r in recs] == [1, 2]
+
+
+def test_render_empty_and_full(tmp_path):
+    assert "no stats" in render([])
+    recs = [{"iter": i, "epoch": 0, "score": 1.0 / (i + 1), "ts": float(i),
+             "lr": 1e-3}
+            for i in range(50)]
+    recs[-1]["update_ratios"] = {"layer_0": 2e-3, "layer_1": 0.5}
+    frame = render(recs)
+    assert "score" in frame and "throughput" in frame and "lr" in frame
+    assert "layer_0" in frame
+    assert "⚠" in frame  # 0.5 ratio flagged unhealthy
+    # box geometry: all lines equal width
+    widths = {len(line) for line in frame.splitlines()}
+    assert len(widths) == 1
+
+
+def test_stats_listener_writes_lr_and_ratios(tmp_path):
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.listeners import StatsListener
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init((4,))
+    listener = StatsListener(log_dir=tmp_path, frequency=1, tensorboard=False)
+    net.set_listeners(listener)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    net.fit(x, y, epochs=3)
+    listener.close()
+
+    recs = load_stats(tmp_path)
+    assert len(recs) == 3
+    assert recs[0]["lr"] == pytest.approx(1e-2)
+    # first record has no ratios (needs a previous snapshot); later ones do
+    assert "update_ratios" not in recs[0]
+    assert "update_ratios" in recs[-1]
+    assert set(recs[-1]["update_ratios"]) == {"layer_0", "layer_1"}
+    assert all(v > 0 for v in recs[-1]["update_ratios"].values())
+    frame = render(recs)
+    assert "layer_0" in frame
+
+
+def test_dashboard_cli_snapshot(tmp_path, capsys):
+    from deeplearning4j_tpu.ui.dashboard import main
+    p = tmp_path / "stats.jsonl"
+    p.write_text(json.dumps({"iter": 1, "epoch": 0, "score": 0.9,
+                             "ts": 0.0}) + "\n")
+    main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "iter 1" in out and "score" in out
