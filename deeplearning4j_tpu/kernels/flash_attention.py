@@ -7,9 +7,17 @@ tiling keeps the (T, T) score matrix out of HBM, MXU matmuls accumulate in
 f32, and the backward pass recomputes probabilities per tile (two passes:
 dQ over query tiles, dK/dV over key tiles) instead of materialising them.
 
+VMEM discipline: K/V (and in the backward passes Q/dO/lse/delta) STREAM
+through the kernel one block per grid step — the KV/Q block index is the
+fastest grid dimension and the online-softmax state lives in VMEM scratch
+that persists across it (TPU grids iterate sequentially). Peak VMEM is
+O(block_q·d + block_k·d), independent of sequence length, so the kernel
+works exactly in the long-context regime flash attention exists for.
+
 Shapes: q, k, v are (B, H, T, D); output (B, H, T, D). ``causal`` applies a
-lower-triangular mask. Falls back to interpreter mode off-TPU so the same
-code path is unit-testable on the CPU mesh.
+lower-triangular mask (fully-masked blocks are skipped via pl.when).
+Falls back to interpreter mode off-TPU so the same code path is
+unit-testable on the CPU mesh.
 """
 
 from __future__ import annotations
@@ -21,6 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -41,45 +50,53 @@ def _block_sizes(t: int, d: int, block_q: int, block_k: int):
 
 # ---------------------------------------------------------------- forward --
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale           # (bq, d)
-    bq, d = q.shape
-    t = k_ref.shape[1]
-    nk = t // block_k
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
 
-    def body(kj, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: skip key blocks that lie entirely above the diagonal
+    live = (kj * bk <= qi * bq + bq - 1) if causal else (kj >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                    # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         if causal:
             q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_idx = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            k_idx = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_idx > q_idx, NEG_INF, s)
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    acc = jnp.zeros((bq, d), jnp.float32)
-    m = jnp.full((bq,), NEG_INF, jnp.float32)
-    l = jnp.zeros((bq,), jnp.float32)
-    if causal:
-        # only key blocks up to (and including) this query block contribute
-        nk_eff = ((qi + 1) * bq + block_k - 1) // block_k
-        nk_eff = jnp.minimum(nk_eff, nk)
-        acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc, m, l))
-    else:
-        acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m, l))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse broadcast over a small lane dim so the block shape is TPU-tileable
-    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l_safe))[:, None], (bq, 8))
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # lse broadcast over a small lane dim so the block is TPU-tileable
+        lse_ref[0] = jnp.broadcast_to(
+            (m_ref[:, 0] + jnp.log(l_safe))[:, None], lse_ref.shape[1:])
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -88,17 +105,20 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
-    grid = (b * h, t // bq)
+    grid = (b * h, t // bq, t // bk)      # kv block = fastest dim (streamed)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_k=bk),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal),
         grid=grid,
-        in_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-                  pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
-                  pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0))],
-        out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-                   pl.BlockSpec((1, bq, 8), lambda bh, i: (bh, i, 0))],
+        in_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                  pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+                  pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))],
+        out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                   pl.BlockSpec((1, bq, 8), lambda bh, i, j: (bh, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
                    jax.ShapeDtypeStruct((b * h, t, 8), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq, 8), jnp.float32),
+                        pltpu.VMEM((bq, 8), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, t, d), lse[:, :, 0].reshape(b, h, t)
@@ -107,81 +127,88 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 # --------------------------------------------------------------- backward --
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_k):
+                   dq_acc_ref, *, scale, causal):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, 0]
-    delta = delta_ref[0][:, 0]
-    bq, d = q.shape
-    t = k_ref.shape[1]
-    nk = t // block_k
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
 
-    def body(kj, dq):
-        k = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    live = (kj * bk <= qi * bq + bq - 1) if causal else (kj >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
             q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_idx = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_idx > q_idx, NEG_INF, s)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-
-    dq = jnp.zeros((bq, d), jnp.float32)
-    if causal:  # skip fully-masked key blocks, mirroring the forward
-        nk_eff = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk)
-        dq = jax.lax.fori_loop(0, nk_eff, body, dq)
-    else:
-        dq = jax.lax.fori_loop(0, nk, body, dq)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q):
-    kj = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    bk, d = k.shape
-    t = q_ref.shape[1]
-    nq = t // block_q
-
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
-        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal:
-            q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_idx = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_idx > q_idx, NEG_INF, s)
         p = jnp.exp(s - lse[:, None])
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+        dq_acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    dk = jnp.zeros((bk, d), jnp.float32)
-    dv = jnp.zeros((bk, d), jnp.float32)
-    if causal:  # first query block that can attend to this key block
-        qi_start = (kj * bk) // block_q
-        dk, dv = jax.lax.fori_loop(qi_start, nq, body, (dk, dv))
-    else:
-        dk, dv = jax.lax.fori_loop(0, nq, body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    # causal: only query blocks at or below this key block contribute
+    live = (qi * bq + bq - 1 >= kj * bk) if causal else (qi >= 0)
+
+    @pl.when(live)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_idx > q_idx, NEG_INF, s)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 # ------------------------------------------------------------- public api --
@@ -219,32 +246,35 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     deltaf = jnp.broadcast_to(delta.reshape(b * h, t)[:, :, None], (b * h, t, 8))
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, block_k=bk),
-        grid=(b * h, t // bq),
-        in_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-                  pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
-                  pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
-                  pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-                  pl.BlockSpec((1, bq, 8), lambda bh, i: (bh, i, 0)),
-                  pl.BlockSpec((1, bq, 8), lambda bh, i: (bh, i, 0))],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
+        grid=(b * h, t // bq, t // bk),   # kv block streamed (fastest dim)
+        in_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                  pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+                  pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+                  pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                  pl.BlockSpec((1, bq, 8), lambda bh, i, j: (bh, i, 0)),
+                  pl.BlockSpec((1, bq, 8), lambda bh, i, j: (bh, i, 0))],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, deltaf)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq),
-        grid=(b * h, t // bk),
-        in_specs=[pl.BlockSpec((1, t, d), lambda bh, j: (bh, 0, 0)),
-                  pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
-                  pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
-                  pl.BlockSpec((1, t, d), lambda bh, j: (bh, 0, 0)),
-                  pl.BlockSpec((1, t, 8), lambda bh, j: (bh, 0, 0)),
-                  pl.BlockSpec((1, t, 8), lambda bh, j: (bh, 0, 0))],
-        out_specs=[pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
-                   pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0))],
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
+        grid=(b * h, t // bk, t // bq),   # q block streamed (fastest dim)
+        in_specs=[pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+                  pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                  pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                  pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+                  pl.BlockSpec((1, bq, 8), lambda bh, j, i: (bh, i, 0)),
+                  pl.BlockSpec((1, bq, 8), lambda bh, j, i: (bh, i, 0))],
+        out_specs=[pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                   pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
                    jax.ShapeDtypeStruct((b * h, t, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, deltaf)
 

@@ -178,6 +178,10 @@ class StatsListener(TrainingListener):
             except Exception:  # noqa: BLE001
                 pass
         self._jsonl = open(self.log_dir / "stats.jsonl", "a")
+        # run delimiter: the dashboard charts only the records after the
+        # last one of these, so appended logs never splice two runs
+        self._jsonl.write(json.dumps({"run_start": time.time()}) + "\n")
+        self._jsonl.flush()
         self._prev_params = None
 
     @staticmethod

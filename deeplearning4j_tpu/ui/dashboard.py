@@ -37,7 +37,9 @@ def sparkline(values: List[float], width: int = 60) -> str:
 
 
 def load_stats(log_dir) -> List[Dict]:
-    """Parse the StatsListener JSONL stream (skips torn trailing writes)."""
+    """Parse the StatsListener JSONL stream: skips torn trailing writes and
+    returns only the LAST run's records (the listener appends, and writes a
+    run_start delimiter each time it opens the file)."""
     path = Path(log_dir) / "stats.jsonl"
     if not path.exists():
         return []
@@ -48,9 +50,13 @@ def load_stats(log_dir) -> List[Dict]:
             if not line:
                 continue
             try:
-                records.append(json.loads(line))
+                rec = json.loads(line)
             except json.JSONDecodeError:
                 continue  # torn write at the tail of a live file
+            if "run_start" in rec:
+                records = []  # later run supersedes everything before it
+            else:
+                records.append(rec)
     return records
 
 

@@ -232,7 +232,12 @@ class StagingArena:
             # _arena stays set: even a released view keeps the slab alive so
             # a stray late write can never hit freed memory
         else:
-            self._fallback = [b for b in self._fallback if b is not block]
+            kept = [b for b in self._fallback if b is not block]
+            if len(kept) == len(self._fallback):
+                raise ValueError(
+                    "block does not belong to this arena (or was already "
+                    "released)")
+            self._fallback = kept
 
     @property
     def in_use(self) -> int:

@@ -41,17 +41,19 @@ class AliasViolation:
         return f"[{self.kind}] {self.detail}"
 
 
-def _buffer_key(leaf) -> Optional[int]:
+def _buffer_key(leaf):
     """Identity key for a device BUFFER (not the Python wrapper): two
     distinct jax.Array objects can alias one buffer (no-copy device_put,
     tree re-wraps), so id(leaf) would miss exactly the aliases that
-    matter. Falls back to id() where the pointer is unavailable
-    (committed multi-device arrays, tracers)."""
+    matter. Keyed by (device, address) — per-chip address spaces can reuse
+    numeric addresses. Falls back to ("py-id", id) where the pointer is
+    unavailable (multi-device arrays, tracers); the tag keeps the two key
+    spaces from colliding."""
     if isinstance(leaf, jax.Array):
         try:
-            return leaf.unsafe_buffer_pointer()
+            return (leaf.device, leaf.unsafe_buffer_pointer())
         except Exception:  # noqa: BLE001
-            return id(leaf)
+            return ("py-id", id(leaf))
     return None
 
 

@@ -308,6 +308,10 @@ int npy_parse_header(const uint8_t* buf, int64_t len,
     if (!d || d >= hend) return -4;
     d = std::strchr(d, ':');
     if (!d) return -4;
+    ++d;
+    while (d < hend && *d == ' ') ++d;
+    if (d < hend && *d == '[') return -7;  // structured dtype: caller falls
+                                           // back to numpy's own parser
     while (d < hend && *d != '\'' && *d != '"') ++d;
     if (d >= hend) return -4;
     ++d;                       // inside quote: e.g. <f4, |u1, <i8

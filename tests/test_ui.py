@@ -83,3 +83,14 @@ def test_dashboard_cli_snapshot(tmp_path, capsys):
     main([str(tmp_path)])
     out = capsys.readouterr().out
     assert "iter 1" in out and "score" in out
+
+
+def test_load_stats_uses_only_last_run(tmp_path):
+    p = tmp_path / "stats.jsonl"
+    p.write_text(
+        json.dumps({"run_start": 1.0}) + "\n"
+        + json.dumps({"iter": 50, "score": 0.2, "ts": 2.0}) + "\n"
+        + json.dumps({"run_start": 100.0}) + "\n"
+        + json.dumps({"iter": 1, "score": 0.9, "ts": 101.0}) + "\n")
+    recs = load_stats(tmp_path)
+    assert [r["iter"] for r in recs] == [1]
