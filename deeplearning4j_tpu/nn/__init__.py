@@ -6,13 +6,15 @@ from .layers.attention import (LearnedSelfAttentionLayer,
                                RecurrentAttentionLayer, SelfAttentionLayer)
 from .layers.base import Ctx, InputType, Layer
 from .layers.conv import (Convolution1DLayer, Convolution3DLayer,
-                          ConvolutionLayer, Cropping2D, Deconvolution2D,
-                          DepthToSpaceLayer, DepthwiseConvolution2D,
-                          GlobalPoolingLayer, LocallyConnected1D,
-                          LocallyConnected2D, PoolingType,
+                          ConvolutionLayer, Cropping1D, Cropping2D,
+                          Cropping3D, Deconvolution2D, DepthToSpaceLayer,
+                          DepthwiseConvolution2D, GlobalPoolingLayer,
+                          LocallyConnected1D, LocallyConnected2D, PoolingType,
                           SeparableConvolution2D, SpaceToDepthLayer,
-                          Subsampling1DLayer, SubsamplingLayer, Upsampling1D,
-                          Upsampling2D, Upsampling3D, ZeroPaddingLayer)
+                          Subsampling1DLayer, Subsampling3DLayer,
+                          SubsamplingLayer, Upsampling1D, Upsampling2D,
+                          Upsampling3D, ZeroPadding1DLayer,
+                          ZeroPadding3DLayer, ZeroPaddingLayer)
 from .layers.capsule import (CapsuleLayer, CapsuleStrengthLayer,
                              PrimaryCapsules)
 from .layers.core import (ActivationLayer, AlphaDropout,

@@ -476,6 +476,63 @@ class Upsampling3D(Layer):
 
 
 @dataclass
+class Subsampling3DLayer(Layer):
+    """3-D pooling (Subsampling3DLayer). NDHWC, matching Convolution3DLayer."""
+
+    kernel_size: Any = (2, 2, 2)
+    stride: Any = None
+    padding: Any = 0
+    pooling_type: str = PoolingType.MAX
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def _triple(self, v):
+        return (v, v, v) if isinstance(v, int) else tuple(v)
+
+    def init(self, key, input_shape):
+        d, h, w, c = input_shape
+        kd, kh, kw = self._triple(self.kernel_size)
+        sd, sh, sw = self._triple(self.stride if self.stride is not None
+                                  else self.kernel_size)
+        if self.convolution_mode == "same":
+            out = (-(-d // sd), -(-h // sh), -(-w // sw), c)
+        else:
+            pd, ph, pw = self._triple(self.padding)
+            out = ((d + 2 * pd - kd) // sd + 1, (h + 2 * ph - kh) // sh + 1,
+                   (w + 2 * pw - kw) // sw + 1, c)
+        return {}, {}, out
+
+    def apply(self, params, state, x, ctx: Ctx):
+        kd, kh, kw = self._triple(self.kernel_size)
+        stride = self._triple(self.stride if self.stride is not None
+                              else self.kernel_size)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            pd, ph, pw = self._triple(self.padding)
+            pad = ((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0))
+        window = (1, kd, kh, kw, 1)
+        strides = (1, *stride, 1)
+        if self.pooling_type == PoolingType.MAX:
+            init_val = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+                else jnp.iinfo(x.dtype).min
+            y = lax.reduce_window(x, init_val, lax.max, window, strides, pad)
+        elif self.pooling_type == PoolingType.AVG:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad) \
+                / (kd * kh * kw)
+        elif self.pooling_type == PoolingType.SUM:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        else:  # pnorm, matching the 1D/2D layers
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window,
+                                  strides, pad) ** (1.0 / p)
+        return y.astype(x.dtype), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
 class ZeroPaddingLayer(Layer):
     padding: Any = (1, 1)  # (ph, pw) or ((pt,pb),(pl,pr))
 
@@ -520,6 +577,97 @@ class Cropping2D(Layer):
     def apply(self, params, state, x, ctx: Ctx):
         (ct, cb), (cl, cr) = self._crops()
         return x[:, ct:x.shape[1] - cb, cl:x.shape[2] - cr, :], state
+
+    def has_params(self):
+        return False
+
+
+def _amount_pair(v):
+    """int → symmetric pair; else pass through as (before, after)."""
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _amount_triple(v):
+    """int / (a,b,c) / ((a0,a1),(b0,b1),(c0,c1)) → 3 (before, after) pairs."""
+    if isinstance(v, int):
+        return ((v, v),) * 3
+    if isinstance(v[0], (tuple, list)):
+        return tuple(tuple(q) for q in v)
+    return tuple((q, q) for q in v)
+
+
+@dataclass
+class ZeroPadding1DLayer(Layer):
+    """(B, T, C) sequence padding (ZeroPadding1DLayer)."""
+
+    padding: Any = 1  # int or (left, right)
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        pl_, pr = _amount_pair(self.padding)
+        return {}, {}, (t + pl_ + pr, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        pl_, pr = _amount_pair(self.padding)
+        return jnp.pad(x, ((0, 0), (pl_, pr), (0, 0))), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class ZeroPadding3DLayer(Layer):
+    """NDHWC padding (ZeroPadding3DLayer)."""
+
+    padding: Any = 1  # int, (pd, ph, pw) or ((df,db),(ht,hb),(wl,wr))
+
+    def init(self, key, input_shape):
+        d, h, w, c = input_shape
+        (df, db), (ht, hb), (wl, wr) = _amount_triple(self.padding)
+        return {}, {}, (d + df + db, h + ht + hb, w + wl + wr, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        (df, db), (ht, hb), (wl, wr) = _amount_triple(self.padding)
+        return jnp.pad(x, ((0, 0), (df, db), (ht, hb), (wl, wr), (0, 0))), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Cropping1D(Layer):
+    """(B, T, C) sequence cropping (Cropping1D)."""
+
+    cropping: Any = 1  # int or (left, right)
+
+    def init(self, key, input_shape):
+        t, c = input_shape
+        cl, cr = _amount_pair(self.cropping)
+        return {}, {}, (t - cl - cr, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        cl, cr = _amount_pair(self.cropping)
+        return x[:, cl:x.shape[1] - cr, :], state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Cropping3D(Layer):
+    """NDHWC cropping (Cropping3D)."""
+
+    cropping: Any = 1
+
+    def init(self, key, input_shape):
+        d, h, w, c = input_shape
+        (df, db), (ht, hb), (wl, wr) = _amount_triple(self.cropping)
+        return {}, {}, (d - df - db, h - ht - hb, w - wl - wr, c)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        (df, db), (ht, hb), (wl, wr) = _amount_triple(self.cropping)
+        return x[:, df:x.shape[1] - db, ht:x.shape[2] - hb,
+                 wl:x.shape[3] - wr, :], state
 
     def has_params(self):
         return False

@@ -179,8 +179,11 @@ class StatsListener(TrainingListener):
                 pass
         self._jsonl = open(self.log_dir / "stats.jsonl", "a")
         # run delimiter: the dashboard charts only the records after the
-        # last one of these, so appended logs never splice two runs
-        self._jsonl.write(json.dumps({"run_start": time.time()}) + "\n")
+        # last one of these, so appended logs never splice two runs. The
+        # leading newline terminates any torn line a crashed run left
+        # behind (an empty line is skipped by the parser).
+        prefix = "\n" if self._jsonl.tell() > 0 else ""
+        self._jsonl.write(prefix + json.dumps({"run_start": time.time()}) + "\n")
         self._jsonl.flush()
         self._prev_params = None
 

@@ -251,3 +251,70 @@ def test_frozen_layer_immune_to_global_constraints():
     y = jax.nn.one_hot(jnp.asarray(rng.integers(0, 2, 8)), 2)
     net.fit(DataSet(jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32)), y))
     assert np.array_equal(w0, np.asarray(net.params["layer_0"]["W"]))
+
+
+def test_subsampling3d_and_pad_crop_3d():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn import (Cropping1D, Cropping3D,
+                                       Subsampling3DLayer, ZeroPadding1DLayer,
+                                       ZeroPadding3DLayer)
+    from deeplearning4j_tpu.nn.layers.base import Ctx
+
+    key = jax.random.PRNGKey(0)
+    ctx = Ctx(train=False)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 8, 8, 8, 3)),
+                    jnp.float32)
+
+    pool = Subsampling3DLayer(kernel_size=(2, 2, 2))
+    _, _, out_shape = pool.init(key, (8, 8, 8, 3))
+    assert out_shape == (4, 4, 4, 3)
+    y, _ = pool.apply({}, {}, x, ctx)
+    assert y.shape == (2, 4, 4, 4, 3)
+    # max pooling oracle on one window
+    assert float(y[0, 0, 0, 0, 0]) == float(jnp.max(x[0, :2, :2, :2, 0]))
+
+    avg = Subsampling3DLayer(kernel_size=(2, 2, 2), pooling_type="avg")
+    ya, _ = avg.apply({}, {}, x, ctx)
+    assert np.isclose(float(ya[0, 0, 0, 0, 0]),
+                      float(jnp.mean(x[0, :2, :2, :2, 0])), atol=1e-6)
+
+    pad3 = ZeroPadding3DLayer(padding=(1, 2, 3))
+    _, _, s3 = pad3.init(key, (8, 8, 8, 3))
+    assert s3 == (10, 12, 14, 3)
+    yp, _ = pad3.apply({}, {}, x, ctx)
+    assert yp.shape == (2, 10, 12, 14, 3)
+    assert float(jnp.sum(jnp.abs(yp[:, 0]))) == 0.0
+
+    crop3 = Cropping3D(cropping=(1, 2, 3))
+    _, _, sc = crop3.init(key, (10, 12, 14, 3))
+    assert sc == (8, 8, 8, 3)
+    yc, _ = crop3.apply({}, {}, yp, ctx)
+    assert np.allclose(np.asarray(yc), np.asarray(x))
+
+    seq = jnp.asarray(np.random.default_rng(1).standard_normal((2, 10, 4)),
+                      jnp.float32)
+    p1 = ZeroPadding1DLayer(padding=(2, 1))
+    _, _, sp = p1.init(key, (10, 4))
+    assert sp == (13, 4)
+    yq, _ = p1.apply({}, {}, seq, ctx)
+    c1 = Cropping1D(cropping=(2, 1))
+    yr, _ = c1.apply({}, {}, yq, ctx)
+    assert np.allclose(np.asarray(yr), np.asarray(seq))
+
+
+def test_subsampling3d_pnorm():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn import Subsampling3DLayer
+    from deeplearning4j_tpu.nn.layers.base import Ctx
+
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((1, 2, 2, 2, 1)),
+                    jnp.float32)
+    layer = Subsampling3DLayer(kernel_size=(2, 2, 2), pooling_type="pnorm",
+                               pnorm=2)
+    y, _ = layer.apply({}, {}, x, Ctx(train=False))
+    expect = float(jnp.sqrt(jnp.sum(jnp.square(x))))
+    assert np.isclose(float(y[0, 0, 0, 0, 0]), expect, atol=1e-5)

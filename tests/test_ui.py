@@ -94,3 +94,19 @@ def test_load_stats_uses_only_last_run(tmp_path):
         + json.dumps({"iter": 1, "score": 0.9, "ts": 101.0}) + "\n")
     recs = load_stats(tmp_path)
     assert [r["iter"] for r in recs] == [1]
+
+
+def test_run_delimiter_survives_torn_tail(tmp_path):
+    """A crashed run leaving a torn trailing line must not swallow the next
+    run's delimiter."""
+    from deeplearning4j_tpu.nn.listeners import StatsListener
+    p = tmp_path / "stats.jsonl"
+    p.write_text(json.dumps({"run_start": 1.0}) + "\n"
+                 + json.dumps({"iter": 9, "score": 0.1, "ts": 2.0}) + "\n"
+                 + '{"iter": 10, "scor')          # crash mid-write, no \n
+    sl = StatsListener(log_dir=tmp_path, frequency=1, tensorboard=False)
+    sl._jsonl.write(json.dumps({"iter": 1, "epoch": 0, "score": 0.8,
+                                "ts": 3.0}) + "\n")
+    sl.close()
+    recs = load_stats(tmp_path)
+    assert [r["iter"] for r in recs] == [1]       # only the NEW run
