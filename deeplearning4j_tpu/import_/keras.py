@@ -1,4 +1,4 @@
-"""Keras .h5 model import — Sequential + Functional subset.
+"""Keras .h5 model import — Sequential + Functional.
 
 Reference parity: ``deeplearning4j-modelimport``
 (``KerasModelImport.importKerasSequentialModelAndWeights`` /
@@ -9,27 +9,43 @@ weights with the layout conversions:
 
 - Dense kernel (in, out) → ours (in, out) as-is
 - Conv2D kernel (kh, kw, cin, cout) → HWIO as-is (both NHWC)
+- Conv2DTranspose kernel (kh, kw, cout, cin) → transposed to HWIO
+- DepthwiseConv2D / SeparableConv2D depthwise kernel (kh, kw, cin, mult)
+  → reshaped (kh, kw, 1, cin*mult); output-channel order cin*mult+m matches
 - LSTM kernels: keras gate order [i, f, c, o] → ours [i, f, o, g(c)]
+- GRU kernels: keras [z, r, h] → ours [r, z, n]; reset_after bias → `rb`
 - BatchNorm: gamma/beta/moving_mean/moving_variance → params + state
+
+Functional (keras 2 AND keras 3 inbound-node formats) becomes a
+ComputationGraph: merge layers → Merge/ElementWise vertices, Flatten →
+CnnToFeedForward preprocessor vertex.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..nn.conf import NeuralNetConfiguration
 from ..nn.layers.base import InputType
-from ..nn.layers.conv import (ConvolutionLayer, GlobalPoolingLayer,
-                              SubsamplingLayer, Upsampling2D, ZeroPaddingLayer)
-from ..nn.layers.core import (ActivationLayer, DenseLayer, DropoutLayer,
-                              EmbeddingSequenceLayer, OutputLayer)
+from ..nn.layers.conv import (Convolution1DLayer, ConvolutionLayer,
+                              Cropping1D, Cropping2D, Deconvolution2D,
+                              DepthwiseConvolution2D, GlobalPoolingLayer,
+                              SeparableConvolution2D, Subsampling1DLayer,
+                              SubsamplingLayer, Upsampling1D, Upsampling2D,
+                              ZeroPadding1DLayer, ZeroPaddingLayer)
+from ..nn.layers.core import (ActivationLayer, AlphaDropout, DenseLayer,
+                              DropoutLayer, EmbeddingSequenceLayer,
+                              GaussianDropout, GaussianNoise, PReLULayer,
+                              SpatialDropout)
 from ..nn.layers.norm import BatchNormalization, LayerNormalization
-from ..nn.layers.recurrent import GRU, LSTM, Bidirectional
+from ..nn.layers.recurrent import GRU, LSTM, Bidirectional, SimpleRnn
 from ..nn.multi_layer_network import MultiLayerNetwork
+from ..nn.preprocessors import CnnToFeedForwardPreProcessor
+from ..nn.vertices import (ElementWiseVertex, MergeVertex, PreprocessorVertex)
 
 _ACT = {"relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh",
         "softmax": "softmax", "linear": "identity", "elu": "elu",
@@ -38,6 +54,9 @@ _ACT = {"relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh",
         "hard_sigmoid": "hardsigmoid", "leaky_relu": "leakyrelu",
         "relu6": "relu6", "mish": "mish", "exponential": "identity"}
 
+_ELEMENTWISE = {"Add": "add", "Subtract": "sub", "Multiply": "mul",
+                "Average": "avg", "Maximum": "max"}
+
 
 def _act(cfg):
     return _ACT.get(cfg.get("activation", "linear"), "identity")
@@ -45,6 +64,10 @@ def _act(cfg):
 
 def _pair(v):
     return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _one(v):
+    return v[0] if isinstance(v, (list, tuple)) else v
 
 
 def _map_layer(kcfg: dict):
@@ -62,11 +85,49 @@ def _map_layer(kcfg: dict):
             dilation=_pair(c.get("dilation_rate", 1)),
             convolution_mode="same" if pad == "same" else "truncate",
             padding=0, activation=_act(c), has_bias=c.get("use_bias", True))
+    if cls == "Conv2DTranspose":
+        pad = c.get("padding", "valid")
+        return Deconvolution2D(
+            n_out=c["filters"], kernel_size=_pair(c["kernel_size"]),
+            stride=_pair(c.get("strides", 1)),
+            convolution_mode="same" if pad == "same" else "truncate",
+            padding=0, activation=_act(c), has_bias=c.get("use_bias", True))
+    if cls == "SeparableConv2D":
+        pad = c.get("padding", "valid")
+        return SeparableConvolution2D(
+            n_out=c["filters"], kernel_size=_pair(c["kernel_size"]),
+            stride=_pair(c.get("strides", 1)),
+            depth_multiplier=c.get("depth_multiplier", 1),
+            convolution_mode="same" if pad == "same" else "truncate",
+            padding=0, activation=_act(c), has_bias=c.get("use_bias", True))
+    if cls == "DepthwiseConv2D":
+        pad = c.get("padding", "valid")
+        return DepthwiseConvolution2D(
+            kernel_size=_pair(c["kernel_size"]),
+            stride=_pair(c.get("strides", 1)),
+            depth_multiplier=c.get("depth_multiplier", 1),
+            convolution_mode="same" if pad == "same" else "truncate",
+            padding=0, activation=_act(c), has_bias=c.get("use_bias", True))
+    if cls == "Conv1D":
+        pad = c.get("padding", "valid")
+        return Convolution1DLayer(
+            n_out=c["filters"], kernel_size=_one(c["kernel_size"]),
+            stride=_one(c.get("strides", 1)),
+            dilation=_one(c.get("dilation_rate", 1)),
+            convolution_mode="same" if pad == "same" else "truncate",
+            padding=0, activation=_act(c), has_bias=c.get("use_bias", True))
     if cls in ("MaxPooling2D", "AveragePooling2D"):
         pad = c.get("padding", "valid")
         return SubsamplingLayer(
             kernel_size=_pair(c.get("pool_size", 2)),
             stride=_pair(c.get("strides") or c.get("pool_size", 2)),
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            convolution_mode="same" if pad == "same" else "truncate")
+    if cls in ("MaxPooling1D", "AveragePooling1D"):
+        pad = c.get("padding", "valid")
+        return Subsampling1DLayer(
+            kernel_size=_one(c.get("pool_size", 2)),
+            stride=_one(c.get("strides") or c.get("pool_size", 2)),
             pooling_type="max" if cls.startswith("Max") else "avg",
             convolution_mode="same" if pad == "same" else "truncate")
     if cls in ("GlobalAveragePooling2D", "GlobalAveragePooling1D"):
@@ -75,16 +136,38 @@ def _map_layer(kcfg: dict):
         return GlobalPoolingLayer(pooling_type="max")
     if cls == "UpSampling2D":
         return Upsampling2D(size=_pair(c.get("size", 2)))
+    if cls == "UpSampling1D":
+        return Upsampling1D(size=_one(c.get("size", 2)))
     if cls == "ZeroPadding2D":
         return ZeroPaddingLayer(padding=c.get("padding", (1, 1)))
+    if cls == "ZeroPadding1D":
+        return ZeroPadding1DLayer(padding=c.get("padding", 1))
+    if cls == "Cropping2D":
+        return Cropping2D(cropping=c.get("cropping", (1, 1)))
+    if cls == "Cropping1D":
+        return Cropping1D(cropping=c.get("cropping", 1))
     if cls == "Dropout":
         return DropoutLayer(rate=c["rate"])
+    if cls == "SpatialDropout2D":
+        return SpatialDropout(rate=c["rate"])
+    if cls == "GaussianDropout":
+        return GaussianDropout(rate=c["rate"])
+    if cls == "GaussianNoise":
+        return GaussianNoise(stddev=c.get("stddev", 0.1))
+    if cls == "AlphaDropout":
+        return AlphaDropout(rate=c["rate"])
     if cls == "Activation":
         return ActivationLayer(activation=_act(c))
     if cls == "ReLU":
         return ActivationLayer(activation="relu")
     if cls == "LeakyReLU":
         return ActivationLayer(activation="leakyrelu")
+    if cls == "ELU":
+        return ActivationLayer(activation="elu")
+    if cls == "Softmax":
+        return ActivationLayer(activation="softmax")
+    if cls == "PReLU":
+        return PReLULayer()
     if cls == "BatchNormalization":
         return BatchNormalization(eps=c.get("epsilon", 1e-3),
                                   decay=c.get("momentum", 0.99))
@@ -92,15 +175,43 @@ def _map_layer(kcfg: dict):
         return LayerNormalization(eps=c.get("epsilon", 1e-3))
     if cls == "Embedding":
         return EmbeddingSequenceLayer(n_in=c["input_dim"], n_out=c["output_dim"])
-    if cls == "LSTM":
-        return LSTM(n_out=c["units"], activation=_act({"activation": c.get("activation", "tanh")}),
-                    gate_activation=_ACT.get(c.get("recurrent_activation", "sigmoid"), "sigmoid"),
-                    forget_gate_bias=0.0)
-    if cls == "GRU":
-        return GRU(n_out=c["units"])
+    if cls in ("LSTM", "GRU", "SimpleRNN"):
+        if cls == "LSTM":
+            rnn = LSTM(n_out=c["units"],
+                       activation=_act({"activation": c.get("activation", "tanh")}),
+                       gate_activation=_ACT.get(c.get("recurrent_activation",
+                                                      "sigmoid"), "sigmoid"),
+                       forget_gate_bias=0.0)
+        elif cls == "GRU":
+            rnn = GRU(n_out=c["units"],
+                      gate_activation=_ACT.get(c.get("recurrent_activation",
+                                                     "sigmoid"), "sigmoid"),
+                      reset_after=c.get("reset_after", True))
+        else:
+            rnn = SimpleRnn(n_out=c["units"],
+                            activation=_act({"activation": c.get("activation", "tanh")}))
+        if not c.get("return_sequences", False):
+            from ..nn.layers.recurrent import LastTimeStep
+            return LastTimeStep(rnn)
+        return rnn
     if cls == "Bidirectional":
-        inner = _map_layer(c["layer"])
-        return Bidirectional(fwd=inner, mode=c.get("merge_mode", "concat"))
+        sub = c["layer"]
+        subc = dict(sub["config"])
+        last_step = not subc.get("return_sequences", False)
+        subc["return_sequences"] = True  # wrapper, not inner, takes last step
+        inner = _map_layer({"class_name": sub["class_name"], "config": subc})
+        mode = c.get("merge_mode", "concat")
+        if mode == "sum":
+            mode = "add"
+        if mode is None:
+            raise NotImplementedError(
+                "Bidirectional merge_mode=None (separate outputs) is not "
+                "supported; use concat/sum/ave/mul")
+        if mode not in ("concat", "add", "mul", "ave", "average"):
+            raise NotImplementedError(f"Bidirectional merge_mode '{mode}'")
+        if mode == "ave":
+            mode = "average"
+        return Bidirectional(fwd=inner, mode=mode, last_step=last_step)
     if cls == "Flatten":
         return None  # auto preprocessor inserts the reshape
     if cls in ("InputLayer",):
@@ -129,56 +240,120 @@ def _lstm_reorder(k, units):
     return np.concatenate([i, f, o, cc], axis=1)
 
 
+def _gru_reorder(k, units):
+    """keras [z, r, h] gate columns → ours [r, z, n]."""
+    z, r, hh = (k[:, j * units:(j + 1) * units] for j in range(3))
+    return np.concatenate([r, z, hh], axis=1)
+
+
+def _depthwise_reshape(k):
+    """keras (kh, kw, cin, mult) → lax HWIO (kh, kw, 1, cin*mult); keras's
+    output-channel order cin*mult + m matches feature_group_count=cin."""
+    kh, kw, cin, mult = k.shape
+    return k.reshape(kh, kw, 1, cin * mult)
+
+
+def _set_layer_weights(layer, pdict: Dict, sdict: Dict, ws: List[np.ndarray]):
+    """Write one keras layer's weight list into our (params, state) dicts."""
+    from ..nn.layers.recurrent import LastTimeStep
+    if isinstance(layer, LastTimeStep):  # return_sequences=False wrapper
+        layer = layer.inner
+    if isinstance(layer, Bidirectional):
+        # h5 weight_names order: forward [kernel, rec, bias] then backward
+        half = len(ws) // 2
+        _set_layer_weights(layer.fwd, pdict["fwd"], sdict.get("fwd", {}),
+                           ws[:half])
+        _set_layer_weights(layer.fwd, pdict["bwd"], sdict.get("bwd", {}),
+                           ws[half:])
+        return
+    if isinstance(layer, DenseLayer):
+        pdict["W"] = jnp.asarray(ws[0])
+        if layer.has_bias and len(ws) > 1:
+            pdict["b"] = jnp.asarray(ws[1])
+    elif isinstance(layer, Deconvolution2D):
+        # keras (kh,kw,cout,cin), gradient-of-conv semantics (flipped kernel)
+        # → our unflipped HWIO conv_transpose: flip spatial + swap I/O
+        pdict["W"] = jnp.asarray(np.transpose(ws[0][::-1, ::-1], (0, 1, 3, 2)))
+        if layer.has_bias and len(ws) > 1:
+            pdict["b"] = jnp.asarray(ws[1])
+    elif isinstance(layer, SeparableConvolution2D):
+        pdict["dW"] = jnp.asarray(_depthwise_reshape(ws[0]))
+        pdict["pW"] = jnp.asarray(ws[1])
+        if layer.has_bias and len(ws) > 2:
+            pdict["b"] = jnp.asarray(ws[2])
+    elif isinstance(layer, DepthwiseConvolution2D):
+        pdict["W"] = jnp.asarray(_depthwise_reshape(ws[0]))
+        if layer.has_bias and len(ws) > 1:
+            pdict["b"] = jnp.asarray(ws[1])
+    elif isinstance(layer, (ConvolutionLayer, Convolution1DLayer)):
+        pdict["W"] = jnp.asarray(ws[0])  # HWIO / TIO as-is
+        if layer.has_bias and len(ws) > 1:
+            pdict["b"] = jnp.asarray(ws[1])
+    elif isinstance(layer, BatchNormalization):
+        gamma, beta, mean, var = ws[:4]
+        pdict["gamma"] = jnp.asarray(gamma)
+        pdict["beta"] = jnp.asarray(beta)
+        sdict["mean"] = jnp.asarray(mean)
+        sdict["var"] = jnp.asarray(var)
+    elif isinstance(layer, LayerNormalization):
+        pdict["gamma"] = jnp.asarray(ws[0])
+        if len(ws) > 1:
+            pdict["beta"] = jnp.asarray(ws[1])
+    elif isinstance(layer, LSTM):
+        units = layer.n_out
+        kernel, rec, bias = ws[:3]
+        pdict["W"] = jnp.asarray(_lstm_reorder(kernel, units))
+        pdict["RW"] = jnp.asarray(_lstm_reorder(rec, units))
+        if bias.ndim == 2:  # keras can stack [input_bias, recurrent_bias]
+            bias = bias.sum(axis=0)
+        pdict["b"] = jnp.asarray(_lstm_reorder(bias[None, :], units)[0])
+    elif isinstance(layer, GRU):
+        units = layer.n_out
+        kernel, rec, bias = ws[:3]
+        pdict["W"] = jnp.asarray(_gru_reorder(kernel, units))
+        pdict["RW"] = jnp.asarray(_gru_reorder(rec, units))
+        if bias.ndim == 2:  # reset_after=True: [input_bias, recurrent_bias]
+            pdict["b"] = jnp.asarray(_gru_reorder(bias[0][None, :], units)[0])
+            pdict["rb"] = jnp.asarray(_gru_reorder(bias[1][None, :], units)[0])
+        else:
+            pdict["b"] = jnp.asarray(_gru_reorder(bias[None, :], units)[0])
+    elif isinstance(layer, SimpleRnn):
+        pdict["W"] = jnp.asarray(ws[0])
+        pdict["RW"] = jnp.asarray(ws[1])
+        if len(ws) > 2:
+            pdict["b"] = jnp.asarray(ws[2])
+    elif isinstance(layer, PReLULayer):
+        pdict["alpha"] = jnp.asarray(ws[0])
+    elif isinstance(layer, EmbeddingSequenceLayer):
+        pdict["W"] = jnp.asarray(ws[0])
+
+
+def _weight_arrays(model_weights, lname):
+    import h5py
+    grp = model_weights[lname]
+    names = [n.decode() if isinstance(n, bytes) else n
+             for n in grp.attrs.get("weight_names", [])]
+    if names:
+        return [np.asarray(grp[n]) for n in names]
+    out = []  # keras3 style: nested 'vars' datasets
+
+    def visit(_, obj):
+        if isinstance(obj, h5py.Dataset):
+            out.append(np.asarray(obj))
+    grp.visititems(visit)
+    return out
+
+
 def _assign_weights(net: MultiLayerNetwork, model_weights, layer_names_in_order):
     """Copy weight arrays from the h5 group into net params/states."""
-    import h5py
-
-    def arrays_for(lname):
-        grp = model_weights[lname]
-        names = [n.decode() if isinstance(n, bytes) else n
-                 for n in grp.attrs.get("weight_names", [])]
-        if names:
-            return [np.asarray(grp[n]) for n in names]
-        # keras3 style: nested 'vars' datasets
-        out = []
-
-        def visit(_, obj):
-            if isinstance(obj, h5py.Dataset):
-                out.append(np.asarray(obj))
-        grp.visititems(visit)
-        return out
-
     for i, (layer, lname) in enumerate(zip(net.layers, layer_names_in_order)):
         if lname is None:
             continue
-        ws = arrays_for(lname)
+        ws = _weight_arrays(model_weights, lname)
         if not ws:
             continue
         key = f"layer_{i}"
-        if isinstance(layer, (DenseLayer,)):
-            layer_params = {"W": jnp.asarray(ws[0])}
-            if layer.has_bias and len(ws) > 1:
-                layer_params["b"] = jnp.asarray(ws[1])
-            net.params[key].update(layer_params)
-        elif isinstance(layer, ConvolutionLayer):
-            net.params[key]["W"] = jnp.asarray(ws[0])
-            if layer.has_bias and len(ws) > 1:
-                net.params[key]["b"] = jnp.asarray(ws[1])
-        elif isinstance(layer, BatchNormalization):
-            gamma, beta, mean, var = ws[:4]
-            net.params[key]["gamma"] = jnp.asarray(gamma)
-            net.params[key]["beta"] = jnp.asarray(beta)
-            net.states[key]["mean"] = jnp.asarray(mean)
-            net.states[key]["var"] = jnp.asarray(var)
-        elif isinstance(layer, LSTM):
-            units = layer.n_out
-            kernel, rec, bias = ws[:3]
-            net.params[key]["W"] = jnp.asarray(_lstm_reorder(kernel, units))
-            net.params[key]["RW"] = jnp.asarray(_lstm_reorder(rec, units))
-            net.params[key]["b"] = jnp.asarray(
-                _lstm_reorder(bias[None, :], units)[0])
-        elif isinstance(layer, EmbeddingSequenceLayer):
-            net.params[key]["W"] = jnp.asarray(ws[0])
+        _set_layer_weights(layer, net.params[key], net.states[key], ws)
     net._invalidate()
 
 
@@ -209,4 +384,103 @@ def import_keras_sequential(path, input_shape=None):
         wg = f["model_weights"] if "model_weights" in f else f
         present = set(wg.keys())
         _assign_weights(net, wg, [n if n in present else None for n in names])
+    return net
+
+
+# ------------------------------------------------------------- functional --
+
+def _inbound_names(kcfg) -> List[str]:
+    """Input node names, handling BOTH the keras-2 nested-list format
+    ([[['name', 0, 0, {}], ...]]) and the keras-3 __keras_tensor__ format."""
+    out: List[str] = []
+
+    def walk(o):
+        if isinstance(o, dict):
+            if o.get("class_name") == "__keras_tensor__":
+                out.append(o["config"]["keras_history"][0])
+                return
+            for v in o.values():
+                walk(v)
+        elif isinstance(o, (list, tuple)):
+            if (len(o) >= 3 and isinstance(o[0], str)
+                    and isinstance(o[1], int) and isinstance(o[2], int)):
+                out.append(o[0])  # keras2 ['name', node_idx, tensor_idx, ...]
+                return
+            for v in o:
+                walk(v)
+
+    walk(kcfg.get("inbound_nodes", []))
+    return out
+
+
+def _io_names(spec) -> List[str]:
+    """config['input_layers'] / ['output_layers'] → names. Either a single
+    ['name', 0, 0] or a list of them."""
+    if not spec:
+        return []
+    if isinstance(spec[0], str):
+        return [spec[0]]
+    return [s[0] for s in spec]
+
+
+def import_keras_model(path):
+    """KerasModelImport.importKerasModelAndWeights analogue: Functional
+    keras model → ComputationGraph."""
+    import h5py
+
+    from ..nn.computation_graph import ComputationGraph
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs["model_config"]
+        cfg = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+        if cfg["class_name"] == "Sequential":
+            raise ValueError("use import_keras_sequential for Sequential models")
+        c = cfg["config"]
+        inputs = _io_names(c["input_layers"])
+        outputs = _io_names(c["output_layers"])
+        b = NeuralNetConfiguration.builder().graph_builder()
+        b.add_inputs(*inputs)
+        input_shapes: Dict[str, tuple] = {}
+        layer_names: Dict[str, Any] = {}  # graph node name → keras group name
+        for kc in c["layers"]:
+            cls = kc["class_name"]
+            name = kc["config"]["name"]
+            if cls == "InputLayer":
+                it = _keras_input_type(kc)
+                if it is not None:
+                    input_shapes[name] = tuple(it[1])
+                continue
+            inbound = _inbound_names(kc)
+            if cls in _ELEMENTWISE:
+                b.add_vertex(name, ElementWiseVertex(op=_ELEMENTWISE[cls]),
+                             *inbound)
+            elif cls == "Concatenate":
+                b.add_vertex(name, MergeVertex(axis=kc["config"].get("axis", -1)),
+                             *inbound)
+            elif cls == "Flatten":
+                b.add_vertex(name,
+                             PreprocessorVertex(CnnToFeedForwardPreProcessor()),
+                             *inbound)
+            else:
+                layer = _map_layer(kc)
+                if layer is None:
+                    raise NotImplementedError(
+                        f"structural keras layer '{cls}' not supported in "
+                        f"functional import")
+                b.add_layer(name, layer, *inbound)
+                layer_names[name] = layer
+        b.set_outputs(*outputs)
+        net = ComputationGraph(b.build())
+        net.init([input_shapes[i] for i in inputs])
+        wg = f["model_weights"] if "model_weights" in f else f
+        present = set(wg.keys())
+        for name, layer in layer_names.items():
+            if name not in present:
+                continue
+            ws = _weight_arrays(wg, name)
+            if not ws:
+                continue
+            _set_layer_weights(layer, net.params[name], net.states[name], ws)
+        net._train_step = None  # drop jit caches compiled pre-assignment
+        net._infer_fn = None
     return net

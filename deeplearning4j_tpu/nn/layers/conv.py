@@ -486,30 +486,27 @@ class Subsampling3DLayer(Layer):
     convolution_mode: str = "truncate"
     pnorm: int = 2
 
-    def _triple(self, v):
-        return (v, v, v) if isinstance(v, int) else tuple(v)
-
     def init(self, key, input_shape):
         d, h, w, c = input_shape
-        kd, kh, kw = self._triple(self.kernel_size)
-        sd, sh, sw = self._triple(self.stride if self.stride is not None
-                                  else self.kernel_size)
+        kd, kh, kw = _triple(self.kernel_size)
+        sd, sh, sw = _triple(self.stride if self.stride is not None
+                             else self.kernel_size)
         if self.convolution_mode == "same":
             out = (-(-d // sd), -(-h // sh), -(-w // sw), c)
         else:
-            pd, ph, pw = self._triple(self.padding)
+            pd, ph, pw = _triple(self.padding)
             out = ((d + 2 * pd - kd) // sd + 1, (h + 2 * ph - kh) // sh + 1,
                    (w + 2 * pw - kw) // sw + 1, c)
         return {}, {}, out
 
     def apply(self, params, state, x, ctx: Ctx):
-        kd, kh, kw = self._triple(self.kernel_size)
-        stride = self._triple(self.stride if self.stride is not None
-                              else self.kernel_size)
+        kd, kh, kw = _triple(self.kernel_size)
+        stride = _triple(self.stride if self.stride is not None
+                         else self.kernel_size)
         if self.convolution_mode == "same":
             pad = "SAME"
         else:
-            pd, ph, pw = self._triple(self.padding)
+            pd, ph, pw = _triple(self.padding)
             pad = ((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0))
         window = (1, kd, kh, kw, 1)
         strides = (1, *stride, 1)

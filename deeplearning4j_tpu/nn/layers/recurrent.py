@@ -169,9 +169,15 @@ class GravesLSTM(LSTM):
 
 @dataclass
 class GRU(BaseRecurrent):
-    """GRU — gate order [r, z, n]."""
+    """GRU — gate order [r, z, n].
+
+    reset_after=True (default, keras v3 semantics): n uses r * (h @ RWn),
+    one fused (h, 3H) recurrent matmul per step. reset_after=False (classic
+    GRU / keras v1): n uses (r * h) @ RWn — the reset gate applies BEFORE
+    the matmul, so the candidate matmul can't fuse with the gate matmul."""
 
     gate_activation: Any = "sigmoid"
+    reset_after: bool = True
 
     def init(self, key, input_shape):
         t, c = input_shape
@@ -192,16 +198,27 @@ class GRU(BaseRecurrent):
         from .. import activations as _a
         gate_act = _a.get(self.gate_activation)
         w, rw, b = (params[k].astype(x.dtype) for k in ("W", "RW", "b"))
+        # optional recurrent bias (keras GRU reset_after=True import): applied
+        # inside the reset gate's product, so it can't fold into `b`
+        rb = params["rb"].astype(x.dtype) if "rb" in params else None
         xw = x @ w + b
         mask = ctx.mask
         h0 = jnp.zeros((x.shape[0], h), x.dtype)
 
         def step(h_prev, inp):
             xt, mt = inp
-            hr = h_prev @ rw
-            r = gate_act(xt[:, :h] + hr[:, :h])
-            z = gate_act(xt[:, h:2 * h] + hr[:, h:2 * h])
-            n = act(xt[:, 2 * h:] + r * hr[:, 2 * h:])
+            if self.reset_after:
+                hr = h_prev @ rw
+                if rb is not None:
+                    hr = hr + rb
+                r = gate_act(xt[:, :h] + hr[:, :h])
+                z = gate_act(xt[:, h:2 * h] + hr[:, h:2 * h])
+                n = act(xt[:, 2 * h:] + r * hr[:, 2 * h:])
+            else:
+                hg = h_prev @ rw[:, :2 * h]
+                r = gate_act(xt[:, :h] + hg[:, :h])
+                z = gate_act(xt[:, h:2 * h] + hg[:, h:2 * h])
+                n = act(xt[:, 2 * h:] + (r * h_prev) @ rw[:, 2 * h:])
             h_new = (1 - z) * n + z * h_prev
             if mt is not None:
                 h_new = jnp.where(mt[:, None] > 0, h_new, h_prev)
@@ -234,18 +251,26 @@ class Bidirectional(Layer):
     fwd: Any = None
     mode: str = BidirectionalMode.CONCAT
 
-    def __init__(self, fwd=None, mode=BidirectionalMode.CONCAT, **kw):
+    # last_step=True reproduces keras Bidirectional(return_sequences=False):
+    # merge(fwd state at t=T-1, bwd state after its full reverse pass). That
+    # bwd state sits at t=0 of the re-aligned bwd sequence, so it is NOT the
+    # same as LastTimeStep over the merged sequence.
+    last_step: bool = False
+
+    def __init__(self, fwd=None, mode=BidirectionalMode.CONCAT,
+                 last_step=False, **kw):
         super().__init__(**kw)
         self.fwd = fwd
         self.mode = mode
+        self.last_step = last_step
 
     def init(self, key, input_shape):
         k1, k2 = _split_key(key, 2)
         pf, sf, out = self.fwd.init(k1, input_shape)
         pb, sb, _ = self.fwd.init(k2, input_shape)
         t, h = out
-        if self.mode == BidirectionalMode.CONCAT:
-            out = (t, 2 * h)
+        h_out = 2 * h if self.mode == BidirectionalMode.CONCAT else h
+        out = (h_out,) if self.last_step else (t, h_out)
         return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}, out
 
     def _reverse(self, x, mask):
@@ -262,6 +287,14 @@ class Bidirectional(Layer):
         xr = self._reverse(x, ctx.mask)
         yb, sb = self.fwd.apply(params["bwd"], state["bwd"], xr, ctx)
         yb = self._reverse(yb, ctx.mask)
+        if self.last_step:
+            if ctx.mask is None:
+                yf = yf[:, -1]
+            else:  # last VALID fwd step
+                lengths = jnp.sum(ctx.mask > 0, axis=1).astype(jnp.int32)
+                yf = jnp.take_along_axis(
+                    yf, jnp.clip(lengths - 1, 0)[:, None, None], axis=1)[:, 0]
+            yb = yb[:, 0]  # bwd state after its full pass sits at t=0
         if self.mode == BidirectionalMode.CONCAT:
             y = jnp.concatenate([yf, yb], axis=-1)
         elif self.mode == BidirectionalMode.ADD:

@@ -322,3 +322,101 @@ def test_staging_arena_fallback_rejects_double_release():
     arena.release(b)
     with pytest.raises(ValueError):
         arena.release(b)
+
+
+def test_keras_import_functional_merges(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    inp = keras.layers.Input((8,), name="in0")
+    a = keras.layers.Dense(16, activation="relu", name="da")(inp)
+    b = keras.layers.Dense(16, activation="tanh", name="db")(inp)
+    cat = keras.layers.Concatenate(name="cat")([a, b])
+    add = keras.layers.Add(name="add")([a, b])
+    d2 = keras.layers.Dense(16, name="dd")(cat)
+    mx = keras.layers.Maximum(name="mx")([d2, add])
+    out = keras.layers.Dense(4, activation="softmax", name="out")(mx)
+    model = keras.Model(inp, out)
+    x = np.random.default_rng(0).random((5, 8)).astype(np.float32)
+    want = model.predict(x, verbose=0)
+    p = tmp_path / "fm.h5"
+    model.save(p)
+    from deeplearning4j_tpu.import_.keras import import_keras_model
+    net = import_keras_model(str(p))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_keras_import_cnn_layers(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((16, 16, 3)),
+        keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+        keras.layers.DepthwiseConv2D(3, padding="same"),
+        keras.layers.SeparableConv2D(8, 3, padding="same"),
+        keras.layers.BatchNormalization(),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Conv2DTranspose(4, 3, strides=2, padding="same"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    x = np.random.default_rng(1).random((2, 16, 16, 3)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = tmp_path / "cnn.h5"
+    m.save(p)
+    from deeplearning4j_tpu.import_.keras import import_keras_sequential
+    net = import_keras_sequential(str(p))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_keras_import_rnn_layers(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    for make, name in [
+        (lambda: keras.layers.GRU(6, reset_after=True), "gru_ra"),
+        (lambda: keras.layers.GRU(6, reset_after=False), "gru"),
+        (lambda: keras.layers.SimpleRNN(6), "srnn"),
+        (lambda: keras.layers.LSTM(6), "lstm"),
+    ]:
+        m = keras.Sequential([
+            keras.layers.Input((7, 4)),
+            make(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        x = np.random.default_rng(2).random((2, 7, 4)).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        p = tmp_path / f"{name}.h5"
+        m.save(p)
+        from deeplearning4j_tpu.import_.keras import import_keras_sequential
+        net = import_keras_sequential(str(p))
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-4,
+                                   err_msg=f"{name} mismatch")
+
+
+def test_keras_import_bidirectional(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    from deeplearning4j_tpu.import_.keras import import_keras_sequential
+    cases = [
+        (dict(return_sequences=True), "concat"),
+        (dict(return_sequences=False), "concat"),
+        (dict(return_sequences=False), "sum"),
+        (dict(return_sequences=True), "ave"),
+    ]
+    x = np.random.default_rng(5).random((2, 6, 4)).astype(np.float32)
+    for i, (rnn_kw, mode) in enumerate(cases):
+        m = keras.Sequential([
+            keras.layers.Input((6, 4)),
+            keras.layers.Bidirectional(keras.layers.LSTM(5, **rnn_kw),
+                                       merge_mode=mode),
+            keras.layers.Dense(3),
+        ])
+        want = m.predict(x, verbose=0)
+        p = tmp_path / f"bi{i}.h5"
+        m.save(p)
+        net = import_keras_sequential(str(p))
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-4,
+                                   err_msg=f"case {rnn_kw} {mode}")
