@@ -40,6 +40,29 @@ class BaseRecurrent(Layer):
     def _gates(self):
         raise NotImplementedError
 
+    # ---- streaming single-step API (reference rnnTimeStep) ---------------
+    # Subclasses implement _cell(params, carry, xproj); apply()'s scan and
+    # step_apply() share it, so the cell math lives once. _cell returns
+    # either h_new (carry == output) or (new_carry, y) (e.g. LSTM).
+
+    def init_carry(self, batch: int, dtype):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def step_apply(self, params, carry, xt, ctx: Ctx):
+        """One timestep of stateful inference: xt (B, C) → (y (B, H), carry).
+        The TPU analogue of MultiLayerNetwork.rnnTimeStep's per-layer state."""
+        xt = self._cast_in(xt)
+        xproj = xt @ params["W"].astype(xt.dtype) + params["b"].astype(xt.dtype)
+        out = self._cell(params, carry, xproj)
+        if isinstance(out, tuple):
+            new_carry, y = out
+        else:
+            new_carry = y = out
+        # keep the carry dtype stable across steps (lax.scan requires it)
+        new_carry = jax.tree_util.tree_map(
+            lambda n, o: n.astype(o.dtype), new_carry, carry)
+        return y, new_carry
+
 
 @dataclass
 class SimpleRnn(BaseRecurrent):
@@ -56,17 +79,20 @@ class SimpleRnn(BaseRecurrent):
         }
         return params, {}, (t, self.n_out)
 
+    def _cell(self, params, h_prev, xproj):
+        """xproj = x_t @ W + b already applied; returns h_new."""
+        return self.activation_fn()(xproj + h_prev @ params["RW"].astype(xproj.dtype))
+
     def apply(self, params, state, x, ctx: Ctx):
         x = self._cast_in(x)
-        act = self.activation_fn()
-        w, rw, b = (params[k].astype(x.dtype) for k in ("W", "RW", "b"))
+        w, b = params["W"].astype(x.dtype), params["b"].astype(x.dtype)
         xw = x @ w + b  # (B,T,H) — hoisted MXU matmul
         mask = ctx.mask
         h0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
 
         def step(h, inp):
             xt, mt = inp
-            h_new = act(xt + h @ rw)
+            h_new = self._cell(params, h, xt)
             if mt is not None:
                 h_new = jnp.where(mt[:, None] > 0, h_new, h)
             return h_new, h_new
@@ -112,41 +138,45 @@ class LSTM(BaseRecurrent):
             params["pO"] = jnp.zeros((h,), self.dtype)
         return params, {}, (t, h)
 
-    def apply(self, params, state, x, ctx: Ctx):
-        x = self._cast_in(x)
+    def _cell(self, params, carry, xproj):
+        """xproj = x_t @ W + b; carry (h, c); returns ((h', c'), h')."""
         h = self.n_out
         act = self.activation_fn()
         from .. import activations as _a
         gate_act = _a.get(self.gate_activation)
-        w, rw, b = (params[k].astype(x.dtype) for k in ("W", "RW", "b"))
-        peep = self._has_peepholes()
-        if peep:
-            pi, pf, po = (params[k].astype(x.dtype) for k in ("pI", "pF", "pO"))
+        h_prev, c_prev = carry
+        rw = params["RW"].astype(xproj.dtype)
+        z = xproj + h_prev @ rw
+        zi, zf, zo, zg = z[:, :h], z[:, h:2 * h], z[:, 2 * h:3 * h], z[:, 3 * h:]
+        if self._has_peepholes():
+            zi = zi + c_prev * params["pI"].astype(xproj.dtype)
+            zf = zf + c_prev * params["pF"].astype(xproj.dtype)
+        i = gate_act(zi)
+        f = gate_act(zf)
+        g = act(zg)
+        c_new = f * c_prev + i * g
+        if self._has_peepholes():
+            zo = zo + c_new * params["pO"].astype(xproj.dtype)
+        o = gate_act(zo)
+        h_new = o * act(c_new)
+        return (h_new, c_new), h_new
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        h = self.n_out
+        w, b = params["W"].astype(x.dtype), params["b"].astype(x.dtype)
         xw = x @ w + b  # hoisted (B,T,4H) MXU matmul
         mask = ctx.mask
         b0 = x.shape[0]
         carry0 = (jnp.zeros((b0, h), x.dtype), jnp.zeros((b0, h), x.dtype))
 
         def step(carry, inp):
-            h_prev, c_prev = carry
             xt, mt = inp
-            z = xt + h_prev @ rw
-            zi, zf, zo, zg = z[:, :h], z[:, h:2 * h], z[:, 2 * h:3 * h], z[:, 3 * h:]
-            if peep:
-                zi = zi + c_prev * pi
-                zf = zf + c_prev * pf
-            i = gate_act(zi)
-            f = gate_act(zf)
-            g = act(zg)
-            c_new = f * c_prev + i * g
-            if peep:
-                zo = zo + c_new * po
-            o = gate_act(zo)
-            h_new = o * act(c_new)
+            (h_new, c_new), _ = self._cell(params, carry, xt)
             if mt is not None:
                 keep = mt[:, None] > 0
-                h_new = jnp.where(keep, h_new, h_prev)
-                c_new = jnp.where(keep, c_new, c_prev)
+                h_new = jnp.where(keep, h_new, carry[0])
+                c_new = jnp.where(keep, c_new, carry[1])
             return (h_new, c_new), h_new
 
         xs = xw.swapaxes(0, 1)
@@ -156,6 +186,10 @@ class LSTM(BaseRecurrent):
             _, hs = lax.scan(step, carry0, (xs, mask.swapaxes(0, 1)))
         y = hs.swapaxes(0, 1)
         return apply_time_mask(y, mask), state
+
+    def init_carry(self, batch, dtype):
+        return (jnp.zeros((batch, self.n_out), dtype),
+                jnp.zeros((batch, self.n_out), dtype))
 
 
 @dataclass
@@ -191,35 +225,41 @@ class GRU(BaseRecurrent):
         }
         return params, {}, (t, h)
 
-    def apply(self, params, state, x, ctx: Ctx):
-        x = self._cast_in(x)
+    def _cell(self, params, h_prev, xproj):
+        """xproj = x_t @ W + b; returns h_new."""
         h = self.n_out
         act = self.activation_fn()
         from .. import activations as _a
         gate_act = _a.get(self.gate_activation)
-        w, rw, b = (params[k].astype(x.dtype) for k in ("W", "RW", "b"))
+        rw = params["RW"].astype(xproj.dtype)
         # optional recurrent bias (keras GRU reset_after=True import): applied
         # inside the reset gate's product, so it can't fold into `b`
-        rb = params["rb"].astype(x.dtype) if "rb" in params else None
+        rb = params["rb"].astype(xproj.dtype) if "rb" in params else None
+        if self.reset_after:
+            hr = h_prev @ rw
+            if rb is not None:
+                hr = hr + rb
+            r = gate_act(xproj[:, :h] + hr[:, :h])
+            z = gate_act(xproj[:, h:2 * h] + hr[:, h:2 * h])
+            n = act(xproj[:, 2 * h:] + r * hr[:, 2 * h:])
+        else:
+            hg = h_prev @ rw[:, :2 * h]
+            r = gate_act(xproj[:, :h] + hg[:, :h])
+            z = gate_act(xproj[:, h:2 * h] + hg[:, h:2 * h])
+            n = act(xproj[:, 2 * h:] + (r * h_prev) @ rw[:, 2 * h:])
+        return (1 - z) * n + z * h_prev
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        h = self.n_out
+        w, b = params["W"].astype(x.dtype), params["b"].astype(x.dtype)
         xw = x @ w + b
         mask = ctx.mask
         h0 = jnp.zeros((x.shape[0], h), x.dtype)
 
         def step(h_prev, inp):
             xt, mt = inp
-            if self.reset_after:
-                hr = h_prev @ rw
-                if rb is not None:
-                    hr = hr + rb
-                r = gate_act(xt[:, :h] + hr[:, :h])
-                z = gate_act(xt[:, h:2 * h] + hr[:, h:2 * h])
-                n = act(xt[:, 2 * h:] + r * hr[:, 2 * h:])
-            else:
-                hg = h_prev @ rw[:, :2 * h]
-                r = gate_act(xt[:, :h] + hg[:, :h])
-                z = gate_act(xt[:, h:2 * h] + hg[:, h:2 * h])
-                n = act(xt[:, 2 * h:] + (r * h_prev) @ rw[:, 2 * h:])
-            h_new = (1 - z) * n + z * h_prev
+            h_new = self._cell(params, h_prev, xt)
             if mt is not None:
                 h_new = jnp.where(mt[:, None] > 0, h_new, h_prev)
             return h_new, h_new

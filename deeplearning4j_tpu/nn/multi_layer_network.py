@@ -392,6 +392,93 @@ class MultiLayerNetwork:
         return roc
 
     # ------------------------------------------------------------ listeners
+    # ------------------------------------------------- streaming inference
+    def rnn_time_step(self, x):
+        """Stateful streaming inference — reference rnnTimeStep: feed one
+        step (B, C) or a chunk (B, T, C); every recurrent layer's hidden
+        state persists across calls until rnn_clear_previous_state(). One
+        jitted scan per chunk; the carry pytree lives on device between
+        calls (no host round-trip in a generation loop)."""
+        from .layers.recurrent import (BaseRecurrent, Bidirectional,
+                                       LastTimeStep)
+        from .layers.wrappers import TimeDistributedLayer
+        for layer in self.layers:
+            if isinstance(unwrap(layer), (Bidirectional, LastTimeStep,
+                                          TimeDistributedLayer)):
+                raise NotImplementedError(
+                    f"rnn_time_step cannot stream through "
+                    f"{type(unwrap(layer)).__name__}: it needs the full "
+                    f"sequence (reference rnnTimeStep has the same limit)")
+        x = jnp.asarray(x)
+        single = x.ndim == 2 or (x.ndim == 1 and jnp.issubdtype(x.dtype, jnp.integer))
+        if single:
+            x = x[:, None] if x.ndim == 1 else x[:, None, :]
+        batch = x.shape[0]
+
+        def carry_dtype(ul):
+            # must match what the cell emits: the post-cast compute dtype
+            if ul.compute_dtype is not None:
+                return ul.compute_dtype
+            return x.dtype if jnp.issubdtype(x.dtype, jnp.floating) \
+                else self._g.param_dtype
+
+        old = getattr(self, "_rnn_carries", None) or {}
+        if getattr(self, "_rnn_carry_batch", None) != batch:
+            old = {}  # batch changed: stale state is meaningless
+        carries = {}
+        for i, layer in enumerate(self.layers):
+            ul = unwrap(layer)
+            if isinstance(ul, BaseRecurrent):
+                key = f"layer_{i}"
+                carries[key] = old.get(key)
+                if carries[key] is None:  # keep rnn_set_previous_state values
+                    carries[key] = ul.init_carry(batch, carry_dtype(ul))
+        self._rnn_carry_batch = batch
+
+        if getattr(self, "_rnn_stream_fn", None) is None:
+            def stream(params, states, carries, xs):
+                def step(cs, xt):
+                    h = xt
+                    new_cs = {}
+                    for i, layer in enumerate(self.layers):
+                        key = f"layer_{i}"
+                        if i in self._preprocessors:  # same as _forward
+                            h = self._preprocessors[i](h)
+                        ul = unwrap(layer)
+                        if isinstance(ul, BaseRecurrent):
+                            h, c = ul.step_apply(params[key], cs[key], h,
+                                                 Ctx(train=False))
+                            new_cs[key] = c
+                        else:
+                            h, _ = layer.apply(params[key], states[key], h,
+                                               Ctx(train=False))
+                    return new_cs, h
+                cs, ys = jax.lax.scan(step, carries, xs.swapaxes(0, 1))
+                return ys.swapaxes(0, 1), cs
+            self._rnn_stream_fn = jax.jit(stream)
+
+        y, carries = self._rnn_stream_fn(self.params, self.states, carries, x)
+        self._rnn_carries = carries
+        return y[:, 0] if single else y
+
+    def rnn_clear_previous_state(self):
+        """Reference rnnClearPreviousState: drop all streaming state."""
+        self._rnn_carries = None
+        self._rnn_carry_batch = None
+
+    def rnn_get_previous_state(self, layer_idx: int):
+        carries = getattr(self, "_rnn_carries", None) or {}
+        return carries.get(f"layer_{layer_idx}")
+
+    def rnn_set_previous_state(self, layer_idx: int, state):
+        carries = dict(getattr(self, "_rnn_carries", None) or {})
+        carries[f"layer_{layer_idx}"] = state
+        self._rnn_carries = carries
+        # record the batch the injected state implies so the next
+        # rnn_time_step keeps it instead of re-initializing
+        leaf = jax.tree_util.tree_leaves(state)[0]
+        self._rnn_carry_batch = leaf.shape[0]
+
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
 
@@ -427,6 +514,7 @@ class MultiLayerNetwork:
     def _invalidate(self):
         self._infer_fn = None
         self._train_step = None
+        self._rnn_stream_fn = None
 
     def clone(self):
         import copy

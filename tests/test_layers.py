@@ -244,3 +244,91 @@ def test_dropout_train_vs_infer():
 ])
 def test_gradient_check(layer, shape):
     central_diff_grad_check(layer, shape)
+
+
+def test_rnn_time_step_matches_full_forward():
+    """Streaming rnn_time_step (reference rnnTimeStep) fed one step at a
+    time must reproduce output() over the whole sequence, for every
+    recurrent cell type."""
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration, RnnOutputLayer)
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    rng = np.random.default_rng(7)
+    for make in (lambda: SimpleRnn(n_in=3, n_out=6),
+                 lambda: LSTM(n_in=3, n_out=6),
+                 lambda: GravesLSTM(n_in=3, n_out=6),
+                 lambda: GRU(n_in=3, n_out=6)):
+        conf = (NeuralNetConfiguration.builder().seed(11).updater(Adam(1e-3))
+                .list()
+                .layer(make())
+                .layer(RnnOutputLayer(n_in=6, n_out=4, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init((5, 3))
+        x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+        full = np.asarray(net.output(x))          # (2, 5, 4)
+        net.rnn_clear_previous_state()
+        stepped = [np.asarray(net.rnn_time_step(x[:, t, :])) for t in range(5)]
+        got = np.stack(stepped, axis=1)
+        np.testing.assert_allclose(got, full, atol=1e-5,
+                                   err_msg=type(make()).__name__)
+        # chunk streaming continues from carried state
+        net.rnn_clear_previous_state()
+        first = np.asarray(net.rnn_time_step(x[:, :3, :]))
+        rest = np.asarray(net.rnn_time_step(x[:, 3:, :]))
+        np.testing.assert_allclose(np.concatenate([first, rest], axis=1),
+                                   full, atol=1e-5)
+        # clearing state restarts the stream
+        net.rnn_clear_previous_state()
+        again = np.asarray(net.rnn_time_step(x[:, 0, :]))
+        np.testing.assert_allclose(again, full[:, 0], atol=1e-5)
+
+
+def test_rnn_time_step_state_injection_and_bf16():
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration, RnnOutputLayer)
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-3))
+            .list()
+            .layer(LSTM(n_in=3, n_out=6))
+            .layer(RnnOutputLayer(n_in=6, n_out=4, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init((5, 3))
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    full = np.asarray(net.output(x))
+
+    # save state mid-stream, restore via rnn_set_previous_state, continue
+    net.rnn_clear_previous_state()
+    net.rnn_time_step(x[:, :3, :])
+    saved = net.rnn_get_previous_state(0)
+    net.rnn_clear_previous_state()
+    net.rnn_set_previous_state(0, saved)
+    rest = np.asarray(net.rnn_time_step(x[:, 3:, :]))
+    np.testing.assert_allclose(rest, full[:, 3:], atol=1e-5)
+
+    # bf16 mixed-precision config streams without dtype errors
+    conf16 = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-3))
+              .data_type(jnp.float32, jnp.bfloat16)
+              .list()
+              .layer(LSTM(n_in=3, n_out=6))
+              .layer(RnnOutputLayer(n_in=6, n_out=4, activation="softmax",
+                                    loss="mcxent"))
+              .build())
+    net16 = MultiLayerNetwork(conf16).init((5, 3))
+    y16 = net16.rnn_time_step(x[:, 0, :])
+    assert y16.shape == (2, 4) and bool(np.all(np.isfinite(np.asarray(y16, np.float32))))
+
+    # Bidirectional cannot stream: clear error, not cryptic shapes
+    confbi = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-3))
+              .list()
+              .layer(Bidirectional(fwd=LSTM(n_in=3, n_out=6)))
+              .layer(RnnOutputLayer(n_in=12, n_out=4, activation="softmax",
+                                    loss="mcxent"))
+              .build())
+    netbi = MultiLayerNetwork(confbi).init((5, 3))
+    with pytest.raises(NotImplementedError, match="Bidirectional"):
+        netbi.rnn_time_step(x[:, 0, :])
