@@ -7,6 +7,10 @@ vs_baseline divides by the DL4J V100 cuDNN reference (360 img/s — see
 BASELINE.md). Synthetic ImageNet-shaped data (zero-egress sandbox); bf16
 NHWC convs (MXU accumulates in f32 on TPU); steady-state timing excludes
 compile.
+
+Secondary configs (SURVEY.md §6): `python bench.py --model lenet|charnn|
+bert|transformer [batch] [steps]` — each prints its own single JSON line
+(no vs_baseline; the published reference numbers cover ResNet-50 only).
 """
 
 from __future__ import annotations
@@ -18,14 +22,140 @@ import time
 BASELINE_SAMPLES_PER_SEC = 360.0  # DL4J ResNet-50 V100 cuDNN (BASELINE.md)
 
 
+def bench_lenet(batch, steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.zoo import LeNet
+
+    net = LeNet(num_classes=10).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((batch, 28, 28, 1), np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    it = ListDataSetIterator([DataSet(x, y)])
+    net.fit(it, epochs=1)  # compile + warmup
+    t0 = time.perf_counter()
+    net.fit(ListDataSetIterator([DataSet(x, y)] * steps), epochs=1)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    return {"metric": "LeNet MNIST fit() samples/sec/chip",
+            "value": round(batch * steps / dt, 2), "unit": "samples/sec/chip"}
+
+
+def bench_charnn(batch, steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.zoo import TextGenerationLSTM
+
+    seq, vocab = 60, 77
+    net = TextGenerationLSTM(num_classes=vocab, input_shape=(seq, vocab)).init()
+    rng = np.random.default_rng(0)
+    x = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (batch, seq))]
+    y = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (batch, seq))]
+    net.fit(ListDataSetIterator([DataSet(x, y)]), epochs=1)
+    t0 = time.perf_counter()
+    net.fit(ListDataSetIterator([DataSet(x, y)] * steps), epochs=1)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    return {"metric": "GravesLSTM char-RNN fit() tokens/sec/chip",
+            "value": round(batch * seq * steps / dt, 2),
+            "unit": "tokens/sec/chip"}
+
+
+def bench_bert(batch, steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    cfg = tfm.BertConfig(max_seq=128)
+    key = jax.random.PRNGKey(0)
+    params = tfm.bert_init(key, cfg)
+    opt = optax.adamw(2e-5)
+    opt_state = opt.init(params)
+
+    def step(params, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(tfm.bert_classifier_loss)(
+            params, cfg, ids, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)))
+    labels = jnp.asarray(rng.integers(0, cfg.num_labels, batch))
+    params, opt_state, loss = jstep(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = jstep(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return {"metric": "BERT-base fine-tune seq/sec/chip (T=128)",
+            "value": round(batch * steps / dt, 2), "unit": "seq/sec/chip"}
+
+
+def bench_transformer(batch, steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
+                                n_layers=8, d_ff=2048, max_seq=1024,
+                                dtype=jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    params = tfm.init_params(key, cfg)
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+    jstep = jax.jit(tfm.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)))
+    tgt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)))
+    params, opt_state, loss = jstep(params, opt_state, ids, tgt)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = jstep(params, opt_state, ids, tgt)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return {"metric": "Transformer-LM (120M, T=1024, flash-attn) tokens/sec/chip",
+            "value": round(batch * cfg.max_seq * steps / dt, 2),
+            "unit": "tokens/sec/chip"}
+
+
 def main():
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    argv = list(sys.argv[1:])
+    model = "resnet50"
+    if argv and argv[0] == "--model":
+        model = argv[1]
+        argv = argv[2:]
+    if model != "resnet50":
+        fn = {"lenet": bench_lenet, "charnn": bench_charnn,
+              "bert": bench_bert, "transformer": bench_transformer}[model]
+        batch = int(argv[0]) if argv else 32
+        steps = int(argv[1]) if len(argv) > 1 else 10
+        print(json.dumps(fn(batch, steps)))
+        return
+
+    batch = int(argv[0]) if argv else 128
+    steps = int(argv[1]) if len(argv) > 1 else 20
 
     from deeplearning4j_tpu.zoo.resnet import ResNet50
     net = ResNet50(num_classes=1000, compute_dtype=jnp.bfloat16).init()

@@ -295,3 +295,27 @@ class TextGenerationLSTM(ZooModel):
 
     def init(self):
         return MultiLayerNetwork(self.conf()).init(self.input_shape)
+
+    def generate(self, net, seed, n_steps, temperature: float = 1.0,
+                 key=None):
+        """Sample `n_steps` tokens after priming on `seed` (B, T, vocab)
+        one-hot — the reference example's sampleCharactersFromNetwork, built
+        on rnn_time_step so each sampled char is ONE streamed step (state
+        stays on device), not a re-run of the whole prefix.
+
+        Returns int32 token ids (B, n_steps)."""
+        import jax
+        import jax.numpy as jnp
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        vocab = self.num_classes
+        net.rnn_clear_previous_state()
+        probs = net.rnn_time_step(jnp.asarray(seed))[:, -1]  # prime on seed
+        tokens = []
+        for _ in range(n_steps):
+            key, sub = jax.random.split(key)
+            logits = jnp.log(jnp.clip(probs, 1e-9)) / temperature
+            tok = jax.random.categorical(sub, logits, axis=-1)   # (B,)
+            tokens.append(tok)
+            probs = net.rnn_time_step(jax.nn.one_hot(tok, vocab))
+        return jnp.stack(tokens, axis=1).astype(jnp.int32)
