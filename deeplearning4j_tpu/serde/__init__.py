@@ -1,0 +1,28 @@
+"""Serialization: zip checkpoints + orbax distributed checkpointing.
+
+Reference parity: ``org.deeplearning4j.util.ModelSerializer``.
+``ModelSerializer`` is the DL4J-shaped static facade over
+:mod:`.model_serializer`'s functions.
+"""
+
+from .model_serializer import load_model, restore_normalizer, save_model
+from .orbax_ckpt import OrbaxCheckpointer, PreemptionWatchdog
+
+
+class ModelSerializer:
+    """DL4J-style static facade (``writeModel`` / ``restoreMultiLayerNetwork``)."""
+
+    write_model = staticmethod(save_model)
+    writeModel = staticmethod(save_model)
+    restore_multi_layer_network = staticmethod(load_model)
+    restoreMultiLayerNetwork = staticmethod(load_model)
+    restore_computation_graph = staticmethod(load_model)
+    restoreComputationGraph = staticmethod(load_model)
+    restore_normalizer = staticmethod(restore_normalizer)
+    restoreNormalizer = staticmethod(restore_normalizer)
+
+
+__all__ = [
+    "ModelSerializer", "save_model", "load_model", "restore_normalizer",
+    "OrbaxCheckpointer", "PreemptionWatchdog",
+]
