@@ -1,16 +1,30 @@
-"""Headline bench: ResNet-50 ImageNet fit() samples/sec/chip (BASELINE.json).
+"""Benchmarks: ResNet-50 headline + SURVEY §6 secondary configs, MFU-audited.
 
-Runs on the real TPU chip (axon). Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+Prints ONE JSON line on stdout (the headline, BASELINE.json contract):
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N,
+   "flops_per_step": ..., "derived_tflops": ..., "mfu": ..., ...}
 
-vs_baseline divides by the DL4J V100 cuDNN reference (360 img/s — see
-BASELINE.md). Synthetic ImageNet-shaped data (zero-egress sandbox); bf16
-NHWC convs (MXU accumulates in f32 on TPU); steady-state timing excludes
-compile.
+Methodology (why this is trustworthy on the axon tunnel):
+- `jax.block_until_ready` does NOT synchronize through the tunnel (measured:
+  a chained 4096^2 matmul loop "finishes" at 6972 TFLOP/s, 35x over the v5e
+  bf16 peak of ~197 TFLOP/s). Only a real device->host fetch syncs. Every
+  timed region here ends in a scalar host fetch.
+- A single fetch carries a fixed ~65ms tunnel round-trip, so throughput is
+  computed from the MARGINAL step time between two chained-step counts
+  (t(n2)-t(n1))/(n2-n1), which cancels the constant.
+- Steps are data-dependent (params/opt-state carried through), so the chain
+  cannot be reordered or elided.
+- Every record carries analytic FLOPs/step (jaxpr walk, MXU ops only — see
+  utils/tracing.py), derived TFLOP/s, and MFU vs the v5e bf16 peak. An MFU
+  > 1 is physically impossible and flags the record `timing_valid: false`.
 
-Secondary configs (SURVEY.md §6): `python bench.py --model lenet|charnn|
-bert|transformer [batch] [steps]` — each prints its own single JSON line
-(no vs_baseline; the published reference numbers cover ResNet-50 only).
+Secondary configs (LeNet, char-RNN, BERT fine-tune, Transformer-LM, 8-way
+dp scaling) run after the headline and are written to `bench_secondary.json`
+(stderr progress only, stdout stays one line). `--model NAME [batch steps]`
+runs a single config and prints its record alone.
+
+Reference parity: DL4J's published ResNet-50 V100 cuDNN number (~360 img/s)
+is the `vs_baseline` denominator — see BASELINE.md.
 """
 
 from __future__ import annotations
@@ -20,53 +34,137 @@ import sys
 import time
 
 BASELINE_SAMPLES_PER_SEC = 360.0  # DL4J ResNet-50 V100 cuDNN (BASELINE.md)
+V5E_BF16_PEAK = 197e12  # TPU v5 lite bf16 peak FLOP/s (public spec)
+
+
+def _peak_flops(dtype="bf16"):
+    """Attainable peak for the config's compute dtype: f32 matmuls run at
+    roughly half the bf16 MXU rate, so auditing an f32 config against the
+    bf16 peak would make the impossibility gate ~2x too lenient."""
+    import jax
+    if jax.default_backend() != "tpu":
+        return None
+    return V5E_BF16_PEAK if dtype == "bf16" else V5E_BF16_PEAK / 2
+
+
+def _fetch(x):
+    """Force a real device->host sync (block_until_ready lies on the tunnel)."""
+    import jax.numpy as jnp
+    return float(jnp.asarray(x).reshape(-1)[0])
+
+
+def measure_marginal(run_chain, n1=5, n2=25, repeats=2):
+    """Marginal per-step seconds of `run_chain(n) -> fetchable`, best of
+    `repeats` at each count (cancels the fixed tunnel round-trip)."""
+    n2 = max(n2, n1 + 2)
+    _fetch(run_chain(2))  # compile + warmup
+    t_at = {}
+    for n in (n1, n2):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            _fetch(run_chain(n))
+            best = min(best, time.perf_counter() - t0)
+        t_at[n] = best
+    per_step = (t_at[n2] - t_at[n1]) / (n2 - n1)
+    # A non-positive marginal means the measurement is garbage (noise beat
+    # the signal): report it as invalid rather than a clamped huge number.
+    return max(per_step, 1e-9), per_step > 0
+
+
+def chain_runner(step_once, carry):
+    """Chained-step closure shared by every config: `step_once(*carry) ->
+    (new_carry, loss)`. Steps are data-dependent through `carry`, and because
+    the jitted steps donate their state args, `carry` is updated in place so
+    no call ever re-reads a donated buffer."""
+
+    def run_chain(n):
+        c, loss = tuple(carry), None
+        for _ in range(n):
+            c, loss = step_once(*c)
+        carry[:] = c
+        return loss
+
+    return run_chain
+
+
+def _record(metric, unit, samples_per_step, timing, flops_per_step,
+            dtype="bf16", **extra):
+    per_step_s, valid = timing
+    peak = _peak_flops(dtype)
+    tflops = flops_per_step / per_step_s / 1e12
+    rec = {
+        "metric": metric,
+        "value": round(samples_per_step / per_step_s, 2),
+        "unit": unit,
+        "step_time_ms": round(per_step_s * 1e3, 3),
+        "flops_per_step": int(flops_per_step),
+        "derived_tflops": round(tflops, 2),
+        "compute_dtype": dtype,
+        "peak_tflops_assumed": None if peak is None else peak / 1e12,
+        "mfu": None if peak is None else round(flops_per_step / per_step_s / peak, 4),
+        "timing": "marginal chained steps, host-fetch synced",
+    }
+    if not valid or (rec["mfu"] is not None and rec["mfu"] > 1.0):
+        rec["timing_valid"] = False
+    rec.update(extra)
+    return rec
+
+
+def _mln_chain(net, x, y):
+    """Chained-train-step runner for a MultiLayerNetwork + its analytic FLOPs."""
+    import jax
+    from deeplearning4j_tpu.utils.tracing import total_flops
+
+    net._build_optimizer(1)
+    step = net._get_train_step()
+    rng = jax.random.PRNGKey(0)
+    flops = total_flops(
+        lambda p, s, o: step.__wrapped__(p, s, o, x, y, rng, None, None)[:3],
+        net.params, net.states, net._opt_state)
+
+    def step_once(p, s, o):
+        p, s, o, loss, _ = step(p, s, o, x, y, rng, None, None)
+        return (p, s, o), loss
+
+    run_chain = chain_runner(step_once, [net.params, net.states,
+                                         net._opt_state])
+    return run_chain, flops
 
 
 def bench_lenet(batch, steps):
-    import jax
     import jax.numpy as jnp
     import numpy as np
-
-    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
-    from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.zoo import LeNet
 
     net = LeNet(num_classes=10).init()
     rng = np.random.default_rng(0)
-    x = rng.random((batch, 28, 28, 1), np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
-    it = ListDataSetIterator([DataSet(x, y)])
-    net.fit(it, epochs=1)  # compile + warmup
-    t0 = time.perf_counter()
-    net.fit(ListDataSetIterator([DataSet(x, y)] * steps), epochs=1)
-    jax.block_until_ready(net.params)
-    dt = time.perf_counter() - t0
-    return {"metric": "LeNet MNIST fit() samples/sec/chip",
-            "value": round(batch * steps / dt, 2), "unit": "samples/sec/chip"}
+    x = jnp.asarray(rng.random((batch, 28, 28, 1), np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+    run_chain, flops = _mln_chain(net, x, y)
+    timing = measure_marginal(run_chain, n1=5, n2=steps)
+    return _record("LeNet MNIST train-step samples/sec/chip",
+                   "samples/sec/chip", batch, timing, flops, dtype="f32",
+                   batch=batch)
 
 
 def bench_charnn(batch, steps):
-    import jax
     import jax.numpy as jnp
     import numpy as np
-
-    from deeplearning4j_tpu.data.dataset import DataSet
-    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
     from deeplearning4j_tpu.zoo import TextGenerationLSTM
 
     seq, vocab = 60, 77
     net = TextGenerationLSTM(num_classes=vocab, input_shape=(seq, vocab)).init()
     rng = np.random.default_rng(0)
-    x = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (batch, seq))]
-    y = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (batch, seq))]
-    net.fit(ListDataSetIterator([DataSet(x, y)]), epochs=1)
-    t0 = time.perf_counter()
-    net.fit(ListDataSetIterator([DataSet(x, y)] * steps), epochs=1)
-    jax.block_until_ready(net.params)
-    dt = time.perf_counter() - t0
-    return {"metric": "GravesLSTM char-RNN fit() tokens/sec/chip",
-            "value": round(batch * seq * steps / dt, 2),
-            "unit": "tokens/sec/chip"}
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[
+        rng.integers(0, vocab, (batch, seq))])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
+        rng.integers(0, vocab, (batch, seq))])
+    run_chain, flops = _mln_chain(net, x, y)
+    timing = measure_marginal(run_chain, n1=5, n2=steps)
+    return _record("GravesLSTM char-RNN train-step tokens/sec/chip",
+                   "tokens/sec/chip", batch * seq, timing, flops,
+                   dtype="f32", batch=batch, seq=seq)
 
 
 def bench_bert(batch, steps):
@@ -74,7 +172,7 @@ def bench_bert(batch, steps):
     import jax.numpy as jnp
     import numpy as np
     import optax
-
+    from deeplearning4j_tpu.utils.tracing import total_flops
     from deeplearning4j_tpu.zoo import transformer as tfm
 
     cfg = tfm.BertConfig(max_seq=128)
@@ -93,15 +191,16 @@ def bench_bert(batch, steps):
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)))
     labels = jnp.asarray(rng.integers(0, cfg.num_labels, batch))
-    params, opt_state, loss = jstep(params, opt_state, ids, labels)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = jstep(params, opt_state, ids, labels)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    return {"metric": "BERT-base fine-tune seq/sec/chip (T=128)",
-            "value": round(batch * steps / dt, 2), "unit": "seq/sec/chip"}
+    flops = total_flops(step, params, opt_state, ids, labels)
+
+    def step_once(p, o):
+        p, o, loss = jstep(p, o, ids, labels)
+        return (p, o), loss
+
+    run_chain = chain_runner(step_once, [params, opt_state])
+    timing = measure_marginal(run_chain, n1=3, n2=steps)
+    return _record("BERT-base fine-tune seq/sec/chip (T=128)", "seq/sec/chip",
+                   batch, timing, flops, batch=batch, seq=cfg.max_seq)
 
 
 def bench_transformer(batch, steps):
@@ -109,7 +208,7 @@ def bench_transformer(batch, steps):
     import jax.numpy as jnp
     import numpy as np
     import optax
-
+    from deeplearning4j_tpu.utils.tracing import total_flops
     from deeplearning4j_tpu.zoo import transformer as tfm
 
     cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
@@ -119,87 +218,210 @@ def bench_transformer(batch, steps):
     params = tfm.init_params(key, cfg)
     opt = optax.adamw(3e-4)
     opt_state = opt.init(params)
-    jstep = jax.jit(tfm.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    raw_step = tfm.make_train_step(cfg, opt)
+    jstep = jax.jit(raw_step, donate_argnums=(0, 1))
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)))
     tgt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)))
-    params, opt_state, loss = jstep(params, opt_state, ids, tgt)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = jstep(params, opt_state, ids, tgt)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    return {"metric": "Transformer-LM (120M, T=1024, flash-attn) tokens/sec/chip",
-            "value": round(batch * cfg.max_seq * steps / dt, 2),
-            "unit": "tokens/sec/chip"}
+    flops = total_flops(raw_step, params, opt_state, ids, tgt)
+
+    def step_once(p, o):
+        p, o, loss = jstep(p, o, ids, tgt)
+        return (p, o), loss
+
+    run_chain = chain_runner(step_once, [params, opt_state])
+    timing = measure_marginal(run_chain, n1=3, n2=steps)
+    return _record(
+        "Transformer-LM (120M, T=1024, flash-attn) tokens/sec/chip",
+        "tokens/sec/chip", batch * cfg.max_seq, timing, flops,
+        batch=batch, seq=cfg.max_seq)
 
 
-def main():
+def bench_dpscale(batch, steps):
+    """8-way dp scaling efficiency on the virtual CPU mesh (SURVEY §6).
+
+    Runs in a subprocess with a CPU-forced env (same reason as
+    __graft_entry__.dryrun_multichip): the calling process may hold the TPU.
+    """
+    import os
+    import re
+    import subprocess
+
+    from deeplearning4j_tpu.utils.subproc import cpu_forced_env
+
+    env, preamble = cpu_forced_env(8)
+    code = (
+        preamble + "import bench; import json;"
+        f"print('DPSCALE ' + json.dumps(bench._dpscale_impl({batch}, {steps})))"
+    )
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              cwd=repo, capture_output=True, text=True,
+                              timeout=900)
+    except subprocess.TimeoutExpired as e:
+        return {"metric": "dp scaling efficiency (8-way virtual CPU mesh)",
+                "error": f"timeout after {e.timeout}s"}
+    m = re.search(r"DPSCALE (\{.*\})", proc.stdout)
+    if proc.returncode != 0 or not m:
+        return {"metric": "dp scaling efficiency (8-way virtual CPU mesh)",
+                "error": (proc.stdout + proc.stderr)[-500:]}
+    return json.loads(m.group(1))
+
+
+def _dpscale_impl(batch, steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+    from deeplearning4j_tpu.train import Adam
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=256, n_out=512, activation="relu"))
+                .layer(DenseLayer(n_out=512, activation="relu"))
+                .layer(OutputLayer(n_out=10, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init((256,))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((batch, 256), np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+
+    def throughput(fit_once):
+        fit_once()  # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            fit_once()
+        return batch * steps / (time.perf_counter() - t0)
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    ds = DataSet(x, y)
+    net1 = build()
+    t1 = throughput(lambda: net1.fit(ds))
+    net8 = build()
+    pw = ParallelWrapper(net8, mesh=make_mesh(jax.devices()[:8], dp=8))
+    t8 = throughput(lambda: pw.fit([ds]))
+    eff = t8 / (t1 * 8)
+    return {"metric": "dp scaling efficiency (8-way virtual CPU mesh)",
+            "value": round(eff, 3), "unit": "eff(8dev)/(8*eff(1dev))",
+            "single_sps": round(t1, 1), "dp8_sps": round(t8, 1),
+            "note": "virtual devices share host cores; ICI scaling is "
+                    "validated by tests/test_parallel.py equivalence instead"}
+
+
+def bench_resnet50(batch, steps):
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
-
-    argv = list(sys.argv[1:])
-    model = "resnet50"
-    if argv and argv[0] == "--model":
-        model = argv[1]
-        argv = argv[2:]
-    if model != "resnet50":
-        fn = {"lenet": bench_lenet, "charnn": bench_charnn,
-              "bert": bench_bert, "transformer": bench_transformer}[model]
-        batch = int(argv[0]) if argv else 32
-        steps = int(argv[1]) if len(argv) > 1 else 10
-        print(json.dumps(fn(batch, steps)))
-        return
-
-    batch = int(argv[0]) if argv else 128
-    steps = int(argv[1]) if len(argv) > 1 else 20
-
+    from deeplearning4j_tpu.utils.tracing import total_flops
     from deeplearning4j_tpu.zoo.resnet import ResNet50
-    net = ResNet50(num_classes=1000, compute_dtype=jnp.bfloat16).init()
 
+    net = ResNet50(num_classes=1000, compute_dtype=jnp.bfloat16).init()
     opt = optax.sgd(0.1, momentum=0.9)
     opt_state = opt.init(net.params)
 
     def train_step(params, states, opt_state, x, y):
         def loss_fn(p, s):
-            acts, pre, new_s = net._forward(p, s, {"in": x}, train=True, rng=None,
+            acts, pre, new_s = net._forward(p, s, {"in": x}, train=True,
+                                            rng=None,
                                             stop_at_output_preact=True)
             out_layer = net.conf.nodes["out"].op
             loss = out_layer.compute_loss(p["out"], pre["out"], y)
             return loss, new_s
 
-        (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, states)
+        (loss, new_states), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, states)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_states, opt_state, loss
 
-    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-
+    jstep = jax.jit(train_step, donate_argnums=(0, 1, 2))
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.random((batch, 224, 224, 3), np.float32), jnp.bfloat16)
+    x = jnp.asarray(rng.random((batch, 224, 224, 3), np.float32),
+                    jnp.bfloat16)
     y = jnp.asarray(np.eye(1000, dtype=np.float32)[
         rng.integers(0, 1000, batch)])
+    flops = total_flops(train_step, net.params, net.states, opt_state, x, y)
 
-    params, states, ostate = net.params, net.states, opt_state
-    # warmup / compile
-    params, states, ostate, loss = step(params, states, ostate, x, y)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, states, ostate, loss = step(params, states, ostate, x, y)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    sps = batch * steps / dt
-    print(json.dumps({
-        "metric": "MultiLayerNetwork.fit() samples/sec/chip (ResNet-50 ImageNet)",
-        "value": round(sps, 2),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
-    }))
+    def step_once(p, s, o):
+        p, s, o, loss = jstep(p, s, o, x, y)
+        return (p, s, o), loss
+
+    run_chain = chain_runner(step_once, [net.params, net.states, opt_state])
+    timing = measure_marginal(run_chain, n1=3, n2=steps)
+    rec = _record(
+        "MultiLayerNetwork.fit() samples/sec/chip (ResNet-50 ImageNet)",
+        "samples/sec/chip", batch, timing, flops, batch=batch)
+    rec["vs_baseline"] = round(rec["value"] / BASELINE_SAMPLES_PER_SEC, 3)
+    return rec
+
+
+CONFIGS = {
+    "resnet50": bench_resnet50,
+    "lenet": bench_lenet,
+    "charnn": bench_charnn,
+    "bert": bench_bert,
+    "transformer": bench_transformer,
+    "dpscale": bench_dpscale,
+}
+
+DEFAULTS = {  # (batch, steps)
+    "resnet50": (128, 13),
+    "lenet": (512, 25),
+    "charnn": (64, 25),
+    "bert": (32, 13),
+    "transformer": (8, 13),
+    "dpscale": (1024, 20),
+}
+
+
+def main():
+    argv = list(sys.argv[1:])
+    model = None
+    if argv and argv[0] == "--model":
+        model = argv[1]
+        argv = argv[2:]
+    if model is not None:
+        b, s = DEFAULTS[model]
+        batch = int(argv[0]) if argv else b
+        steps = int(argv[1]) if len(argv) > 1 else s
+        print(json.dumps(CONFIGS[model](batch, steps)))
+        return
+
+    batch, steps = DEFAULTS["resnet50"]
+    if argv:
+        batch = int(argv[0])
+    if len(argv) > 1:
+        steps = int(argv[1])
+
+    headline = bench_resnet50(batch, steps)
+    print(json.dumps(headline), flush=True)
+
+    # Secondary configs (SURVEY §6) -> bench_secondary.json; never stdout.
+    t_start = time.perf_counter()
+    secondary = {}
+    for name in ("lenet", "charnn", "bert", "transformer", "dpscale"):
+        if time.perf_counter() - t_start > 900:
+            secondary[name] = {"skipped": "time budget"}
+        else:
+            try:
+                b, s = DEFAULTS[name]
+                secondary[name] = CONFIGS[name](b, s)
+            except Exception as e:  # noqa: BLE001 — record, don't kill headline
+                secondary[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
+        print(f"[bench] {name}: "
+              f"{secondary[name].get('value', secondary[name])}",
+              file=sys.stderr, flush=True)
+    import pathlib
+    out = {"headline": headline, "secondary": secondary}
+    pathlib.Path(__file__).with_name("bench_secondary.json").write_text(
+        json.dumps(out, indent=2) + "\n")
 
 
 if __name__ == "__main__":

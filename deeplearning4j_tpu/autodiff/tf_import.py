@@ -89,6 +89,8 @@ class TFImporter:
             "Tile": lambda i, n: jnp.tile(i[0], _axes(i[1])),
             "StopGradient": lambda i, n: lax.stop_gradient(i[0]),
             "Rsub": lambda i, n: i[1] - i[0],
+            "Einsum": lambda i, n: jnp.einsum(
+                n.attr["equation"].s.decode(), *i),
             "FusedBatchNorm": self._fused_bn, "FusedBatchNormV3": self._fused_bn,
             "Conv2D": self._conv2d, "MaxPool": self._maxpool,
             "AvgPool": self._avgpool,

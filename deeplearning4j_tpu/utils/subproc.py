@@ -1,0 +1,44 @@
+"""CPU-forced subprocess scaffolding.
+
+The sandbox pins JAX to the real single-chip TPU tunnel (env JAX_PLATFORMS
+plus a sitecustomize `jax.config.update` at interpreter start), and a process
+that has already initialized that backend cannot be retargeted. Anything that
+needs an n-device virtual CPU platform (multichip dry-runs, dp-scaling bench)
+must therefore re-exec in a child whose env forces CPU BEFORE jax initializes.
+This module is the single copy of that recipe (used by __graft_entry__ and
+bench.py — the round-1 libtpu-mismatch lesson, encoded once).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def cpu_forced_env(n_devices: int,
+                   base_env: Optional[Dict[str, str]] = None
+                   ) -> Tuple[Dict[str, str], str]:
+    """(env, preamble) for a child python that must see `n_devices` CPU
+    devices. `preamble` is python source to exec FIRST in the child: it
+    overrides the sitecustomize's config.update and puts the repo root on
+    sys.path (`-c` children don't get the '' entry under PYTHONSAFEPATH)."""
+    env = dict(os.environ if base_env is None else base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    kept = [f for f in env.get("XLA_FLAGS", "").split() if COUNT_FLAG not in f]
+    env["XLA_FLAGS"] = " ".join(kept + [f"--{COUNT_FLAG}={n_devices}"])
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    preamble = ("import jax; jax.config.update('jax_platforms', 'cpu');\n"
+                f"import sys; sys.path.insert(0, {repo!r});\n")
+    return env, preamble
+
+
+def env_forces_cpu(n_devices: int) -> bool:
+    """True if THIS process's env already forces >= n_devices CPU devices
+    (i.e. running inline is plausible, pending a live-backend check)."""
+    import re
+    m = re.search(rf"{COUNT_FLAG}=(\d+)", os.environ.get("XLA_FLAGS", ""))
+    return (os.environ.get("JAX_PLATFORMS") == "cpu" and m is not None
+            and int(m.group(1)) >= n_devices)

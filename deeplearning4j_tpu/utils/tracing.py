@@ -83,10 +83,17 @@ class OpRecord:
         return f"{self.prim:<28}{self.count:>6}  {fl}  {t}  {self.shapes[0] if self.shapes else ''}"
 
 
-def _walk_jaxpr(jaxpr, agg: Dict[str, OpRecord], depth=0):
+def _walk_jaxpr(jaxpr, agg: Dict[str, OpRecord], depth=0, mult=1):
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         # Recurse into higher-order primitives so scan/cond/jit bodies count.
+        # A scan body executes `length` times — multiply its contribution, or
+        # every scanned model (LSTM over T, per-layer transformer scan)
+        # under-counts by the trip count. while_loop trip counts are unknown
+        # at trace time: counted once (documented best-effort floor).
+        sub_mult = mult
+        if name == "scan":
+            sub_mult = mult * int(eqn.params.get("length", 1))
         for pname in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr",
                       "branches", "fun_jaxpr"):
             sub = eqn.params.get(pname)
@@ -96,19 +103,19 @@ def _walk_jaxpr(jaxpr, agg: Dict[str, OpRecord], depth=0):
             for s in subs:
                 inner = s.jaxpr if hasattr(s, "jaxpr") else s
                 if hasattr(inner, "eqns"):
-                    _walk_jaxpr(inner, agg, depth + 1)
+                    _walk_jaxpr(inner, agg, depth + 1, sub_mult)
         rec = agg.setdefault(name, OpRecord(prim=name))
-        rec.count += 1
+        rec.count += mult
         fn = _FLOP_FNS.get(name)
         if fn is not None:
             try:
-                rec.flops += fn(eqn)
+                rec.flops += mult * fn(eqn)
             except Exception:  # noqa: BLE001 — estimation is best-effort
                 pass
         for ov in eqn.outvars:
             aval = getattr(ov, "aval", None)
             if aval is not None and hasattr(aval, "shape"):
-                rec.bytes_out += math.prod(aval.shape or (1,)) * getattr(
+                rec.bytes_out += mult * math.prod(aval.shape or (1,)) * getattr(
                     aval.dtype, "itemsize", 4)
         if len(rec.shapes) < 3 and eqn.outvars:
             aval = getattr(eqn.outvars[0], "aval", None)

@@ -321,6 +321,12 @@ def bert_init(key, cfg: BertConfig):
         },
         "pooler": norm(k[7], (d, d), d),
         "cls": jnp.zeros((d, cfg.num_labels), cfg.param_dtype),
+        # MLM head: transform dense + norm scale + decoder bias; the decoder
+        # weight is TIED to the token embedding (upstream BERT convention —
+        # reference: BertIterator MLM pretraining task, SURVEY §2.7).
+        "mlm_dense": norm(jax.random.fold_in(k[7], 1), (d, d), d),
+        "mlm_ln": jnp.ones((d,), cfg.param_dtype),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), cfg.param_dtype),
     }
 
 
@@ -366,3 +372,63 @@ def bert_classifier_loss(params, cfg: BertConfig, ids, labels, type_ids=None,
     logits, _ = bert_forward(params, cfg, ids, type_ids, attn_mask)
     logp = jax.nn.log_softmax(logits, -1)
     return -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), -1).mean()
+
+
+# ---------------------------------------------------------------- BERT MLM
+def bert_mlm_logits(params, cfg: BertConfig, hidden):
+    """MLM decoder over final hidden states: dense+gelu+norm, then project
+    onto the TIED token embedding + bias. (B, T, vocab) float32 logits."""
+    h = jax.nn.gelu(hidden @ params["mlm_dense"].astype(hidden.dtype))
+    h = _rmsnorm(h, params["mlm_ln"])
+    logits = jnp.einsum("btd,vd->btv", h, params["embed"].astype(h.dtype))
+    return (logits + params["mlm_bias"].astype(logits.dtype)).astype(jnp.float32)
+
+
+def bert_mask_tokens(key, ids, cfg: BertConfig, mask_token_id,
+                     mask_prob: float = 0.15, special_mask=None):
+    """Standard BERT masking (80% [MASK] / 10% random / 10% keep).
+
+    Returns (masked_ids, labels, weights): `labels` are the original ids,
+    `weights` 1.0 at selected positions. jit-friendly (static shapes, no
+    data-dependent control flow). `special_mask` (B, T) bool marks positions
+    never selected (CLS/SEP/PAD).
+    """
+    k_sel, k_op, k_rand = jax.random.split(key, 3)
+    sel = jax.random.uniform(k_sel, ids.shape) < mask_prob
+    if special_mask is not None:
+        sel = jnp.logical_and(sel, jnp.logical_not(special_mask))
+    op = jax.random.uniform(k_op, ids.shape)
+    rand_ids = jax.random.randint(k_rand, ids.shape, 0, cfg.vocab_size)
+    masked = jnp.where(op < 0.8, mask_token_id,
+                       jnp.where(op < 0.9, rand_ids, ids))
+    masked_ids = jnp.where(sel, masked, ids)
+    return masked_ids, ids, sel.astype(jnp.float32)
+
+
+def bert_mlm_loss(params, cfg: BertConfig, masked_ids, labels, weights,
+                  type_ids=None, attn_mask=None):
+    """Weighted cross-entropy over masked positions only."""
+    _, hidden = bert_forward(params, cfg, masked_ids, type_ids, attn_mask)
+    logp = jax.nn.log_softmax(bert_mlm_logits(params, cfg, hidden), -1)
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                               -1)[..., 0]
+    denom = jnp.maximum(weights.sum(), 1.0)
+    return (nll * weights).sum() / denom
+
+
+def make_bert_mlm_train_step(cfg: BertConfig, optimizer, mask_token_id,
+                             mask_prob: float = 0.15):
+    """Jittable MLM pretrain step: (params, opt_state, rng, ids) ->
+    (params, opt_state, rng, loss). Masking happens on-device inside jit."""
+    import optax
+
+    def step(params, opt_state, rng, ids, type_ids=None, attn_mask=None):
+        rng, sub = jax.random.split(rng)
+        masked_ids, labels, weights = bert_mask_tokens(
+            sub, ids, cfg, mask_token_id, mask_prob)
+        loss, grads = jax.value_and_grad(bert_mlm_loss)(
+            params, cfg, masked_ids, labels, weights, type_ids, attn_mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, rng, loss
+
+    return step

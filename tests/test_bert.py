@@ -1,0 +1,194 @@
+"""BERT path end-to-end (SURVEY §2.7 "fine-tune + MLM"; VERDICT r1 item 3).
+
+Mirrors the reference's marquee SameDiff use: MLM pretraining objective
+(upstream `BertIterator` masking task), classifier fine-tune, and a frozen
+TF GraphDef round-trip through the importer (upstream `TFGraphMapper`).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeplearning4j_tpu.zoo import transformer as tfm
+
+TINY = tfm.BertConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=16, num_labels=2,
+                      dtype=jnp.float32, param_dtype=jnp.float32)
+MASK_ID = 63
+
+
+def _ids(key, batch=16, seq=16, vocab=60):
+    return jax.random.randint(key, (batch, seq), 0, vocab)
+
+
+def test_bert_mask_tokens_statistics():
+    cfg = TINY
+    key = jax.random.PRNGKey(0)
+    ids = _ids(key, batch=64, seq=16)
+    masked, labels, weights = tfm.bert_mask_tokens(
+        jax.random.PRNGKey(1), ids, cfg, MASK_ID, mask_prob=0.15)
+    assert (labels == ids).all()          # labels are the originals
+    frac = float(weights.mean())
+    assert 0.10 < frac < 0.20             # ~15% selected
+    sel = weights > 0
+    # unselected positions are untouched
+    assert (jnp.where(sel, 0, masked) == jnp.where(sel, 0, ids)).all()
+    # of selected: ~80% became [MASK]
+    frac_mask = float((masked[sel] == MASK_ID).mean())
+    assert 0.6 < frac_mask < 0.95
+
+
+def test_bert_mask_tokens_respects_special_mask():
+    cfg = TINY
+    ids = _ids(jax.random.PRNGKey(2), batch=8, seq=16)
+    special = jnp.zeros(ids.shape, bool).at[:, 0].set(True)  # CLS column
+    _, _, weights = tfm.bert_mask_tokens(
+        jax.random.PRNGKey(3), ids, cfg, MASK_ID, mask_prob=0.5,
+        special_mask=special)
+    assert float(weights[:, 0].sum()) == 0.0
+
+
+def test_bert_mlm_pretrain_loss_drops():
+    cfg = TINY
+    params = tfm.bert_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(tfm.make_bert_mlm_train_step(cfg, opt, MASK_ID))
+    # a learnable corpus: token t is always followed by (t+1) % 60
+    start = jnp.arange(16) % 60
+    ids = (start[:, None] + jnp.arange(16)[None, :]) % 60
+    rng = jax.random.PRNGKey(7)
+    losses = []
+    for _ in range(100):
+        params, opt_state, rng, loss = step(params, opt_state, rng, ids)
+        losses.append(float(loss))
+    first, last = np.mean(losses[:5]), np.mean(losses[-5:])
+    assert last < 0.65 * first, (first, last)
+
+
+def test_bert_finetune_loss_drops_and_learns():
+    cfg = TINY
+    params = tfm.bert_init(jax.random.PRNGKey(1), cfg)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    def step(params, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(tfm.bert_classifier_loss)(
+            params, cfg, ids, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    jstep = jax.jit(step)
+    ids = _ids(jax.random.PRNGKey(4), batch=32, seq=16)
+    labels = (ids[:, 0] >= 30).astype(jnp.int32)  # separable from token 0
+    losses = []
+    for _ in range(50):
+        params, opt_state, loss = jstep(params, opt_state, ids, labels)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+    logits, _ = tfm.bert_forward(params, cfg, ids)
+    acc = float((jnp.argmax(logits, -1) == labels).mean())
+    assert acc >= 0.9, acc
+
+
+def test_bert_mlm_logits_shape_and_tying():
+    cfg = TINY
+    params = tfm.bert_init(jax.random.PRNGKey(2), cfg)
+    ids = _ids(jax.random.PRNGKey(5), batch=4, seq=16)
+    _, hidden = tfm.bert_forward(params, cfg, ids)
+    logits = tfm.bert_mlm_logits(params, cfg, hidden)
+    assert logits.shape == (4, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    # decoder is tied: perturbing the embedding row changes that vocab column
+    p2 = dict(params)
+    p2["embed"] = params["embed"].at[17].add(1.0)
+    logits2 = tfm.bert_mlm_logits(p2, cfg, hidden)
+    diff = jnp.abs(logits2 - logits)
+    assert float(diff[..., 17].max()) > 0
+    assert float(jnp.delete(diff, 17, axis=-1).max()) == 0.0
+
+
+def test_tf_import_mini_bert_roundtrip():
+    """Freeze a 1-block BERT-style encoder (embedding gather, LN via
+    rsqrt/mean, MHA with BatchMatMul+Softmax, gelu-via-Erf FFN) to a
+    GraphDef and round-trip it through the importer with output parity."""
+    tf = pytest.importorskip("tensorflow")
+    tf1 = tf.compat.v1
+    rng = np.random.default_rng(0)
+    V, T, D, H = 50, 12, 16, 2
+    hd = D // H
+
+    def ln(x, name):
+        mean = tf.reduce_mean(x, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.square(x - mean), axis=-1, keepdims=True)
+        return (x - mean) * tf.math.rsqrt(var + 1e-6)
+
+    def gelu(x):
+        return x * 0.5 * (1.0 + tf.math.erf(x / np.sqrt(2.0).astype(np.float32)))
+
+    g = tf1.Graph()
+    with g.as_default():
+        ids = tf1.placeholder(tf.int32, (None, T), name="ids")
+        embed = tf1.constant(rng.standard_normal((V, D)).astype(np.float32))
+        pos = tf1.constant(rng.standard_normal((T, D)).astype(np.float32))
+        x = tf.gather(embed, ids) + pos
+        wqkv = tf1.constant(rng.standard_normal((D, 3 * D)).astype(np.float32) * 0.2)
+        wo = tf1.constant(rng.standard_normal((D, D)).astype(np.float32) * 0.2)
+        h = ln(x, "ln1")
+        qkv = tf.einsum("btd,dz->btz", h, wqkv)
+        q, k, v = tf.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return tf.transpose(tf.reshape(t, (-1, T, H, hd)), (0, 2, 1, 3))
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = tf.matmul(q, k, transpose_b=True) / np.sqrt(hd).astype(np.float32)
+        attn = tf.nn.softmax(scores)
+        ctx = tf.matmul(attn, v)
+        ctx = tf.reshape(tf.transpose(ctx, (0, 2, 1, 3)), (-1, T, D))
+        x = x + tf.einsum("btd,dz->btz", ctx, wo)
+        w_in = tf1.constant(rng.standard_normal((D, 4 * D)).astype(np.float32) * 0.2)
+        w_out = tf1.constant(rng.standard_normal((4 * D, D)).astype(np.float32) * 0.2)
+        h2 = ln(x, "ln2")
+        x = tf.add(x, tf.einsum("btf,fd->btd", gelu(
+            tf.einsum("btd,df->btf", h2, w_in)), w_out), name="encoded")
+
+    from deeplearning4j_tpu.autodiff.tf_import import import_frozen_graph
+    sd, _ = import_frozen_graph(g.as_graph_def())
+    feed = rng.integers(0, V, (3, T)).astype(np.int32)
+    got = np.asarray(sd.eval(sd.get_variable("encoded"), {"ids": feed}))
+    with tf1.Session(graph=g) as sess:
+        want = sess.run("encoded:0", {"ids:0": feed})
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_bench_bert_and_transformer_paths_compile():
+    """The bench configs must not be bench-only code paths (VERDICT weak 7):
+    compile + run one step of each on tiny shapes."""
+    cfg = TINY
+    params = tfm.bert_init(jax.random.PRNGKey(3), cfg)
+    opt = optax.adamw(1e-4)
+    ostate = opt.init(params)
+
+    def bstep(params, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(tfm.bert_classifier_loss)(
+            params, cfg, ids, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    ids = _ids(jax.random.PRNGKey(6), batch=4, seq=16)
+    labels = jnp.zeros((4,), jnp.int32)
+    _, _, loss = jax.jit(bstep)(params, ostate, ids, labels)
+    assert jnp.isfinite(loss)
+
+    tcfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_seq=16,
+                                 dtype=jnp.float32, remat=False)
+    tparams = tfm.init_params(jax.random.PRNGKey(4), tcfg)
+    tostate = opt.init(tparams)
+    tstep = jax.jit(tfm.make_train_step(tcfg, opt))
+    tgt = _ids(jax.random.PRNGKey(8), batch=4, seq=16)
+    _, _, tloss = tstep(tparams, tostate, ids, tgt)
+    assert jnp.isfinite(tloss)
