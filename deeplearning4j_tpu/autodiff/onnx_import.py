@@ -484,6 +484,226 @@ HANDLERS: Dict[str, Any] = {
     "Range": lambda i, n: jnp.arange(_static(i[0]).item(),
                                      _static(i[1]).item(),
                                      _static(i[2]).item()),
+    # --- opset-13 long tail onto the broadened sd_ops registry
+    "Einsum": lambda i, n: jnp.einsum(n.astr("equation"), *i),
+    "CumSum": lambda i, n: _onnx_cumsum(i[0], int(_static(i[1]).item()),
+                                        n.ai("exclusive", 0),
+                                        n.ai("reverse", 0)),
+    "Mod": lambda i, n: (jnp.fmod(i[0], i[1]) if n.ai("fmod", 0)
+                         else jnp.mod(i[0], i[1])),
+    "Trilu": lambda i, n: (jnp.triu if n.ai("upper", 1) else jnp.tril)(
+        i[0], int(_static(i[1]).item()) if len(i) > 1 and i[1] is not None else 0),
+    "HardSwish": lambda i, n: jax.nn.hard_swish(i[0]),
+    "Mish": lambda i, n: jax.nn.mish(i[0]),
+    "Xor": lambda i, n: i[0] ^ i[1],
+    "BitShift": lambda i, n: (jnp.left_shift(i[0], i[1])
+                              if n.astr("direction") == "LEFT"
+                              else jnp.right_shift(i[0], i[1])),
+    "GatherND": lambda i, n: _onnx_gather_nd(i[0], i[1]),
+    "ScatterND": lambda i, n: _onnx_scatter_nd(i[0], i[1], i[2]),
+    "ScatterElements": lambda i, n: _onnx_scatter_elements(
+        i[0], i[1], i[2], n.ai("axis", 0)),
+    "OneHot": lambda i, n: _onnx_one_hot(i, n),
+    "DepthToSpace": lambda i, n: _depth_to_space_nchw(i[0], n.ai("blocksize", 2),
+                                                      n.astr("mode", "DCR")),
+    "SpaceToDepth": lambda i, n: _space_to_depth_nchw(i[0], n.ai("blocksize", 2)),
+    "ReduceL1": _reduce(lambda x, axis, keepdims: jnp.sum(
+        jnp.abs(x), axis=axis, keepdims=keepdims)),
+    "ReduceSumSquare": _reduce(lambda x, axis, keepdims: jnp.sum(
+        jnp.square(x), axis=axis, keepdims=keepdims)),
+    "ReduceLogSumExp": _reduce(lambda x, axis, keepdims:
+                               jax.scipy.special.logsumexp(
+                                   x, axis=axis, keepdims=keepdims)),
+    "IsNaN": lambda i, n: jnp.isnan(i[0]),
+    "IsInf": lambda i, n: jnp.isinf(i[0]),
+}
+
+
+def _onnx_cumsum(x, axis, exclusive, reverse):
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x           # shift: exclusive prefix sum
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+def _onnx_gather_nd(params, indices):
+    from .sd_ops import _gather_nd
+    return _gather_nd(params, indices)
+
+
+def _onnx_scatter_nd(data, indices, updates):
+    idx = indices.astype(jnp.int32)
+    return data.at[tuple(idx[..., k] for k in range(idx.shape[-1]))].set(updates)
+
+
+def _onnx_scatter_elements(data, indices, updates, axis):
+    return jnp.put_along_axis(data, indices.astype(jnp.int32), updates,
+                              axis=axis, inplace=False)
+
+
+def _onnx_one_hot(i, n):
+    indices, depth, values = i[0], int(_static(i[1]).item()), i[2]
+    axis = n.ai("axis", -1)
+    off, on = values[0], values[1]
+    idx = indices.astype(jnp.int32)
+    idx = jnp.where(idx < 0, idx + depth, idx)  # ONNX: negatives wrap
+    oh = jax.nn.one_hot(idx, depth, axis=axis)
+    return oh * (on - off) + off
+
+
+def _space_to_depth_nchw(x, bs):
+    b, c, h, w = x.shape
+    x = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    return x.transpose(0, 3, 5, 1, 2, 4).reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+def _depth_to_space_nchw(x, bs, mode="DCR"):
+    b, c, h, w = x.shape
+    if mode == "DCR":
+        x = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+        x = x.transpose(0, 3, 4, 1, 5, 2)
+    else:  # CRD
+        x = x.reshape(b, c // (bs * bs), bs, bs, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+# ----------------------------------------------------------- RNN ops (multi-
+# output). ONNX gate orders: LSTM iofc, GRU zrh; weights are [num_dir,
+# gates*hidden, in]. Implemented as lax.scan over time (TPU-friendly static
+# shapes); bidirectional runs a reversed second scan.
+def _rnn_unsupported(n, kind, peephole=None):
+    """Loud-failure invariant: reject inputs/attrs we'd silently miscompute."""
+    acts = n.attrs.get("activations")
+    defaults = {"LSTM": ["Sigmoid", "Tanh", "Tanh"],
+                "GRU": ["Sigmoid", "Tanh"]}[kind]
+    if acts and acts.strings not in ([], defaults, defaults * 2):
+        raise NotImplementedError(
+            f"ONNX {kind}: non-default activations {acts.strings}")
+    if n.af("clip", 0.0):
+        raise NotImplementedError(f"ONNX {kind}: cell clip not supported")
+    if peephole is not None:
+        raise NotImplementedError("ONNX LSTM: peephole weights (P) not supported")
+
+
+def _onnx_lstm(i, n):
+    X, W, R = i[0], i[1], i[2]
+    B = i[3] if len(i) > 3 and i[3] is not None else None
+    if len(i) > 4 and i[4] is not None:
+        raise NotImplementedError(
+            "ONNX LSTM: per-example sequence_lens not supported (pad-free "
+            "batches only) — would silently miscompute padded examples")
+    h0 = i[5] if len(i) > 5 and i[5] is not None else None
+    c0 = i[6] if len(i) > 6 and i[6] is not None else None
+    _rnn_unsupported(n, "LSTM",
+                     peephole=i[7] if len(i) > 7 and i[7] is not None else None)
+    hidden = R.shape[-1]
+    direction = n.astr("direction", "forward")
+    num_dir = W.shape[0]
+
+    def run(d, reverse):
+        w, r = W[d].T, R[d].T                       # [in,4h], [h,4h]
+        b = (B[d][:4 * hidden] + B[d][4 * hidden:]) if B is not None else 0.0
+        hi = h0[d] if h0 is not None else jnp.zeros((X.shape[1], hidden), X.dtype)
+        ci = c0[d] if c0 is not None else jnp.zeros((X.shape[1], hidden), X.dtype)
+
+        def cell(carry, xt):
+            h, c = carry
+            z = xt @ w + h @ r + b
+            zi, zo, zf, zg = jnp.split(z, 4, axis=-1)   # iofc
+            c2 = jax.nn.sigmoid(zf) * c + jax.nn.sigmoid(zi) * jnp.tanh(zg)
+            h2 = jax.nn.sigmoid(zo) * jnp.tanh(c2)
+            return (h2, c2), h2
+
+        xs = X[::-1] if reverse else X
+        (hT, cT), ys = lax.scan(cell, (hi, ci), xs)
+        if reverse:
+            ys = ys[::-1]
+        return ys, hT, cT
+
+    dirs = [run(0, direction == "reverse")]
+    if num_dir == 2:
+        dirs.append(run(1, True))
+    Y = jnp.stack([d[0] for d in dirs], axis=1)     # [seq, num_dir, B, h]
+    Y_h = jnp.stack([d[1] for d in dirs], axis=0)
+    Y_c = jnp.stack([d[2] for d in dirs], axis=0)
+    return Y, Y_h, Y_c
+
+
+def _onnx_gru(i, n):
+    X, W, R = i[0], i[1], i[2]
+    B = i[3] if len(i) > 3 and i[3] is not None else None
+    if len(i) > 4 and i[4] is not None:
+        raise NotImplementedError(
+            "ONNX GRU: per-example sequence_lens not supported")
+    h0 = i[5] if len(i) > 5 and i[5] is not None else None
+    _rnn_unsupported(n, "GRU")
+    hidden = R.shape[-1]
+    direction = n.astr("direction", "forward")
+    num_dir = W.shape[0]
+    lbr = n.ai("linear_before_reset", 0)
+
+    def run(d, reverse):
+        w, r = W[d].T, R[d].T                       # [in,3h], [h,3h]
+        wb = B[d][:3 * hidden] if B is not None else jnp.zeros(3 * hidden, X.dtype)
+        rb = B[d][3 * hidden:] if B is not None else jnp.zeros(3 * hidden, X.dtype)
+        hi = h0[d] if h0 is not None else jnp.zeros((X.shape[1], hidden), X.dtype)
+
+        def cell(h, xt):
+            xz = xt @ w + wb
+            hz = h @ r
+            z = jax.nn.sigmoid(xz[..., :hidden] + hz[..., :hidden]
+                               + rb[:hidden])
+            rr = jax.nn.sigmoid(xz[..., hidden:2 * hidden]
+                                + hz[..., hidden:2 * hidden]
+                                + rb[hidden:2 * hidden])
+            if lbr:
+                nh = jnp.tanh(xz[..., 2 * hidden:]
+                              + rr * (hz[..., 2 * hidden:]
+                                      + rb[2 * hidden:]))
+            else:
+                nh = jnp.tanh(xz[..., 2 * hidden:]
+                              + (rr * h) @ r[:, 2 * hidden:]
+                              + rb[2 * hidden:])
+            h2 = (1 - z) * nh + z * h
+            return h2, h2
+
+        xs = X[::-1] if reverse else X
+        hT, ys = lax.scan(cell, hi, xs)
+        if reverse:
+            ys = ys[::-1]
+        return ys, hT
+
+    dirs = [run(0, direction == "reverse")]
+    if num_dir == 2:
+        dirs.append(run(1, True))
+    Y = jnp.stack([d[0] for d in dirs], axis=1)
+    Y_h = jnp.stack([d[1] for d in dirs], axis=0)
+    return Y, Y_h
+
+
+def _onnx_topk(i, n):
+    k = int(_static(i[1]).item())
+    axis = n.ai("axis", -1)
+    largest = n.ai("largest", 1)
+    x = i[0] if largest else -i[0]
+    x_last = jnp.moveaxis(x, axis, -1)
+    vals, idxs = lax.top_k(x_last, k)
+    if not largest:
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idxs, -1, axis).astype(jnp.int64))
+
+
+# op -> (handler returning tuple, n_outputs_fixed)
+MULTI_OUTPUT = {
+    "LSTM": _onnx_lstm,
+    "GRU": _onnx_gru,
+    "TopK": _onnx_topk,
 }
 
 
@@ -588,6 +808,58 @@ class OnnxImporter:
                 for j, out_name in enumerate(node.outputs):
                     produced[out_name] = sd._op(_safe(out_name) + "_op", mk(j), [x])
                     produced[out_name].rename(_safe(out_name))
+                continue
+            # ---- build-time constant folding. torch exports RNNs (and
+            # dynamic-ish reshapes) behind Shape->Gather->Concat->
+            # ConstantOfShape chains; folding them keeps every downstream
+            # shape static, which XLA requires anyway.
+            if op == "Shape" and node.inputs[0] in produced:
+                src = produced[node.inputs[0]]
+                shp = const_np[node.inputs[0]].shape \
+                    if node.inputs[0] in const_np else src.shape
+                if shp is not None and all(
+                        isinstance(d, int) and d >= 0 for d in shp):
+                    arr = np.asarray(shp, np.int64)
+                    produced[node.outputs[0]] = sd.constant(
+                        _safe(node.outputs[0]) + "_shape", jnp.asarray(arr))
+                    produced[node.outputs[0]].rename(_safe(node.outputs[0]))
+                    const_np[node.outputs[0]] = arr
+                    continue
+            if (op in HANDLERS and HANDLERS[op] is not None
+                    and node.inputs and len(node.outputs) == 1
+                    and all((not x) or x in const_np for x in node.inputs)):
+                vals = [jnp.asarray(const_np[x]) if x else None
+                        for x in node.inputs]
+                try:
+                    folded = np.asarray(HANDLERS[op](vals, node))
+                except Exception:
+                    folded = None
+                if folded is not None:
+                    produced[node.outputs[0]] = sd.constant(
+                        _safe(node.outputs[0]) + "_folded", jnp.asarray(folded))
+                    produced[node.outputs[0]].rename(_safe(node.outputs[0]))
+                    const_np[node.outputs[0]] = folded
+                    continue
+            if op in MULTI_OUTPUT:
+                mh = MULTI_OUTPUT[op]
+                present = [bool(x) for x in node.inputs]
+                ins = [produced[x] for x in node.inputs if x]
+
+                def make_tup(h=mh, nd=node, mask=tuple(present)):
+                    def fn(*vals):
+                        it = iter(vals)
+                        full = [next(it) if m else None for m in mask]
+                        return h(full, nd)
+                    return fn
+
+                tup = sd._op(_safe(node.outputs[0]) + "_tuple", make_tup(), ins)
+                for j, out_name in enumerate(node.outputs):
+                    if not out_name:          # optional output, unconsumed
+                        continue
+                    view = sd._op(_safe(out_name) + "_op",
+                                  (lambda jj: lambda t: t[jj])(j), [tup])
+                    view.rename(_safe(out_name))
+                    produced[out_name] = view
                 continue
             handler = HANDLERS.get(op)
             if handler is None:

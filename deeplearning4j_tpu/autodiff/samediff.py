@@ -256,9 +256,21 @@ class SameDiff:
         self._vars: Dict[str, SDVariable] = {}
         self._values: Dict[str, jnp.ndarray] = {}   # variables + constants
         self._counter = 0
-        self.math = _Namespace(self, _MATH)
-        self.nn = _Namespace(self, _NN)
-        self.loss = _Namespace(self, _LOSS)
+        from . import sd_ops
+        self.math = _Namespace(self, {**_MATH, **sd_ops.MATH_EXT})
+        self.nn = _Namespace(self, {**_NN, **sd_ops.NN_EXT})
+        self.loss = _Namespace(self, {**_LOSS, **sd_ops.LOSS_EXT})
+        # upstream parity: SDBaseOps methods live on SameDiff itself; here
+        # they're both a namespace (sd.base.*) and direct attrs (sd.<op>)
+        # via __getattr__ below. SDLinalg/SDBitwise/SDRandom/SDCNN/SDRNN/
+        # SDImage mirror nd4j's namespace objects.
+        self.base = _Namespace(self, sd_ops.BASE)
+        self.linalg = _Namespace(self, sd_ops.LINALG)
+        self.bitwise = _Namespace(self, sd_ops.BITWISE)
+        self.random = _Namespace(self, sd_ops.RANDOM)
+        self.cnn = _Namespace(self, sd_ops.CNN)
+        self.rnn = _Namespace(self, sd_ops.RNN)
+        self.image = _Namespace(self, sd_ops.IMAGE)
         self._training_config: Optional[TrainingConfig] = None
         self._loss_vars: List[str] = []
         self._opt_state = None
@@ -268,6 +280,16 @@ class SameDiff:
     @staticmethod
     def create() -> "SameDiff":
         return SameDiff()
+
+    def __getattr__(self, name):
+        # SDBaseOps parity: base ops are callable directly on sd (sd.concat,
+        # sd.scatter_add, ...) exactly like the upstream SameDiff class.
+        if not name.startswith("_"):
+            base = self.__dict__.get("base")
+            if base is not None and name in base._table:
+                return getattr(base, name)
+        raise AttributeError(
+            f"'SameDiff' object has no attribute {name!r}")
 
     # ------------------------------------------------------------ node mgmt
     def _fresh(self, base):

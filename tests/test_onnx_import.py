@@ -182,3 +182,70 @@ def test_unknown_op_is_loud():
     model = _pb_str(7, graph)
     with pytest.raises(NotImplementedError, match="FancyCustomOp"):
         import_onnx(model)
+
+
+def test_lstm_roundtrip():
+    """ONNX LSTM op (iofc gates) vs torch.nn.LSTM — the reference's
+    samediff-import RNN path (VERDICT r1 item 4)."""
+    model = torch.nn.LSTM(input_size=5, hidden_size=7, batch_first=False)
+    x = torch.randn(9, 2, 5)  # [seq, batch, in]
+    data = _export(model, (x,), input_names=["x"],
+                   output_names=["y", "hn", "cn"])
+    sd, outs = import_onnx(data)
+    got = np.asarray(outs[0].eval({"x": x.numpy()}))
+    want, (hn, cn) = model(x)
+    want = want.detach().numpy()
+    np.testing.assert_allclose(got.reshape(want.shape), want, atol=1e-5)
+
+
+def test_lstm_bidirectional_roundtrip():
+    model = torch.nn.LSTM(input_size=4, hidden_size=6, bidirectional=True)
+    x = torch.randn(7, 3, 4)
+    data = _export(model, (x,), input_names=["x"],
+                   output_names=["y", "hn", "cn"])
+    sd, outs = import_onnx(data)
+    got = np.asarray(outs[0].eval({"x": x.numpy()}))
+    # torch's exporter appends Transpose+Reshape, so the graph output is
+    # already in torch layout [seq, batch, 2*hidden]
+    want = model(x)[0].detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_gru_roundtrip():
+    model = torch.nn.GRU(input_size=5, hidden_size=7)
+    x = torch.randn(9, 2, 5)
+    data = _export(model, (x,), input_names=["x"], output_names=["y", "hn"])
+    sd, outs = import_onnx(data)
+    got = np.asarray(outs[0].eval({"x": x.numpy()}))
+    want = model(x)[0].detach().numpy()
+    np.testing.assert_allclose(got.reshape(want.shape), want, atol=1e-5)
+
+
+def test_topk_einsum_cumsum_roundtrip():
+    class M(torch.nn.Module):
+        def forward(self, x):
+            vals, idx = torch.topk(x, k=3, dim=-1)
+            e = torch.einsum("bi,bj->bij", vals, vals)
+            return torch.cumsum(e, dim=-1), idx
+
+    x = torch.randn(4, 10)
+    data = _export(M(), (x,), input_names=["x"], output_names=["c", "idx"])
+    sd, outs = import_onnx(data)
+    want_c, want_idx = M()(x)
+    got_c = np.asarray(outs[0].eval({"x": x.numpy()}))
+    got_idx = np.asarray(outs[1].eval({"x": x.numpy()}))
+    np.testing.assert_allclose(got_c, want_c.numpy(), atol=1e-5)
+    np.testing.assert_array_equal(got_idx, want_idx.numpy())
+
+
+def test_scatter_gather_nd_handlers():
+    from deeplearning4j_tpu.autodiff.onnx_import import (_onnx_gather_nd,
+                                                         _onnx_scatter_nd)
+    import jax.numpy as jnp
+    data = jnp.arange(12.0).reshape(3, 4)
+    idx = jnp.asarray([[0, 1], [2, 3]])
+    np.testing.assert_allclose(np.asarray(_onnx_gather_nd(data, idx)),
+                               [1.0, 11.0])
+    out = _onnx_scatter_nd(data, jnp.asarray([[1]]),
+                           jnp.asarray([[9.0, 9, 9, 9]]))
+    np.testing.assert_allclose(np.asarray(out)[1], [9, 9, 9, 9])

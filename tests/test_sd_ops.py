@@ -1,0 +1,371 @@
+"""SameDiff broad op registry vs numpy oracles (VERDICT r1 item 4).
+
+Reference parity: upstream nd4j op-semantics tests over SDBaseOps/SDMath/
+SDLinalg/SDBitwise/SDRandom/SDCNN/SDRNN/SDImage. Each case drives the op
+through the REAL SameDiff namespace dispatch (sd.<ns>.<op> builds a graph
+node; .eval() executes it), compared against a numpy oracle.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import sd_ops
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+R = np.random.default_rng(0)
+A = R.standard_normal((4, 5)).astype(np.float32)
+B = R.standard_normal((4, 5)).astype(np.float32)
+M = R.standard_normal((5, 3)).astype(np.float32)
+SQ = (R.standard_normal((4, 4)) + 4 * np.eye(4)).astype(np.float32)
+V = R.standard_normal(7).astype(np.float32)
+IDS = np.array([0, 2, 1, 2], np.int32)
+IMG = R.random((2, 8, 8, 3)).astype(np.float32)
+INTS = np.arange(12, dtype=np.int32).reshape(3, 4)
+
+# (namespace, op, args, kwargs, oracle(np))
+CASES = [
+    # ---- base: shape
+    ("base", "reshape", (A, (5, 4)), {}, lambda: A.reshape(5, 4)),
+    ("base", "permute", (A, 1, 0), {}, lambda: A.T),
+    ("base", "expand_dims", (A, 1), {}, lambda: A[:, None, :]),
+    ("base", "squeeze", (A[:, None, :], 1), {}, lambda: A),
+    ("base", "concat", (A, B), {"axis": 1}, lambda: np.concatenate([A, B], 1)),
+    ("base", "stack", (A, B), {"axis": 0}, lambda: np.stack([A, B])),
+    ("base", "tile", (A, (2, 1)), {}, lambda: np.tile(A, (2, 1))),
+    ("base", "repeat", (A, 2), {"axis": 0}, lambda: np.repeat(A, 2, 0)),
+    ("base", "pad", (A, ((1, 1), (0, 2))), {},
+     lambda: np.pad(A, ((1, 1), (0, 2)))),
+    ("base", "reverse", (A, 0), {}, lambda: A[::-1]),
+    ("base", "roll", (V, 2), {}, lambda: np.roll(V, 2)),
+    ("base", "broadcast_to", (V, (3, 7)), {},
+     lambda: np.broadcast_to(V, (3, 7))),
+    ("base", "swapaxes", (A, 0, 1), {}, lambda: A.T),
+    ("base", "ravel", (A,), {}, lambda: A.ravel()),
+    # ---- base: creation / dtype
+    ("base", "zeros_like", (A,), {}, lambda: np.zeros_like(A)),
+    ("base", "full_like", (A, 3.0), {}, lambda: np.full_like(A, 3.0)),
+    ("base", "eye", (4,), {}, lambda: np.eye(4, dtype=np.float32)),
+    ("base", "fill", ((2, 3), 7.0), {}, lambda: np.full((2, 3), 7.0)),
+    ("base", "linspace", (0.0, 1.0, 5), {},
+     lambda: np.linspace(0, 1, 5, dtype=np.float32)),
+    ("base", "range", (5,), {}, lambda: np.arange(5)),
+    ("base", "cast", (A, jnp.int32), {}, lambda: A.astype(np.int32)),
+    ("base", "one_hot", (IDS, 3), {}, lambda: np.eye(3, dtype=np.float32)[IDS]),
+    # ---- base: gather/scatter
+    ("base", "gather", (A, [2, 0]), {}, lambda: A[[2, 0]]),
+    ("base", "gather_nd", (A, [[0, 1], [3, 4]]), {},
+     lambda: np.array([A[0, 1], A[3, 4]])),
+    ("base", "scatter_add", (V, [1, 1, 3], [1.0, 2.0, 3.0]), {},
+     lambda: np.add.at(_v := V.copy(), [1, 1, 3], [1.0, 2.0, 3.0]) or _v),
+    ("base", "scatter_update", (V, [0, 2], [9.0, 8.0]), {},
+     lambda: (_v := V.copy(), _v.__setitem__([0, 2], [9.0, 8.0]))[0]),
+    ("base", "scatter_max", (V, [0, 1], [100.0, -100.0]), {},
+     lambda: np.maximum.at(_v := V.copy(), [0, 1], [100.0, -100.0]) or _v),
+    ("base", "scatter_nd", ([[1], [3]], [[1, 1, 1, 1, 1]] * 2, (5, 5)), {},
+     lambda: (_o := np.zeros((5, 5)), _o.__setitem__(1, 1),
+              _o.__setitem__(3, 1))[0]),
+    ("base", "slice", (A, (1, 2), (2, 3)), {}, lambda: A[1:3, 2:5]),
+    ("base", "strided_slice", (A, (0, 1), (4, 5), (2, 2)), {},
+     lambda: A[0:4:2, 1:5:2]),
+    ("base", "where", (A > 0, A, B), {}, lambda: np.where(A > 0, A, B)),
+    ("base", "take_along_axis", (A, np.argsort(A, 1), 1), {},
+     lambda: np.sort(A, 1)),
+    ("base", "searchsorted", (np.sort(V), 0.0), {},
+     lambda: np.searchsorted(np.sort(V), np.float32(0.0))),
+    ("base", "diag", (V,), {}, lambda: np.diag(V)),
+    ("base", "diag_part", (SQ,), {}, lambda: np.diagonal(SQ)),
+    ("base", "trace", (SQ,), {}, lambda: np.trace(SQ)),
+    ("base", "tril", (SQ,), {}, lambda: np.tril(SQ)),
+    ("base", "triu", (SQ, 1), {}, lambda: np.triu(SQ, 1)),
+    # ---- base: reductions
+    ("base", "sum", (A, 0), {}, lambda: A.sum(0)),
+    ("base", "mean", (A,), {}, lambda: A.mean()),
+    ("base", "prod", (A, 1), {}, lambda: A.prod(1)),
+    ("base", "std", (A, 0), {}, lambda: A.std(0)),
+    ("base", "variance", (A, 0), {"ddof": 1}, lambda: A.var(0, ddof=1)),
+    ("base", "norm1", (A, 1), {}, lambda: np.abs(A).sum(1)),
+    ("base", "norm2", (A, 1), {}, lambda: np.sqrt((A * A).sum(1))),
+    ("base", "norm_max", (A,), {}, lambda: np.abs(A).max()),
+    ("base", "squared_norm", (A,), {}, lambda: (A * A).sum()),
+    ("base", "count_nonzero", (np.array([0, 1, 0, 2]),), {}, lambda: 2),
+    ("base", "count_zero", (np.array([0, 1, 0, 2]),), {}, lambda: 2),
+    ("base", "any", (A > 100,), {}, lambda: False),
+    ("base", "all", (A < 100,), {}, lambda: True),
+    ("base", "argmax", (A, 1), {}, lambda: A.argmax(1)),
+    ("base", "argmin", (A, 0), {}, lambda: A.argmin(0)),
+    ("base", "iamax", (V,), {}, lambda: np.abs(V).argmax()),
+    ("base", "cumsum", (V,), {}, lambda: np.cumsum(V)),
+    ("base", "cumprod", (V,), {}, lambda: np.cumprod(V)),
+    ("base", "logsumexp", (A, 1), {},
+     lambda: np.log(np.exp(A).sum(1))),
+    # ---- base: segments
+    ("base", "segment_sum", (V[:4], [0, 0, 1, 2], 3), {},
+     lambda: np.array([V[0] + V[1], V[2], V[3]])),
+    ("base", "segment_max", (np.arange(4.0), [0, 0, 1, 1], 2), {},
+     lambda: np.array([1.0, 3.0])),
+    ("base", "segment_mean", (np.arange(4.0), [0, 0, 1, 1], 2), {},
+     lambda: np.array([0.5, 2.5])),
+    ("base", "unsorted_segment_sum", (np.arange(4.0), [1, 0, 1, 0], 2), {},
+     lambda: np.array([4.0, 2.0])),
+    # ---- base: sort/sets/matmul
+    ("base", "sort", (V,), {}, lambda: np.sort(V)),
+    ("base", "sort", (V,), {"descending": True}, lambda: -np.sort(-V)),
+    ("base", "argsort", (V,), {}, lambda: np.argsort(V)),
+    ("base", "invert_permutation", (np.array([2, 0, 1]),), {},
+     lambda: np.array([1, 2, 0])),
+    ("base", "bincount", (IDS, 3), {}, lambda: np.bincount(IDS, minlength=3)),
+    ("base", "mmul", (A, M), {}, lambda: A @ M),
+    ("base", "batch_mmul", (np.stack([A, A]), np.stack([M, M])), {},
+     lambda: np.stack([A @ M, A @ M])),
+    ("base", "batch_mmul", (A, A), {"transpose_b": True}, lambda: A @ A.T),
+    ("base", "tensor_mmul", (A, M, 1), {}, lambda: np.tensordot(A, M, 1)),
+    ("base", "outer", (V, V), {}, lambda: np.outer(V, V)),
+    ("base", "kron", (np.eye(2), SQ), {}, lambda: np.kron(np.eye(2), SQ)),
+    ("base", "einsum", ("ij,jk->ik", A, M), {}, lambda: A @ M),
+    ("base", "clip_by_value", (A, -0.5, 0.5), {},
+     lambda: np.clip(A, -0.5, 0.5)),
+    ("base", "nan_to_num", (np.array([np.nan, 1.0, np.inf], np.float32),), {},
+     lambda: np.nan_to_num(np.array([np.nan, 1.0, np.inf], np.float32))),
+    # ---- math extensions
+    ("math", "atan2", (A, B), {}, lambda: np.arctan2(A, B)),
+    ("math", "asinh", (A,), {}, lambda: np.arcsinh(A)),
+    ("math", "acosh", (1 + np.abs(A),), {}, lambda: np.arccosh(1 + np.abs(A))),
+    ("math", "atanh", (0.5 * np.tanh(A),), {},
+     lambda: np.arctanh(0.5 * np.tanh(A))),
+    ("math", "expm1", (A,), {}, lambda: np.expm1(A)),
+    ("math", "log2", (np.abs(A) + 1,), {}, lambda: np.log2(np.abs(A) + 1)),
+    ("math", "log10", (np.abs(A) + 1,), {}, lambda: np.log10(np.abs(A) + 1)),
+    ("math", "rsqrt", (np.abs(A) + 1,), {},
+     lambda: 1 / np.sqrt(np.abs(A) + 1)),
+    ("math", "cbrt", (A,), {}, lambda: np.cbrt(A)),
+    ("math", "lgamma", (np.abs(A) + 0.5,), {},
+     lambda: np.vectorize(math.lgamma)(np.abs(A) + 0.5)),
+    ("math", "mod", (INTS, 5), {}, lambda: INTS % 5),
+    ("math", "floor_div", (INTS, 5), {}, lambda: INTS // 5),
+    ("math", "rdiv", (np.float32(2.0), np.float32(10.0)), {}, lambda: 5.0),
+    ("math", "rsub", (np.float32(2.0), np.float32(10.0)), {}, lambda: 8.0),
+    ("math", "eq", (IDS, 2), {}, lambda: IDS == 2),
+    ("math", "gt", (A, B), {}, lambda: A > B),
+    ("math", "is_finite", (np.array([1.0, np.inf, np.nan]),), {},
+     lambda: np.array([True, False, False])),
+    ("math", "logical_xor", (A > 0, B > 0), {},
+     lambda: (A > 0) ^ (B > 0)),
+    ("math", "cosine_similarity", (V, V), {}, lambda: 1.0),
+    ("math", "euclidean_distance", (A, B), {},
+     lambda: np.sqrt(((A - B) ** 2).sum(-1))),
+    ("math", "manhattan_distance", (A, B), {},
+     lambda: np.abs(A - B).sum(-1)),
+    ("math", "hamming_distance", (IDS, np.array([0, 1, 1, 2], np.int32)), {},
+     lambda: 1.0),
+    ("math", "squared_difference", (A, B), {}, lambda: (A - B) ** 2),
+    ("math", "trunc", (A * 3,), {}, lambda: np.trunc(A * 3)),
+    ("math", "hypot", (A, B), {}, lambda: np.hypot(A, B)),
+    ("math", "step", (A,), {}, lambda: (A > 0).astype(np.float32)),
+    ("math", "diff", (V,), {}, lambda: np.diff(V)),
+    ("math", "moving_average", (V, 3), {},
+     lambda: np.convolve(V, np.ones(3) / 3, mode="valid")),
+    # ---- linalg
+    ("linalg", "cholesky", (SQ @ SQ.T,), {},
+     lambda: np.linalg.cholesky(SQ @ SQ.T)),
+    ("linalg", "inv", (SQ,), {}, lambda: np.linalg.inv(SQ)),
+    ("linalg", "det", (SQ,), {}, lambda: np.linalg.det(SQ)),
+    ("linalg", "solve", (SQ, V[:4]), {}, lambda: np.linalg.solve(SQ, V[:4])),
+    ("linalg", "matrix_power", (SQ, 3), {},
+     lambda: np.linalg.matrix_power(SQ, 3)),
+    ("linalg", "matrix_transpose", (A,), {}, lambda: A.T),
+    ("linalg", "matrix_diag", (V,), {}, lambda: np.diag(V)),
+    ("linalg", "logdet", (SQ @ SQ.T,), {},
+     lambda: np.linalg.slogdet(SQ @ SQ.T)[1]),
+    ("linalg", "norm", (A,), {}, lambda: np.linalg.norm(A)),
+    ("linalg", "tri", (3,), {}, lambda: np.tri(3, dtype=np.float32)),
+    # ---- bitwise
+    ("bitwise", "and_", (INTS, 6), {}, lambda: INTS & 6),
+    ("bitwise", "or_", (INTS, 6), {}, lambda: INTS | 6),
+    ("bitwise", "xor", (INTS, 6), {}, lambda: INTS ^ 6),
+    ("bitwise", "left_shift", (INTS, 2), {}, lambda: INTS << 2),
+    ("bitwise", "right_shift", (INTS, 1), {}, lambda: INTS >> 1),
+    ("bitwise", "bit_count", (np.array([0, 1, 3, 255], np.int32),), {},
+     lambda: np.array([0, 1, 2, 8])),
+    # ---- cnn (oracle: direct computation)
+    ("cnn", "global_avg_pooling", (IMG,), {}, lambda: IMG.mean((1, 2))),
+    ("cnn", "global_max_pooling", (IMG,), {}, lambda: IMG.max((1, 2))),
+    ("cnn", "upsampling2d", (IMG, 2), {},
+     lambda: IMG.repeat(2, 1).repeat(2, 2)),
+    ("cnn", "batch_norm", (A, A.mean(0), A.var(0), np.ones(5, np.float32),
+                           np.zeros(5, np.float32)), {},
+     lambda: (A - A.mean(0)) / np.sqrt(A.var(0) + 1e-5)),
+    # ---- image
+    ("image", "flip_left_right", (IMG,), {}, lambda: IMG[:, :, ::-1]),
+    ("image", "flip_up_down", (IMG,), {}, lambda: IMG[:, ::-1]),
+    ("image", "rot90", (IMG,), {}, lambda: np.rot90(IMG, 1, (1, 2))),
+    ("image", "adjust_brightness", (IMG, 0.1), {}, lambda: IMG + 0.1),
+    ("image", "rgb_to_grayscale", (IMG,), {},
+     lambda: (IMG * [0.2989, 0.587, 0.114]).sum(-1, keepdims=True)),
+    ("image", "central_crop", (IMG, 0.5), {}, lambda: IMG[:, 2:6, 2:6]),
+]
+
+
+@pytest.mark.parametrize("ns,op,args,kwargs,oracle",
+                         CASES, ids=[f"{c[0]}.{c[1]}_{i}"
+                                     for i, c in enumerate(CASES)])
+def test_op_vs_numpy_oracle(ns, op, args, kwargs, oracle):
+    sd = SameDiff.create()
+    out = getattr(getattr(sd, ns), op)(*args, **kwargs)
+    got = np.asarray(out.eval())
+    want = np.asarray(oracle())
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_base_ops_callable_directly_on_sd():
+    sd = SameDiff.create()
+    v = sd.constant("c", jnp.asarray(A))
+    out = sd.concat(v, v, axis=0)           # SDBaseOps-on-SameDiff parity
+    assert np.asarray(out.eval()).shape == (8, 5)
+    s = sd.sum(v, 0)
+    np.testing.assert_allclose(np.asarray(s.eval()), A.sum(0), rtol=1e-5)
+
+
+def test_multi_output_ops():
+    sd = SameDiff.create()
+    vals, counts = sd_ops.BASE["unique_with_counts"](
+        jnp.asarray([3, 1, 3, 2, 3]), 4)
+    np.testing.assert_array_equal(np.asarray(vals)[:3], [1, 2, 3])
+    qr_q, qr_r = sd_ops.LINALG["qr"](jnp.asarray(SQ))
+    np.testing.assert_allclose(np.asarray(qr_q @ qr_r), SQ, atol=1e-4)
+
+
+def test_sequence_and_partition_ops():
+    m = sd_ops.BASE["sequence_mask"]([2, 4], 5)
+    np.testing.assert_array_equal(
+        np.asarray(m), [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+    x = jnp.asarray([[1.0, 1], [2, 2], [3, 3], [4, 4]])
+    parts = sd_ops.BASE["dynamic_partition"](x, jnp.asarray([0, 1, 0, 1]), 2)
+    np.testing.assert_allclose(np.asarray(parts[0]).sum(), 8.0)
+    np.testing.assert_allclose(np.asarray(parts[1]).sum(), 12.0)
+    st = sd_ops.BASE["dynamic_stitch"](
+        [jnp.asarray([0, 2]), jnp.asarray([1, 3])],
+        [jnp.asarray([[1.0], [3.0]]), jnp.asarray([[2.0], [4.0]])])
+    np.testing.assert_allclose(np.asarray(st).ravel(), [1, 2, 3, 4])
+    rs = sd_ops.BASE["reverse_sequence"](
+        jnp.asarray([[1.0, 2, 3, 0], [1, 2, 3, 4]]), [3, 4])
+    np.testing.assert_allclose(np.asarray(rs),
+                               [[3, 2, 1, 0], [4, 3, 2, 1]])
+
+
+def test_confusion_and_clip():
+    cm = sd_ops.BASE["confusion_matrix"](
+        jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 2, 2, 1]), 3)
+    np.testing.assert_array_equal(
+        np.asarray(cm), [[1, 0, 0], [0, 1, 1], [0, 0, 1]])
+    x = jnp.asarray([3.0, 4.0])
+    c = sd_ops.BASE["clip_by_norm"](x, 1.0)
+    np.testing.assert_allclose(np.asarray(c), [0.6, 0.8], atol=1e-6)
+    ts = sd_ops.BASE["clip_by_global_norm"]([x, x], 5.0)
+    g = np.sqrt(sum((np.asarray(t) ** 2).sum() for t in ts))
+    np.testing.assert_allclose(g, 5.0, rtol=1e-5)
+
+
+def test_space_depth_roundtrip():
+    x = jnp.asarray(R.random((2, 4, 4, 3)).astype(np.float32))
+    d = sd_ops.BASE["space_to_depth"](x, 2)
+    assert d.shape == (2, 2, 2, 12)
+    back = sd_ops.BASE["depth_to_space"](d, 2)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_random_ops_deterministic_and_shaped():
+    key = jax.random.PRNGKey(0)
+    for name in ("uniform", "normal", "truncated_normal", "laplace",
+                 "gumbel", "cauchy", "exponential"):
+        a = sd_ops.RANDOM[name](key, (100,))
+        b = sd_ops.RANDOM[name](key, (100,))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.shape == (100,)
+    u = sd_ops.RANDOM["uniform"](key, (2000,), minval=2.0, maxval=4.0)
+    assert 2.0 <= float(u.min()) and float(u.max()) < 4.0
+    r = sd_ops.RANDOM["randint"](key, (500,), 0, 7)
+    assert set(np.unique(np.asarray(r))) <= set(range(7))
+    p = sd_ops.RANDOM["permutation"](key, 10)
+    np.testing.assert_array_equal(np.sort(np.asarray(p)), np.arange(10))
+    bern = sd_ops.RANDOM["bernoulli"](key, 0.3, (5000,))
+    assert 0.25 < float(jnp.mean(bern)) < 0.35
+
+
+def test_cnn_conv_matches_manual():
+    x = jnp.asarray(R.random((1, 5, 5, 1)).astype(np.float32))
+    w = jnp.asarray(R.random((3, 3, 1, 2)).astype(np.float32))
+    out = sd_ops.CNN["conv2d"](x, w, padding="VALID")
+    assert out.shape == (1, 3, 3, 2)
+    manual = np.zeros((3, 3, 2), np.float32)
+    xn, wn = np.asarray(x)[0, :, :, 0], np.asarray(w)[:, :, 0, :]
+    for i in range(3):
+        for j in range(3):
+            for c in range(2):
+                manual[i, j, c] = (xn[i:i + 3, j:j + 3] * wn[:, :, c]).sum()
+    np.testing.assert_allclose(np.asarray(out)[0], manual, rtol=1e-4)
+    p = sd_ops.CNN["max_pooling2d"](x, 2)
+    assert p.shape == (1, 2, 2, 1)
+    a = sd_ops.CNN["avg_pooling2d"](x, (2, 2), padding="SAME")
+    assert a.shape == (1, 3, 3, 1)
+
+
+def test_rnn_cells_and_layers():
+    b, d, h = 2, 3, 4
+    x = jnp.asarray(R.standard_normal((b, d)).astype(np.float32))
+    h0 = jnp.zeros((b, h))
+    c0 = jnp.zeros((b, h))
+    w_ih = jnp.asarray(R.standard_normal((d, 4 * h)).astype(np.float32)) * 0.1
+    w_hh = jnp.asarray(R.standard_normal((h, 4 * h)).astype(np.float32)) * 0.1
+    bias = jnp.zeros(4 * h)
+    h1, c1 = sd_ops.RNN["lstm_cell"](x, h0, c0, w_ih, w_hh, bias)
+    assert h1.shape == (b, h) and bool(jnp.isfinite(h1).all())
+    seq = jnp.asarray(R.standard_normal((b, 6, d)).astype(np.float32))
+    hs = sd_ops.RNN["lstm_layer"](seq, h0, w_ih, w_hh, bias)
+    assert hs.shape == (b, 6, h)
+    # gru
+    wg_ih = jnp.asarray(R.standard_normal((d, 3 * h)).astype(np.float32)) * 0.1
+    wg_hh = jnp.asarray(R.standard_normal((h, 3 * h)).astype(np.float32)) * 0.1
+    bg = jnp.zeros(3 * h)
+    g1 = sd_ops.RNN["gru_cell"](x, h0, wg_ih, wg_hh, bg)
+    assert g1.shape == (b, h)
+    gs = sd_ops.RNN["gru_layer"](seq, h0, wg_ih, wg_hh, bg)
+    assert gs.shape == (b, 6, h)
+
+
+def test_loss_ext_sane():
+    labels = jnp.asarray([1.0, 0.0, 1.0])
+    logits = jnp.asarray([2.0, -1.0, 0.5])
+    for name in ("hinge_loss", "squared_hinge_loss", "focal_loss",
+                 "smooth_l1_loss"):
+        v = float(sd_ops.LOSS_EXT[name](labels, logits))
+        assert np.isfinite(v) and v >= 0
+    # kld of identical distributions is ~0
+    p = jnp.asarray([[0.2, 0.3, 0.5]])
+    assert abs(float(sd_ops.LOSS_EXT["kl_divergence"](p, p))) < 1e-5
+    assert float(sd_ops.LOSS_EXT["l2_loss"](jnp.asarray([3.0, 4.0]))) == 12.5
+
+
+def test_ops_are_differentiable():
+    # representative diff check: grad flows through namespace-built graphs
+    sd = SameDiff.create()
+    x = sd.var("x", value=np.asarray(A))
+    loss = sd.base.sum(sd.math.squared_difference(
+        sd.linalg.mmul(x, sd.constant("m", jnp.asarray(M))),
+        sd.constant("t", jnp.zeros((4, 3)))))
+    grads = sd.grad(loss.name, wrt=["x"])
+    want = 2 * (A @ M) @ M.T
+    np.testing.assert_allclose(np.asarray(grads["x"]), want, rtol=1e-4)
+
+
+def test_registry_breadth():
+    # VERDICT r1: "broaden to ~300 ops". Count the full registry (new
+    # namespaces + the original math/nn/loss tables).
+    from deeplearning4j_tpu.autodiff import samediff as sdm
+    total = (sd_ops.op_count() + len(sdm._MATH) + len(sdm._NN)
+             + len(sdm._LOSS))
+    assert total >= 300, total
+    assert sd_ops.op_count() >= 240, sd_ops.op_count()
