@@ -335,13 +335,20 @@ def _weight_arrays(model_weights, lname):
              for n in grp.attrs.get("weight_names", [])]
     if names:
         return [np.asarray(grp[n]) for n in names]
-    out = []  # keras3 style: nested 'vars' datasets
+    found = []  # keras3 style: nested 'vars' datasets, integer-named
 
-    def visit(_, obj):
+    def visit(name, obj):
         if isinstance(obj, h5py.Dataset):
-            out.append(np.asarray(obj))
+            found.append((name, obj))
     grp.visititems(visit)
-    return out
+
+    # visititems yields lexicographic order ('10' < '2'); sort integer-like
+    # path segments numerically so layers with 10+ variables stay ordered
+    def sort_key(item):
+        return tuple((0, int(seg)) if seg.isdigit() else (1, seg)
+                     for seg in item[0].split("/"))
+
+    return [np.asarray(obj) for _, obj in sorted(found, key=sort_key)]
 
 
 def _assign_weights(net: MultiLayerNetwork, model_weights, layer_names_in_order):

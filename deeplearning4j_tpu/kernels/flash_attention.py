@@ -29,7 +29,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+try:  # jaxlib builds without Pallas-TPU support (CPU-only wheels)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover - depends on jaxlib build
+    pltpu = None
 
 NEG_INF = -1e30
 
@@ -214,12 +218,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # ------------------------------------------------------------- public api --
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_pallas(q, k, v, scale: Optional[float] = None,
+                            causal: bool = False, block_q: int = 128,
+                            block_k: int = 128,
+                            interpret: Optional[bool] = None):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
 def flash_attention(q, k, v, scale: Optional[float] = None,
                     causal: bool = False, block_q: int = 128,
                     block_k: int = 128, interpret: Optional[bool] = None):
-    """Fused scaled-dot-product attention. q/k/v: (B, H, T, D) → (B, H, T, D)."""
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out
+    """Fused scaled-dot-product attention. q/k/v: (B, H, T, D) → (B, H, T, D).
+
+    On jaxlib builds without Pallas-TPU support (``pltpu`` unimportable) this
+    transparently falls back to the plain-XLA :func:`mha_reference` path so
+    the module stays usable (plain jax autodiff replaces the custom VJP).
+    """
+    if pltpu is None:
+        return mha_reference(q, k, v, scale, causal).astype(q.dtype)
+    return _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
+                                   interpret)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -282,7 +301,7 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash_attention_pallas.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention_ntc(q, k, v, causal=False, interpret=None):

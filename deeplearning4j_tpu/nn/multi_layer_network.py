@@ -410,7 +410,10 @@ class MultiLayerNetwork:
                     f"{type(unwrap(layer)).__name__}: it needs the full "
                     f"sequence (reference rnnTimeStep has the same limit)")
         x = jnp.asarray(x)
-        single = x.ndim == 2 or (x.ndim == 1 and jnp.issubdtype(x.dtype, jnp.integer))
+        # 2-D *integer* input is a (B, T) token-id chunk for embedding-fronted
+        # models, NOT a single (B, C) feature step; only float 2-D is a step.
+        integer = jnp.issubdtype(x.dtype, jnp.integer)
+        single = (x.ndim == 2 and not integer) or (x.ndim == 1 and integer)
         if single:
             x = x[:, None] if x.ndim == 1 else x[:, None, :]
         batch = x.shape[0]

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import math
 import os
+import warnings
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Sequence
 
@@ -107,6 +108,12 @@ def bootstrap_distributed(coordinator: Optional[str] = None,
 
     On TPU pods the args come from the environment; elsewhere pass them
     explicitly. Safe to call when already initialized.
+
+    A failed init RAISES when the caller clearly asked for multi-host
+    (explicit args, or cluster env vars present): silently falling back to
+    single-process training is exactly the kind of quiet misconfiguration
+    the reference's cluster bootstrap rejects. Only a bare, argument-less
+    call in a single-process dev environment downgrades to a warning.
     """
     if jax.process_count() > 1:
         return
@@ -117,10 +124,22 @@ def bootstrap_distributed(coordinator: Optional[str] = None,
         kw["num_processes"] = num_processes
     if process_id is not None:
         kw["process_id"] = process_id
+    multi_host_requested = bool(kw) or any(
+        os.environ.get(v) for v in
+        ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+         "MEGASCALE_COORDINATOR_ADDRESS"))
     try:
         jax.distributed.initialize(**kw)
-    except (RuntimeError, ValueError):
-        pass  # single-process dev environment
+    except (RuntimeError, ValueError) as e:
+        if multi_host_requested:
+            raise RuntimeError(
+                f"multi-host bootstrap failed (coordinator={coordinator!r}, "
+                f"num_processes={num_processes!r}, process_id={process_id!r})"
+                " — refusing to fall back to single-process training"
+            ) from e
+        warnings.warn(f"jax.distributed.initialize unavailable ({e}); "
+                      "continuing single-process", RuntimeWarning,
+                      stacklevel=2)
 
 
 def hybrid_mesh_2d(ici_axes: Dict[str, int], dcn_axes: Dict[str, int]) -> Mesh:

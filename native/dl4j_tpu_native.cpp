@@ -16,6 +16,7 @@
 #include <cstring>
 #include <mutex>
 #include <new>
+#include <string>
 
 extern "C" {
 
@@ -301,7 +302,11 @@ int npy_parse_header(const uint8_t* buf, int64_t len,
         return -2;
     }
     if (hstart + hlen > static_cast<uint64_t>(len)) return -3;
-    const char* h = reinterpret_cast<const char*>(buf + hstart);
+    // Copy the header into a NUL-terminated local buffer: the str* scanners
+    // below must never run past the caller's (ptr, len) region — the C API
+    // contract cannot rely on callers passing NUL-terminated memory.
+    std::string hbuf(reinterpret_cast<const char*>(buf + hstart), hlen);
+    const char* h = hbuf.c_str();
     const char* hend = h + hlen;
     // descr: find "'descr':" then the quoted dtype like '<f4'
     const char* d = std::strstr(h, "descr");
@@ -358,12 +363,16 @@ int64_t parse_csv_matrix(const char* text, int64_t len, int64_t n_cols,
     int64_t rows = 0;
     float* rowbuf = static_cast<float*>(std::malloc(n_cols * sizeof(float)));
     if (!rowbuf) return 0;
+    std::string linebuf;  // NUL-terminated line copy: strtof must never scan
+                          // past the caller's (ptr, len) region
     while (p < end && rows < max_rows) {
-        const char* line_end = static_cast<const char*>(
+        const char* raw_end = static_cast<const char*>(
             std::memchr(p, '\n', end - p));
-        if (!line_end) line_end = end;
+        if (!raw_end) raw_end = end;
+        linebuf.assign(p, raw_end - p);
+        const char* q = linebuf.c_str();
+        const char* line_end = q + linebuf.size();
         int64_t c = 0;
-        const char* q = p;
         while (q < line_end && c <= n_cols) {
             while (q < line_end && (*q == ',' || *q == ' ' || *q == '\t' ||
                                     *q == ';' || *q == '\r')) ++q;
@@ -379,7 +388,7 @@ int64_t parse_csv_matrix(const char* text, int64_t len, int64_t n_cols,
             std::memcpy(out + rows * n_cols, rowbuf, n_cols * sizeof(float));
             ++rows;
         }
-        p = line_end + 1;
+        p = raw_end + 1;
     }
     std::free(rowbuf);
     return rows;

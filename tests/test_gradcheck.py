@@ -194,7 +194,10 @@ def test_matrix_breadth():
 def test_flash_attention_vjp_interpret():
     """The pallas flash-attention custom VJP vs jax autodiff of the naive
     reference, in interpreter mode (runs on CPU)."""
-    import deeplearning4j_tpu.kernels.flash_attention as fa
+    import importlib
+    # kernels/__init__ rebinds the `flash_attention` attribute to the
+    # function, shadowing the submodule — import the module explicitly
+    fa = importlib.import_module("deeplearning4j_tpu.kernels.flash_attention")
     r = np.random.default_rng(0)
     b, h, t, d = 1, 2, 16, 8
     q = jnp.asarray(r.standard_normal((b, h, t, d)).astype(np.float32))

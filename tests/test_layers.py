@@ -332,3 +332,36 @@ def test_rnn_time_step_state_injection_and_bf16():
     netbi = MultiLayerNetwork(confbi).init((5, 3))
     with pytest.raises(NotImplementedError, match="Bidirectional"):
         netbi.rnn_time_step(x[:, 0, :])
+
+
+def test_rnn_time_step_integer_token_chunks():
+    """A 2-D integer (B, T) array is a token-id CHUNK for embedding-fronted
+    models (ADVICE r1), not a single (B, C) feature step; 1-D integer is a
+    single step."""
+    from deeplearning4j_tpu.nn import (EmbeddingSequenceLayer,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, RnnOutputLayer)
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(1e-3))
+            .list()
+            .layer(EmbeddingSequenceLayer(n_in=13, n_out=5))
+            .layer(LSTM(n_in=5, n_out=6))
+            .layer(RnnOutputLayer(n_in=6, n_out=13, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init((7,))
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 13, (2, 7))
+    full = np.asarray(net.output(ids))            # (2, 7, 13)
+
+    net.rnn_clear_previous_state()
+    first = np.asarray(net.rnn_time_step(ids[:, :4]))   # 2-D int chunk
+    rest = np.asarray(net.rnn_time_step(ids[:, 4:]))
+    assert first.shape == (2, 4, 13) and rest.shape == (2, 3, 13)
+    np.testing.assert_allclose(np.concatenate([first, rest], axis=1), full,
+                               atol=1e-5)
+
+    net.rnn_clear_previous_state()
+    stepped = [np.asarray(net.rnn_time_step(ids[:, t])) for t in range(7)]
+    np.testing.assert_allclose(np.stack(stepped, axis=1), full, atol=1e-5)
