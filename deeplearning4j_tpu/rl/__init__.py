@@ -1,6 +1,7 @@
-"""deeplearning4j_tpu.rl — RL4J-lite: DQN/DoubleDQN, A2C, replay, envs."""
+"""deeplearning4j_tpu.rl — RL4J-lite: DQN/DoubleDQN, A2C, A3C, replay, envs."""
 
 from .a2c import A2C, A2CConfiguration
+from .a3c import A3C, A3CConfiguration, A3CDiscrete
 from .dqn import DQN, QLearningConfiguration
 from .env import (CartPoleEnv, Environment, VectorizedCartPole, cartpole_init,
                   cartpole_step)

@@ -1,0 +1,172 @@
+"""Asynchronous Advantage Actor-Critic — parity with RL4J's
+``org.deeplearning4j.rl4j.learning.async.a3c.discrete.A3CDiscrete`` /
+``AsyncLearning`` (the Hogwild actor-thread pool + shared global network).
+
+TPU-first redesign of the async thread pool. The reference spawns
+``numThreads`` CPU workers; each holds a STALE local copy of the global
+network, rolls out ``nStep`` transitions in its own env, computes a
+gradient against its local copy, pushes it into the shared updater, then
+pulls fresh globals. We reproduce exactly that update discipline as one
+XLA program per iteration:
+
+1. all W workers roll out and differentiate **in parallel** (``vmap``)
+   against their own local (stale) parameter copies — a stacked pytree
+   with a leading worker axis;
+2. a sequential ``lax.scan`` over workers applies each worker's gradient
+   through the SHARED optax optimizer state onto the global params —
+   worker k's update sees the globals already moved by workers < k,
+   computed from params that did not include those moves (true Hogwild
+   gradient staleness, deterministic rather than scheduler-ordered);
+3. immediately after pushing, each worker pulls the then-current globals
+   as its next local copy (the reference's post-push sync), so worker 0
+   runs the next rollout one-to-W updates staler than worker W-1.
+
+Same estimator as the reference: n-step bootstrapped returns, advantage
+baseline, entropy bonus, global-norm clipping.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .env import cartpole_init, cartpole_step
+from .networks import build_actor_critic
+
+
+@dataclass
+class A3CConfiguration:
+    gamma: float = 0.99
+    learning_rate: float = 7e-4
+    n_workers: int = 8              # reference numThreads
+    n_envs_per_worker: int = 2      # envs stepped by each worker's rollout
+    rollout_length: int = 16        # reference nStep (t_max)
+    value_coef: float = 0.5
+    entropy_coef: float = 0.01
+    max_grad_norm: float = 0.5
+    seed: int = 0
+    hidden: Sequence[int] = (64, 64)
+
+
+class A3C:
+    """A3CDiscrete analogue: Hogwild workers as a vmapped+scanned XLA program."""
+
+    def __init__(self, config: A3CConfiguration = None,
+                 env_init=cartpole_init, env_step=cartpole_step,
+                 obs_dim: int = 4, n_actions: int = 2):
+        self.cfg = cfg = config or A3CConfiguration()
+        init_fn, self._ac_fn = build_actor_critic(obs_dim, n_actions, cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        pkey, self._key = jax.random.split(key)
+        self.params = init_fn(pkey)                       # the GLOBAL network
+        self._opt = optax.chain(optax.clip_by_global_norm(cfg.max_grad_norm),
+                                optax.adam(cfg.learning_rate))
+        self._opt_state = self._opt.init(self.params)     # the SHARED updater
+        # every worker starts in sync with the globals
+        self._locals = jax.tree_util.tree_map(
+            lambda p: jnp.broadcast_to(p, (cfg.n_workers,) + p.shape),
+            self.params)
+
+        ac_fn, opt = self._ac_fn, self._opt
+        W, E, T = cfg.n_workers, cfg.n_envs_per_worker, cfg.rollout_length
+        gamma = cfg.gamma
+
+        def worker_grad(local_params, states, key):
+            """One worker: nStep rollout on its own envs with its own stale
+            params → (gradient, done count, final env states)."""
+            def body(carry, _):
+                states, key = carry
+                akey, rkey, key = jax.random.split(key, 3)
+                logits, _ = ac_fn(local_params, states)
+                actions = jax.random.categorical(akey, logits)     # (E,)
+                nxt, rew, done = jax.vmap(env_step)(states, actions)
+                fresh = jax.vmap(env_init)(jax.random.split(rkey, E))
+                nxt = jnp.where(done[:, None], fresh, nxt)
+                return (nxt, key), (states, actions, rew,
+                                    done.astype(jnp.float32))
+            (states, key), (obs, actions, rew, done) = jax.lax.scan(
+                body, (states, key), None, length=T)
+            _, boot = ac_fn(local_params, states)                  # V(s_T)
+
+            def disc(carry, xs):
+                r, d = xs
+                g = r + gamma * (1.0 - d) * carry
+                return g, g
+            _, returns = jax.lax.scan(disc, boot, (rew, done), reverse=True)
+            flat = lambda a: a.reshape((T * E,) + a.shape[2:])
+
+            def loss_fn(p):
+                logits, values = ac_fn(p, flat(obs))
+                logp = jax.nn.log_softmax(logits)
+                logp_a = jnp.take_along_axis(
+                    logp, flat(actions)[:, None], 1)[:, 0]
+                adv = flat(returns) - values
+                policy_loss = -(jax.lax.stop_gradient(adv) * logp_a).mean()
+                value_loss = jnp.square(adv).mean()
+                entropy = -(jnp.exp(logp) * logp).sum(axis=1).mean()
+                return (policy_loss + cfg.value_coef * value_loss
+                        - cfg.entropy_coef * entropy)
+            grads = jax.grad(loss_fn)(local_params)
+            return grads, done.sum(), states
+
+        @jax.jit
+        def iteration(global_params, opt_state, locals_, states, key):
+            keys = jax.random.split(key, W + 1)
+            # 1. parallel actors: every worker differentiates vs ITS params
+            grads, dones, states = jax.vmap(worker_grad)(
+                locals_, states, keys[:W])
+
+            # 2+3. async apply: push each worker's (stale) gradient through
+            # the shared updater in worker order, then that worker pulls the
+            # fresh globals — lax.scan carries (globals, opt_state)
+            def push_pull(carry, g):
+                gp, os_ = carry
+                updates, os_ = opt.update(g, os_, gp)
+                gp = optax.apply_updates(gp, updates)
+                return (gp, os_), gp
+            (global_params, opt_state), new_locals = jax.lax.scan(
+                push_pull, (global_params, opt_state), grads)
+            return global_params, opt_state, new_locals, states, \
+                keys[W], dones.sum()
+
+        self._iteration = iteration
+        self._env_init = env_init
+
+    def train(self, iterations: int) -> List[float]:
+        """Returns episode terminations per iteration (lower = better: the
+        vectorised cartpole pays 1/step, so fewer resets = longer balancing)."""
+        cfg = self.cfg
+        self._key, rkey = jax.random.split(self._key)
+        states = jax.vmap(lambda k: jax.vmap(self._env_init)(
+            jax.random.split(k, cfg.n_envs_per_worker)))(
+            jax.random.split(rkey, cfg.n_workers))       # (W, E, obs)
+        dones = []
+        for _ in range(iterations):
+            (self.params, self._opt_state, self._locals, states,
+             self._key, d) = self._iteration(
+                self.params, self._opt_state, self._locals, states, self._key)
+            dones.append(float(d))
+        return dones
+
+    def act(self, obs, greedy: bool = True) -> int:
+        logits, _ = self._ac_fn(self.params, jnp.asarray(obs)[None, :])
+        if greedy:
+            return int(jnp.argmax(logits[0]))
+        self._key, sub = jax.random.split(self._key)
+        return int(jax.random.categorical(sub, logits[0]))
+
+    def play(self, env, max_steps: int = 500) -> float:
+        obs = env.reset()
+        total, done, t = 0.0, False, 0
+        while not done and t < max_steps:
+            obs, r, done, _ = env.step(self.act(obs))
+            total += r
+            t += 1
+        return total
+
+
+A3CDiscrete = A3C  # reference class-name alias

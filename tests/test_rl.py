@@ -66,6 +66,46 @@ def test_dqn_learns_cartpole():
     assert agent.play(max_steps=200) > 50
 
 
+def test_a3c_hogwild_semantics():
+    """Workers genuinely diverge (stale locals) and the shared updater sees
+    every worker's push: after one iteration worker 0's locals differ from
+    worker W-1's, and worker W-1's locals equal the new globals."""
+    from deeplearning4j_tpu.rl import A3C, A3CConfiguration
+    cfg = A3CConfiguration(seed=3, n_workers=4, n_envs_per_worker=2,
+                           rollout_length=8)
+    agent = A3C(cfg)
+    agent.train(1)
+    leaves = jax.tree_util.tree_leaves(agent._locals)
+    globals_ = jax.tree_util.tree_leaves(agent.params)
+    saw_divergence = False
+    for loc, glob in zip(leaves, globals_):
+        # last worker pulled the final globals
+        np.testing.assert_array_equal(np.asarray(loc[-1]), np.asarray(glob))
+        if not np.array_equal(np.asarray(loc[0]), np.asarray(loc[-1])):
+            saw_divergence = True  # earlier workers are staler
+    assert saw_divergence
+    # adam moment state reflects all W pushes (count == W)
+    def find_counts(obj):
+        if isinstance(obj, tuple):
+            if hasattr(obj, "_fields") and "count" in obj._fields:
+                yield obj.count
+            for child in obj:
+                yield from find_counts(child)
+    counts = list(find_counts(agent._opt_state))
+    assert counts and all(int(c) == cfg.n_workers for c in counts)
+
+
+@pytest.mark.slow
+def test_a3c_learns_cartpole():
+    from deeplearning4j_tpu.rl import A3C, A3CConfiguration
+    cfg = A3CConfiguration(seed=0, n_workers=8, n_envs_per_worker=2,
+                           rollout_length=20)
+    agent = A3C(cfg)
+    dones = agent.train(400)
+    assert np.mean(dones[-50:]) < np.mean(dones[:50]) * 0.75
+    assert agent.play(CartPoleEnv(seed=11, max_steps=300)) > 80
+
+
 @pytest.mark.slow
 def test_a2c_learns_cartpole():
     cfg = A2CConfiguration(seed=0, n_envs=8, rollout_length=32)
