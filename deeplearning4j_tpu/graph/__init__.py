@@ -1,0 +1,10 @@
+"""deeplearning4j_tpu.graph — graph vertex embeddings.
+
+Parity with the ``deeplearning4j-graph`` module: a lightweight graph
+structure (``org.deeplearning4j.graph.graph.Graph``), uniform random
+walks (``RandomWalkIterator``), and DeepWalk vertex embeddings
+(``org.deeplearning4j.graph.models.deepwalk.DeepWalk``).
+"""
+
+from .deepwalk import DeepWalk
+from .graph import Graph, random_walks

@@ -1,0 +1,65 @@
+"""DeepWalk — parity with
+``org.deeplearning4j.graph.models.deepwalk.DeepWalk`` (random-walk corpus
+→ skip-gram vertex embeddings; Builder knobs vectorSize/windowSize/
+walkLength/learningRate).
+
+The walk corpus is generated host-side (graph.random_walks) and embedded
+by the shared on-device SGNS trainer via SequenceVectors — the upstream
+class does exactly this composition (GraphWalkIterator feeding its
+SequenceVectors superclass), with per-pair Hogwild replaced by the jitted
+batch step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..nlp.sequencevectors import SequenceVectors
+from .graph import Graph, random_walks
+
+
+@dataclass
+class DeepWalk:
+    """Vertex embeddings from uniform random walks + skip-gram/NS."""
+
+    layer_size: int = 64          # reference vectorSize
+    window_size: int = 5
+    walk_length: int = 40
+    walks_per_vertex: int = 10
+    negative: int = 5
+    learning_rate: float = 0.025
+    epochs: int = 3
+    batch_size: int = 2048
+    seed: int = 0
+
+    _sv: Optional[SequenceVectors] = field(default=None, repr=False)
+
+    def fit(self, graph: Graph):
+        walks = random_walks(graph, self.walk_length, self.walks_per_vertex,
+                             self.seed)
+        self._sv = SequenceVectors(
+            layer_size=self.layer_size, window_size=self.window_size,
+            negative=self.negative, learning_rate=self.learning_rate,
+            epochs=self.epochs, batch_size=self.batch_size, seed=self.seed)
+        self._sv.fit([list(map(int, w)) for w in walks])
+        return self
+
+    # ------------------------------------------------ query surface
+    def vertex_vector(self, v: int) -> np.ndarray:
+        """Reference getVertexVector."""
+        return self._sv.element_vector(v)
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._sv.similarity_elements(a, b)
+
+    def verts_nearest(self, v: int, top_n: int = 10) -> List[int]:
+        """Nearest vertices by cosine (reference verticesNearest)."""
+        return [int(w) for w in self._sv.elements_nearest(v, top_n=top_n)]
+
+    @property
+    def vectors(self) -> SequenceVectors:
+        """The underlying SequenceVectors (lookup table access)."""
+        return self._sv

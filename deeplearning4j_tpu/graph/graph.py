@@ -1,0 +1,92 @@
+"""Graph structure + random walks — parity with
+``org.deeplearning4j.graph.graph.Graph`` (adjacency-list graph over int
+vertex ids) and ``org.deeplearning4j.graph.iterator.RandomWalkIterator``
+(uniform next-neighbor walks of fixed length).
+
+Walks are generated vectorised: the ragged adjacency is padded to a
+(V, max_degree) neighbor matrix so ALL walks advance one step per numpy
+op — the host-side analogue of stepping every walker in lock-step,
+replacing the reference's per-walk iterator loop.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Graph:
+    """Undirected-by-default adjacency-list graph over vertices 0..V-1."""
+
+    def __init__(self, n_vertices: int,
+                 edges: Optional[Iterable[Tuple[int, int]]] = None,
+                 undirected: bool = True):
+        if n_vertices <= 0:
+            raise ValueError(f"n_vertices must be positive, got {n_vertices}")
+        self.n_vertices = n_vertices
+        self.undirected = undirected
+        self._adj: List[List[int]] = [[] for _ in range(n_vertices)]
+        for a, b in (edges or []):
+            self.add_edge(a, b)
+
+    def add_edge(self, a: int, b: int):
+        if not (0 <= a < self.n_vertices and 0 <= b < self.n_vertices):
+            raise ValueError(f"edge ({a}, {b}) out of range 0..{self.n_vertices - 1}")
+        self._adj[a].append(b)
+        if self.undirected and a != b:
+            self._adj[b].append(a)
+        return self
+
+    def neighbors(self, v: int) -> List[int]:
+        return list(self._adj[v])
+
+    def degree(self, v: int) -> int:
+        return len(self._adj[v])
+
+    def num_edges(self) -> int:
+        total = sum(len(n) for n in self._adj)
+        return total // 2 if self.undirected else total
+
+    def padded_adjacency(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(V, max_degree) neighbor matrix padded with self-ids + (V,) degrees.
+
+        Padding with the vertex's own id makes dead-end walks self-loop
+        instead of indexing garbage (the reference's NoEdges handling is
+        EXCEPTION_ON_DISCONNECTED by default; SELF_LOOP matches its
+        PADDING mode and keeps the walk tensor rectangular)."""
+        max_deg = max(1, max((len(n) for n in self._adj), default=1))
+        nbr = np.tile(np.arange(self.n_vertices, dtype=np.int32)[:, None],
+                      (1, max_deg))
+        for v, ns in enumerate(self._adj):
+            if ns:
+                nbr[v, :len(ns)] = np.asarray(ns, np.int32)
+        deg = np.asarray([max(1, len(n)) for n in self._adj], np.int32)
+        return nbr, deg
+
+
+def random_walks(graph: Graph, walk_length: int = 40,
+                 walks_per_vertex: int = 10, seed: int = 0,
+                 starts: Optional[Sequence[int]] = None) -> np.ndarray:
+    """Uniform random walks, (n_walks, walk_length) int32 vertex ids.
+
+    Every vertex starts ``walks_per_vertex`` walks (shuffled start order,
+    like the reference's GraphWalkIterator epochs) unless ``starts`` is
+    given explicitly.
+    """
+    nbr, deg = graph.padded_adjacency()
+    rng = np.random.default_rng(seed)
+    if starts is None:
+        starts = np.tile(np.arange(graph.n_vertices, dtype=np.int32),
+                         walks_per_vertex)
+        rng.shuffle(starts)
+    else:
+        starts = np.asarray(starts, np.int32)
+    walks = np.empty((len(starts), walk_length), np.int32)
+    cur = starts.copy()
+    walks[:, 0] = cur
+    for t in range(1, walk_length):
+        r = rng.integers(0, deg[cur])
+        cur = nbr[cur, r]
+        walks[:, t] = cur
+    return walks

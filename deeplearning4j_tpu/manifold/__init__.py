@@ -1,0 +1,6 @@
+"""deeplearning4j_tpu.manifold — dimensionality reduction for visualisation.
+
+Parity with ``deeplearning4j-manifold`` (``BarnesHutTsne``).
+"""
+
+from .tsne import TSNE, BarnesHutTsne

@@ -1,6 +1,8 @@
-"""deeplearning4j_tpu.nlp — Word2Vec/ParagraphVectors + tokenizers
-(DL4J deeplearning4j-nlp analogue)."""
+"""deeplearning4j_tpu.nlp — Word2Vec/ParagraphVectors/GloVe/
+SequenceVectors + tokenizers (DL4J deeplearning4j-nlp analogue)."""
 
+from .glove import GloVe
+from .sequencevectors import SequenceVectors
 from .tokenizers import (BasicLineIterator, BPETokenizer, CharTokenizer,
                          CollectionSentenceIterator, CommonPreprocessor,
                          DefaultTokenizerFactory, LowCasePreProcessor,

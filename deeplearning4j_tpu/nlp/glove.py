@@ -1,0 +1,139 @@
+"""GloVe — parity with DL4J's
+``org.deeplearning4j.models.glove.Glove`` (co-occurrence counting +
+AdaGrad on the weighted least-squares objective, ``xMax``/``alpha``
+weighting, symmetric windows).
+
+TPU-first redesign: the reference shards co-occurrence counting across
+threads and runs per-pair Hogwild AdaGrad. Here the co-occurrence pass is
+a host-side dict accumulation (it is IO/string bound, like the
+reference's CoOccurrenceReader), and training is mini-batched AdaGrad on
+device: each jitted step takes a batch of (i, j, log X_ij, f(X_ij))
+records, autodiff turns the embedding gathers into scatter-adds, and the
+AdaGrad accumulator update rides the same program. Final vectors are
+``W + W̃`` (both tables summed, the standard GloVe export).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import VocabCache
+from .word2vec import Word2Vec
+
+
+@dataclass
+class GloVe(Word2Vec):
+    """GloVe embeddings with the reference Builder's knobs."""
+
+    x_max: float = 100.0         # reference xMax
+    alpha: float = 0.75          # reference alpha
+    learning_rate: float = 0.05  # AdaGrad base lr (reference learningRate)
+    epochs: int = 25
+    symmetric: bool = True       # reference symmetric(true)
+    batch_size: int = 8192
+
+    def __post_init__(self):
+        # inherited SGNS-only knobs have no meaning for the GloVe objective —
+        # reject them loudly rather than silently no-op a hyperparam sweep
+        if self.negative != 5 or self.subsample != 1e-3 \
+                or self.min_learning_rate != 1e-4:
+            raise ValueError(
+                "GloVe has no negative sampling, subsampling, or lr decay: "
+                "'negative'/'subsample'/'min_learning_rate' are Word2Vec-only "
+                "knobs (use x_max/alpha/learning_rate)")
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, sentences: Iterable[str]):
+        tok = [self.tokenizer_factory.create(s).get_tokens()
+               for s in sentences]
+        return self._fit_tokens(tok)
+
+    def _fit_tokens(self, tok: List[List[str]]):
+        self.vocab = VocabCache(self.min_word_frequency).fit(tok)
+        ids = [self.vocab.encode(t) for t in tok]
+        rows, cols, vals = self._cooccurrences(ids)
+        if len(rows) == 0:
+            raise ValueError("no co-occurrences — corpus too small")
+
+        V, D = self.vocab.num_words(), self.layer_size
+        key = jax.random.PRNGKey(self.seed)
+        ks = jax.random.split(key, 4)
+        scale = 0.5 / D
+        params = {
+            "W": jax.random.uniform(ks[0], (V, D), jnp.float32, -scale, scale),
+            "Wc": jax.random.uniform(ks[1], (V, D), jnp.float32, -scale, scale),
+            "b": jnp.zeros((V,), jnp.float32),
+            "bc": jnp.zeros((V,), jnp.float32),
+        }
+        # AdaGrad history, initialised at 1.0 like the reference's
+        # (and the original C implementation's) gradsq tables
+        hist = jax.tree_util.tree_map(jnp.ones_like, params)
+        lr = self.learning_rate
+
+        def loss_fn(p, i, j, logx, f):
+            pred = (jnp.einsum("bd,bd->b", p["W"][i], p["Wc"][j])
+                    + p["b"][i] + p["bc"][j])
+            return jnp.sum(f * jnp.square(pred - logx))
+
+        @jax.jit
+        def step(params, hist, i, j, logx, f):
+            loss, g = jax.value_and_grad(loss_fn)(params, i, j, logx, f)
+            hist = jax.tree_util.tree_map(lambda h, gr: h + gr * gr, hist, g)
+            params = jax.tree_util.tree_map(
+                lambda p, gr, h: p - lr * gr / jnp.sqrt(h), params, g, hist)
+            return params, hist, loss
+
+        logx = np.log(vals).astype(np.float32)
+        f = np.minimum(1.0, (vals / self.x_max) ** self.alpha).astype(np.float32)
+        n = len(rows)
+        bs = min(self.batch_size, n)
+        rng = np.random.default_rng(self.seed)
+        last = 0.0
+        for _ in range(self.epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n, bs):
+                idx = perm[s:s + bs]
+                if len(idx) < bs:     # pad final batch with zero-weight rows
+                    pad = rng.integers(0, n, bs - len(idx))
+                    fb = np.concatenate([f[idx], np.zeros(len(pad), np.float32)])
+                    idx = np.concatenate([idx, pad])
+                else:
+                    fb = f[idx]
+                params, hist, last = step(
+                    params, hist, jnp.asarray(rows[idx]),
+                    jnp.asarray(cols[idx]), jnp.asarray(logx[idx]),
+                    jnp.asarray(fb))
+        self.syn0 = np.asarray(params["W"] + params["Wc"])
+        self._last_loss = float(last)
+        return self
+
+    # ------------------------------------------------- co-occurrence pass
+    def _cooccurrences(self, ids: List[np.ndarray]
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Window-weighted counts X_ij += 1/distance (reference
+        CoOccurrenceReader semantics; symmetric adds both directions)."""
+        counts: Dict[Tuple[int, int], float] = {}
+        for sent in ids:
+            sent = sent[sent > 0]                       # drop UNK
+            L = len(sent)
+            for i in range(L):
+                wi = int(sent[i])
+                for d in range(1, self.window_size + 1):
+                    j = i - d
+                    if j < 0:
+                        break
+                    wj = int(sent[j])
+                    w = 1.0 / d
+                    counts[(wi, wj)] = counts.get((wi, wj), 0.0) + w
+                    if self.symmetric:
+                        counts[(wj, wi)] = counts.get((wj, wi), 0.0) + w
+        if not counts:
+            return (np.empty(0, np.int32),) * 2 + (np.empty(0, np.float32),)
+        keys = np.asarray(list(counts.keys()), np.int32)
+        vals = np.asarray(list(counts.values()), np.float32)
+        return keys[:, 0], keys[:, 1], vals

@@ -1,0 +1,49 @@
+"""SequenceVectors — parity with DL4J's
+``org.deeplearning4j.models.sequencevectors.SequenceVectors`` (the generic
+"embed any sequence of elements" abstraction that Word2Vec, ParagraphVectors
+and DeepWalk all specialise upstream).
+
+Here the specialisation runs the other way round for implementation reuse —
+``SequenceVectors`` feeds pre-tokenised element sequences straight into the
+shared SGNS trainer (`Word2Vec._fit_tokens`): elements are arbitrary
+hashables keyed by ``str(element)``, there is no tokenizer and no
+frequent-element subsampling by default, exactly like the upstream base
+class configured with a custom ``SequenceIterator``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Hashable, Iterable, List, Sequence
+
+import numpy as np
+
+from .word2vec import Word2Vec
+
+
+@dataclass
+class SequenceVectors(Word2Vec):
+    """Skip-gram/NS embeddings over sequences of arbitrary elements."""
+
+    min_word_frequency: int = 1   # upstream minElementFrequency
+    subsample: float = 0.0        # elements usually aren't Zipfian words
+
+    def fit(self, sequences: Iterable[Sequence[Hashable]]):
+        tok = [[str(e) for e in seq] for seq in sequences]
+        return self._fit_tokens(tok)
+
+    # element-named surface (upstream SequenceVectors API)
+    def has_element(self, element: Hashable) -> bool:
+        return self.has_word(str(element))
+
+    def element_vector(self, element: Hashable) -> np.ndarray:
+        return self.get_word_vector(str(element))
+
+    def element_frequency(self, element: Hashable) -> int:
+        return self.vocab.word_frequency(str(element))
+
+    def similarity_elements(self, a: Hashable, b: Hashable) -> float:
+        return self.similarity(str(a), str(b))
+
+    def elements_nearest(self, element: Hashable, top_n: int = 10) -> List[str]:
+        return self.words_nearest(str(element), top_n=top_n)

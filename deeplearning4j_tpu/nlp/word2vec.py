@@ -71,8 +71,13 @@ class Word2Vec:
 
     # ------------------------------------------------------------------ fit
     def fit(self, sentences: Iterable[str]):
-        sentences = list(sentences)
-        tok = [self.tokenizer_factory.create(s).get_tokens() for s in sentences]
+        tok = [self.tokenizer_factory.create(s).get_tokens()
+               for s in sentences]
+        return self._fit_tokens(tok)
+
+    def _fit_tokens(self, tok: List[List[str]]):
+        """Train from pre-tokenized element sequences — the entry point
+        SequenceVectors (the upstream parent abstraction) uses directly."""
         self.vocab = VocabCache(self.min_word_frequency).fit(tok)
         ids = [self.vocab.encode(t) for t in tok]
 

@@ -1,12 +1,12 @@
-"""Advantage Actor-Critic — parity with RL4J's
-``org.deeplearning4j.rl4j.learning.async.a3c.discrete.A3CDiscrete``.
+"""Advantage Actor-Critic, synchronous — parity with RL4J's
+``AdvantageActorCritic`` update rule run as the single-learner (sync)
+variant; the async Hogwild learner lives in :mod:`.a3c`.
 
-TPU-first redesign of A3C's async CPU threads: instead of K Hogwild
-actor threads each stepping its own Java env, K envs are a single
-``vmap``-vectorised batch stepped inside ``lax.scan`` — the whole
-n-step rollout AND the policy/value/entropy update is one XLA program
-per iteration. Same estimator (n-step returns, advantage baseline,
-entropy bonus), deterministic instead of asynchronously stale.
+TPU-first shape: K envs are a single ``vmap``-vectorised batch stepped
+inside ``lax.scan`` — the whole n-step rollout AND the
+policy/value/entropy update is one XLA program per iteration. Same
+estimator as the reference (n-step returns, advantage baseline, entropy
+bonus), shared with A3C via :mod:`.actor_critic`.
 """
 
 from __future__ import annotations
@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .actor_critic import (DiscretePolicyMixin, actor_critic_loss,
+                           make_rollout, nstep_returns)
 from .env import cartpole_init, cartpole_step
 from .networks import build_actor_critic
 
@@ -35,7 +37,7 @@ class A2CConfiguration:
     hidden: Sequence[int] = (64, 64)
 
 
-class A2C:
+class A2C(DiscretePolicyMixin):
     """A2C over the vectorised on-device cartpole (or any pure env pair)."""
 
     def __init__(self, config: A2CConfiguration = None,
@@ -51,44 +53,16 @@ class A2C:
         self._opt_state = self._opt.init(self.params)
 
         ac_fn, opt = self._ac_fn, self._opt
-        N, T, gamma = cfg.n_envs, cfg.rollout_length, cfg.gamma
-
-        def rollout(params, states, key):
-            """lax.scan over T steps of N vmapped envs → trajectory batch."""
-            def body(carry, _):
-                states, key = carry
-                akey, rkey, key = jax.random.split(key, 3)
-                logits, _ = ac_fn(params, states)
-                actions = jax.random.categorical(akey, logits)         # (N,)
-                nxt, rew, done = jax.vmap(env_step)(states, actions)
-                fresh = jax.vmap(env_init)(jax.random.split(rkey, N))
-                nxt = jnp.where(done[:, None], fresh, nxt)
-                out = (states, actions, rew, done.astype(jnp.float32))
-                return (nxt, key), out
-            (states, key), traj = jax.lax.scan(body, (states, key), None, length=T)
-            return states, key, traj
-
-        def loss_fn(params, obs, actions, returns):
-            logits, values = ac_fn(params, obs)                        # (T*N, ...)
-            logp = jax.nn.log_softmax(logits)
-            logp_a = jnp.take_along_axis(logp, actions[:, None], 1)[:, 0]
-            adv = returns - values
-            policy_loss = -(jax.lax.stop_gradient(adv) * logp_a).mean()
-            value_loss = jnp.square(adv).mean()
-            entropy = -(jnp.exp(logp) * logp).sum(axis=1).mean()
-            return (policy_loss + cfg.value_coef * value_loss
-                    - cfg.entropy_coef * entropy), entropy
+        N, T = cfg.n_envs, cfg.rollout_length
+        rollout = make_rollout(ac_fn, env_step, env_init, N, T)
+        loss_fn = actor_critic_loss(ac_fn, cfg.value_coef, cfg.entropy_coef)
 
         @jax.jit
         def iteration(params, opt_state, states, key):
             states, key, (obs, actions, rew, done) = rollout(
                 params, states, key)
-            _, boot = ac_fn(params, states)                            # V(s_T)
-            def disc(carry, xs):
-                r, d, = xs
-                g = r + gamma * (1.0 - d) * carry
-                return g, g
-            _, returns = jax.lax.scan(disc, boot, (rew, done), reverse=True)
+            _, boot = ac_fn(params, states)                        # V(s_T)
+            returns = nstep_returns(cfg.gamma, boot, rew, done)
             flat = lambda a: a.reshape((T * N,) + a.shape[2:])
             (loss, ent), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, flat(obs), flat(actions), flat(returns))
@@ -111,19 +85,3 @@ class A2C:
                 self._iteration(self.params, self._opt_state, states, self._key)
             dones.append(float(d))
         return dones
-
-    def act(self, obs, greedy: bool = True) -> int:
-        logits, _ = self._ac_fn(self.params, jnp.asarray(obs)[None, :])
-        if greedy:
-            return int(jnp.argmax(logits[0]))
-        self._key, sub = jax.random.split(self._key)
-        return int(jax.random.categorical(sub, logits[0]))
-
-    def play(self, env, max_steps: int = 500) -> float:
-        obs = env.reset()
-        total, done, t = 0.0, False, 0
-        while not done and t < max_steps:
-            obs, r, done, _ = env.step(self.act(obs))
-            total += r
-            t += 1
-        return total

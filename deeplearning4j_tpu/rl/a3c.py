@@ -21,8 +21,8 @@ XLA program per iteration:
    as its next local copy (the reference's post-push sync), so worker 0
    runs the next rollout one-to-W updates staler than worker W-1.
 
-Same estimator as the reference: n-step bootstrapped returns, advantage
-baseline, entropy bonus, global-norm clipping.
+The rollout/returns/loss estimator is shared with the synchronous A2C
+via :mod:`.actor_critic`.
 """
 
 from __future__ import annotations
@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .actor_critic import (DiscretePolicyMixin, actor_critic_loss,
+                           make_rollout, nstep_returns)
 from .env import cartpole_init, cartpole_step
 from .networks import build_actor_critic
 
@@ -52,7 +54,7 @@ class A3CConfiguration:
     hidden: Sequence[int] = (64, 64)
 
 
-class A3C:
+class A3C(DiscretePolicyMixin):
     """A3CDiscrete analogue: Hogwild workers as a vmapped+scanned XLA program."""
 
     def __init__(self, config: A3CConfiguration = None,
@@ -73,44 +75,20 @@ class A3C:
 
         ac_fn, opt = self._ac_fn, self._opt
         W, E, T = cfg.n_workers, cfg.n_envs_per_worker, cfg.rollout_length
-        gamma = cfg.gamma
+        rollout = make_rollout(ac_fn, env_step, env_init, E, T)
+        loss_fn = actor_critic_loss(ac_fn, cfg.value_coef, cfg.entropy_coef)
 
         def worker_grad(local_params, states, key):
             """One worker: nStep rollout on its own envs with its own stale
             params → (gradient, done count, final env states)."""
-            def body(carry, _):
-                states, key = carry
-                akey, rkey, key = jax.random.split(key, 3)
-                logits, _ = ac_fn(local_params, states)
-                actions = jax.random.categorical(akey, logits)     # (E,)
-                nxt, rew, done = jax.vmap(env_step)(states, actions)
-                fresh = jax.vmap(env_init)(jax.random.split(rkey, E))
-                nxt = jnp.where(done[:, None], fresh, nxt)
-                return (nxt, key), (states, actions, rew,
-                                    done.astype(jnp.float32))
-            (states, key), (obs, actions, rew, done) = jax.lax.scan(
-                body, (states, key), None, length=T)
+            states, key, (obs, actions, rew, done) = rollout(
+                local_params, states, key)
             _, boot = ac_fn(local_params, states)                  # V(s_T)
-
-            def disc(carry, xs):
-                r, d = xs
-                g = r + gamma * (1.0 - d) * carry
-                return g, g
-            _, returns = jax.lax.scan(disc, boot, (rew, done), reverse=True)
+            returns = nstep_returns(cfg.gamma, boot, rew, done)
             flat = lambda a: a.reshape((T * E,) + a.shape[2:])
-
-            def loss_fn(p):
-                logits, values = ac_fn(p, flat(obs))
-                logp = jax.nn.log_softmax(logits)
-                logp_a = jnp.take_along_axis(
-                    logp, flat(actions)[:, None], 1)[:, 0]
-                adv = flat(returns) - values
-                policy_loss = -(jax.lax.stop_gradient(adv) * logp_a).mean()
-                value_loss = jnp.square(adv).mean()
-                entropy = -(jnp.exp(logp) * logp).sum(axis=1).mean()
-                return (policy_loss + cfg.value_coef * value_loss
-                        - cfg.entropy_coef * entropy)
-            grads = jax.grad(loss_fn)(local_params)
+            grads = jax.grad(
+                lambda p: loss_fn(p, flat(obs), flat(actions),
+                                  flat(returns))[0])(local_params)
             return grads, done.sum(), states
 
         @jax.jit
@@ -151,22 +129,6 @@ class A3C:
                 self.params, self._opt_state, self._locals, states, self._key)
             dones.append(float(d))
         return dones
-
-    def act(self, obs, greedy: bool = True) -> int:
-        logits, _ = self._ac_fn(self.params, jnp.asarray(obs)[None, :])
-        if greedy:
-            return int(jnp.argmax(logits[0]))
-        self._key, sub = jax.random.split(self._key)
-        return int(jax.random.categorical(sub, logits[0]))
-
-    def play(self, env, max_steps: int = 500) -> float:
-        obs = env.reset()
-        total, done, t = 0.0, False, 0
-        while not done and t < max_steps:
-            obs, r, done, _ = env.step(self.act(obs))
-            total += r
-            t += 1
-        return total
 
 
 A3CDiscrete = A3C  # reference class-name alias

@@ -1,0 +1,81 @@
+"""Shared actor-critic machinery for A2C (synchronous) and A3C (Hogwild).
+
+The reference splits this the same way: RL4J's ``AdvantageActorCritic``
+update rule + ``ActorCriticPolicy`` play surface are shared between the
+sync and async learners. Here that shared core is three pure builders —
+the vmapped-env n-step rollout, the bootstrapped discounted returns, and
+the policy/value/entropy loss — plus the greedy/sampling play mixin.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_rollout(ac_fn, env_step, env_init, n_envs: int, length: int):
+    """(params, states, key) → (states, key, (obs, actions, rew, done)):
+    ``lax.scan`` over `length` steps of `n_envs` vmapped envs, sampling
+    actions from the policy and auto-resetting finished envs."""
+    def rollout(params, states, key):
+        def body(carry, _):
+            states, key = carry
+            akey, rkey, key = jax.random.split(key, 3)
+            logits, _ = ac_fn(params, states)
+            actions = jax.random.categorical(akey, logits)       # (n_envs,)
+            nxt, rew, done = jax.vmap(env_step)(states, actions)
+            fresh = jax.vmap(env_init)(jax.random.split(rkey, n_envs))
+            nxt = jnp.where(done[:, None], fresh, nxt)
+            out = (states, actions, rew, done.astype(jnp.float32))
+            return (nxt, key), out
+        (states, key), traj = jax.lax.scan(body, (states, key), None,
+                                           length=length)
+        return states, key, traj
+    return rollout
+
+
+def nstep_returns(gamma: float, bootstrap, rew, done):
+    """Backward scan of n-step bootstrapped returns; `done` truncates."""
+    def disc(carry, xs):
+        r, d = xs
+        g = r + gamma * (1.0 - d) * carry
+        return g, g
+    _, returns = jax.lax.scan(disc, bootstrap, (rew, done), reverse=True)
+    return returns
+
+
+def actor_critic_loss(ac_fn, value_coef: float, entropy_coef: float):
+    """(params, obs, actions, returns) → (loss, entropy): policy gradient
+    with advantage baseline, value regression, entropy bonus."""
+    def loss_fn(params, obs, actions, returns):
+        logits, values = ac_fn(params, obs)
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, actions[:, None], 1)[:, 0]
+        adv = returns - values
+        policy_loss = -(jax.lax.stop_gradient(adv) * logp_a).mean()
+        value_loss = jnp.square(adv).mean()
+        entropy = -(jnp.exp(logp) * logp).sum(axis=1).mean()
+        return (policy_loss + value_coef * value_loss
+                - entropy_coef * entropy), entropy
+    return loss_fn
+
+
+class DiscretePolicyMixin:
+    """act()/play() surface (reference ACPolicy): greedy or sampled action
+    from `self.params` via `self._ac_fn`, episode playout on a host env."""
+
+    def act(self, obs, greedy: bool = True) -> int:
+        logits, _ = self._ac_fn(self.params, jnp.asarray(obs)[None, :])
+        if greedy:
+            return int(jnp.argmax(logits[0]))
+        self._key, sub = jax.random.split(self._key)
+        return int(jax.random.categorical(sub, logits[0]))
+
+    def play(self, env, max_steps: int = 500) -> float:
+        obs = env.reset()
+        total, done, t = 0.0, False, 0
+        while not done and t < max_steps:
+            obs, r, done, _ = env.step(self.act(obs))
+            total += r
+            t += 1
+        return total

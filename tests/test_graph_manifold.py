@@ -1,0 +1,88 @@
+"""deeplearning4j-graph + deeplearning4j-manifold parity tests:
+Graph/random walks, DeepWalk community structure, exact t-SNE cluster
+separation and KL health.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import DeepWalk, Graph, random_walks
+from deeplearning4j_tpu.manifold import TSNE, BarnesHutTsne
+
+
+def _two_cliques(k=6):
+    """Two k-cliques joined by a single bridge edge: 0..k-1 and k..2k-1."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    g.add_edge(k - 1, k)  # bridge
+    return g
+
+
+def test_graph_structure_and_walks():
+    g = _two_cliques(4)
+    assert g.n_vertices == 8
+    assert g.degree(0) == 3 and g.degree(3) == 4      # 3 is the bridge vertex
+    assert g.num_edges() == 2 * 6 + 1
+    assert set(g.neighbors(0)) == {1, 2, 3}
+    with pytest.raises(ValueError):
+        g.add_edge(0, 99)
+
+    walks = random_walks(g, walk_length=10, walks_per_vertex=3, seed=0)
+    assert walks.shape == (24, 10) and walks.dtype == np.int32
+    assert walks.min() >= 0 and walks.max() < 8
+    # every step is along an edge (or a self-loop only for isolated vertices)
+    for w in walks:
+        for a, b in zip(w, w[1:]):
+            assert b in g.neighbors(a)
+
+    # isolated vertex: walk self-loops instead of crashing
+    iso = Graph(3, edges=[(0, 1)])
+    w = random_walks(iso, walk_length=5, starts=[2], seed=1)
+    assert (w == 2).all()
+
+
+def test_deepwalk_finds_communities():
+    g = _two_cliques(6)
+    dw = DeepWalk(layer_size=16, window_size=4, walk_length=20,
+                  walks_per_vertex=30, epochs=8, batch_size=512,
+                  learning_rate=0.05, seed=0).fit(g)
+    assert dw.vertex_vector(0).shape == (16,)
+    # in-clique similarity beats cross-clique for interior vertices
+    # (vertices away from the bridge; 0..4 vs 7..11)
+    in_c = np.mean([dw.similarity(0, j) for j in (1, 2, 3)])
+    cross = np.mean([dw.similarity(0, j) for j in (8, 9, 10)])
+    # cosine dissimilarity across the bridge must dominate in-clique
+    assert (1.0 - cross) > 3.0 * (1.0 - in_c), (in_c, cross)
+    near = dw.verts_nearest(1, top_n=4)
+    assert sum(v < 6 for v in near) >= 3
+
+
+def test_tsne_separates_clusters():
+    rng = np.random.default_rng(0)
+    centers = np.asarray([[8.0] + [0.0] * 9,
+                          [0.0] * 9 + [8.0],
+                          [0.0, 8.0] + [0.0] * 8])
+    x = np.concatenate([c + rng.standard_normal((40, 10)) for c in centers])
+    labels = np.repeat(np.arange(3), 40)
+
+    ts = TSNE(n_components=2, perplexity=15, n_iter=400, seed=0)
+    y = ts.fit_transform(x.astype(np.float32))
+    assert y.shape == (120, 2) and np.isfinite(y).all()
+    assert np.isfinite(ts.kl_divergence_) and ts.kl_divergence_ < 1.5
+
+    # intra-cluster spread is much tighter than inter-cluster separation
+    cents = np.stack([y[labels == c].mean(0) for c in range(3)])
+    intra = np.mean([np.linalg.norm(y[labels == c] - cents[c], axis=1).mean()
+                     for c in range(3)])
+    inter = np.mean([np.linalg.norm(cents[i] - cents[j])
+                     for i in range(3) for j in range(i + 1, 3)])
+    assert inter > 3.0 * intra, (intra, inter)
+
+
+def test_tsne_reference_alias_and_validation():
+    assert BarnesHutTsne is TSNE
+    with pytest.raises(ValueError):
+        TSNE().fit_transform(np.zeros((2, 5), np.float32))

@@ -86,6 +86,41 @@ def test_word2vec_save_load(tmp_path):
 
 
 @pytest.mark.slow
+def test_glove_learns_cooccurrence():
+    from deeplearning4j_tpu.nlp import GloVe
+    g = GloVe(layer_size=24, window_size=3, min_word_frequency=5,
+              epochs=120, x_max=20.0, learning_rate=0.05,
+              seed=5).fit(_toy_corpus())
+    assert g.has_word("sun") and g.has_word("moon")
+    assert g.similarity("sun", "morning") > g.similarity("sun", "stars")
+    assert g.similarity("moon", "dark") > g.similarity("moon", "bright")
+    near = g.words_nearest("night", top_n=4)
+    assert any(w in near for w in ("moon", "dark", "evening", "stars"))
+
+
+def test_sequence_vectors_generic_elements():
+    """SequenceVectors embeds arbitrary hashables — here int SKUs whose
+    sequences come in two disjoint 'baskets' (upstream's canonical non-word
+    use case)."""
+    from deeplearning4j_tpu.nlp import SequenceVectors
+    rng = np.random.default_rng(1)
+    group_a, group_b = [10, 11, 12, 13], [20, 21, 22, 23]
+    seqs = []
+    for _ in range(150):
+        seqs.append(list(rng.permutation(group_a)))
+        seqs.append(list(rng.permutation(group_b)))
+    sv = SequenceVectors(layer_size=16, window_size=3, negative=4,
+                         epochs=40, batch_size=256, learning_rate=0.08,
+                         seed=2).fit(seqs)
+    assert sv.has_element(10) and sv.has_element(23)
+    assert sv.element_frequency(10) == 150
+    assert (sv.similarity_elements(10, 11)
+            > sv.similarity_elements(10, 21))
+    near = sv.elements_nearest(20, top_n=3)
+    assert any(e in near for e in ("21", "22", "23"))
+
+
+@pytest.mark.slow
 def test_paragraph_vectors_infer():
     docs = (["the cat sat on the mat with another cat"] * 10
             + ["stocks market trading profit finance money"] * 10)
