@@ -11,3 +11,10 @@ from .iterators import (ArrayDataSetIterator, BaseDatasetIterator,
                         ListDataSetIterator, MnistDataSetIterator,
                         MultipleEpochsIterator, RandomDataSetIterator,
                         make_synthetic_mnist)
+from .sequence_readers import (ALIGN_END, ALIGN_START, EQUAL_LENGTH,
+                               CollectionSequenceRecordReader,
+                               CSVLineSequenceRecordReader,
+                               CSVSequenceRecordReader,
+                               RegexSequenceRecordReader,
+                               SequenceRecordReader,
+                               SequenceRecordReaderDataSetIterator)

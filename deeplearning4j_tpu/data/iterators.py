@@ -217,6 +217,25 @@ def _load_idx(path: Path) -> Optional[np.ndarray]:
         return None
 
 
+def _load_idx_image_dataset(image_stem: Path, label_stem: Path, n: int,
+                            n_classes: int, label_offset: int = 0):
+    """Shared MNIST/EMNIST idx loading: (N,28,28,1) float [0,1] + one-hot.
+    Tries bare and .gz filenames; returns (None, None) when absent."""
+    for suffix in ("", ".gz"):
+        fi = Path(str(image_stem) + suffix)
+        fl = Path(str(label_stem) + suffix)
+        if fi.exists() and fl.exists():
+            imgs = _load_idx(fi)
+            labels = _load_idx(fl)
+            if imgs is not None and labels is not None:
+                imgs = (imgs[:n].astype(np.float32) / 255.0)[..., None]
+                labels = labels[:n].astype(int) - label_offset
+                onehot = np.zeros((len(labels), n_classes), np.float32)
+                onehot[np.arange(len(labels)), labels] = 1.0
+                return imgs, onehot
+    return None, None
+
+
 class MnistDataSetIterator(BaseDatasetIterator):
     """Reference MnistDataSetIterator: (B,28,28,1) NHWC in [0,1], 10-class
     one-hot. Real IDX files used when present; else procedural digits."""
@@ -230,7 +249,7 @@ class MnistDataSetIterator(BaseDatasetIterator):
         n = num_examples or n_default
         imgs, labels = self._load_real(train, n)
         if imgs is None:
-            imgs, labels = make_synthetic_mnist(n, seed=seed + (0 if train else 10**6))
+            imgs, labels = self._synthetic(n, seed + (0 if train else 10**6))
         if binarize:
             imgs = (imgs > 0.5).astype(np.float32)
         if shuffle:
@@ -241,22 +260,15 @@ class MnistDataSetIterator(BaseDatasetIterator):
             imgs = imgs.reshape(len(imgs), -1)
         self._features, self._labels = imgs, labels
 
-    @staticmethod
-    def _load_real(train: bool, n: int):
+    def _synthetic(self, n, seed):
+        return make_synthetic_mnist(n, seed=seed)
+
+    def _load_real(self, train: bool, n: int):
         base = DATA_HOME / "mnist"
         stem = "train" if train else "t10k"
-        for suffix in ("", ".gz"):
-            fi = base / f"{stem}-images-idx3-ubyte{suffix}"
-            fl = base / f"{stem}-labels-idx1-ubyte{suffix}"
-            if fi.exists() and fl.exists():
-                imgs = _load_idx(fi)
-                labels = _load_idx(fl)
-                if imgs is not None and labels is not None:
-                    imgs = (imgs[:n].astype(np.float32) / 255.0)[..., None]
-                    onehot = np.zeros((len(labels[:n]), 10), np.float32)
-                    onehot[np.arange(len(labels[:n])), labels[:n]] = 1.0
-                    return imgs, onehot
-        return None, None
+        return _load_idx_image_dataset(base / f"{stem}-images-idx3-ubyte",
+                                       base / f"{stem}-labels-idx1-ubyte",
+                                       n, 10)
 
     def total_examples(self):
         return len(self._features)
@@ -269,7 +281,47 @@ class MnistDataSetIterator(BaseDatasetIterator):
 
 
 class EmnistDataSetIterator(MnistDataSetIterator):
-    """EMNIST analogue; falls back to the same procedural digits (digits split)."""
+    """Reference EmnistDataSetIterator with its Set splits. Real idx files
+    (``~/.deeplearning4j_tpu/emnist/emnist-<split>-<train|test>-images-idx3-
+    ubyte[.gz]``, the NIST naming) when present; else procedural glyphs
+    (digit shape + deterministic per-class roll so classes >= 10 stay
+    separable)."""
+
+    NUM_CLASSES = {"complete": 62, "byclass": 62, "bymerge": 47,
+                   "balanced": 47, "letters": 26, "digits": 10, "mnist": 10}
+
+    def __init__(self, batch_size: int, split: str = "digits",
+                 train: bool = True, **kw):
+        if split not in self.NUM_CLASSES:
+            raise ValueError(f"unknown EMNIST split {split!r}; "
+                             f"one of {sorted(self.NUM_CLASSES)}")
+        self.split = split
+        self.n_classes = self.NUM_CLASSES[split]
+        super().__init__(batch_size, train=train, **kw)
+
+    def _load_real(self, train, n):
+        base = DATA_HOME / "emnist"
+        stem = "train" if train else "test"
+        return _load_idx_image_dataset(
+            base / f"emnist-{self.split}-{stem}-images-idx3-ubyte",
+            base / f"emnist-{self.split}-{stem}-labels-idx1-ubyte",
+            n, self.n_classes,
+            # the NIST letters files are 1-indexed (a=1) — keyed on the
+            # split, not on the observed label range (deterministic)
+            label_offset=1 if self.split == "letters" else 0)
+
+    def _synthetic(self, n, seed):
+        rng = np.random.default_rng(seed)
+        cls = rng.integers(0, self.n_classes, size=n)
+        imgs = np.stack([np.roll(_render_digit(int(c) % 10, rng),
+                                 3 * (int(c) // 10), axis=0)
+                         for c in cls])[..., None]
+        labels = np.zeros((n, self.n_classes), np.float32)
+        labels[np.arange(n), cls] = 1.0
+        return imgs, labels
+
+    def total_outcomes(self):
+        return self.n_classes
 
 
 class IrisDataSetIterator(BaseDatasetIterator):

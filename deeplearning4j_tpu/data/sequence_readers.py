@@ -1,0 +1,231 @@
+"""Sequence record readers — parity with DataVec's
+``org.datavec.api.records.reader.impl.csv.CSVSequenceRecordReader`` /
+``CSVLineSequenceRecordReader`` / ``regex.RegexSequenceRecordReader`` and
+the bridge ``org.deeplearning4j.datasets.datavec.
+SequenceRecordReaderDataSetIterator`` (alignment modes, masking).
+
+A sequence record is ``List[List[value]]`` — time steps of column values.
+The bridge pads ragged sequences to the batch max and emits (B, T, C)
+features + masks, which is exactly what the recurrent layers consume; on
+TPU padded-dense + mask beats ragged host-side batching (static shapes →
+one compiled program per bucket).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import re
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .dataset import DataSet
+from .iterators import BaseDatasetIterator
+
+
+class SequenceRecordReader:
+    """Iterable of sequences; each sequence is a list of time-step rows."""
+
+    def __iter__(self) -> Iterable[List[List[float]]]:  # pragma: no cover
+        raise NotImplementedError
+
+    def reset(self):
+        return self
+
+
+class CollectionSequenceRecordReader(SequenceRecordReader):
+    """In-memory sequences (reference CollectionSequenceRecordReader)."""
+
+    def __init__(self, sequences: Sequence[Sequence[Sequence[float]]]):
+        self._seqs = [[list(step) for step in seq] for seq in sequences]
+
+    def __iter__(self):
+        return iter(self._seqs)
+
+
+def _resolve_paths(source: Union[str, Sequence[str]]) -> List[Path]:
+    """str = glob pattern or directory (sorted for determinism); list = as-is."""
+    if isinstance(source, (list, tuple)):
+        return [Path(p) for p in source]
+    p = Path(source)
+    if p.is_dir():
+        return sorted(q for q in p.iterdir() if q.is_file())
+    return [Path(q) for q in sorted(_glob.glob(str(source)))]
+
+
+def _parse_value(v: str) -> float:
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"non-numeric value {v!r} in sequence file "
+                         "(apply a TransformProcess for categorical data)")
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """One CSV file per sequence, rows = time steps (reference
+    CSVSequenceRecordReader(skipLines, delimiter))."""
+
+    def __init__(self, source: Union[str, Sequence[str]], skip_lines: int = 0,
+                 delimiter: str = ","):
+        self.paths = _resolve_paths(source)
+        if not self.paths:
+            raise ValueError(f"no sequence files match {source!r}")
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        for path in self.paths:
+            lines = path.read_text().splitlines()[self.skip_lines:]
+            seq = [[_parse_value(v) for v in ln.split(self.delimiter)]
+                   for ln in lines if ln.strip()]
+            if not seq:
+                # dropping it would silently MISPAIR parallel feature/label
+                # file sets in two-reader mode
+                raise ValueError(f"empty sequence file: {path}")
+            yield seq
+
+
+class CSVLineSequenceRecordReader(SequenceRecordReader):
+    """Each LINE of one CSV file is a whole univariate sequence: the line's
+    values become T single-column time steps (reference
+    CSVLineSequenceRecordReader)."""
+
+    def __init__(self, path: str, skip_lines: int = 0, delimiter: str = ","):
+        self.path = Path(path)
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        lines = self.path.read_text().splitlines()[self.skip_lines:]
+        for ln in lines:
+            if ln.strip():
+                yield [[_parse_value(v)] for v in ln.split(self.delimiter)]
+
+
+class RegexSequenceRecordReader(SequenceRecordReader):
+    """One file per sequence; each line parsed by a regex whose capture
+    groups become the step's columns (reference RegexSequenceRecordReader).
+    Lines that don't match raise — silent row drops hide data bugs."""
+
+    def __init__(self, source: Union[str, Sequence[str]], regex: str):
+        self.paths = _resolve_paths(source)
+        if not self.paths:
+            raise ValueError(f"no sequence files match {source!r}")
+        self.pattern = re.compile(regex)
+
+    def __iter__(self):
+        for path in self.paths:
+            seq = []
+            for i, ln in enumerate(path.read_text().splitlines()):
+                if not ln.strip():
+                    continue
+                m = self.pattern.match(ln)
+                if m is None:
+                    raise ValueError(
+                        f"{path}:{i + 1}: line does not match regex "
+                        f"{self.pattern.pattern!r}: {ln!r}")
+                seq.append([_parse_value(g) for g in m.groups()])
+            if not seq:
+                raise ValueError(f"empty sequence file: {path}")
+            yield seq
+
+
+# ------------------------------------------------ bridge → padded DataSets
+ALIGN_START = "ALIGN_START"
+ALIGN_END = "ALIGN_END"
+EQUAL_LENGTH = "EQUAL_LENGTH"
+
+
+class SequenceRecordReaderDataSetIterator(BaseDatasetIterator):
+    """Reference SequenceRecordReaderDataSetIterator.
+
+    Single-reader mode: ``label_index`` splits each step's row into
+    features and a per-step label (one-hot unless ``regression``).
+    Two-reader mode: separate feature/label readers, aligned per
+    ``alignment_mode`` (EQUAL_LENGTH asserts equal; ALIGN_START/END pad
+    the shorter stream's mask at the end/start — reference AlignmentMode).
+    Ragged sequences are padded to the longest in the SOURCE (static
+    shapes for jit) with 0/1 masks.
+    """
+
+    def __init__(self, reader: SequenceRecordReader, batch_size: int,
+                 num_classes: Optional[int] = None, label_index: int = -1,
+                 regression: bool = False,
+                 labels_reader: Optional[SequenceRecordReader] = None,
+                 alignment_mode: str = ALIGN_END):
+        super().__init__(batch_size)
+        feats, labels = [], []
+        if labels_reader is None:
+            for seq in reader:
+                rows = np.asarray(seq, np.float32)
+                li = label_index if label_index >= 0 \
+                    else rows.shape[1] + label_index
+                labels.append(rows[:, li])
+                feats.append(np.delete(rows, li, axis=1))
+        else:
+            fseqs = [np.asarray(s, np.float32) for s in reader]
+            lseqs = [np.asarray(s, np.float32) for s in labels_reader]
+            if len(fseqs) != len(lseqs):
+                raise ValueError(f"feature reader yielded {len(fseqs)} "
+                                 f"sequences, label reader {len(lseqs)}")
+            if alignment_mode == EQUAL_LENGTH:
+                for i, (f, l) in enumerate(zip(fseqs, lseqs)):
+                    if len(f) != len(l):
+                        raise ValueError(
+                            f"sequence {i}: feature length {len(f)} != label "
+                            f"length {len(l)} under EQUAL_LENGTH")
+            elif alignment_mode not in (ALIGN_START, ALIGN_END):
+                raise ValueError(f"unknown alignment mode {alignment_mode!r}")
+            feats, labels = fseqs, [l[:, 0] if l.ndim > 1 and l.shape[1] == 1
+                                    else l for l in lseqs]
+
+        n = len(feats)
+        if n == 0:
+            raise ValueError("sequence reader produced no sequences")
+        T = max(max(len(f) for f in feats), max(len(l) for l in labels))
+        C = feats[0].shape[1]
+        self._features = np.zeros((n, T, C), np.float32)
+        self._fmask = np.zeros((n, T), np.float32)
+        self._lmask = np.zeros((n, T), np.float32)
+
+        if regression:
+            lab_dim = (np.asarray(labels[0]).shape[1]
+                       if np.asarray(labels[0]).ndim > 1 else 1)
+        else:
+            if num_classes is None:
+                num_classes = int(max(np.max(l) for l in labels)) + 1
+            lab_dim = num_classes
+        self._labels = np.zeros((n, T, lab_dim), np.float32)
+
+        align_end = (labels_reader is not None and alignment_mode == ALIGN_END)
+        for i, (f, l) in enumerate(zip(feats, labels)):
+            # ALIGN_END aligns the LAST step of both streams to t = T-1
+            # (reference AlignmentMode.ALIGN_END) — whichever stream is
+            # shorter shifts right; ALIGN_START/single-reader start at 0
+            fo = T - len(f) if align_end else 0
+            self._features[i, fo:fo + len(f)] = f
+            self._fmask[i, fo:fo + len(f)] = 1.0
+            l = np.asarray(l)
+            lo = T - len(l) if align_end else 0
+            sl = slice(lo, lo + len(l))
+            if regression:
+                self._labels[i, sl] = l.reshape(len(l), lab_dim)
+            else:
+                li = l.astype(int)
+                if (li != l).any() or li.min() < 0 or li.max() >= lab_dim:
+                    raise ValueError(
+                        f"class labels must be integers in [0, {lab_dim}); "
+                        f"sequence {i} has range [{l.min()}, {l.max()}]")
+                self._labels[i, sl] = np.eye(lab_dim, dtype=np.float32)[li]
+            self._lmask[i, sl] = 1.0
+
+    def total_examples(self):
+        return len(self._features)
+
+    def total_outcomes(self):
+        return self._labels.shape[-1]
+
+    def _slice(self, lo, hi):
+        return DataSet(self._features[lo:hi], self._labels[lo:hi],
+                       self._fmask[lo:hi], self._lmask[lo:hi])
