@@ -1,6 +1,9 @@
 """Training UI (SURVEY §2.9): TensorBoard via nn.listeners.StatsListener,
-terminal dashboard via this package (`python -m deeplearning4j_tpu.ui`)."""
+terminal dashboard via this package (`python -m deeplearning4j_tpu.ui`),
+and the browser dashboard (`UIServer.get_instance()` — reference
+VertxUIServer; or `python -m deeplearning4j_tpu.ui --serve`)."""
 
 from .dashboard import load_stats, render, sparkline, watch
+from .server import UIServer
 
-__all__ = ["load_stats", "render", "sparkline", "watch"]
+__all__ = ["UIServer", "load_stats", "render", "sparkline", "watch"]

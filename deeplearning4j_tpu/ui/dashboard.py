@@ -120,8 +120,20 @@ def main(argv=None):
     ap.add_argument("log_dir", nargs="?", default="runs/dl4j_tpu")
     ap.add_argument("--watch", action="store_true")
     ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--serve", action="store_true",
+                    help="serve the browser dashboard instead")
+    ap.add_argument("--port", type=int, default=9000)
     args = ap.parse_args(argv)
-    if args.watch:
+    if args.serve:
+        from .server import UIServer
+        srv = UIServer(args.log_dir, args.port).start()
+        print(f"training UI at http://127.0.0.1:{srv.port}/ "
+              f"(stats: {args.log_dir}) — Ctrl-C to stop")
+        try:
+            srv._thread.join()
+        except KeyboardInterrupt:
+            srv.stop()
+    elif args.watch:
         watch(args.log_dir, args.interval)
     else:
         print(render(load_stats(args.log_dir)))

@@ -1,0 +1,199 @@
+"""Browser training UI — parity with DL4J's
+``org.deeplearning4j.ui.VertxUIServer`` / ``UIServer.getInstance()``
+(the live web dashboard fed by ``StatsListener``).
+
+Architecture mirrors the reference: the training process writes stats to
+a storage (here the StatsListener JSONL stream — the analogue of
+FileStatsStorage), and the UI server *attaches* to that storage and
+serves a browser view. The page is fully self-contained (inline
+JS/canvas, no external assets — works with zero egress) and polls the
+JSON endpoint, rendering the same charts the reference shows: score over
+iterations, learning rate, and the per-layer update:param ratio
+training-health chart.
+
+Endpoints:
+  GET /             the dashboard page
+  GET /train/stats  last-run records as JSON (FileStatsStorage read)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .dashboard import load_stats
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu training UI</title>
+<style>
+ body { font-family: -apple-system, 'Segoe UI', sans-serif; margin: 2em;
+        background: #fafafa; color: #222; }
+ h1 { font-size: 1.2em; } h2 { font-size: 1.0em; color: #444; }
+ .meta { color: #666; font-size: 0.9em; }
+ canvas { background: #fff; border: 1px solid #ddd; border-radius: 4px;
+          display: block; margin-bottom: 1.5em; }
+ .warn { color: #b00; }
+</style></head><body>
+<h1>deeplearning4j_tpu — training</h1>
+<div class="meta" id="meta">waiting for stats…</div>
+<h2>score</h2><canvas id="score" width="860" height="220"></canvas>
+<h2>learning rate</h2><canvas id="lr" width="860" height="120"></canvas>
+<h2>update : param ratios (healthy ≈ 1e-3)</h2>
+<canvas id="ratios" width="860" height="220"></canvas>
+<div class="meta" id="ratiolegend"></div>
+<script>
+const COLORS = ['#3366cc','#dc3912','#ff9900','#109618','#990099','#0099c6',
+                '#dd4477','#66aa00','#b82e2e','#316395'];
+function drawSeries(id, series, logY) {
+  const cv = document.getElementById(id), ctx = cv.getContext('2d');
+  ctx.clearRect(0, 0, cv.width, cv.height);
+  // min/max via reduce, hoisted out of tx/ty: spreading 100k+ points into
+  // Math.min(...) overflows the argument limit and O(n^2) kills long runs
+  const f = logY ? Math.log10 : (v => v);
+  let xlo = Infinity, xhi = -Infinity, lo = Infinity, hi = -Infinity, n = 0;
+  series.forEach(s => s.points.forEach(p => {
+    if (logY && p[1] <= 0) return;
+    xlo = Math.min(xlo, p[0]); xhi = Math.max(xhi, p[0]);
+    lo = Math.min(lo, f(p[1])); hi = Math.max(hi, f(p[1])); n++;
+  }));
+  if (!n) return;
+  const tx = v => 40 + (v - xlo) / Math.max(1e-12, xhi - xlo) *
+                  (cv.width - 60);
+  const ty = v => cv.height - 20 - (f(v) - lo) /
+                  Math.max(1e-12, hi - lo) * (cv.height - 40);
+  ctx.font = '11px sans-serif'; ctx.fillStyle = '#888';
+  ctx.fillText(logY ? ('1e' + hi.toFixed(1)) : hi.toPrecision(4), 2, 14);
+  ctx.fillText(logY ? ('1e' + lo.toFixed(1)) : lo.toPrecision(4), 2,
+               cv.height - 8);
+  series.forEach((s, i) => {
+    ctx.strokeStyle = COLORS[i % COLORS.length]; ctx.beginPath();
+    s.points.forEach((p, j) => {
+      if (logY && p[1] <= 0) return;
+      const x = tx(p[0]), y = ty(p[1]);
+      j ? ctx.lineTo(x, y) : ctx.moveTo(x, y);
+    });
+    ctx.stroke();
+  });
+}
+async function refresh() {
+  try {
+    const r = await fetch('/train/stats'); const data = await r.json();
+    const recs = data.records;
+    if (!recs.length) return;
+    const last = recs[recs.length - 1];
+    document.getElementById('meta').textContent =
+      `iter ${last.iter} · epoch ${last.epoch} · score ` +
+      `${last.score.toPrecision(5)} · ${recs.length} records`;
+    drawSeries('score',
+      [{points: recs.filter(r => 'score' in r).map(r => [r.iter, r.score])}],
+      false);
+    drawSeries('lr',
+      [{points: recs.filter(r => 'lr' in r).map(r => [r.iter, r.lr])}],
+      false);
+    const layers = [...new Set(recs.flatMap(
+      r => Object.keys(r.update_ratios || {})))];
+    drawSeries('ratios', layers.map(l => ({points:
+      recs.filter(r => r.update_ratios && l in r.update_ratios)
+          .map(r => [r.iter, r.update_ratios[l]])})), true);
+    document.getElementById('ratiolegend').innerHTML = layers.map((l, i) =>
+      `<span style="color:${COLORS[i % COLORS.length]}">■ ${l}</span>`
+    ).join(' &nbsp; ');
+  } catch (e) { /* server restarting; keep polling */ }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4j-tpu-ui/1.0"
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path == "/" or self.path == "/train" or self.path == "/index.html":
+            body = _PAGE.encode()
+            ctype = "text/html; charset=utf-8"
+        elif self.path.startswith("/train/stats"):
+            body = json.dumps(
+                {"records": load_stats(self.server.ui_log_dir)}).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silent: training logs own the console
+        pass
+
+
+class UIServer:
+    """Reference UIServer: ``UIServer.get_instance().attach(log_dir)`` then
+    browse http://localhost:<port>/ while training writes stats."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, log_dir: str = "runs/dl4j_tpu", port: int = 9000):
+        self.log_dir = log_dir
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None  # bound in start()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, log_dir: Optional[str] = None,
+                     port: Optional[int] = None) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(log_dir or "runs/dl4j_tpu",
+                                9000 if port is None else port).start()
+        else:
+            if port is not None and port != cls._instance.port:
+                raise ValueError(
+                    f"UI server already running on port "
+                    f"{cls._instance.port}; cannot move it to {port} "
+                    "(stop() it first)")
+            if log_dir is not None and log_dir != cls._instance.log_dir:
+                cls._instance.attach(log_dir)
+        return cls._instance
+
+    @property
+    def port(self) -> int:
+        return self._port if self._httpd is None \
+            else self._httpd.server_address[1]
+
+    def attach(self, log_dir: str) -> "UIServer":
+        """Point the server at a (new) StatsListener log dir — the analogue
+        of attaching a StatsStorage instance."""
+        self.log_dir = log_dir
+        if self._httpd is not None:
+            self._httpd.ui_log_dir = log_dir
+        return self
+
+    def start(self) -> "UIServer":
+        if self._thread is None:
+            # bind lazily: construction must neither hold the port nor raise
+            self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port),
+                                              _Handler)
+            self._httpd.ui_log_dir = self.log_dir
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="dl4j-tpu-ui",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            if self._thread is not None:
+                # shutdown() waits on a flag only serve_forever() sets —
+                # calling it on a never-started server deadlocks forever
+                self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if UIServer._instance is self:
+            UIServer._instance = None

@@ -85,6 +85,90 @@ def test_dashboard_cli_snapshot(tmp_path, capsys):
     assert "iter 1" in out and "score" in out
 
 
+def test_ui_server_serves_page_and_stats(tmp_path):
+    """Browser UI (reference VertxUIServer): page + JSON endpoint served
+    from a live StatsListener stream; attach() repoints storage."""
+    import urllib.request
+
+    from deeplearning4j_tpu.ui import UIServer
+
+    p = tmp_path / "stats.jsonl"
+    p.write_text(json.dumps({"iter": 1, "epoch": 0, "score": 0.9, "ts": 0.0,
+                             "lr": 1e-3,
+                             "update_ratios": {"layer_0": 2e-3}}) + "\n")
+    srv = UIServer(log_dir=str(tmp_path), port=0).start()   # ephemeral port
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        page = urllib.request.urlopen(f"{base}/", timeout=5).read().decode()
+        assert "deeplearning4j_tpu" in page and "<canvas" in page
+        assert "update : param" in page
+
+        stats = json.loads(urllib.request.urlopen(
+            f"{base}/train/stats", timeout=5).read())
+        assert stats["records"][0]["score"] == 0.9
+        assert stats["records"][0]["update_ratios"]["layer_0"] == 2e-3
+
+        # live updates: a new record appears on the next poll
+        with open(p, "a") as f:
+            f.write(json.dumps({"iter": 2, "epoch": 0, "score": 0.5,
+                                "ts": 1.0}) + "\n")
+        stats = json.loads(urllib.request.urlopen(
+            f"{base}/train/stats", timeout=5).read())
+        assert [r["iter"] for r in stats["records"]] == [1, 2]
+
+        # attach() switches storage like the reference's attach(statsStorage)
+        other = tmp_path / "other"
+        other.mkdir()
+        (other / "stats.jsonl").write_text(
+            json.dumps({"iter": 7, "epoch": 1, "score": 0.1, "ts": 2.0}) + "\n")
+        srv.attach(str(other))
+        stats = json.loads(urllib.request.urlopen(
+            f"{base}/train/stats", timeout=5).read())
+        assert [r["iter"] for r in stats["records"]] == [7]
+
+        # 404 for unknown paths
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        srv.stop()
+
+
+def test_ui_server_singleton(tmp_path):
+    from deeplearning4j_tpu.ui import UIServer
+    a = UIServer.get_instance(log_dir=str(tmp_path), port=0)
+    try:
+        assert UIServer.get_instance() is a
+        # a new log_dir re-attaches; a conflicting port refuses
+        other = tmp_path / "x"
+        other.mkdir()
+        assert UIServer.get_instance(log_dir=str(other)) is a
+        assert a.log_dir == str(other)
+        with pytest.raises(ValueError, match="already running"):
+            UIServer.get_instance(port=a.port + 1)
+    finally:
+        a.stop()
+    assert UIServer._instance is None
+
+
+def test_ui_server_stop_without_start_is_safe(tmp_path):
+    """stop() on a never-started server must not deadlock or leak a port;
+    construction must not bind the socket."""
+    import socket
+
+    from deeplearning4j_tpu.ui import UIServer
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    busy_port = sock.getsockname()[1]
+    try:
+        srv = UIServer(log_dir=str(tmp_path), port=busy_port)  # no raise
+        srv.stop()                                             # no deadlock
+        with pytest.raises(OSError):
+            srv.start()                                        # bind fails HERE
+        srv.stop()
+    finally:
+        sock.close()
+
+
 def test_load_stats_uses_only_last_run(tmp_path):
     p = tmp_path / "stats.jsonl"
     p.write_text(
