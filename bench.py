@@ -132,7 +132,9 @@ def _mln_chain(net, x, y):
     return run_chain, flops
 
 
-def bench_lenet(batch, steps):
+def build_lenet(batch):
+    """(run_chain, flops) for the LeNet config — importable by tests so the
+    bench code path compiles in CI, not only at round end."""
     import jax.numpy as jnp
     import numpy as np
     from deeplearning4j_tpu.zoo import LeNet
@@ -141,33 +143,41 @@ def bench_lenet(batch, steps):
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.random((batch, 28, 28, 1), np.float32))
     y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
-    run_chain, flops = _mln_chain(net, x, y)
+    return _mln_chain(net, x, y)
+
+
+def bench_lenet(batch, steps):
+    run_chain, flops = build_lenet(batch)
     timing = measure_marginal(run_chain, n1=5, n2=steps)
     return _record("LeNet MNIST train-step samples/sec/chip",
                    "samples/sec/chip", batch, timing, flops, dtype="f32",
                    batch=batch)
 
 
-def bench_charnn(batch, steps):
+def build_charnn(batch, seq=60, vocab=77):
     import jax.numpy as jnp
     import numpy as np
     from deeplearning4j_tpu.zoo import TextGenerationLSTM
 
-    seq, vocab = 60, 77
     net = TextGenerationLSTM(num_classes=vocab, input_shape=(seq, vocab)).init()
     rng = np.random.default_rng(0)
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[
         rng.integers(0, vocab, (batch, seq))])
     y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
         rng.integers(0, vocab, (batch, seq))])
-    run_chain, flops = _mln_chain(net, x, y)
+    return _mln_chain(net, x, y)
+
+
+def bench_charnn(batch, steps):
+    seq = 60
+    run_chain, flops = build_charnn(batch, seq=seq)
     timing = measure_marginal(run_chain, n1=5, n2=steps)
     return _record("GravesLSTM char-RNN train-step tokens/sec/chip",
                    "tokens/sec/chip", batch * seq, timing, flops,
                    dtype="f32", batch=batch, seq=seq)
 
 
-def bench_bert(batch, steps):
+def build_bert(batch, cfg):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -175,7 +185,6 @@ def bench_bert(batch, steps):
     from deeplearning4j_tpu.utils.tracing import total_flops
     from deeplearning4j_tpu.zoo import transformer as tfm
 
-    cfg = tfm.BertConfig(max_seq=128)
     key = jax.random.PRNGKey(0)
     params = tfm.bert_init(key, cfg)
     opt = optax.adamw(2e-5)
@@ -197,13 +206,19 @@ def bench_bert(batch, steps):
         p, o, loss = jstep(p, o, ids, labels)
         return (p, o), loss
 
-    run_chain = chain_runner(step_once, [params, opt_state])
+    return chain_runner(step_once, [params, opt_state]), flops
+
+
+def bench_bert(batch, steps):
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    cfg = tfm.BertConfig(max_seq=128)
+    run_chain, flops = build_bert(batch, cfg)
     timing = measure_marginal(run_chain, n1=3, n2=steps)
     return _record("BERT-base fine-tune seq/sec/chip (T=128)", "seq/sec/chip",
                    batch, timing, flops, batch=batch, seq=cfg.max_seq)
 
 
-def bench_transformer(batch, steps):
+def build_transformer(batch, cfg):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -211,9 +226,6 @@ def bench_transformer(batch, steps):
     from deeplearning4j_tpu.utils.tracing import total_flops
     from deeplearning4j_tpu.zoo import transformer as tfm
 
-    cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
-                                n_layers=8, d_ff=2048, max_seq=1024,
-                                dtype=jnp.bfloat16)
     key = jax.random.PRNGKey(0)
     params = tfm.init_params(key, cfg)
     opt = optax.adamw(3e-4)
@@ -229,7 +241,16 @@ def bench_transformer(batch, steps):
         p, o, loss = jstep(p, o, ids, tgt)
         return (p, o), loss
 
-    run_chain = chain_runner(step_once, [params, opt_state])
+    return chain_runner(step_once, [params, opt_state]), flops
+
+
+def bench_transformer(batch, steps):
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
+                                n_layers=8, d_ff=2048, max_seq=1024,
+                                dtype=jnp.bfloat16)
+    run_chain, flops = build_transformer(batch, cfg)
     timing = measure_marginal(run_chain, n1=3, n2=steps)
     return _record(
         "Transformer-LM (120M, T=1024, flash-attn) tokens/sec/chip",
@@ -314,7 +335,7 @@ def _dpscale_impl(batch, steps):
                     "validated by tests/test_parallel.py equivalence instead"}
 
 
-def bench_resnet50(batch, steps):
+def build_resnet50(batch, num_classes=1000):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -322,7 +343,7 @@ def bench_resnet50(batch, steps):
     from deeplearning4j_tpu.utils.tracing import total_flops
     from deeplearning4j_tpu.zoo.resnet import ResNet50
 
-    net = ResNet50(num_classes=1000, compute_dtype=jnp.bfloat16).init()
+    net = ResNet50(num_classes=num_classes, compute_dtype=jnp.bfloat16).init()
     opt = optax.sgd(0.1, momentum=0.9)
     opt_state = opt.init(net.params)
 
@@ -345,15 +366,19 @@ def bench_resnet50(batch, steps):
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.random((batch, 224, 224, 3), np.float32),
                     jnp.bfloat16)
-    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
-        rng.integers(0, 1000, batch)])
+    y = jnp.asarray(np.eye(num_classes, dtype=np.float32)[
+        rng.integers(0, num_classes, batch)])
     flops = total_flops(train_step, net.params, net.states, opt_state, x, y)
 
     def step_once(p, s, o):
         p, s, o, loss = jstep(p, s, o, x, y)
         return (p, s, o), loss
 
-    run_chain = chain_runner(step_once, [net.params, net.states, opt_state])
+    return chain_runner(step_once, [net.params, net.states, opt_state]), flops
+
+
+def bench_resnet50(batch, steps):
+    run_chain, flops = build_resnet50(batch)
     timing = measure_marginal(run_chain, n1=3, n2=steps)
     rec = _record(
         "MultiLayerNetwork.fit() samples/sec/chip (ResNet-50 ImageNet)",

@@ -1,0 +1,81 @@
+"""Every bench.py config's train step compiles and runs (VERDICT r1 weak
+item: bench-only code paths were invisible to CI until the round's single
+bench run). Tiny shapes on the CPU mesh; same builder code the real bench
+uses, so a refactor that breaks a bench surfaces here, not at round end.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import bench  # noqa: E402  (repo-root module)
+
+
+def _run_one(run_chain):
+    loss = float(np.asarray(run_chain(2)).reshape(-1)[0])
+    assert np.isfinite(loss), loss
+    return loss
+
+
+def test_bench_lenet_step():
+    run_chain, flops = bench.build_lenet(batch=8)
+    assert flops > 0
+    _run_one(run_chain)
+
+
+def test_bench_charnn_step():
+    run_chain, flops = bench.build_charnn(batch=4, seq=12, vocab=20)
+    assert flops > 0
+    _run_one(run_chain)
+
+
+def test_bench_bert_step():
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    cfg = tfm.BertConfig(max_seq=16, vocab_size=128, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64)
+    run_chain, flops = bench.build_bert(batch=2, cfg=cfg)
+    assert flops > 0
+    _run_one(run_chain)
+
+
+def test_bench_transformer_step():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=128, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq=16,
+                                dtype=jnp.float32)
+    run_chain, flops = bench.build_transformer(batch=2, cfg=cfg)
+    assert flops > 0
+    _run_one(run_chain)
+
+
+@pytest.mark.slow
+def test_bench_resnet50_step():
+    run_chain, flops = bench.build_resnet50(batch=2, num_classes=10)
+    assert flops > 0
+    _run_one(run_chain)
+
+
+def test_bench_dpscale_impl():
+    """The dp-scaling config (single fit vs ParallelWrapper dp=8) runs on
+    the virtual mesh and reports a positive efficiency."""
+    rec = bench._dpscale_impl(batch=64, steps=2)
+    assert rec["value"] > 0 and rec["single_sps"] > 0 and rec["dp8_sps"] > 0
+
+
+def test_bench_record_flags_impossible_mfu(monkeypatch):
+    """The MFU audit gate: a derived MFU > 1 marks the record invalid."""
+    monkeypatch.setattr(bench, "_peak_flops", lambda dtype="bf16": 197e12)
+    rec = bench._record("m", "u", samples_per_step=128,
+                        timing=(1e-9, True), flops_per_step=10**9)
+    assert rec["mfu"] > 1.0 and rec["timing_valid"] is False
+    rec2 = bench._record("m", "u", samples_per_step=128,
+                         timing=(1.0, True), flops_per_step=10**12)
+    assert rec2["mfu"] < 1.0 and "timing_valid" not in rec2
+    # a non-positive marginal time is garbage regardless of MFU
+    rec3 = bench._record("m", "u", samples_per_step=128,
+                         timing=(1.0, False), flops_per_step=10**9)
+    assert rec3["timing_valid"] is False
