@@ -1,0 +1,5 @@
+"""deeplearning4j_tpu.import_ — model import (deeplearning4j-modelimport)."""
+
+from .keras import (KerasLambdaLayer, clear_custom_layers,
+                    import_keras_model, import_keras_sequential,
+                    register_custom_layer, register_lambda)

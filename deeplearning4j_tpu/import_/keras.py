@@ -24,13 +24,15 @@ CnnToFeedForward preprocessor vertex.
 from __future__ import annotations
 
 import json
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..nn.conf import NeuralNetConfiguration
-from ..nn.layers.base import InputType
+from ..nn.layers.base import InputType, Layer
 from ..nn.layers.conv import (Convolution1DLayer, ConvolutionLayer,
                               Cropping1D, Cropping2D, Deconvolution2D,
                               DepthwiseConvolution2D, GlobalPoolingLayer,
@@ -57,6 +59,70 @@ _ACT = {"relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh",
 _ELEMENTWISE = {"Add": "add", "Subtract": "sub", "Multiply": "mul",
                 "Average": "avg", "Maximum": "max"}
 
+# --------------------------------------------- custom layer / Lambda registry
+# Reference parity: KerasLayer.registerCustomLayer(name, class) and
+# KerasLambdaLayer — Lambda bodies don't serialize portably, so (exactly
+# like the reference requires a SameDiffLambdaLayer) the user registers a
+# function for each Lambda layer NAME before importing.
+_CUSTOM_LAYERS: Dict[str, Any] = {}
+_LAMBDAS: Dict[str, Any] = {}
+
+
+def register_custom_layer(class_name: str, factory, assign_weights=None):
+    """Register ``factory(keras_layer_config_dict) -> Layer`` for a keras
+    ``class_name`` the importer doesn't map (reference registerCustomLayer).
+
+    For custom layers WITH trainable weights, also pass
+    ``assign_weights(layer, params_dict, state_dict, weight_arrays)`` —
+    importing a weighted custom layer without it raises rather than
+    silently keeping random init."""
+    _CUSTOM_LAYERS[class_name] = (factory, assign_weights)
+
+
+def register_lambda(layer_name: str, fn):
+    """Register the jax function for a keras ``Lambda`` layer, keyed by the
+    LAYER NAME (reference KerasLayer.registerLambdaLayer). ``fn(x) -> y``
+    must be jax-traceable; output shape is inferred via eval_shape."""
+    _LAMBDAS[layer_name] = fn
+
+
+def clear_custom_layers():
+    _CUSTOM_LAYERS.clear()
+    _LAMBDAS.clear()
+
+
+@dataclass
+class KerasLambdaLayer(Layer):
+    """Parameter-free layer wrapping a user-registered jax function — our
+    SameDiffLambdaLayer analogue."""
+
+    fn: Any = None
+    lambda_name: str = ""
+
+    def init(self, key, input_shape):
+        # probe dynamic (None) dims — common for variable-length RNN input —
+        # then restore None where the fn preserved the probed extent
+        probe = tuple(4 if d is None else d for d in input_shape)
+        try:
+            out = jax.eval_shape(
+                self.fn, jax.ShapeDtypeStruct((1,) + probe, jnp.float32))
+        except Exception as e:  # noqa: BLE001 — surface as an import error
+            raise ValueError(
+                f"Lambda '{self.lambda_name}': output-shape inference failed "
+                f"for input shape {input_shape}: {e}") from e
+        out_shape = tuple(out.shape[1:])
+        if len(out_shape) == len(probe):
+            out_shape = tuple(
+                None if d is None and o == p else o
+                for d, p, o in zip(input_shape, probe, out_shape))
+        return {}, {}, out_shape
+
+    def apply(self, params, state, x, ctx):
+        return self.fn(x), state
+
+    def has_params(self):
+        return False
+
 
 def _act(cfg):
     return _ACT.get(cfg.get("activation", "linear"), "identity")
@@ -74,6 +140,21 @@ def _map_layer(kcfg: dict):
     """keras layer config dict → our layer (or None for structural layers)."""
     cls = kcfg["class_name"]
     c = kcfg["config"]
+    if cls in _CUSTOM_LAYERS:              # user registry wins (reference
+        factory, assign = _CUSTOM_LAYERS[cls]   # registerCustomLayer)
+        layer = factory(kcfg)
+        layer._keras_custom = cls
+        layer._keras_assign = assign
+        return layer
+    if cls == "Lambda":
+        name = c.get("name", "")
+        if name not in _LAMBDAS:
+            raise NotImplementedError(
+                f"Lambda layer '{name}': python lambda bodies don't "
+                "serialize portably — register_lambda("
+                f"{name!r}, fn) before importing (the reference requires "
+                "a SameDiffLambdaLayer the same way)")
+        return KerasLambdaLayer(fn=_LAMBDAS[name], lambda_name=name)
     if cls == "Dense":
         return DenseLayer(n_out=c["units"], activation=_act(c),
                           has_bias=c.get("use_bias", True))
@@ -216,7 +297,10 @@ def _map_layer(kcfg: dict):
         return None  # auto preprocessor inserts the reshape
     if cls in ("InputLayer",):
         return None
-    raise NotImplementedError(f"Keras layer '{cls}' not mapped yet")
+    raise NotImplementedError(
+        f"Keras layer '{cls}' not mapped yet — register_custom_layer("
+        f"{cls!r}, factory) can supply a mapping (reference "
+        "KerasLayer.registerCustomLayer)")
 
 
 def _keras_input_type(kcfg):
@@ -258,6 +342,18 @@ def _set_layer_weights(layer, pdict: Dict, sdict: Dict, ws: List[np.ndarray]):
     from ..nn.layers.recurrent import LastTimeStep
     if isinstance(layer, LastTimeStep):  # return_sequences=False wrapper
         layer = layer.inner
+    assign = getattr(layer, "_keras_assign", None)
+    if assign is not None:
+        assign(layer, pdict, sdict, ws)
+        return
+    if getattr(layer, "_keras_custom", None) and ws:
+        raise ValueError(
+            f"custom layer '{layer._keras_custom}' has {len(ws)} weight "
+            "arrays in the h5 file but no assign_weights hook — importing "
+            "would silently keep random init; pass register_custom_layer("
+            f"{layer._keras_custom!r}, factory, assign_weights=...)")
+    if isinstance(layer, KerasLambdaLayer):
+        return  # parameter-free by construction
     if isinstance(layer, Bidirectional):
         # h5 weight_names order: forward [kernel, rec, bias] then backward
         half = len(ws) // 2

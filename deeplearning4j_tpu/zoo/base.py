@@ -28,8 +28,24 @@ class ZooModel:
         raise NotImplementedError
 
     def init_pretrained(self, path):
-        """Load weights from a local ModelSerializer zip (offline analogue
-        of the reference's pretrained-download path)."""
+        """Load a local pretrained checkpoint (offline analogue of the
+        reference's pretrained-download path): a ModelSerializer zip, or a
+        keras .h5/.hdf5 file routed through the keras importer."""
+        if str(path).endswith((".h5", ".hdf5")):
+            import json
+
+            import h5py
+
+            from ..import_.keras import (import_keras_model,
+                                         import_keras_sequential)
+            with h5py.File(path, "r") as f:   # route EXPLICITLY by class
+                raw = f.attrs["model_config"]
+                cls = json.loads(
+                    raw.decode() if isinstance(raw, bytes) else raw
+                )["class_name"]
+            if cls == "Sequential":
+                return import_keras_sequential(path)
+            return import_keras_model(path)
         from ..serde.model_serializer import load_model
         return load_model(path)
 

@@ -131,6 +131,148 @@ def test_keras_import_sequential(tmp_path):
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+def test_keras_lambda_layer_registry(tmp_path):
+    """Lambda import requires user registration (reference KerasLambdaLayer):
+    unregistered → actionable error; registered → output parity."""
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(16, activation="relu", name="d0"),
+        keras.layers.Lambda(lambda t: t * 2.0 + 1.0, name="scale_shift"),
+        keras.layers.Dense(4, activation="softmax", name="d1"),
+    ])
+    x = np.random.default_rng(1).random((3, 8)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = tmp_path / "lam.h5"
+    m.save(p)
+
+    from deeplearning4j_tpu.import_ import (clear_custom_layers,
+                                            import_keras_sequential,
+                                            register_lambda)
+    try:
+        with pytest.raises(NotImplementedError, match="register_lambda"):
+            import_keras_sequential(str(p))
+        register_lambda("scale_shift", lambda t: t * 2.0 + 1.0)
+        net = import_keras_sequential(str(p))
+        np.testing.assert_allclose(np.asarray(net.output(x)), want, atol=1e-5)
+    finally:
+        clear_custom_layers()
+
+
+def test_keras_custom_layer_registry(tmp_path):
+    """register_custom_layer supplies mappings for unmapped keras classes
+    (reference KerasLayer.registerCustomLayer)."""
+    tf = pytest.importorskip("tensorflow")
+    if not hasattr(tf.keras.layers, "ThresholdedReLU"):
+        pytest.skip("keras build lacks ThresholdedReLU")
+    import jax.numpy as jnp
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(8, activation="tanh", name="d0"),
+        keras.layers.ThresholdedReLU(theta=0.5, name="thr"),
+        keras.layers.Dense(3, name="d1"),
+    ])
+    x = np.random.default_rng(2).standard_normal((4, 6)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = tmp_path / "cust.h5"
+    m.save(p)
+
+    from deeplearning4j_tpu.import_ import (KerasLambdaLayer,
+                                            clear_custom_layers,
+                                            import_keras_sequential,
+                                            register_custom_layer)
+    try:
+        with pytest.raises(NotImplementedError, match="register_custom_layer"):
+            import_keras_sequential(str(p))
+        register_custom_layer(
+            "ThresholdedReLU",
+            lambda kcfg: KerasLambdaLayer(fn=lambda t: jnp.where(
+                t > kcfg["config"]["theta"], t, 0.0)))
+        net = import_keras_sequential(str(p))
+        np.testing.assert_allclose(np.asarray(net.output(x)), want, atol=1e-5)
+    finally:
+        clear_custom_layers()
+
+
+def test_keras_custom_layer_with_weights_needs_assign_hook(tmp_path):
+    """A weighted custom layer without assign_weights must raise, not
+    silently keep random init; with the hook, weights flow through."""
+    tf = pytest.importorskip("tensorflow")
+    import jax.numpy as jnp
+    keras = tf.keras
+
+    @keras.utils.register_keras_serializable("test")
+    class ScaleLayer(keras.layers.Layer):
+        def build(self, input_shape):
+            self.scale = self.add_weight(
+                name="scale", shape=(input_shape[-1],),
+                initializer="random_normal")
+
+        def call(self, t):
+            return t * self.scale
+
+    m = keras.Sequential([
+        keras.layers.Input((5,)),
+        ScaleLayer(name="sc"),
+        keras.layers.Dense(3, name="d0"),
+    ])
+    x = np.random.default_rng(4).standard_normal((2, 5)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = tmp_path / "scale.h5"
+    m.save(p)
+
+    from deeplearning4j_tpu.import_ import (KerasLambdaLayer,
+                                            clear_custom_layers,
+                                            import_keras_sequential,
+                                            register_custom_layer)
+
+    class ScaleOurs(KerasLambdaLayer):
+        def init(self, key, input_shape):
+            return ({"scale": jnp.ones(input_shape[-1])}, {},
+                    tuple(input_shape))
+
+        def apply(self, params, state, t, ctx):
+            return t * params["scale"], state
+
+        def has_params(self):
+            return True
+
+    try:
+        register_custom_layer("test>ScaleLayer", lambda kcfg: ScaleOurs())
+        with pytest.raises(ValueError, match="assign_weights"):
+            import_keras_sequential(str(p))
+        clear_custom_layers()
+        register_custom_layer(
+            "test>ScaleLayer", lambda kcfg: ScaleOurs(),
+            assign_weights=lambda layer, pd, sd, ws:
+                pd.__setitem__("scale", jnp.asarray(ws[0])))
+        net = import_keras_sequential(str(p))
+        np.testing.assert_allclose(np.asarray(net.output(x)), want, atol=1e-5)
+    finally:
+        clear_custom_layers()
+
+
+def test_zoo_init_pretrained_h5(tmp_path):
+    """ZooModel.init_pretrained routes .h5 files through the keras importer
+    (local-file analogue of initPretrained)."""
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((5,)),
+        keras.layers.Dense(7, activation="relu"),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    x = np.random.default_rng(3).random((3, 5)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = tmp_path / "w.h5"
+    m.save(p)
+    from deeplearning4j_tpu.zoo import LeNet
+    net = LeNet().init_pretrained(str(p))
+    np.testing.assert_allclose(np.asarray(net.output(x)), want, atol=1e-5)
+
+
 def test_staging_arena_alloc_release():
     arena = native.StagingArena(block_size=1000, n_blocks=4)
     try:
