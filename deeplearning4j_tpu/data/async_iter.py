@@ -73,14 +73,19 @@ class AsyncDataSetIterator:
     def _start(self):
         self._make_buffers()
         self._stop = threading.Event()
+        self._error = []   # generation-local; producer appends, consumer raises
         self._thread = threading.Thread(
-            target=self._produce, args=(self._ring, self._q, self._stop),
+            target=self._produce,
+            args=(self._ring, self._q, self._stop, self._error),
             daemon=True)
         self._thread.start()
 
-    def _produce(self, ring, q, stop):
-        """Writes ONLY to the generation's own (ring, q, stop) — after reset()
-        these are abandoned objects and nothing here touches the live ones."""
+    def _produce(self, ring, q, stop, error):
+        """Writes ONLY to the generation's own (ring, q, stop, error) — after
+        reset() these are abandoned objects and nothing here touches the
+        live ones. A source exception is captured into `error` and re-raised
+        on the CONSUMER side at the sentinel — silently truncating an epoch
+        because the data pipeline died would be a training-integrity bug."""
         try:
             for ds in self.inner:
                 payload = _pack(ds) if ring is not None else ds
@@ -97,6 +102,8 @@ class AsyncDataSetIterator:
                             continue
                 if stop.is_set():
                     return
+        except BaseException as e:  # noqa: BLE001 — handed to the consumer
+            error.append(e)
         finally:
             while not stop.is_set():
                 if ring is not None:
@@ -123,12 +130,21 @@ class AsyncDataSetIterator:
                     self._stop.wait(0.001)
                     continue
                 if raw == _SENTINEL:
+                    self._raise_producer_error()
                     raise StopIteration
                 return _unpack(raw)
             item = q.get()
             if isinstance(item, bytes) and item == _SENTINEL:
+                self._raise_producer_error()
                 raise StopIteration
             return item
+
+    def _raise_producer_error(self):
+        if self._error:
+            raise RuntimeError(
+                "async data producer failed mid-epoch (source iterator "
+                "raised) — training would silently truncate"
+            ) from self._error[0]
 
     def __len__(self):
         return len(self.inner)

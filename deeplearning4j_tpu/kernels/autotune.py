@@ -1,0 +1,109 @@
+"""Block-size autotuning for pallas kernels (SURVEY §7 R2 item).
+
+The reference leans on cuDNN's internal autotuner (cudnnFindConvolution
+AlgorithmEx et al.); XLA has no equivalent for hand-written pallas
+kernels, so this is ours: time each candidate config on the REAL device
+with the same marginal-chained-steps discipline bench.py uses, pick the
+fastest, and cache the choice both in-process and on disk
+(``~/.deeplearning4j_tpu/autotune.json``) so one process's sweep pays for
+every later run on the same chip generation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+_memory_cache: Dict[str, Tuple] = {}
+_CACHE_PATH = Path(os.environ.get(
+    "DL4J_TPU_DATA", Path.home() / ".deeplearning4j_tpu")) / "autotune.json"
+
+
+def _disk_cache() -> dict:
+    try:
+        return json.loads(_CACHE_PATH.read_text())
+    except Exception:  # noqa: BLE001 — absent/corrupt cache = empty
+        return {}
+
+
+def _save_disk_cache(cache: dict):
+    try:
+        _CACHE_PATH.parent.mkdir(parents=True, exist_ok=True)
+        _CACHE_PATH.write_text(json.dumps(cache, indent=1))
+    except OSError:
+        pass  # read-only home: in-process cache still works
+
+
+def clear_cache():
+    _memory_cache.clear()
+    try:
+        _CACHE_PATH.unlink()
+    except OSError:
+        pass
+
+
+def _time_once(run: Callable[[], object], reps: int = 8) -> float:
+    """Marginal seconds per call: chained calls ended by one host fetch
+    (block_until_ready does not sync through the axon tunnel)."""
+    import jax.numpy as jnp
+
+    def fetch(x):
+        return float(jnp.asarray(x).reshape(-1)[0])
+
+    fetch(run())  # compile + warmup
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = run()
+    fetch(out)
+    t_n = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fetch(run())
+    t_1 = time.perf_counter() - t0
+    return max((t_n - t_1) / (reps - 1), 1e-9)
+
+
+def autotune(key: str, candidates: Iterable[Tuple],
+             make_run: Callable[[Tuple], Optional[Callable[[], object]]],
+             enabled: bool = True) -> Tuple:
+    """Pick the fastest candidate for `key`; cached thereafter.
+
+    make_run(candidate) returns a nullary closure executing the kernel with
+    that config (returning a fetchable array), or None if the candidate is
+    invalid for the shape. With enabled=False (or when every candidate
+    fails) the FIRST valid candidate is returned untimed.
+    """
+    if key in _memory_cache:
+        return _memory_cache[key]
+    disk = _disk_cache()
+    if key in disk:
+        choice = tuple(disk[key])
+        _memory_cache[key] = choice
+        return choice
+
+    candidates = [c for c in candidates]
+    if not enabled:
+        choice = candidates[0]
+        _memory_cache[key] = choice
+        return choice
+
+    best, best_t = None, float("inf")
+    for cand in candidates:
+        run = make_run(cand)
+        if run is None:
+            continue
+        try:
+            t = _time_once(run)
+        except Exception:  # noqa: BLE001 — config doesn't compile/fit VMEM
+            continue
+        if t < best_t:
+            best, best_t = cand, t
+    if best is None:
+        best = candidates[0]
+    _memory_cache[key] = best
+    disk[key] = list(best)
+    _save_disk_cache(disk)
+    return best

@@ -30,16 +30,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # jaxlib builds without Pallas-TPU support (CPU-only wheels)
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover - depends on jaxlib build
-    pltpu = None
+from ._common import interpret_default as _interpret_default
+from ._common import pltpu
 
 NEG_INF = -1e30
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _block_sizes(t: int, d: int, block_q: int, block_k: int):
@@ -304,11 +298,45 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash_attention_pallas.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _tuned_blocks(b, h, t, d, dtype, causal, interpret) -> tuple:
+    """Autotuned (block_q, block_k) for this attention shape — timed on the
+    real chip once, cached to disk (kernels/autotune.py); defaults to
+    (128, 128) off-TPU or when tuning is disabled."""
+    import os
+
+    if interpret or jax.default_backend() != "tpu" \
+            or os.environ.get("DL4J_TPU_AUTOTUNE", "1") != "1":
+        return 128, 128
+    from .autotune import autotune
+
+    def make_run(cand):
+        bq, bk = cand
+        if t % bq or t % bk:
+            return None
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (b, h, t, d), dtype)
+
+        def run():
+            return _flash_attention_pallas(q, q, q, None, causal, bq, bk,
+                                           False)
+        return run
+
+    chip = jax.devices()[0].device_kind.replace(" ", "_")
+    return autotune(
+        f"flash:{chip}:{b}x{h}x{t}x{d}:{jnp.dtype(dtype).name}:{causal}",
+        [(128, 128), (256, 128), (128, 256), (256, 256), (512, 128),
+         (128, 512)],
+        make_run)
+
+
 def flash_attention_ntc(q, k, v, causal=False, interpret=None):
     """(B, T, H, D)-layout adapter around :func:`flash_attention` — the
-    layout the nn layers and the transformer use."""
+    layout the nn layers and the transformer use. Block sizes are
+    autotuned per shape on the real chip."""
+    b, t, h, d = q.shape
+    bq, bk = _tuned_blocks(b, h, t, d, q.dtype, causal, interpret)
     out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                          v.transpose(0, 2, 1, 3), None, causal, 128, 128,
+                          v.transpose(0, 2, 1, 3), None, causal, bq, bk,
                           interpret)
     return out.transpose(0, 2, 1, 3)
 

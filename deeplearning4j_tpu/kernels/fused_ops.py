@@ -1,0 +1,118 @@
+"""Fused batchnorm-activation — pallas TPU kernel (SURVEY §7 R2 kernel).
+
+Reference counterpart: libnd4j's fused ``batchnorm`` + activation epilogue
+(cuDNN ``cudnnBatchNormalizationForwardInference`` followed by the fused
+activation the reference's conv helpers request). At inference the whole
+BN collapses to a per-channel affine y = act(x * scale + shift) with
+
+    scale = gamma / sqrt(var + eps),   shift = beta - mean * scale
+
+precomputed once; the kernel then makes ONE bandwidth-bound pass over x:
+rows stream through VMEM in blocks, the (1, C) scale/shift vectors stay
+resident, and the activation is applied in-register before the row block
+is written back — no (B·H·W, C) intermediate ever round-trips to HBM.
+
+Backward (rarely needed at inference, but required for frozen-BN
+fine-tuning) is recompute-based via the jnp reference, like the other
+kernels in this package.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._common import interpret_default
+from ._common import pltpu
+
+_VMEM_BUDGET = 8 << 20  # row blocks stay comfortably inside VMEM
+
+
+def plan_blocks(n: int, c: int, itemsize: int):
+    """Row-block size for an (N, C) pass, or None when no clean block fits
+    VMEM (callers fall back to the XLA path). A non-divisible N is only
+    acceptable when the WHOLE array is one small block."""
+    for cand in (1024, 512, 256, 128, 8):
+        if n % cand == 0 and 2 * cand * c * max(itemsize, 4) <= _VMEM_BUDGET:
+            return cand
+    if 2 * n * c * max(itemsize, 4) <= _VMEM_BUDGET:
+        return n
+    return None
+
+_ACTS = {
+    "identity": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "swish": jax.nn.swish,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "softplus": jax.nn.softplus,
+}
+
+
+def supported_activation(name) -> bool:
+    return isinstance(name, str) and name in _ACTS
+
+
+_interpret_default = interpret_default
+
+
+def bn_act_reference(x2d, scale, shift, activation: str):
+    """jnp oracle AND recompute target: act(x * scale + shift), (N, C)."""
+    return _ACTS[activation](x2d * scale[None, :] + shift[None, :])
+
+
+def _kernel(x_ref, scale_ref, shift_ref, o_ref, *, activation):
+    y = (x_ref[...].astype(jnp.float32) * scale_ref[...]
+         + shift_ref[...])
+    o_ref[...] = _ACTS[activation](y).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_bn_act(x2d, scale, shift, activation: str = "identity",
+                 interpret=None):
+    """(N, C) rows × per-channel affine + activation, one HBM pass."""
+    out, _ = _fwd(x2d, scale, shift, activation, interpret)
+    return out
+
+
+def _fwd(x2d, scale, shift, activation, interpret):
+    res = (x2d, scale, shift)
+    if pltpu is None:
+        return bn_act_reference(x2d, scale, shift, activation), res
+    if interpret is None:
+        interpret = _interpret_default()
+    n, c = x2d.shape
+    bn = plan_blocks(n, c, x2d.dtype.itemsize)
+    if bn is None:                       # no VMEM-safe blocking: XLA path
+        return bn_act_reference(x2d, scale, shift, activation
+                                ).astype(x2d.dtype), res
+    out = pl.pallas_call(
+        functools.partial(_kernel, activation=activation),
+        grid=(n // bn,),
+        in_specs=[pl.BlockSpec((bn, c), lambda i: (i, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((bn, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, c), x2d.dtype),
+        interpret=interpret,
+    )(x2d, scale.reshape(1, c).astype(jnp.float32),
+      shift.reshape(1, c).astype(jnp.float32))
+    return out, res
+
+
+def _bwd(activation, interpret, res, g):
+    x2d, scale, shift = res
+    _, vjp_fn = jax.vjp(
+        lambda x, sc, sh: bn_act_reference(x, sc, sh, activation),
+        x2d, scale, shift)
+    return vjp_fn(g)
+
+
+fused_bn_act.defvjp(_fwd, _bwd)

@@ -15,6 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -33,6 +34,23 @@ class BatchNormalization(Layer):
     beta_init: float = 0.0
     lock_gamma_beta: bool = False
     use_log_std: bool = False
+    # DL4J BatchNormalization inherits activation from FeedForwardLayer;
+    # at inference the whole BN+act collapses into the fused pallas
+    # scale-shift-act kernel ("auto": on TPU; True forces interpret mode)
+    activation: Any = "identity"
+    fused: Any = "auto"
+
+    def _can_fuse(self) -> bool:
+        from ...kernels.fused_ops import supported_activation
+        if self.fused is False or not supported_activation(self.activation):
+            return False
+        if self.fused is True:
+            return True
+        # "auto" fuses only when there IS an activation to fuse — plain
+        # identity BN gains nothing over XLA's own fusion, so don't route
+        # every existing BN through the kernel by default
+        return self.activation != "identity" \
+            and jax.default_backend() == "tpu"
 
     def init(self, key, input_shape):
         c = self.n_out or input_shape[-1]
@@ -57,10 +75,28 @@ class BatchNormalization(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
+            if self._can_fuse():
+                # inference BN+act folds to act(x*scale + shift): one
+                # bandwidth-bound pallas pass (kernels/fused_ops.py)
+                from ...kernels.fused_ops import fused_bn_act
+                inv = lax.rsqrt(var + self.eps)
+                scale, shift = inv, -mean * inv
+                if not self.lock_gamma_beta:
+                    g32 = params["gamma"].astype(jnp.float32)
+                    scale = inv * g32
+                    shift = params["beta"].astype(jnp.float32) - mean * scale
+                c = x.shape[-1]
+                y = fused_bn_act(x.reshape(-1, c), scale, shift,
+                                 self.activation,
+                                 True if self.fused is True else None)
+                return y.reshape(x.shape), new_state
         inv = lax.rsqrt(var + self.eps)
         y = (x.astype(jnp.float32) - mean) * inv
         if not self.lock_gamma_beta:
             y = y * params["gamma"].astype(jnp.float32) + params["beta"].astype(jnp.float32)
+        if self.activation != "identity":
+            from .. import activations as _a
+            y = _a.get(self.activation)(y)
         return y.astype(x.dtype), new_state
 
 

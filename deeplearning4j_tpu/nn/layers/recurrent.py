@@ -116,9 +116,22 @@ class LSTM(BaseRecurrent):
 
     forget_gate_bias: float = 1.0
     gate_activation: Any = "sigmoid"
+    # "auto": use the fused pallas whole-sequence kernel on TPU when the
+    # cell is standard (sigmoid/tanh, no mask); True forces it (interpret
+    # mode off-TPU, for tests); False always uses the lax.scan path
+    fused: Any = "auto"
 
     def _has_peepholes(self):
         return False
+
+    def _can_fuse(self, mask) -> bool:
+        if self.fused is False or mask is not None:
+            return False
+        if self.activation != "tanh" or self.gate_activation != "sigmoid":
+            return False
+        if self.fused is True:
+            return True
+        return jax.default_backend() == "tpu"
 
     def init(self, key, input_shape):
         t, c = input_shape
@@ -168,6 +181,19 @@ class LSTM(BaseRecurrent):
         xw = x @ w + b  # hoisted (B,T,4H) MXU matmul
         mask = ctx.mask
         b0 = x.shape[0]
+        from ...kernels.fused_lstm import fits_vmem
+        if self._can_fuse(mask) and fits_vmem(b0, h, x.dtype.itemsize):
+            from ...kernels.fused_lstm import fused_lstm_seq
+            rw = params["RW"].astype(x.dtype)
+            if self._has_peepholes():
+                peep = jnp.stack([params["pI"], params["pF"], params["pO"]]
+                                 ).astype(jnp.float32)
+            else:
+                peep = jnp.zeros((3, h), jnp.float32)
+            z0 = jnp.zeros((b0, h), x.dtype)
+            y = fused_lstm_seq(xw, rw, peep, z0, z0,
+                               True if self.fused is True else None)
+            return y, state
         carry0 = (jnp.zeros((b0, h), x.dtype), jnp.zeros((b0, h), x.dtype))
 
         def step(carry, inp):

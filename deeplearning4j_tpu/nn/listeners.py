@@ -25,6 +25,9 @@ class TrainingListener:
 
 class ScoreIterationListener(TrainingListener):
     """Print score every N iterations (reference ScoreIterationListener)."""
+    deferred_score_ok = True  # pure logging: fit() may report the
+    # (step, score) pair one dispatch late to keep the device busy
+
 
     def __init__(self, print_iterations: int = 10, log_fn: Callable = print):
         self.print_iterations = max(1, print_iterations)
@@ -37,6 +40,9 @@ class ScoreIterationListener(TrainingListener):
 
 class PerformanceListener(TrainingListener):
     """Throughput reporting: iterations/sec + examples/sec."""
+    deferred_score_ok = True  # pure logging: fit() may report the
+    # (step, score) pair one dispatch late to keep the device busy
+
 
     def __init__(self, frequency: int = 10, report_batch: bool = True, log_fn: Callable = print):
         self.frequency = max(1, frequency)
@@ -59,6 +65,9 @@ class PerformanceListener(TrainingListener):
 
 class TimeIterationListener(TrainingListener):
     """ETA logging based on expected total iteration count."""
+    deferred_score_ok = True  # pure logging: fit() may report the
+    # (step, score) pair one dispatch late to keep the device busy
+
 
     def __init__(self, total_iterations: int, frequency: int = 100, log_fn: Callable = print):
         self.total = total_iterations
@@ -75,6 +84,9 @@ class TimeIterationListener(TrainingListener):
 
 
 class CollectScoresListener(TrainingListener):
+    deferred_score_ok = True  # pure logging: fit() may report the
+    # (step, score) pair one dispatch late to keep the device busy
+
     def __init__(self, frequency: int = 1):
         self.frequency = max(1, frequency)
         self.iterations: List[int] = []
@@ -163,6 +175,9 @@ class StatsListener(TrainingListener):
     always a JSONL stream that ``deeplearning4j_tpu.ui`` renders in the
     terminal. Ratio computation snapshots params every `frequency` steps
     (off the hot path; a few tiny reductions per report)."""
+    deferred_score_ok = True  # pure logging: fit() may report the
+    # (step, score) pair one dispatch late to keep the device busy
+
 
     def __init__(self, log_dir="runs/dl4j_tpu", frequency: int = 10,
                  report_ratios: bool = True, tensorboard: bool = True):

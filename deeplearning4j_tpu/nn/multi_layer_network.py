@@ -312,29 +312,83 @@ class MultiLayerNetwork:
         if getattr(self, "_anomaly_detector", None) is not None:
             from ..train.anomaly import DelayedAnomalyCheck
             anomaly_check = DelayedAnomalyCheck(self._anomaly_detector)
-        for _ in range(epochs):
-            for ds in iterator:
-                x = jnp.asarray(ds.features)
-                y = jnp.asarray(ds.labels)
-                fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-                lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
-                self._host_key, rng = jax.random.split(self._host_key)
-                self.params, self.states, self._opt_state, loss, gstats = step_fn(
-                    self.params, self.states, self._opt_state, x, y, rng, fmask, lmask)
-                self._step_count += 1
-                if anomaly_check is not None and gstats is not None:
-                    anomaly_check.push(gstats, self._step_count)
-                last = loss
-                if self.listeners:
-                    lv = float(loss)
-                    for listener in self.listeners:
-                        listener.iteration_done(self, self._step_count, self.epoch_count, lv)
-            self.epoch_count += 1
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
+
+        # DL4J's fit wraps the source in an AsyncDataSetIterator so batch
+        # prep runs on a background thread while the device computes; do
+        # the same when the iterator opts in (async_supported).
+        wrapped = None
+        run_iter = iterator
+        if getattr(iterator, "async_supported", lambda: False)() \
+                and type(iterator).__name__ != "AsyncDataSetIterator":
+            from ..data.async_iter import AsyncDataSetIterator
+            wrapped = AsyncDataSetIterator(iterator, queue_size=2)
+            run_iter = wrapped
+
+        # Listener score fetches are deferred ONE iteration when every
+        # attached listener opts in (`deferred_score_ok`, the pure logging
+        # ones): float(loss) blocks until the step finishes, so fetching
+        # step k-1's loss while step k is in flight keeps the device
+        # pipeline full. Listeners that read model state at the reported
+        # iteration (checkpointing, eval, NaN watchdog) keep the exact
+        # synchronous semantics — params must match the (step, score) pair.
+        defer_ok = all(getattr(ls, "deferred_score_ok", False)
+                       for ls in self.listeners)
+        pending = None
+
+        def flush_pending():
+            nonlocal pending
+            if pending is not None:
+                loss_d, si, ei = pending
+                pending = None
+                lv = float(loss_d)
+                for listener in self.listeners:
+                    listener.iteration_done(self, si, ei, lv)
+
+        try:
+            for e in range(epochs):
+                for ds in run_iter:
+                    x = jnp.asarray(ds.features)
+                    y = jnp.asarray(ds.labels)
+                    fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+                    lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+                    self._host_key, rng = jax.random.split(self._host_key)
+                    self.params, self.states, self._opt_state, loss, gstats = step_fn(
+                        self.params, self.states, self._opt_state, x, y, rng, fmask, lmask)
+                    self._step_count += 1
+                    if anomaly_check is not None and gstats is not None:
+                        anomaly_check.push(gstats, self._step_count)
+                    last = loss
+                    if self.listeners:
+                        if defer_ok:
+                            flush_pending()
+                            pending = (loss, self._step_count,
+                                       self.epoch_count)
+                        else:
+                            lv = float(loss)
+                            for listener in self.listeners:
+                                listener.iteration_done(
+                                    self, self._step_count, self.epoch_count,
+                                    lv)
+                self.epoch_count += 1
+                if e < epochs - 1:
+                    if hasattr(run_iter, "reset"):
+                        run_iter.reset()
+                elif wrapped is not None:
+                    # final epoch: close the wrapper FIRST so reset doesn't
+                    # spin up a producer whose prefetch is thrown away
+                    wrapped.close()
+                    wrapped = None
+                    if hasattr(iterator, "reset"):
+                        iterator.reset()
+                elif hasattr(run_iter, "reset"):
+                    run_iter.reset()
+                flush_pending()   # all iteration_done before on_epoch_end
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+        finally:
+            if wrapped is not None:
+                wrapped.close()
         if anomaly_check is not None:
             anomaly_check.flush()
         return None if last is None else float(last)

@@ -60,6 +60,146 @@ def test_flash_bf16_inputs():
     assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref))) < 2e-2
 
 
+def test_fused_lstm_matches_reference_forward():
+    from deeplearning4j_tpu.kernels.fused_lstm import (fused_lstm_seq,
+                                                       lstm_seq_reference)
+    b, t, h = 2, 12, 16
+    xproj = jnp.asarray(RNG.standard_normal((b, t, 4 * h)).astype(np.float32))
+    rw = jnp.asarray(RNG.standard_normal((h, 4 * h)).astype(np.float32) * 0.3)
+    peep = jnp.asarray(RNG.standard_normal((3, h)).astype(np.float32) * 0.1)
+    z = jnp.zeros((b, h))
+    out = fused_lstm_seq(xproj, rw, peep, z, z, True)   # interpret mode
+    ref = lstm_seq_reference(xproj, rw, peep, z, z)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_fused_lstm_grads_match_reference():
+    from deeplearning4j_tpu.kernels.fused_lstm import (fused_lstm_seq,
+                                                       lstm_seq_reference)
+    b, t, h = 2, 8, 8
+    xproj = jnp.asarray(RNG.standard_normal((b, t, 4 * h)).astype(np.float32))
+    rw = jnp.asarray(RNG.standard_normal((h, 4 * h)).astype(np.float32) * 0.3)
+    peep = jnp.asarray(RNG.standard_normal((3, h)).astype(np.float32) * 0.1)
+    z = jnp.zeros((b, h))
+    w = jnp.cos(jnp.arange(h))
+
+    g = jax.grad(lambda *a: jnp.sum(fused_lstm_seq(*a, True) * w),
+                 argnums=(0, 1, 2))(xproj, rw, peep, z, z)
+    gr = jax.grad(lambda *a: jnp.sum(lstm_seq_reference(*a) * w),
+                  argnums=(0, 1, 2))(xproj, rw, peep, z, z)
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+
+def test_lstm_layer_fused_path_matches_scan():
+    """LSTM/GravesLSTM with fused=True (interpret) == the lax.scan path,
+    forward AND parameter gradients, through the layer API."""
+    from deeplearning4j_tpu.nn.layers.base import Ctx
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM, GravesLSTM
+    for cls in (LSTM, GravesLSTM):
+        scan_l = cls(n_in=5, n_out=6, fused=False)
+        fused_l = cls(n_in=5, n_out=6, fused=True)
+        params, state, _ = scan_l.init(jax.random.PRNGKey(3), (7, 5))
+        x = jnp.asarray(RNG.standard_normal((3, 7, 5)).astype(np.float32))
+        y_scan, _ = scan_l.apply(params, state, x, Ctx())
+        y_fused, _ = fused_l.apply(params, state, x, Ctx())
+        np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_scan),
+                                   atol=1e-5, err_msg=cls.__name__)
+
+        def loss(l, p):
+            y, _ = l.apply(p, state, x, Ctx())
+            return jnp.sum(jnp.square(y))
+
+        g_scan = jax.grad(lambda p: loss(scan_l, p))(params)
+        g_fused = jax.grad(lambda p: loss(fused_l, p))(params)
+        for key in params:
+            np.testing.assert_allclose(np.asarray(g_fused[key]),
+                                       np.asarray(g_scan[key]), atol=1e-4,
+                                       err_msg=f"{cls.__name__}.{key}")
+        # masked input must route to the scan path (fused can't freeze state)
+        mask = jnp.ones((3, 7)).at[0, 5:].set(0.0)
+        ym, _ = fused_l.apply(params, state, x, Ctx(mask=mask))
+        ym_ref, _ = scan_l.apply(params, state, x, Ctx(mask=mask))
+        np.testing.assert_allclose(np.asarray(ym), np.asarray(ym_ref),
+                                   atol=1e-5)
+
+
+def test_fused_bn_act_matches_reference():
+    from deeplearning4j_tpu.kernels.fused_ops import (bn_act_reference,
+                                                      fused_bn_act)
+    n, c = 384, 24
+    x = jnp.asarray(RNG.standard_normal((n, c)).astype(np.float32))
+    scale = jnp.asarray(RNG.uniform(0.5, 2.0, c).astype(np.float32))
+    shift = jnp.asarray(RNG.standard_normal(c).astype(np.float32))
+    for act in ("identity", "relu", "tanh", "swish"):
+        out = fused_bn_act(x, scale, shift, act, True)   # interpret mode
+        ref = bn_act_reference(x, scale, shift, act)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, err_msg=act)
+    # gradients flow via the recompute backward
+    g = jax.grad(lambda x_: jnp.sum(
+        jnp.square(fused_bn_act(x_, scale, shift, "relu", True))))(x)
+    gr = jax.grad(lambda x_: jnp.sum(
+        jnp.square(bn_act_reference(x_, scale, shift, "relu"))))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-5)
+
+
+def test_batchnorm_fused_inference_matches_plain():
+    """BN(activation=...) inference: fused pallas path == plain path; the
+    activation field itself matches an explicit ActivationLayer after."""
+    from deeplearning4j_tpu.nn.layers.base import Ctx
+    from deeplearning4j_tpu.nn.layers.norm import BatchNormalization
+    x = jnp.asarray(RNG.standard_normal((6, 5, 5, 8)).astype(np.float32))
+    plain = BatchNormalization(activation="relu", fused=False)
+    fused = BatchNormalization(activation="relu", fused=True)
+    params, state, _ = plain.init(jax.random.PRNGKey(0), (5, 5, 8))
+    # train a step so running stats are non-trivial
+    _, state = plain.apply(params, state, x, Ctx(train=True))
+    y_plain, _ = plain.apply(params, state, x, Ctx(train=False))
+    y_fused, _ = fused.apply(params, state, x, Ctx(train=False))
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_plain),
+                               atol=1e-5)
+    assert float(jnp.min(y_fused)) >= 0.0    # relu actually applied
+
+
+def test_autotune_picks_and_caches(tmp_path, monkeypatch):
+    from deeplearning4j_tpu.kernels import autotune as at
+    monkeypatch.setattr(at, "_CACHE_PATH", tmp_path / "autotune.json")
+    at._memory_cache.clear()
+    calls = []
+
+    def make_run(cand):
+        if cand == (9, 9):
+            return None                     # invalid for the shape
+        def run():
+            calls.append(cand)
+            time_cost = 0.02 if cand == (1, 1) else 0.0
+            import time as _t
+            _t.sleep(time_cost)
+            return jnp.zeros(1)
+        return run
+
+    choice = at.autotune("k1", [(1, 1), (2, 2), (9, 9)], make_run)
+    assert choice == (2, 2)                 # the fast one wins
+    # cached: no further timing calls
+    n = len(calls)
+    assert at.autotune("k1", [(1, 1), (2, 2)], make_run) == (2, 2)
+    assert len(calls) == n
+    # disk cache survives a fresh in-process cache
+    at._memory_cache.clear()
+    assert at.autotune("k1", [(1, 1), (2, 2)], make_run) == (2, 2)
+    assert len(calls) == n
+    # disabled → first candidate, untimed
+    assert at.autotune("k2", [(3, 3), (4, 4)], make_run,
+                       enabled=False) == (3, 3)
+    assert len(calls) == n
+
+
+def test_tuned_blocks_defaults_off_tpu():
+    from deeplearning4j_tpu.kernels.flash_attention import _tuned_blocks
+    assert _tuned_blocks(2, 4, 256, 64, jnp.float32, True, None) == (128, 128)
+
+
 def test_self_attention_layer_pallas_impl_matches_xla():
     from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
     from deeplearning4j_tpu.nn.layers.base import Ctx

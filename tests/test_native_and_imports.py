@@ -95,6 +95,26 @@ def test_async_iterator_delivers_everything():
     async_it.close()
 
 
+def test_async_iterator_propagates_source_errors():
+    """A source iterator that raises mid-stream must surface on the
+    consumer — silent epoch truncation is a training-integrity bug."""
+    class Poisoned:
+        batch_size = 4
+
+        def __iter__(self):
+            yield DataSet(np.zeros((4, 2), np.float32),
+                          np.zeros((4, 2), np.float32))
+            raise OSError("corrupt record")
+
+    async_it = AsyncDataSetIterator(Poisoned(), queue_size=2)
+    try:
+        with pytest.raises(RuntimeError, match="async data producer failed"):
+            for _ in async_it:
+                pass
+    finally:
+        async_it.close()
+
+
 def test_tf_import_mlp():
     tf = pytest.importorskip("tensorflow")
     tf1 = tf.compat.v1
