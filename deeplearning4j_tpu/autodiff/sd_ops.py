@@ -670,6 +670,214 @@ NN_EXT = {
 del NN_EXT["sparsemax"]
 
 
+# -------------------------------------------------------- r2 long tail ----
+# Second widening pass toward the upstream registry: absolute-value
+# reductions, the matchCondition family, entropy/standardize, unsorted
+# segment ops, space/batch, merge vertices, linalg band/LU, attention and
+# NMS/crop-and-resize image ops.
+
+_CONDS = {
+    "lt": jnp.less, "lte": jnp.less_equal, "gt": jnp.greater,
+    "gte": jnp.greater_equal, "eq": jnp.equal, "neq": jnp.not_equal,
+}
+
+
+def _clip_by_avg_norm(x, clip, axes=None):
+    rms = jnp.sqrt(jnp.mean(jnp.square(x), _axes(axes), keepdims=True))
+    return jnp.where(rms > clip, x * clip / jnp.maximum(rms, 1e-12), x)
+
+
+def _match_condition(x, cond, value):
+    """Upstream matchCondition(in, Conditions.lessThan(v)) — the Condition
+    object becomes a static name from {lt, lte, gt, gte, eq, neq}."""
+    if cond not in _CONDS:
+        raise ValueError(f"unknown condition {cond!r}; one of {sorted(_CONDS)}")
+    return _CONDS[cond](x, value)
+
+
+def _space_to_batch(x, block, paddings=((0, 0), (0, 0))):
+    b, h, w, c = x.shape
+    x = jnp.pad(x, ((0, 0), tuple(paddings[0]), tuple(paddings[1]), (0, 0)))
+    h2, w2 = x.shape[1], x.shape[2]
+    x = x.reshape(b, h2 // block, block, w2 // block, block, c)
+    return x.transpose(2, 4, 0, 1, 3, 5).reshape(
+        b * block * block, h2 // block, w2 // block, c)
+
+
+def _batch_to_space(x, block, crops=((0, 0), (0, 0))):
+    bb, h, w, c = x.shape
+    b = bb // (block * block)
+    x = x.reshape(block, block, b, h, w, c).transpose(2, 3, 0, 4, 1, 5)
+    x = x.reshape(b, h * block, w * block, c)
+    (ct, cb), (cl, cr) = crops
+    return x[:, ct:h * block - cb, cl:w * block - cr, :]
+
+
+def _mh_attention(q, k, v, wq, wk, wv, wo, mask=None):
+    """Upstream multiHeadDotProductAttention: project with (H, Dp, Din)
+    weight stacks, per-head scaled dot attention, output-project with
+    (Dout, H*Dp). Inputs are (B, T, Din)."""
+    qh = jnp.einsum("btd,hpd->bhtp", q, wq)
+    kh = jnp.einsum("btd,hpd->bhtp", k, wk)
+    vh = jnp.einsum("btd,hpd->bhtp", v, wv)
+    s = jnp.einsum("bhqp,bhkp->bhqk", qh, kh) / _math.sqrt(qh.shape[-1])
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    att = jax.nn.softmax(s, -1)
+    out = jnp.einsum("bhqk,bhkp->bhqp", att, vh)
+    b, h, t, p = out.shape
+    return jnp.einsum("btx,ox->bto",
+                      out.transpose(0, 2, 1, 3).reshape(b, t, h * p), wo)
+
+
+def _nms(boxes, scores, max_out, iou_threshold=0.5, score_threshold=-jnp.inf):
+    """Non-max suppression, static max_out (XLA): returns (indices, valid)
+    where `indices` is padded with -1 beyond `valid` picks. Boxes are
+    (N, 4) [y1, x1, y2, x2]."""
+    n = boxes.shape[0]
+    y1, x1, y2, x2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+
+    def iou(i, j):
+        yy1 = jnp.maximum(y1[i], y1[j])
+        xx1 = jnp.maximum(x1[i], x1[j])
+        yy2 = jnp.minimum(y2[i], y2[j])
+        xx2 = jnp.minimum(x2[i], x2[j])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(area[i] + area[j] - inter, 1e-9)
+
+    def body(state, _):
+        live, picked_count = state
+        masked = jnp.where(live, scores, -jnp.inf)
+        i = jnp.argmax(masked)
+        ok = masked[i] > jnp.maximum(score_threshold, -jnp.inf + 1)
+        ok = jnp.logical_and(ok, jnp.isfinite(masked[i]))
+        suppress = iou(i, jnp.arange(n)) > iou_threshold
+        live = jnp.where(ok, jnp.logical_and(live, ~suppress), live)
+        live = live.at[i].set(False)
+        return (live, picked_count + ok.astype(jnp.int32)), \
+            jnp.where(ok, i, -1).astype(jnp.int32)
+
+    (_, count), idx = lax.scan(body, (jnp.ones(n, bool), jnp.int32(0)),
+                               None, length=int(max_out))
+    return idx, count
+
+
+def _crop_and_resize(images, boxes, box_indices, crop_size,
+                     extrapolation_value=0.0):
+    """tf.image.crop_and_resize semantics: normalized [y1, x1, y2, x2]
+    boxes, bilinear sampling on a (ch, cw) grid per box; a crop dimension
+    of 1 samples the box CENTER, and samples outside the image take
+    ``extrapolation_value`` (both as in TF)."""
+    ch, cw = int(crop_size[0]), int(crop_size[1])
+    _, h, w, _ = images.shape
+
+    def grid(lo, hi, n, extent):
+        if n == 1:
+            return (0.5 * (lo + hi) * (extent - 1))[None]
+        return lo * (extent - 1) + (jnp.arange(n) / (n - 1)) \
+            * (hi - lo) * (extent - 1)
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        ys = grid(y1, y2, ch, h)
+        xs = grid(x1, x2, cw, w)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        img = images[bi]
+        a = img[y0][:, x0]
+        b = img[y0][:, x1i]
+        c = img[y1i][:, x0]
+        d = img[y1i][:, x1i]
+        out = (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+               + c * wy * (1 - wx) + d * wy * wx)
+        inside = ((ys >= 0) & (ys <= h - 1))[:, None, None] \
+            & ((xs >= 0) & (xs <= w - 1))[None, :, None]
+        return jnp.where(inside, out, extrapolation_value)
+
+    return jax.vmap(one)(jnp.asarray(boxes),
+                         jnp.asarray(box_indices).astype(jnp.int32))
+
+
+BASE.update({
+    "space_to_batch": _space_to_batch,
+    "batch_to_space": _batch_to_space,
+    "unsorted_segment_min": lambda x, ids, num: jax.ops.segment_min(
+        x, jnp.asarray(ids).astype(jnp.int32), int(num)),
+    "unsorted_segment_max": lambda x, ids, num: jax.ops.segment_max(
+        x, jnp.asarray(ids).astype(jnp.int32), int(num)),
+    "unsorted_segment_prod": lambda x, ids, num: jax.ops.segment_prod(
+        x, jnp.asarray(ids).astype(jnp.int32), int(num)),
+    "unsorted_segment_mean": lambda x, ids, num: jax.ops.segment_sum(
+        x, jnp.asarray(ids).astype(jnp.int32), int(num)) / jnp.maximum(
+        jax.ops.segment_sum(jnp.ones_like(x, jnp.float32),
+                            jnp.asarray(ids).astype(jnp.int32), int(num)), 1),
+    "unsorted_segment_sqrt_n": lambda x, ids, num: jax.ops.segment_sum(
+        x, jnp.asarray(ids).astype(jnp.int32), int(num)) / jnp.sqrt(
+        jnp.maximum(jax.ops.segment_sum(
+            jnp.ones_like(x, jnp.float32),
+            jnp.asarray(ids).astype(jnp.int32), int(num)), 1)),
+    "merge_add": lambda *xs: sum(xs),
+    "merge_avg": lambda *xs: sum(xs) / len(xs),
+    "merge_max": lambda *xs: jnp.stack(xs).max(0),
+    "list_diff": lambda x, y, size: jnp.setdiff1d(
+        x, y, size=int(size), fill_value=0),
+})
+
+MATH_EXT.update({
+    "amax": lambda x, axis=None: jnp.max(jnp.abs(x), _axes(axis)),
+    "amin": lambda x, axis=None: jnp.min(jnp.abs(x), _axes(axis)),
+    "amean": lambda x, axis=None: jnp.mean(jnp.abs(x), _axes(axis)),
+    "asum": lambda x, axis=None: jnp.sum(jnp.abs(x), _axes(axis)),
+    "reciprocal": jnp.reciprocal, "square": jnp.square,
+    "log1p": jnp.log1p, "logaddexp2": jnp.logaddexp2,
+    "match_condition": _match_condition,
+    "match_condition_count": lambda x, cond, value: jnp.sum(
+        _match_condition(x, cond, value).astype(jnp.int32)),
+    "zero_fraction": lambda x: jnp.mean((x == 0).astype(jnp.float32)),
+    "entropy": lambda x, axis=None: -jnp.sum(
+        x * jnp.log(jnp.maximum(x, 1e-30)), _axes(axis)),
+    "log_entropy": lambda x, axis=None: jnp.log(-jnp.sum(
+        x * jnp.log(jnp.maximum(x, 1e-30)), _axes(axis))),
+    "shannon_entropy": lambda x, axis=None: -jnp.sum(
+        x * jnp.log2(jnp.maximum(x, 1e-30)), _axes(axis)),
+    "standardize": lambda x, axis=-1, eps=1e-12: (
+        x - jnp.mean(x, _axes(axis), keepdims=True)) / jnp.sqrt(
+        jnp.var(x, _axes(axis), keepdims=True) + eps),
+    "is_non_decreasing": lambda x: jnp.all(jnp.diff(x.ravel()) >= 0),
+    "is_strictly_increasing": lambda x: jnp.all(jnp.diff(x.ravel()) > 0),
+    "clip_by_avg_norm": _clip_by_avg_norm,
+})
+
+LINALG.update({
+    "matrix_band_part": lambda x, lower, upper: x * (
+        (jnp.arange(x.shape[-2])[:, None] - jnp.arange(x.shape[-1])[None, :]
+         <= (lower if lower >= 0 else x.shape[-2]))
+        & (jnp.arange(x.shape[-1])[None, :] - jnp.arange(x.shape[-2])[:, None]
+           <= (upper if upper >= 0 else x.shape[-1]))),
+    "lu": jax.scipy.linalg.lu,
+})
+
+NN_EXT.update({
+    "layer_norm": lambda x, gain, bias, eps=1e-5: (
+        x - jnp.mean(x, -1, keepdims=True)) * lax.rsqrt(
+        jnp.var(x, -1, keepdims=True) + eps) * gain + bias,
+    "log_softmax": lambda x, axis=-1: jax.nn.log_softmax(x, axis),
+    "multi_head_dot_product_attention": _mh_attention,
+    "gelu": jax.nn.gelu, "selu": jax.nn.selu, "elu": jax.nn.elu,
+    "swish": jax.nn.swish, "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+})
+
+IMAGE.update({
+    "non_max_suppression": _nms,
+    "crop_and_resize": _crop_and_resize,
+})
+
+
 NAMESPACES = {
     "base": BASE, "math": MATH_EXT, "nn": NN_EXT, "loss": LOSS_EXT,
     "linalg": LINALG, "bitwise": BITWISE, "random": RANDOM, "cnn": CNN,

@@ -85,7 +85,8 @@ def fused_bn_act(x2d, scale, shift, activation: str = "identity",
 def _fwd(x2d, scale, shift, activation, interpret):
     res = (x2d, scale, shift)
     if pltpu is None:
-        return bn_act_reference(x2d, scale, shift, activation), res
+        return bn_act_reference(x2d, scale, shift, activation
+                                ).astype(x2d.dtype), res
     if interpret is None:
         interpret = _interpret_default()
     n, c = x2d.shape

@@ -174,10 +174,10 @@ class StatsListener(TrainingListener):
     training-health chart. Writes TensorBoard scalars when available AND
     always a JSONL stream that ``deeplearning4j_tpu.ui`` renders in the
     terminal. Ratio computation snapshots params every `frequency` steps
-    (off the hot path; a few tiny reductions per report)."""
-    deferred_score_ok = True  # pure logging: fit() may report the
-    # (step, score) pair one dispatch late to keep the device busy
+    (off the hot path; a few tiny reductions per report).
 
+    NOT deferred_score_ok: _ratios reads live model params, so the
+    (step, score, params) triple must stay synchronous."""
 
     def __init__(self, log_dir="runs/dl4j_tpu", frequency: int = 10,
                  report_ratios: bool = True, tensorboard: bool = True):

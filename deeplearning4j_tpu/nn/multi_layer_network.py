@@ -389,6 +389,9 @@ class MultiLayerNetwork:
         finally:
             if wrapped is not None:
                 wrapped.close()
+            # a mid-epoch exception must still deliver the completed step's
+            # deferred callback — scores would otherwise end one step short
+            flush_pending()
         if anomaly_check is not None:
             anomaly_check.flush()
         return None if last is None else float(last)

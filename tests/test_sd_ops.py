@@ -363,9 +363,156 @@ def test_ops_are_differentiable():
 
 def test_registry_breadth():
     # VERDICT r1: "broaden to ~300 ops". Count the full registry (new
-    # namespaces + the original math/nn/loss tables).
+    # namespaces + the original math/nn/loss tables). The r2 long-tail
+    # pass pushes the registry past 300 on its own.
     from deeplearning4j_tpu.autodiff import samediff as sdm
     total = (sd_ops.op_count() + len(sdm._MATH) + len(sdm._NN)
              + len(sdm._LOSS))
-    assert total >= 300, total
-    assert sd_ops.op_count() >= 240, sd_ops.op_count()
+    assert total >= 360, total
+    assert sd_ops.op_count() >= 300, sd_ops.op_count()
+
+
+# ------------------------------------------------------- r2 long-tail ops
+def test_match_condition_family():
+    x = jnp.asarray([-1.0, 0.0, 2.0, 5.0])
+    m = sd_ops.MATH_EXT["match_condition"](x, "gt", 1.0)
+    np.testing.assert_array_equal(np.asarray(m), [False, False, True, True])
+    assert int(sd_ops.MATH_EXT["match_condition_count"](x, "lte", 0.0)) == 2
+    with pytest.raises(ValueError, match="unknown condition"):
+        sd_ops.MATH_EXT["match_condition"](x, "almost", 1.0)
+    assert float(sd_ops.MATH_EXT["zero_fraction"](x)) == pytest.approx(0.25)
+
+
+def test_abs_reductions_and_entropy():
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.MATH_EXT["amax"](jnp.asarray(A))),
+        np.abs(A).max(), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.MATH_EXT["asum"](jnp.asarray(A), 0)),
+        np.abs(A).sum(0), rtol=1e-5)
+    p = np.asarray([0.5, 0.25, 0.25], np.float32)
+    np.testing.assert_allclose(
+        float(sd_ops.MATH_EXT["shannon_entropy"](jnp.asarray(p))), 1.5,
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        float(sd_ops.MATH_EXT["entropy"](jnp.asarray(p))),
+        -np.sum(p * np.log(p)), rtol=1e-5)
+    s = np.asarray(sd_ops.MATH_EXT["standardize"](jnp.asarray(A), 1))
+    np.testing.assert_allclose(s.mean(1), 0, atol=1e-6)
+    np.testing.assert_allclose(s.std(1), 1, atol=1e-3)
+    assert bool(sd_ops.MATH_EXT["is_non_decreasing"](jnp.asarray([1, 1, 2])))
+    assert not bool(sd_ops.MATH_EXT["is_strictly_increasing"](
+        jnp.asarray([1, 1, 2])))
+
+
+def test_unsorted_segment_long_tail():
+    x = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    ids = jnp.asarray([0, 0, 1, 1])
+    assert np.asarray(sd_ops.BASE["unsorted_segment_min"](x, ids, 2)
+                      ).tolist() == [1.0, 3.0]
+    assert np.asarray(sd_ops.BASE["unsorted_segment_max"](x, ids, 2)
+                      ).tolist() == [2.0, 4.0]
+    assert np.asarray(sd_ops.BASE["unsorted_segment_prod"](x, ids, 2)
+                      ).tolist() == [2.0, 12.0]
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.BASE["unsorted_segment_mean"](x, ids, 2)),
+        [1.5, 3.5])
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.BASE["unsorted_segment_sqrt_n"](x, ids, 2)),
+        [3.0 / np.sqrt(2), 7.0 / np.sqrt(2)])
+
+
+def test_space_batch_roundtrip_and_merge():
+    x = jnp.asarray(R.random((2, 4, 4, 3)).astype(np.float32))
+    sb = sd_ops.BASE["space_to_batch"](x, 2)
+    assert sb.shape == (8, 2, 2, 3)
+    back = sd_ops.BASE["batch_to_space"](sb, 2)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+    a, b = jnp.asarray(A), jnp.asarray(B)
+    np.testing.assert_allclose(np.asarray(sd_ops.BASE["merge_add"](a, b)),
+                               A + B, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sd_ops.BASE["merge_avg"](a, b)),
+                               (A + B) / 2, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sd_ops.BASE["merge_max"](a, b)),
+                               np.maximum(A, B), rtol=1e-6)
+    d = sd_ops.BASE["list_diff"](jnp.asarray([1, 2, 3, 4]),
+                                 jnp.asarray([2, 4]), size=2)
+    assert sorted(np.asarray(d).tolist()) == [1, 3]
+
+
+def test_matrix_band_part_and_lu():
+    x = jnp.asarray(SQ)
+    band = np.asarray(sd_ops.LINALG["matrix_band_part"](x, 1, 0))
+    want = np.tril(SQ) * (np.triu(np.ones_like(SQ), -1) > 0)
+    np.testing.assert_allclose(band, want, rtol=1e-6)
+    # full band = identity op
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.LINALG["matrix_band_part"](x, -1, -1)), SQ)
+    p, l, u = sd_ops.LINALG["lu"](x)
+    np.testing.assert_allclose(np.asarray(p @ l @ u), SQ, atol=1e-4)
+
+
+def test_layer_norm_and_mh_attention():
+    x = jnp.asarray(A)
+    g = jnp.ones(5)
+    b = jnp.zeros(5)
+    ln = np.asarray(sd_ops.NN_EXT["layer_norm"](x, g, b))
+    np.testing.assert_allclose(ln.mean(1), 0, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.NN_EXT["log_softmax"](x)),
+        np.log(np.exp(A) / np.exp(A).sum(1, keepdims=True)), atol=1e-5)
+
+    heads, dp, din, t = 2, 4, 6, 3
+    q = jnp.asarray(R.standard_normal((1, t, din)).astype(np.float32))
+    wq, wk, wv = (jnp.asarray(R.standard_normal((heads, dp, din))
+                              .astype(np.float32) * 0.3) for _ in range(3))
+    wo = jnp.asarray(R.standard_normal((din, heads * dp)).astype(np.float32) * 0.3)
+    out = sd_ops.NN_EXT["multi_head_dot_product_attention"](
+        q, q, q, wq, wk, wv, wo)
+    assert out.shape == (1, t, din)
+    # oracle: single-batch manual attention
+    qh = np.einsum("btd,hpd->bhtp", np.asarray(q), np.asarray(wq))
+    kh = np.einsum("btd,hpd->bhtp", np.asarray(q), np.asarray(wk))
+    vh = np.einsum("btd,hpd->bhtp", np.asarray(q), np.asarray(wv))
+    s = np.einsum("bhqp,bhkp->bhqk", qh, kh) / np.sqrt(dp)
+    att = np.exp(s - s.max(-1, keepdims=True))
+    att = att / att.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bhkp->bhqp", att, vh)
+    o = o.transpose(0, 2, 1, 3).reshape(1, t, heads * dp)
+    want = np.einsum("btx,ox->bto", o, np.asarray(wo))
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+
+
+def test_non_max_suppression():
+    boxes = jnp.asarray([[0, 0, 1, 1],        # best
+                         [0, 0, 1.05, 1.05],  # overlaps best → suppressed
+                         [2, 2, 3, 3],        # separate cluster
+                         [2, 2, 3.02, 3.02]], jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7, 0.6])
+    idx, count = sd_ops.IMAGE["non_max_suppression"](boxes, scores, 4,
+                                                     iou_threshold=0.5)
+    assert int(count) == 2
+    assert np.asarray(idx)[:2].tolist() == [0, 2]
+
+
+def test_crop_and_resize_identity_and_quadrant():
+    img = jnp.asarray(R.random((1, 8, 8, 3)).astype(np.float32))
+    # identity box reproduces the image
+    out = sd_ops.IMAGE["crop_and_resize"](img, [[0, 0, 1, 1]], [0], (8, 8))
+    np.testing.assert_allclose(np.asarray(out)[0], np.asarray(img)[0],
+                               atol=1e-5)
+    # top-left quadrant at native resolution
+    out = sd_ops.IMAGE["crop_and_resize"](
+        img, [[0, 0, 3.0 / 7.0, 3.0 / 7.0]], [0], (4, 4))
+    np.testing.assert_allclose(np.asarray(out)[0],
+                               np.asarray(img)[0, :4, :4], atol=1e-5)
+    # crop size 1 samples the box CENTER (TF semantics), not the corner
+    out = sd_ops.IMAGE["crop_and_resize"](img, [[0, 0, 1, 1]], [0], (1, 1))
+    c = 0.5 * 7.0   # center coord 3.5 → mean of the 4 middle pixels
+    want = np.asarray(img)[0, 3:5, 3:5].mean((0, 1))
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0], want, atol=1e-5)
+    assert not np.allclose(np.asarray(out)[0, 0, 0], np.asarray(img)[0, 0, 0])
+    # out-of-range samples take the extrapolation value (0)
+    out = sd_ops.IMAGE["crop_and_resize"](img, [[0.5, 0.5, 1.5, 1.5]],
+                                          [0], (4, 4))
+    assert np.asarray(out)[0, -1, -1].tolist() == [0.0, 0.0, 0.0]
