@@ -682,6 +682,16 @@ _CONDS = {
 }
 
 
+def _list_diff(x, y, size):
+    """Upstream listDiff returns (values, indices): the indices (padded
+    with -1 beyond the true count) disambiguate pad slots from a genuine
+    element 0 in the values."""
+    x = jnp.asarray(x)
+    keep = ~jnp.isin(x, jnp.asarray(y))
+    (idx,) = jnp.where(keep, size=int(size), fill_value=-1)
+    return jnp.where(idx >= 0, x[jnp.maximum(idx, 0)], 0), idx
+
+
 def _clip_by_avg_norm(x, clip, axes=None):
     rms = jnp.sqrt(jnp.mean(jnp.square(x), _axes(axes), keepdims=True))
     return jnp.where(rms > clip, x * clip / jnp.maximum(rms, 1e-12), x)
@@ -750,8 +760,8 @@ def _nms(boxes, scores, max_out, iou_threshold=0.5, score_threshold=-jnp.inf):
         live, picked_count = state
         masked = jnp.where(live, scores, -jnp.inf)
         i = jnp.argmax(masked)
-        ok = masked[i] > jnp.maximum(score_threshold, -jnp.inf + 1)
-        ok = jnp.logical_and(ok, jnp.isfinite(masked[i]))
+        ok = jnp.logical_and(masked[i] > score_threshold,
+                             jnp.isfinite(masked[i]))
         suppress = iou(i, jnp.arange(n)) > iou_threshold
         live = jnp.where(ok, jnp.logical_and(live, ~suppress), live)
         live = live.at[i].set(False)
@@ -789,10 +799,9 @@ def _crop_and_resize(images, boxes, box_indices, crop_size,
         wy = (ys - y0)[:, None, None]
         wx = (xs - x0)[None, :, None]
         img = images[bi]
-        a = img[y0][:, x0]
-        b = img[y0][:, x1i]
-        c = img[y1i][:, x0]
-        d = img[y1i][:, x1i]
+        top, bot = img[y0], img[y1i]         # one row gather each
+        a, b = top[:, x0], top[:, x1i]
+        c, d = bot[:, x0], bot[:, x1i]
         out = (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
                + c * wy * (1 - wx) + d * wy * wx)
         inside = ((ys >= 0) & (ys <= h - 1))[:, None, None] \
@@ -824,8 +833,7 @@ BASE.update({
     "merge_add": lambda *xs: sum(xs),
     "merge_avg": lambda *xs: sum(xs) / len(xs),
     "merge_max": lambda *xs: jnp.stack(xs).max(0),
-    "list_diff": lambda x, y, size: jnp.setdiff1d(
-        x, y, size=int(size), fill_value=0),
+    "list_diff": _list_diff,
 })
 
 MATH_EXT.update({
@@ -833,8 +841,7 @@ MATH_EXT.update({
     "amin": lambda x, axis=None: jnp.min(jnp.abs(x), _axes(axis)),
     "amean": lambda x, axis=None: jnp.mean(jnp.abs(x), _axes(axis)),
     "asum": lambda x, axis=None: jnp.sum(jnp.abs(x), _axes(axis)),
-    "reciprocal": jnp.reciprocal, "square": jnp.square,
-    "log1p": jnp.log1p, "logaddexp2": jnp.logaddexp2,
+    "logaddexp2": jnp.logaddexp2,
     "match_condition": _match_condition,
     "match_condition_count": lambda x, cond, value: jnp.sum(
         _match_condition(x, cond, value).astype(jnp.int32)),
@@ -862,14 +869,12 @@ LINALG.update({
     "lu": jax.scipy.linalg.lu,
 })
 
+# NOTE: layer_norm/log_softmax/gelu/selu/elu/swish/mish (and square/log1p/
+# reciprocal in math) already live in samediff's core _NN/_MATH tables —
+# NOT duplicated here (sd.nn merges both dicts; a second copy would shadow
+# signatures and double-count the registry).
 NN_EXT.update({
-    "layer_norm": lambda x, gain, bias, eps=1e-5: (
-        x - jnp.mean(x, -1, keepdims=True)) * lax.rsqrt(
-        jnp.var(x, -1, keepdims=True) + eps) * gain + bias,
-    "log_softmax": lambda x, axis=-1: jax.nn.log_softmax(x, axis),
     "multi_head_dot_product_attention": _mh_attention,
-    "gelu": jax.nn.gelu, "selu": jax.nn.selu, "elu": jax.nn.elu,
-    "swish": jax.nn.swish, "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
 })
 
 IMAGE.update({

@@ -387,11 +387,15 @@ class MultiLayerNetwork:
                     if hasattr(listener, "on_epoch_end"):
                         listener.on_epoch_end(self)
         finally:
+            # a mid-epoch exception must still deliver the completed step's
+            # deferred callback (scores would end one step short) — but it
+            # must never MASK the original error, and runs before close()
+            try:
+                flush_pending()
+            except Exception:  # noqa: BLE001 — original exception wins
+                pass
             if wrapped is not None:
                 wrapped.close()
-            # a mid-epoch exception must still deliver the completed step's
-            # deferred callback — scores would otherwise end one step short
-            flush_pending()
         if anomaly_check is not None:
             anomaly_check.flush()
         return None if last is None else float(last)

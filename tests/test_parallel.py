@@ -68,6 +68,34 @@ def test_ring_attention_exact(devices8):
     assert float(jnp.abs(ref2 - got2).max()) < 2e-5
 
 
+def test_ring_attention_long_context(devices8):
+    """SURVEY §7 long-context scale: exact at T=4096 (vs full attention)
+    and a T=16384 run whose first sequence-block must equal LOCAL causal
+    attention (causality masks every other block) — validates the ring at
+    lengths where materializing the T² score matrix would be impossible
+    on-device."""
+    mesh = make_mesh(sp=8)
+    rng = np.random.default_rng(1)
+
+    t = 4096
+    q, k, v = (jnp.asarray(rng.standard_normal((1, t, 2, 8)), jnp.float32)
+               for _ in range(3))
+    ref = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    got = ring_attention(mesh, q, k, v, causal=True)
+    assert float(jnp.abs(ref - got).max()) < 5e-5
+
+    t = 16384
+    q, k, v = (jnp.asarray(rng.standard_normal((1, t, 1, 8)), jnp.float32)
+               for _ in range(3))
+    out = ring_attention(mesh, q, k, v, causal=True)
+    assert out.shape == (1, t, 1, 8)
+    assert bool(jnp.isfinite(out).all())
+    blk = t // 8
+    local = jax.nn.dot_product_attention(q[:, :blk], k[:, :blk], v[:, :blk],
+                                         is_causal=True)
+    assert float(jnp.abs(out[:, :blk] - local).max()) < 5e-5
+
+
 def test_pipeline_matches_single(devices8):
     cfg = tfm.TransformerConfig(vocab_size=61, d_model=16, n_heads=2,
                                 n_layers=4, d_ff=32, max_seq=8,

@@ -366,9 +366,10 @@ def test_registry_breadth():
     # namespaces + the original math/nn/loss tables). The r2 long-tail
     # pass pushes the registry past 300 on its own.
     from deeplearning4j_tpu.autodiff import samediff as sdm
-    total = (sd_ops.op_count() + len(sdm._MATH) + len(sdm._NN)
-             + len(sdm._LOSS))
-    assert total >= 360, total
+    distinct = set()
+    for table in (*sd_ops.NAMESPACES.values(), sdm._MATH, sdm._NN, sdm._LOSS):
+        distinct.update(table)
+    assert len(distinct) >= 360, len(distinct)
     assert sd_ops.op_count() >= 300, sd_ops.op_count()
 
 
@@ -435,9 +436,15 @@ def test_space_batch_roundtrip_and_merge():
                                (A + B) / 2, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(sd_ops.BASE["merge_max"](a, b)),
                                np.maximum(A, B), rtol=1e-6)
-    d = sd_ops.BASE["list_diff"](jnp.asarray([1, 2, 3, 4]),
-                                 jnp.asarray([2, 4]), size=2)
-    assert sorted(np.asarray(d).tolist()) == [1, 3]
+    vals, idx = sd_ops.BASE["list_diff"](jnp.asarray([1, 2, 3, 4]),
+                                         jnp.asarray([2, 4]), size=2)
+    assert np.asarray(vals).tolist() == [1, 3]
+    assert np.asarray(idx).tolist() == [0, 2]
+    # a genuine 0 in the diff is distinguishable from padding via indices
+    vals, idx = sd_ops.BASE["list_diff"](jnp.asarray([0, 5]),
+                                         jnp.asarray([5]), size=2)
+    assert np.asarray(vals).tolist() == [0, 0]
+    assert np.asarray(idx).tolist() == [0, -1]   # one real hit, one pad
 
 
 def test_matrix_band_part_and_lu():
@@ -453,14 +460,17 @@ def test_matrix_band_part_and_lu():
 
 
 def test_layer_norm_and_mh_attention():
+    # layer_norm/log_softmax live in samediff's core _NN table (the r2 pass
+    # must NOT shadow them) — drive them through the sd.nn dispatch
+    sd = SameDiff.create()
     x = jnp.asarray(A)
-    g = jnp.ones(5)
-    b = jnp.zeros(5)
-    ln = np.asarray(sd_ops.NN_EXT["layer_norm"](x, g, b))
+    xv = sd.constant("x", x)
+    ln = np.asarray(sd.nn.layer_norm(xv, jnp.ones(5), jnp.zeros(5)).eval())
     np.testing.assert_allclose(ln.mean(1), 0, atol=1e-5)
     np.testing.assert_allclose(
-        np.asarray(sd_ops.NN_EXT["log_softmax"](x)),
+        np.asarray(sd.nn.log_softmax(xv).eval()),
         np.log(np.exp(A) / np.exp(A).sum(1, keepdims=True)), atol=1e-5)
+    assert "layer_norm" not in sd_ops.NN_EXT
 
     heads, dp, din, t = 2, 4, 6, 3
     q = jnp.asarray(R.standard_normal((1, t, din)).astype(np.float32))
