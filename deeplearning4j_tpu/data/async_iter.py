@@ -22,27 +22,68 @@ from typing import Optional
 
 import numpy as np
 
-from .dataset import DataSet
+from .dataset import DataSet, MultiDataSet
 
 _SENTINEL = b"__END__"
 
 
-def _pack(ds: DataSet) -> bytes:
+def _pack(ds) -> bytes:
     buf = io.BytesIO()
-    parts = {"features": ds.features, "labels": ds.labels}
-    if ds.features_mask is not None:
-        parts["features_mask"] = ds.features_mask
-    if ds.labels_mask is not None:
-        parts["labels_mask"] = ds.labels_mask
+    if isinstance(ds, MultiDataSet):
+        parts = {}
+        for i, f in enumerate(ds.features):
+            parts[f"mf{i}"] = f
+        for i, l in enumerate(ds.labels):
+            parts[f"ml{i}"] = l
+        for i, m in enumerate(ds.features_masks or []):
+            if m is not None:
+                parts[f"mfm{i}"] = m
+        for i, m in enumerate(ds.labels_masks or []):
+            if m is not None:
+                parts[f"mlm{i}"] = m
+    else:
+        parts = {"features": ds.features, "labels": ds.labels}
+        if ds.features_mask is not None:
+            parts["features_mask"] = ds.features_mask
+        if ds.labels_mask is not None:
+            parts["labels_mask"] = ds.labels_mask
     np.savez(buf, **parts)
     return buf.getvalue()
 
 
-def _unpack(raw: bytes) -> DataSet:
+def _unpack(raw: bytes):
     with np.load(io.BytesIO(raw)) as z:
-        return DataSet(z["features"], z["labels"],
-                       z["features_mask"] if "features_mask" in z else None,
-                       z["labels_mask"] if "labels_mask" in z else None)
+        if "features" in z:
+            return DataSet(z["features"], z["labels"],
+                           z["features_mask"] if "features_mask" in z else None,
+                           z["labels_mask"] if "labels_mask" in z else None)
+        def series(prefix):
+            out = []
+            for i in range(len(z.files)):
+                if f"{prefix}{i}" not in z:
+                    break
+                out.append(z[f"{prefix}{i}"])
+            return out
+        feats, labs = series("mf"), series("ml")
+        fmasks = [z[f"mfm{i}"] if f"mfm{i}" in z else None
+                  for i in range(len(feats))]
+        lmasks = [z[f"mlm{i}"] if f"mlm{i}" in z else None
+                  for i in range(len(labs))]
+        return MultiDataSet(
+            feats, labs,
+            fmasks if any(m is not None for m in fmasks) else None,
+            lmasks if any(m is not None for m in lmasks) else None)
+
+
+def maybe_wrap_async(iterator, queue_size: int = 2):
+    """(possibly-wrapped iterator, wrapper-or-None): wrap when the source
+    opts in via async_supported() and isn't already async — the shared
+    policy for MultiLayerNetwork.fit and ComputationGraph.fit."""
+    if getattr(iterator, "async_supported", lambda: False)() \
+            and not isinstance(iterator, AsyncDataSetIterator):
+        wrapped = AsyncDataSetIterator(iterator, queue_size=queue_size)
+        return wrapped, wrapped
+    return iterator, None
 
 
 class AsyncDataSetIterator:

@@ -202,6 +202,55 @@ class Word2Vec:
         m.syn0 = np.load(path + ".npy")
         return m
 
+    def save_word2vec_format(self, path: str, include_header: bool = True):
+        """The interchange text format every word2vec/fastText/GloVe tool
+        reads (reference WordVectorSerializer.writeWord2VecModel): optional
+        "V D" header line, then one `word v1 v2 ... vD` line per word.
+        UNK (index 0) is skipped — it is an internal slot, not a word."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            if include_header:
+                f.write(f"{len(self.vocab.index_to_word) - 1} "
+                        f"{self.layer_size}\n")
+            for i, word in enumerate(self.vocab.index_to_word):
+                if i == 0:
+                    continue
+                vec = " ".join(f"{v:.6f}" for v in self.syn0[i])
+                f.write(f"{word} {vec}\n")
+
+    @classmethod
+    def load_word2vec_format(cls, path: str) -> "Word2Vec":
+        """Read the text interchange format (reference
+        WordVectorSerializer.readWord2VecModel); header line optional."""
+        words, rows = [], []
+        with open(path, encoding="utf-8") as f:
+            for ln_no, ln in enumerate(f):
+                # split() (not split(" ")): word2vec.c writes a trailing
+                # space after the last value on every line
+                parts = ln.split()
+                if ln_no == 0 and len(parts) == 2 \
+                        and parts[0].isdigit() and parts[1].isdigit():
+                    continue  # "V D" header (both tokens must be ints —
+                    #              a 1-D vector line is word + ONE float)
+                if len(parts) < 2:
+                    continue
+                words.append(parts[0])
+                rows.append(np.asarray(parts[1:], np.float32))
+        if not rows:
+            raise ValueError(f"no word vectors found in {path}")
+        dims = {len(r) for r in rows}
+        if len(dims) != 1:
+            raise ValueError(f"inconsistent vector sizes in {path}: {dims}")
+        d = dims.pop()
+        m = cls(layer_size=d)
+        m.vocab = VocabCache()
+        m.vocab.index_to_word = [VocabCache.UNK] + words
+        m.vocab.word_to_index = {w: i for i, w in
+                                 enumerate(m.vocab.index_to_word)}
+        m.syn0 = np.concatenate([np.zeros((1, d), np.float32),
+                                 np.stack(rows)])
+        return m
+
 
 @dataclass
 class ParagraphVectors(Word2Vec):

@@ -249,8 +249,25 @@ class ComputationGraph:
         if getattr(self, "_anomaly_detector", None) is not None:
             from ..train.anomaly import DelayedAnomalyCheck
             anomaly_check = DelayedAnomalyCheck(self._anomaly_detector)
-        for _ in range(epochs):
-            for ds in iterator:
+        # async batch prep on a background thread, like MultiLayerNetwork.fit
+        # (DL4J wraps both fit entry points the same way)
+        from ..data.async_iter import maybe_wrap_async
+        run_iter, wrapped = maybe_wrap_async(iterator)
+        try:
+            last = self._fit_epochs(run_iter, iterator, wrapped, epochs,
+                                    step_fn, anomaly_check)
+        finally:
+            if wrapped is not None:
+                wrapped.close()
+        if anomaly_check is not None:
+            anomaly_check.flush()
+        return None if last is None else float(last)
+
+    def _fit_epochs(self, run_iter, source_iter, wrapped, epochs, step_fn,
+                    anomaly_check):
+        last = None
+        for e in range(epochs):
+            for ds in run_iter:
                 from ..data.dataset import MultiDataSet as MDS
                 if isinstance(ds, MDS):
                     feats, labs = ds.features, ds.labels
@@ -275,14 +292,21 @@ class ComputationGraph:
                     for listener in self.listeners:
                         listener.iteration_done(self, self._step_count, self.epoch_count, lv)
             self.epoch_count += 1
-            if hasattr(iterator, "reset"):
-                iterator.reset()
+            if e < epochs - 1:
+                if hasattr(run_iter, "reset"):
+                    run_iter.reset()
+            elif wrapped is not None:
+                # final epoch: close the wrapper FIRST so reset doesn't
+                # spin up a producer whose prefetch is thrown away
+                wrapped.close()
+                if hasattr(source_iter, "reset"):
+                    source_iter.reset()
+            elif hasattr(run_iter, "reset"):
+                run_iter.reset()
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(self)
-        if anomaly_check is not None:
-            anomaly_check.flush()
-        return None if last is None else float(last)
+        return last
 
     def score(self, ds):
         from ..data.dataset import MultiDataSet as MDS

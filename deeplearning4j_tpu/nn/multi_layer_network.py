@@ -316,13 +316,8 @@ class MultiLayerNetwork:
         # DL4J's fit wraps the source in an AsyncDataSetIterator so batch
         # prep runs on a background thread while the device computes; do
         # the same when the iterator opts in (async_supported).
-        wrapped = None
-        run_iter = iterator
-        if getattr(iterator, "async_supported", lambda: False)() \
-                and type(iterator).__name__ != "AsyncDataSetIterator":
-            from ..data.async_iter import AsyncDataSetIterator
-            wrapped = AsyncDataSetIterator(iterator, queue_size=2)
-            run_iter = wrapped
+        from ..data.async_iter import maybe_wrap_async
+        run_iter, wrapped = maybe_wrap_async(iterator)
 
         # Listener score fetches are deferred ONE iteration when every
         # attached listener opts in (`deferred_score_ok`, the pure logging

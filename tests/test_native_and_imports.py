@@ -95,6 +95,42 @@ def test_async_iterator_delivers_everything():
     async_it.close()
 
 
+def test_async_iterator_multidataset_roundtrip():
+    """MultiDataSet batches survive the ring pack/unpack (ComputationGraph
+    fit wraps its iterators the same way MultiLayerNetwork does)."""
+    from deeplearning4j_tpu.data.async_iter import _pack, _unpack
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    rng = np.random.default_rng(0)
+    mds = MultiDataSet(
+        [rng.random((4, 3)).astype(np.float32),
+         rng.random((4, 2)).astype(np.float32)],
+        [rng.random((4, 5)).astype(np.float32)],
+        features_masks=[None, rng.random((4, 2)).astype(np.float32)],
+        labels_masks=None)
+    back = _unpack(_pack(mds))
+    assert isinstance(back, MultiDataSet)
+    assert len(back.features) == 2 and len(back.labels) == 1
+    np.testing.assert_array_equal(back.features[1], mds.features[1])
+    np.testing.assert_array_equal(back.labels[0], mds.labels[0])
+    assert back.features_masks[0] is None
+    np.testing.assert_array_equal(back.features_masks[1],
+                                  mds.features_masks[1])
+
+    class MdsIter:
+        batch_size = 4
+
+        def __iter__(self):
+            yield mds
+            yield mds
+
+    it = AsyncDataSetIterator(MdsIter(), queue_size=2)
+    try:
+        got = list(it)
+        assert len(got) == 2 and isinstance(got[0], MultiDataSet)
+    finally:
+        it.close()
+
+
 def test_async_iterator_propagates_source_errors():
     """A source iterator that raises mid-stream must surface on the
     consumer — silent epoch truncation is a training-integrity bug."""

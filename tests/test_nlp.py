@@ -74,6 +74,51 @@ def test_word2vec_learns_cooccurrence():
     assert any(w in near for w in ("moon", "dark", "evening", "stars"))
 
 
+def test_word2vec_text_format_roundtrip(tmp_path):
+    """The interchange .vec text format (WordVectorSerializer parity):
+    round-trip preserves vectors/similarities; headerless files load too."""
+    w2v = Word2Vec(layer_size=6, min_word_frequency=1, epochs=1,
+                   batch_size=64, subsample=0.0).fit(
+        ["red green blue cyan"] * 20)
+    p = str(tmp_path / "vectors.vec")
+    w2v.save_word2vec_format(p)
+    first = open(p).readline().split()
+    assert first == [str(len(w2v.vocab.index_to_word) - 1), "6"]
+
+    back = Word2Vec.load_word2vec_format(p)
+    assert back.has_word("red") and back.layer_size == 6
+    np.testing.assert_allclose(back.get_word_vector("green"),
+                               w2v.get_word_vector("green"), atol=1e-5)
+    assert back.similarity("red", "blue") == pytest.approx(
+        w2v.similarity("red", "blue"), abs=1e-5)
+
+    # headerless variant (some tools omit it)
+    lines = open(p).read().splitlines()[1:]
+    p2 = str(tmp_path / "nohdr.vec")
+    open(p2, "w").write("\n".join(lines) + "\n")
+    back2 = Word2Vec.load_word2vec_format(p2)
+    np.testing.assert_allclose(back2.get_word_vector("cyan"),
+                               w2v.get_word_vector("cyan"), atol=1e-5)
+
+    with pytest.raises(ValueError, match="no word vectors"):
+        empty = tmp_path / "empty.vec"
+        empty.write_text("")
+        Word2Vec.load_word2vec_format(str(empty))
+
+    # word2vec.c writes a trailing space after the last value — must load
+    p3 = tmp_path / "trailing.vec"
+    p3.write_text("2 3\nfoo 1.0 2.0 3.0 \nbar 4.0 5.0 6.0 \n")
+    m = Word2Vec.load_word2vec_format(str(p3))
+    np.testing.assert_allclose(m.get_word_vector("bar"), [4.0, 5.0, 6.0])
+
+    # headerless 1-D vectors: the first line is NOT mistaken for a header
+    p4 = tmp_path / "oned.vec"
+    p4.write_text("a 1.5\nb 2.5\n")
+    m = Word2Vec.load_word2vec_format(str(p4))
+    assert m.has_word("a") and m.layer_size == 1
+    np.testing.assert_allclose(m.get_word_vector("a"), [1.5])
+
+
 def test_word2vec_save_load(tmp_path):
     w2v = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1,
                    batch_size=64, subsample=0.0).fit(
