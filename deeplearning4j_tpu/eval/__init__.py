@@ -1,5 +1,6 @@
 """deeplearning4j_tpu.eval — evaluation metrics."""
 
+from .calibration import EvaluationCalibration
 from .classification import ConfusionMatrix, Evaluation, EvaluationBinary
 from .regression import RegressionEvaluation
 from .roc import ROC, ROCBinary, ROCMultiClass

@@ -1,0 +1,168 @@
+"""EvaluationCalibration — probability-calibration analysis.
+
+Reference parity: ``org.nd4j.evaluation.classification.EvaluationCalibration``
+(reliability diagram per class, residual plots, probability histograms —
+the charts the reference's UI renders for calibration health).
+
+TPU-first: each eval() call is ONE jitted pass vmapped over classes
+(per-class scatter-add histograms over the whole (N, C) batch); the host
+keeps only the small per-bin accumulators, which merge across batches and
+devices like the other evaluators.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _calibration_pass(probs, positives, rel_bins, hist_bins):
+    """(N, C) probabilities + (N, C) 0/1 positives → per-class accumulators:
+    reliability (counts, Σprob, pos) over rel_bins and the residual /
+    probability-by-label histograms over hist_bins. One dispatch total."""
+
+    def per_class(p, y):
+        ridx = jnp.clip((p * rel_bins).astype(jnp.int32), 0, rel_bins - 1)
+        counts = jnp.zeros(rel_bins).at[ridx].add(1.0)
+        prob_sums = jnp.zeros(rel_bins).at[ridx].add(p)
+        pos = jnp.zeros(rel_bins).at[ridx].add(y)
+        resid = jnp.abs(y - p)
+        hidx = jnp.clip((resid * hist_bins).astype(jnp.int32), 0,
+                        hist_bins - 1)
+        residual = jnp.zeros(hist_bins).at[hidx].add(1.0)
+        pidx = jnp.clip((p * hist_bins).astype(jnp.int32), 0, hist_bins - 1)
+        hist_all = jnp.zeros(hist_bins).at[pidx].add(1.0)
+        hist_pos = jnp.zeros(hist_bins).at[pidx].add(y)
+        return counts, prob_sums, pos, residual, hist_all, hist_pos
+
+    return jax.vmap(per_class, in_axes=1)(probs, positives)
+
+
+class EvaluationCalibration:
+    """Reliability/residual/probability-histogram accumulator."""
+
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 50):
+        self.reliability_bins = int(reliability_bins)
+        self.histogram_bins = int(histogram_bins)
+        self._n_classes = None
+
+    def _ensure(self, n_classes):
+        if self._n_classes is None:
+            self._n_classes = n_classes
+            rb, hb = self.reliability_bins, self.histogram_bins
+            self._counts = np.zeros((n_classes, rb))
+            self._prob_sums = np.zeros((n_classes, rb))
+            self._pos = np.zeros((n_classes, rb))
+            self._residual_hist = np.zeros((n_classes, hb))
+            self._prob_hist_pos = np.zeros((n_classes, hb))
+            self._prob_hist_neg = np.zeros((n_classes, hb))
+        elif n_classes != self._n_classes:
+            raise ValueError(f"class count changed: {self._n_classes} → "
+                             f"{n_classes}")
+
+    def _require_data(self):
+        if self._n_classes is None:
+            raise ValueError(
+                "EvaluationCalibration has no data — eval() was never "
+                "called (empty iterator?)")
+
+    # ------------------------------------------------------------ accumulate
+    def eval(self, labels, predictions, mask=None):
+        """labels (N, C) one-hot (or (N,) indices), predictions (N, C)
+        probabilities. RNN shapes (B, T, C) are flattened with `mask`
+        (B, T) selecting valid steps — same convention as Evaluation."""
+        p = jnp.asarray(predictions)
+        y = jnp.asarray(labels)
+        if p.ndim == 3:
+            b, t, c = p.shape
+            p = p.reshape(b * t, c)
+            y = y.reshape(b * t, -1) if y.ndim == 3 else y.reshape(b * t)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(b * t) > 0
+                p = p[np.asarray(keep)]
+                y = y[np.asarray(keep)]
+        if y.ndim == 1:
+            y = jax.nn.one_hot(y.astype(jnp.int32), p.shape[-1])
+        self._ensure(p.shape[-1])
+        counts, sums, pos, residual, hist_all, hist_pos = _calibration_pass(
+            p, (y > 0.5).astype(jnp.float32),
+            self.reliability_bins, self.histogram_bins)
+        self._counts += np.asarray(counts)
+        self._prob_sums += np.asarray(sums)
+        self._pos += np.asarray(pos)
+        self._residual_hist += np.asarray(residual)
+        hist_pos = np.asarray(hist_pos)
+        self._prob_hist_pos += hist_pos
+        self._prob_hist_neg += np.asarray(hist_all) - hist_pos
+        return self
+
+    def merge(self, other: "EvaluationCalibration") -> "EvaluationCalibration":
+        if (other.reliability_bins != self.reliability_bins
+                or other.histogram_bins != self.histogram_bins):
+            raise ValueError(
+                f"cannot merge: bin configs differ "
+                f"({self.reliability_bins}/{self.histogram_bins} vs "
+                f"{other.reliability_bins}/{other.histogram_bins})")
+        if other._n_classes is None:
+            return self
+        self._ensure(other._n_classes)
+        for attr in ("_counts", "_prob_sums", "_pos", "_residual_hist",
+                     "_prob_hist_pos", "_prob_hist_neg"):
+            setattr(self, attr, getattr(self, attr) + getattr(other, attr))
+        return self
+
+    # --------------------------------------------------------------- queries
+    def reliability_info(self, class_idx: int):
+        """(bin_centers, mean_predicted, fraction_positives, counts) — the
+        reliability diagram for one class (reference getReliabilityInfo)."""
+        self._require_data()
+        rb = self.reliability_bins
+        counts = self._counts[class_idx]
+        safe = np.maximum(counts, 1)
+        return ((np.arange(rb) + 0.5) / rb,
+                self._prob_sums[class_idx] / safe,
+                self._pos[class_idx] / safe,
+                counts.astype(np.int64))
+
+    def expected_calibration_error(self, class_idx: int = None) -> float:
+        """ECE: count-weighted |mean predicted − fraction positive|."""
+        self._require_data()
+        classes = (range(self._n_classes) if class_idx is None
+                   else [class_idx])
+        num, denom = 0.0, 0.0
+        for c in classes:
+            _, mean_p, frac_pos, counts = self.reliability_info(c)
+            num += float(np.sum(counts * np.abs(mean_p - frac_pos)))
+            denom += float(np.sum(counts))
+        return num / max(denom, 1.0)
+
+    def residual_plot(self, class_idx: int):
+        """(bin_centers, counts) histogram of |label − prob|."""
+        self._require_data()
+        hb = self.histogram_bins
+        return ((np.arange(hb) + 0.5) / hb,
+                self._residual_hist[class_idx].astype(np.int64))
+
+    def probability_histogram(self, class_idx: int, positive: bool = True):
+        """(bin_centers, counts) of predicted probability, split by the
+        true label (reference's positive/negative histograms)."""
+        self._require_data()
+        hb = self.histogram_bins
+        hist = (self._prob_hist_pos if positive
+                else self._prob_hist_neg)[class_idx]
+        return (np.arange(hb) + 0.5) / hb, hist.astype(np.int64)
+
+    def stats(self) -> str:
+        if self._n_classes is None:
+            return "EvaluationCalibration: no data"
+        lines = [f"EvaluationCalibration ({self.reliability_bins} bins, "
+                 f"{int(self._counts[0].sum())} samples/class)"]
+        for c in range(self._n_classes):
+            lines.append(f"  class {c}: ECE="
+                         f"{self.expected_calibration_error(c):.4f}")
+        lines.append(f"  overall ECE={self.expected_calibration_error():.4f}")
+        return "\n".join(lines)

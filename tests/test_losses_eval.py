@@ -139,3 +139,68 @@ def test_evaluation_binary():
     ev.eval(labels, preds)
     assert abs(ev.recall(0) - 0.5) < 1e-9  # out0: tp=1 fn=1
     assert abs(ev.precision(1) - 1.0) < 1e-9
+
+
+def test_evaluation_calibration():
+    """A well-calibrated predictor's reliability curve tracks the diagonal
+    (ECE small); a systematically overconfident one does not. Histograms
+    account for every sample; merge == single pass."""
+    from deeplearning4j_tpu.eval import EvaluationCalibration
+    rng = np.random.default_rng(0)
+    n = 20000
+    p1 = rng.uniform(0.02, 0.98, n).astype(np.float32)
+    y1 = (rng.random(n) < p1).astype(np.float32)      # labels drawn AT p
+    labels = np.stack([1 - y1, y1], 1)
+    preds = np.stack([1 - p1, p1], 1)
+
+    cal = EvaluationCalibration(reliability_bins=10)
+    cal.eval(labels, preds)
+    centers, mean_p, frac_pos, counts = cal.reliability_info(1)
+    assert counts.sum() == n
+    np.testing.assert_allclose(mean_p, frac_pos, atol=0.05)
+    ece_good = cal.expected_calibration_error()
+    assert ece_good < 0.03, ece_good
+
+    # overconfident: push probabilities toward the extremes
+    over = np.clip((p1 - 0.5) * 3 + 0.5, 0.01, 0.99).astype(np.float32)
+    bad = EvaluationCalibration(reliability_bins=10)
+    bad.eval(labels, np.stack([1 - over, over], 1))
+    assert bad.expected_calibration_error() > 3 * ece_good
+
+    # residual + probability histograms conserve mass, pos+neg == all
+    _, res = cal.residual_plot(1)
+    assert res.sum() == n
+    _, hp = cal.probability_histogram(1, positive=True)
+    _, hn = cal.probability_histogram(1, positive=False)
+    assert hp.sum() + hn.sum() == n
+    assert hp.sum() == int(y1.sum())
+
+    # merge across two halves equals one pass
+    a = EvaluationCalibration(reliability_bins=10)
+    b = EvaluationCalibration(reliability_bins=10)
+    a.eval(labels[: n // 2], preds[: n // 2])
+    b.eval(labels[n // 2:], preds[n // 2:])
+    a.merge(b)
+    # halves accumulate in f32 on device, so summation order shifts ulps
+    np.testing.assert_allclose(a.expected_calibration_error(),
+                               cal.expected_calibration_error(), atol=1e-5)
+    assert "ECE" in cal.stats()
+
+    # bin-config mismatch refuses to merge; no-data queries raise cleanly
+    import pytest as _pt
+    with _pt.raises(ValueError, match="bin configs differ"):
+        cal.merge(EvaluationCalibration(reliability_bins=20))
+    fresh = EvaluationCalibration()
+    with _pt.raises(ValueError, match="no data"):
+        fresh.expected_calibration_error()
+    assert "no data" in fresh.stats()
+
+    # masked RNN shape follows the Evaluation convention
+    rnn = EvaluationCalibration(reliability_bins=10)
+    lab3 = labels[:12].reshape(2, 6, 2)
+    pred3 = preds[:12].reshape(2, 6, 2)
+    mask = np.ones((2, 6), np.float32)
+    mask[0, 4:] = 0
+    rnn.eval(lab3, pred3, mask=mask)
+    _, _, _, counts3 = rnn.reliability_info(1)
+    assert counts3.sum() == 10   # 12 steps - 2 masked
