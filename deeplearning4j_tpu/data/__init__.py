@@ -7,10 +7,15 @@ from .datavec import (CSVRecordReader, CollectionRecordReader,
                       make_image_augmenter, resize_images)
 from .iterators import (ArrayDataSetIterator, BaseDatasetIterator,
                         Cifar10DataSetIterator, EmnistDataSetIterator,
-                        IrisDataSetIterator, KFoldIterator,
-                        ListDataSetIterator, MnistDataSetIterator,
-                        MultipleEpochsIterator, RandomDataSetIterator,
-                        make_synthetic_mnist)
+                        IrisDataSetIterator, IteratorDataSetIterator,
+                        KFoldIterator, ListDataSetIterator,
+                        MnistDataSetIterator, MultipleEpochsIterator,
+                        RandomDataSetIterator, make_synthetic_mnist)
+from .normalizers import (CompositeDataSetPreProcessor,
+                          ImagePreProcessingScaler,
+                          MultiNormalizerMinMaxScaler,
+                          MultiNormalizerStandardize, NormalizerMinMaxScaler,
+                          NormalizerStandardize, VGG16ImagePreProcessor)
 from .sequence_readers import (ALIGN_END, ALIGN_START, EQUAL_LENGTH,
                                CollectionSequenceRecordReader,
                                CSVLineSequenceRecordReader,

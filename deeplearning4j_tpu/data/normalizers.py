@@ -140,9 +140,7 @@ class NormalizerMinMaxScaler(AbstractNormalizer):
             self._l.update(ds.labels)
 
     def _scale(self, x, st):
-        rng = np.maximum(st.max - st.min, 1e-12)
-        unit = (x - st.min) / rng
-        return (unit * (self.max_range - self.min_range) + self.min_range).astype(np.float32)
+        return _minmax_scale(x, st, self.min_range, self.max_range)
 
     def _unscale(self, x, st):
         rng = np.maximum(st.max - st.min, 1e-12)
@@ -199,8 +197,9 @@ class VGG16ImagePreProcessor(AbstractNormalizer):
         return (x + self.MEANS).astype(np.float32)
 
 
-class MultiNormalizerStandardize:
-    """Per-input/per-output standardization for MultiDataSet."""
+class _MultiNormalizerBase:
+    """Shared streaming fit over MultiDataSet inputs/outputs; subclasses
+    define the per-array transform via _apply(x, stats)."""
 
     def __init__(self):
         self._f: list = []
@@ -226,13 +225,41 @@ class MultiNormalizerStandardize:
             data.reset()
         return self
 
+    def _apply(self, x, st):  # pragma: no cover — abstract
+        raise NotImplementedError
+
     def transform(self, mds: MultiDataSet) -> MultiDataSet:
-        feats = [((np.asarray(f, np.float32) - st.mean) / st.std).astype(np.float32)
-                 for st, f in zip(self._f, mds.features)]
+        feats = [self._apply(f, st) for st, f in zip(self._f, mds.features)]
         labs = mds.labels if not self.fit_labels else [
-            ((np.asarray(l, np.float32) - st.mean) / st.std).astype(np.float32)
-            for st, l in zip(self._l, mds.labels)]
+            self._apply(l, st) for st, l in zip(self._l, mds.labels)]
         return MultiDataSet(feats, labs, mds.features_masks, mds.labels_masks)
+
+
+class MultiNormalizerStandardize(_MultiNormalizerBase):
+    """Per-input/per-output standardization for MultiDataSet."""
+
+    def _apply(self, x, st):
+        return ((np.asarray(x, np.float32) - st.mean) / st.std
+                ).astype(np.float32)
+
+
+def _minmax_scale(x, st, lo, hi):
+    rng = np.maximum(st.max - st.min, 1e-12)
+    unit = (np.asarray(x, np.float32) - st.min) / rng
+    return (unit * (hi - lo) + lo).astype(np.float32)
+
+
+class MultiNormalizerMinMaxScaler(_MultiNormalizerBase):
+    """Per-input/per-output min-max scaling for MultiDataSet (reference
+    MultiNormalizerMinMaxScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        super().__init__()
+        self.min_range = min_range
+        self.max_range = max_range
+
+    def _apply(self, x, st):
+        return _minmax_scale(x, st, self.min_range, self.max_range)
 
 
 class CompositeDataSetPreProcessor:

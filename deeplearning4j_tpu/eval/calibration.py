@@ -19,24 +19,27 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@partial(jax.jit, static_argnums=(2, 3))
-def _calibration_pass(probs, positives, rel_bins, hist_bins):
-    """(N, C) probabilities + (N, C) 0/1 positives → per-class accumulators:
-    reliability (counts, Σprob, pos) over rel_bins and the residual /
-    probability-by-label histograms over hist_bins. One dispatch total."""
+@partial(jax.jit, static_argnums=(3, 4))
+def _calibration_pass(probs, positives, weights, rel_bins, hist_bins):
+    """(N, C) probabilities + (N, C) 0/1 positives + (N,) 0/1 weights →
+    per-class accumulators: reliability (counts, Σprob, pos) over rel_bins
+    and the residual / probability-by-label histograms over hist_bins.
+    Masked rows carry weight 0 — shapes stay STATIC so the kernel compiles
+    once regardless of how many steps each batch masks out."""
 
     def per_class(p, y):
+        w = weights
         ridx = jnp.clip((p * rel_bins).astype(jnp.int32), 0, rel_bins - 1)
-        counts = jnp.zeros(rel_bins).at[ridx].add(1.0)
-        prob_sums = jnp.zeros(rel_bins).at[ridx].add(p)
-        pos = jnp.zeros(rel_bins).at[ridx].add(y)
+        counts = jnp.zeros(rel_bins).at[ridx].add(w)
+        prob_sums = jnp.zeros(rel_bins).at[ridx].add(p * w)
+        pos = jnp.zeros(rel_bins).at[ridx].add(y * w)
         resid = jnp.abs(y - p)
         hidx = jnp.clip((resid * hist_bins).astype(jnp.int32), 0,
                         hist_bins - 1)
-        residual = jnp.zeros(hist_bins).at[hidx].add(1.0)
+        residual = jnp.zeros(hist_bins).at[hidx].add(w)
         pidx = jnp.clip((p * hist_bins).astype(jnp.int32), 0, hist_bins - 1)
-        hist_all = jnp.zeros(hist_bins).at[pidx].add(1.0)
-        hist_pos = jnp.zeros(hist_bins).at[pidx].add(y)
+        hist_all = jnp.zeros(hist_bins).at[pidx].add(w)
+        hist_pos = jnp.zeros(hist_bins).at[pidx].add(y * w)
         return counts, prob_sums, pos, residual, hist_all, hist_pos
 
     return jax.vmap(per_class, in_axes=1)(probs, positives)
@@ -77,19 +80,22 @@ class EvaluationCalibration:
         (B, T) selecting valid steps — same convention as Evaluation."""
         p = jnp.asarray(predictions)
         y = jnp.asarray(labels)
+        w = None
         if p.ndim == 3:
             b, t, c = p.shape
             p = p.reshape(b * t, c)
             y = y.reshape(b * t, -1) if y.ndim == 3 else y.reshape(b * t)
             if mask is not None:
-                keep = np.asarray(mask).reshape(b * t) > 0
-                p = p[np.asarray(keep)]
-                y = y[np.asarray(keep)]
+                # weight, don't compress: boolean indexing would make the
+                # row count data-dependent and recompile per batch
+                w = (jnp.asarray(mask).reshape(b * t) > 0).astype(jnp.float32)
         if y.ndim == 1:
             y = jax.nn.one_hot(y.astype(jnp.int32), p.shape[-1])
+        if w is None:
+            w = jnp.ones(p.shape[0], jnp.float32)
         self._ensure(p.shape[-1])
         counts, sums, pos, residual, hist_all, hist_pos = _calibration_pass(
-            p, (y > 0.5).astype(jnp.float32),
+            p, (y > 0.5).astype(jnp.float32), w,
             self.reliability_bins, self.histogram_bins)
         self._counts += np.asarray(counts)
         self._prob_sums += np.asarray(sums)

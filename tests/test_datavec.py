@@ -116,6 +116,45 @@ def test_transform_into_network_fit():
     assert last < first
 
 
+def test_iterator_dataset_iterator_rebatches():
+    """IteratorDataSetIterator: ragged source DataSets re-batched to a
+    fixed size, trailing partial delivered, reset re-reads the source."""
+    from deeplearning4j_tpu.data import DataSet, IteratorDataSetIterator
+    rng = np.random.default_rng(0)
+    chunks = [DataSet(rng.random((n, 3)).astype(np.float32),
+                      np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)])
+              for n in (5, 2, 6)]                      # 13 examples total
+    it = IteratorDataSetIterator(chunks, batch_size=4)
+    sizes = [b.num_examples() for b in it]
+    assert sizes == [4, 4, 4, 1]
+    it.reset()
+    assert sum(b.num_examples() for b in it) == 13
+    with pytest.raises(ValueError, match="no DataSets"):
+        IteratorDataSetIterator([], batch_size=4)
+
+
+def test_multi_normalizer_minmax():
+    from deeplearning4j_tpu.data import (MultiDataSet,
+                                         MultiNormalizerMinMaxScaler)
+    rng = np.random.default_rng(1)
+    mds = MultiDataSet(
+        [rng.uniform(-5, 5, (20, 3)).astype(np.float32),
+         rng.uniform(0, 100, (20, 2)).astype(np.float32)],
+        [rng.uniform(-1, 3, (20, 1)).astype(np.float32)])
+    norm = MultiNormalizerMinMaxScaler().fit_label(True).fit(mds)
+    out = norm.transform(mds)
+    for f in out.features:
+        assert f.min() >= -1e-6 and f.max() <= 1 + 1e-6
+        assert f.min() == pytest.approx(0, abs=1e-5)
+        assert f.max() == pytest.approx(1, abs=1e-5)
+    assert out.labels[0].min() == pytest.approx(0, abs=1e-5)
+    # custom range
+    norm2 = MultiNormalizerMinMaxScaler(-1.0, 1.0).fit(mds)
+    out2 = norm2.transform(mds)
+    assert out2.features[0].min() == pytest.approx(-1, abs=1e-5)
+    assert out2.features[0].max() == pytest.approx(1, abs=1e-5)
+
+
 def test_image_augmenter_shapes_and_flip():
     key = jax.random.PRNGKey(0)
     imgs = jax.random.uniform(key, (4, 8, 8, 3))
