@@ -430,15 +430,30 @@ def main():
     print(json.dumps(headline), flush=True)
 
     # Secondary configs (SURVEY §6) -> bench_secondary.json; never stdout.
+    # Each runs in a FRESH subprocess: residual allocator/compilation state
+    # from the headline (and from each other) measurably depresses the
+    # later configs when they share a process (observed: charnn 2.9M vs
+    # 4.7M tokens/s isolated).
+    import os
+    import subprocess
     t_start = time.perf_counter()
     secondary = {}
+    script = os.path.abspath(__file__)
+    repo = os.path.dirname(script)
     for name in ("lenet", "charnn", "bert", "transformer", "dpscale"):
-        if time.perf_counter() - t_start > 900:
+        if time.perf_counter() - t_start > 1200:
             secondary[name] = {"skipped": "time budget"}
         else:
             try:
-                b, s = DEFAULTS[name]
-                secondary[name] = CONFIGS[name](b, s)
+                proc = subprocess.run(
+                    [sys.executable, script, "--model", name],
+                    capture_output=True, text=True, timeout=900, cwd=repo)
+                if proc.returncode == 0 and proc.stdout.strip():
+                    secondary[name] = json.loads(
+                        proc.stdout.strip().splitlines()[-1])
+                else:
+                    secondary[name] = {
+                        "error": (proc.stdout + proc.stderr)[-500:]}
             except Exception as e:  # noqa: BLE001 — record, don't kill headline
                 secondary[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
         print(f"[bench] {name}: "

@@ -108,28 +108,19 @@ class ArrayDataSetIterator(ListDataSetIterator):
         super().__init__(DataSet(features, labels), batch_size)
 
 
-class IteratorDataSetIterator(BaseDatasetIterator):
+class IteratorDataSetIterator(ListDataSetIterator):
     """Wrap a plain iterable of DataSets (any sizes) into the
     DataSetIterator protocol, RE-BATCHED to a fixed batch size (reference
     IteratorDataSetIterator). The source is read ONCE up front and merged
-    (masks included); reset() just rewinds the cursor over the cached
-    arrays. A trailing partial batch is delivered, not dropped."""
+    (masks included) — exactly ListDataSetIterator's machinery; reset()
+    rewinds the cursor over the cached arrays. A trailing partial batch is
+    delivered, not dropped."""
 
     def __init__(self, source, batch_size: int):
-        super().__init__(batch_size)
         chunks = list(source)
         if not chunks:
             raise ValueError("source iterable produced no DataSets")
-        self._merged = DataSet.merge(chunks)   # preserves masks
-
-    def total_examples(self):
-        return self._merged.num_examples()
-
-    def total_outcomes(self):
-        return self._merged.labels.shape[-1]
-
-    def _slice(self, lo, hi):
-        return self._merged._take(np.arange(lo, hi))
+        super().__init__(chunks, batch_size)
 
 
 class RandomDataSetIterator(BaseDatasetIterator):

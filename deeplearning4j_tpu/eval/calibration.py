@@ -29,6 +29,10 @@ def _calibration_pass(probs, positives, weights, rel_bins, hist_bins):
 
     def per_class(p, y):
         w = weights
+        # zero masked rows BEFORE accumulating: padded steps can hold NaN
+        # (softmax over fully-masked logits) and NaN * 0 is NaN
+        p = jnp.where(w > 0, p, 0.0)
+        y = jnp.where(w > 0, y, 0.0)
         ridx = jnp.clip((p * rel_bins).astype(jnp.int32), 0, rel_bins - 1)
         counts = jnp.zeros(rel_bins).at[ridx].add(w)
         prob_sums = jnp.zeros(rel_bins).at[ridx].add(p * w)

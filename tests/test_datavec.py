@@ -118,7 +118,7 @@ def test_transform_into_network_fit():
 
 def test_iterator_dataset_iterator_rebatches():
     """IteratorDataSetIterator: ragged source DataSets re-batched to a
-    fixed size, trailing partial delivered, reset re-reads the source."""
+    fixed size, trailing partial delivered, reset rewinds the cache."""
     from deeplearning4j_tpu.data import DataSet, IteratorDataSetIterator
     rng = np.random.default_rng(0)
     chunks = [DataSet(rng.random((n, 3)).astype(np.float32),

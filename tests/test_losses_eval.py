@@ -204,3 +204,13 @@ def test_evaluation_calibration():
     rnn.eval(lab3, pred3, mask=mask)
     _, _, _, counts3 = rnn.reliability_info(1)
     assert counts3.sum() == 10   # 12 steps - 2 masked
+
+    # NaN in MASKED steps (softmax over fully-masked logits) must not
+    # poison the accumulators
+    pred_nan = pred3.copy()
+    pred_nan[0, 4:] = np.nan
+    rn = EvaluationCalibration(reliability_bins=10)
+    rn.eval(lab3, pred_nan, mask=mask)
+    assert np.isfinite(rn.expected_calibration_error())
+    np.testing.assert_allclose(rn.expected_calibration_error(),
+                               rnn.expected_calibration_error(), atol=1e-6)
