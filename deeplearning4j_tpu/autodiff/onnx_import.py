@@ -380,7 +380,71 @@ def _pad_op(i, n):
     return jnp.pad(x, cfg, mode={"reflect": "reflect", "edge": "edge"}[mode])
 
 
+_NEAREST_IDX = {
+    # ONNX nearest_mode → index computation on the source coordinate x
+    "floor": np.floor,
+    "ceil": np.ceil,
+    "round_prefer_floor": lambda x: np.ceil(x - 0.5),
+    "round_prefer_ceil": lambda x: np.floor(x + 0.5),
+}
+
+
+def _resize(i, n):
+    """ONNX Resize / Upsample across opsets: Resize-11+ inputs are
+    [X, roi?, scales?, sizes?], Resize-10 and Upsample-9 are [X, scales],
+    Upsample-7 carries a `scales` float-list attribute. Supports nearest
+    (asymmetric, all four nearest_modes) and linear/cubic (half_pixel via
+    jax.image.resize, which implements TF2 half-pixel sampling)."""
+    x = i[0]
+    sizes = None
+    if len(i) > 3 and i[3] is not None:
+        sizes = _static(i[3]).ravel().astype(np.int64).tolist()
+    else:
+        scales = None
+        if len(i) > 2 and i[2] is not None and np.size(_static(i[2])):
+            scales = _static(i[2]).ravel().tolist()     # Resize-11+ slot
+        elif len(i) == 2 and i[1] is not None and np.size(_static(i[1])):
+            scales = _static(i[1]).ravel().tolist()     # Resize-10/Upsample-9
+        elif "scales" in n.attrs:                       # Upsample-7 attr
+            scales = list(n.attrs["scales"].floats)
+        if scales is not None:
+            # spec: output dim = floor(input_dim * scale)
+            sizes = [int(np.floor(d * s)) for d, s in zip(x.shape, scales)]
+    if sizes is None:
+        raise NotImplementedError("Resize needs constant scales or sizes")
+    mode = n.astr("mode", "nearest")
+    coord = n.astr("coordinate_transformation_mode", "half_pixel")
+    if mode == "nearest":
+        if coord not in ("asymmetric", "half_pixel"):
+            raise NotImplementedError(
+                f"Resize nearest with coordinate mode '{coord}'")
+        if coord == "asymmetric":
+            nearest = n.astr("nearest_mode", "round_prefer_floor")
+            if nearest not in _NEAREST_IDX:
+                raise NotImplementedError(f"nearest_mode '{nearest}'")
+            to_idx = _NEAREST_IDX[nearest]
+            out = x
+            for ax, (old, new) in enumerate(zip(x.shape, sizes)):
+                if new == old:
+                    continue
+                src = np.arange(new) * (old / new)
+                ix = np.clip(to_idx(src).astype(np.int64), 0, old - 1)
+                out = jnp.take(out, jnp.asarray(ix), axis=ax)
+            return out
+        return jax.image.resize(x, tuple(sizes), method="nearest")
+    if mode in ("linear", "cubic"):
+        if coord not in ("half_pixel", "pytorch_half_pixel"):
+            raise NotImplementedError(
+                f"Resize {mode} with coordinate mode '{coord}'")
+        method = "linear" if mode == "linear" else "cubic"
+        return jax.image.resize(x.astype(jnp.float32), tuple(sizes),
+                                method=method).astype(x.dtype)
+    raise NotImplementedError(f"Resize mode '{mode}'")
+
+
 HANDLERS: Dict[str, Any] = {
+    "Resize": _resize,
+    "Upsample": _resize,   # opset<10 alias (scales input or attribute)
     # --- elementwise math
     "Add": lambda i, n: i[0] + i[1], "Sub": lambda i, n: i[0] - i[1],
     "Mul": lambda i, n: i[0] * i[1], "Div": lambda i, n: i[0] / i[1],

@@ -60,6 +60,25 @@ def test_mlp_roundtrip():
     np.testing.assert_allclose(got, want, atol=1e-4)
 
 
+def test_resize_upsample_roundtrip():
+    """ONNX Resize as torch exports it: nearest (asymmetric) and bilinear
+    (half-pixel) upsampling paths."""
+    for mode, align in (("nearest", None), ("bilinear", False)):
+        kw = {"mode": mode}
+        if align is not None:
+            kw["align_corners"] = align
+        model = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 4, 3, padding=1),
+            torch.nn.Upsample(scale_factor=2, **kw))
+        x = torch.randn(1, 3, 6, 6)
+        data = _export(model, x, input_names=["input"], output_names=["out"])
+        sd, outs = import_onnx(data)
+        got = np.asarray(outs[0].eval({"input": x.numpy()}))
+        want = model(x).detach().numpy()
+        assert got.shape == want.shape == (1, 4, 12, 12)
+        np.testing.assert_allclose(got, want, atol=2e-4, err_msg=mode)
+
+
 def test_cnn_roundtrip():
     model = torch.nn.Sequential(
         torch.nn.Conv2d(3, 8, 3, padding=1),
