@@ -42,4 +42,5 @@ from .listeners import (CheckpointListener, CollectScoresListener,
                         StatsListener, TimeIterationListener)
 from .losses import Loss
 from .multi_layer_network import MultiLayerNetwork
+from .transfer import FineTuneConfiguration, TransferLearning
 from .weights import WeightInit

@@ -49,6 +49,7 @@ class ComputationGraph:
             if self.conf.input_types is None:
                 raise ValueError("Provide input_shapes or set_input_types")
             input_shapes = [tuple(t[1]) for t in self.conf.input_types]
+        self._init_shapes = [tuple(s) for s in input_shapes]  # for transfer
         shapes = {name: tuple(s) for name, s in zip(self.conf.inputs, input_shapes)}
         key = jax.random.PRNGKey(self._g.seed)
         for name in self.conf.topo_order:

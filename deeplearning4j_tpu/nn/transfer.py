@@ -47,7 +47,178 @@ class FineTuneConfiguration:
             g.weight_init = self.weight_init
 
 
+def _copy_if_compatible(src_p, dst_p, src_s):
+    """(params, states) deep COPIES when tree structure + leaf shapes match,
+    else None. Copies (jnp.array), never aliases: the train step donates its
+    params/states buffers, so aliasing would let the transferred net's first
+    fit() delete the SOURCE network's arrays."""
+    import jax.numpy as jnp
+    if jax.tree_util.tree_structure(src_p) != \
+            jax.tree_util.tree_structure(dst_p):
+        return None
+    if not all(a.shape == b.shape for a, b in zip(
+            jax.tree_util.tree_leaves(src_p),
+            jax.tree_util.tree_leaves(dst_p))):
+        return None
+    return (jax.tree_util.tree_map(jnp.array, src_p),
+            jax.tree_util.tree_map(jnp.array, src_s))
+
+
 class TransferLearning:
+    class GraphBuilder:
+        """ComputationGraph transfer — parity with the reference's
+        ``TransferLearning.GraphBuilder``: freeze up to named vertices
+        (ancestors included), nOutReplace by layer name, remove vertices
+        with their connections, graft new layers/vertices, re-point
+        outputs. Retained, shape-compatible weights are copied over."""
+
+        def __init__(self, net):
+            from .computation_graph import ComputationGraph
+            if not isinstance(net, ComputationGraph) or not net.initialized:
+                raise ValueError("source must be an initialized "
+                                 "ComputationGraph")
+            self._src = net
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_at: List[str] = []
+            self._nout_replace: List = []
+            self._removed: List[str] = []
+            self._added: List = []          # (name, op, inputs, is_layer)
+            self._outputs: Optional[List[str]] = None
+            self._input_shapes = None
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, *vertex_names: str):
+            """Freeze the named vertices AND everything feeding them
+            (reference setFeatureExtractor semantics)."""
+            self._freeze_at.extend(vertex_names)
+            return self
+
+        def nout_replace(self, layer_name: str, n_out: int, weight_init=None):
+            self._nout_replace.append((layer_name, n_out, weight_init))
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            self._removed.append(name)
+            return self
+
+        def add_layer(self, name: str, layer: Layer, *inputs: str):
+            self._added.append((name, layer, list(inputs), True))
+            return self
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            self._added.append((name, vertex, list(inputs), False))
+            return self
+
+        def set_outputs(self, *names: str):
+            self._outputs = list(names)
+            return self
+
+        def set_input_shapes(self, *shapes):
+            self._input_shapes = [tuple(s) for s in shapes]
+            return self
+
+        def _ancestors(self, nodes, names):
+            out = set()
+            stack = list(names)
+            while stack:
+                n = stack.pop()
+                if n in out or n not in nodes:
+                    continue
+                out.add(n)
+                stack.extend(nodes[n].inputs)
+            return out
+
+        def build(self):
+            from .computation_graph import ComputationGraph
+            from .graph import GraphBuilder as ConfBuilder
+            src = self._src
+            g = copy.deepcopy(src.conf.globals_)
+            if self._fine_tune is not None:
+                self._fine_tune.apply_to(g)
+
+            kept = {n: copy.deepcopy(d) for n, d in src.conf.nodes.items()
+                    if n not in self._removed}
+            # a removed name that is re-added (grafting a replacement under
+            # the same name) is not dangling — DL4J's standard workflow
+            readded = {n for n, _, _, _ in self._added}
+            gone = set(self._removed) - readded
+            dangling = [n for n, d in kept.items()
+                        if any(i in gone for i in d.inputs)]
+            if dangling:
+                raise ValueError(
+                    f"nodes {dangling} still consume removed vertices — "
+                    "remove them too or re-point their inputs via add_*")
+
+            frozen = self._ancestors(kept, self._freeze_at)
+            missing = [n for n in self._freeze_at if n not in kept]
+            if missing:
+                raise ValueError(f"unknown feature-extractor nodes {missing}")
+            invalid = set()                 # nodes whose weights can't copy
+
+            def touch_consumers(name, n_out):
+                """Invalidate consumers of `name`; direct Layer consumers
+                get the exact new n_in, Layers reached THROUGH vertices get
+                n_in=None so init re-infers the fan-in from the real shape
+                (a vertex may change the width, e.g. a concat)."""
+                for n, d in kept.items():
+                    if name not in d.inputs:
+                        continue
+                    invalid.add(n)
+                    if isinstance(d.op, Layer):
+                        if getattr(d.op, "n_in", None) is not None:
+                            d.op = dataclasses.replace(d.op, n_in=n_out)
+                    else:                   # vertex: recurse; its Layer
+                        touch_consumers(n, None)   # consumers re-infer n_in
+
+            for lname, n_out, winit in self._nout_replace:
+                if lname not in kept or not isinstance(kept[lname].op, Layer):
+                    raise ValueError(f"nout_replace: no layer '{lname}'")
+                kept[lname].op = dataclasses.replace(kept[lname].op,
+                                                     n_out=n_out)
+                if winit is not None:
+                    kept[lname].op.weight_init = winit
+                invalid.add(lname)
+                touch_consumers(lname, n_out)
+
+            b = ConfBuilder(g)
+            b.add_inputs(*src.conf.inputs)
+            for name in src.conf.topo_order:
+                if name not in kept:
+                    continue
+                d = kept[name]
+                if isinstance(d.op, Layer):
+                    if name in frozen:
+                        d.op.frozen = True
+                    b.add_layer(name, d.op, *d.inputs)
+                else:
+                    b.add_vertex(name, d.op, *d.inputs)
+            for name, op, inputs, is_layer in self._added:
+                (b.add_layer if is_layer else b.add_vertex)(name, op, *inputs)
+            outputs = self._outputs if self._outputs is not None else [
+                o for o in src.conf.outputs if o not in self._removed]
+            if not outputs:
+                raise ValueError("no outputs left — set_outputs() required")
+            b.set_outputs(*outputs)
+            if src.conf.input_types is not None:
+                b.set_input_types(*src.conf.input_types)
+
+            net = ComputationGraph(b.build())
+            shapes = self._input_shapes or getattr(src, "_init_shapes", None)
+            net.init(shapes)
+            for name in kept:
+                if name in invalid or name not in net.params \
+                        or name not in src.params:
+                    continue
+                copied = _copy_if_compatible(src.params[name],
+                                             net.params[name],
+                                             src.states[name])
+                if copied is not None:
+                    net.params[name], net.states[name] = copied
+            return net
+
     class Builder:
         def __init__(self, net: MultiLayerNetwork):
             if not net.initialized:
@@ -126,13 +297,9 @@ class TransferLearning:
             for i in range(keep_n):
                 if i in invalid:
                     continue
-                src_p = src.params[f"layer_{i}"]
-                dst_p = net.params[f"layer_{i}"]
-                if jax.tree_util.tree_structure(src_p) == jax.tree_util.tree_structure(dst_p):
-                    ok = all(a.shape == b.shape for a, b in zip(
-                        jax.tree_util.tree_leaves(src_p), jax.tree_util.tree_leaves(dst_p)))
-                    if ok:
-                        net.params[f"layer_{i}"] = jax.tree_util.tree_map(lambda a: a, src_p)
-                        net.states[f"layer_{i}"] = jax.tree_util.tree_map(
-                            lambda a: a, src.states[f"layer_{i}"])
+                copied = _copy_if_compatible(src.params[f"layer_{i}"],
+                                             net.params[f"layer_{i}"],
+                                             src.states[f"layer_{i}"])
+                if copied is not None:
+                    net.params[f"layer_{i}"], net.states[f"layer_{i}"] = copied
             return net

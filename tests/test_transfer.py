@@ -1,0 +1,181 @@
+"""TransferLearning tests — reference TransferLearningHelper/Builder and
+GraphBuilder suites: freeze semantics (frozen params bit-identical after
+fit), nOutReplace weight invalidation, layer grafting, weight retention.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (DenseLayer, FineTuneConfiguration,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer,
+                                   TransferLearning)
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.train import Adam, Sgd
+
+R = np.random.default_rng(0)
+X = R.standard_normal((32, 6)).astype(np.float32)
+Y = np.eye(3, dtype=np.float32)[R.integers(0, 3, 32)]
+
+
+def _src_mln():
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=10, activation="relu"))
+            .layer(DenseLayer(n_in=10, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init((6,))
+    net.fit(X, Y, epochs=2)
+    return net
+
+
+def test_mln_transfer_freeze_and_replace():
+    src = _src_mln()
+    new = (TransferLearning.Builder(src)
+           .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(1e-2)))
+           .set_feature_extractor(0)                 # freeze layer 0
+           .nout_replace(2, 5)                       # new 5-class head
+           .set_input_shape((6,))
+           .build())
+    # retained weights copied (layer 1 kept; layer 0 kept+frozen)
+    for i in (0, 1):
+        np.testing.assert_array_equal(
+            np.asarray(new.params[f"layer_{i}"]["W"]),
+            np.asarray(src.params[f"layer_{i}"]["W"]))
+    y5 = np.eye(5, dtype=np.float32)[R.integers(0, 5, 32)]
+    w0 = np.asarray(new.params["layer_0"]["W"]).copy()
+    w1 = np.asarray(new.params["layer_1"]["W"]).copy()
+    new.fit(X, y5, epochs=3)
+    np.testing.assert_array_equal(np.asarray(new.params["layer_0"]["W"]), w0)
+    assert not np.array_equal(np.asarray(new.params["layer_1"]["W"]), w1)
+    assert new.output(X).shape == (32, 5)
+
+
+def test_mln_transfer_graft_layers():
+    src = _src_mln()
+    new = (TransferLearning.Builder(src)
+           .remove_output_layer()
+           .add_layer(DenseLayer(n_in=8, n_out=4, activation="relu"))
+           .add_layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                  loss="mcxent"))
+           .set_input_shape((6,))
+           .build())
+    assert len(new.layers) == 4
+    y2 = np.eye(2, dtype=np.float32)[R.integers(0, 2, 32)]
+    s0 = new.score(__import__(
+        "deeplearning4j_tpu.data.dataset", fromlist=["DataSet"]
+    ).DataSet(X, y2))
+    new.fit(X, y2, epochs=15)
+    assert new.fit(X, y2) < s0
+
+
+def _src_graph():
+    b = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+         .graph_builder())
+    b.add_inputs("in")
+    b.add_layer("trunk", DenseLayer(n_in=6, n_out=10, activation="relu"), "in")
+    b.add_layer("mid", DenseLayer(n_in=10, n_out=8, activation="tanh"),
+                "trunk")
+    b.add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss="mcxent"), "mid")
+    b.set_outputs("out")
+    net = ComputationGraph(b.build()).init([(6,)])
+    net.fit(__import__(
+        "deeplearning4j_tpu.data.dataset", fromlist=["DataSet"]
+    ).DataSet(X, Y), epochs=2)
+    return net
+
+
+def test_graph_transfer_freeze_ancestors_and_new_head():
+    src = _src_graph()
+    new = (TransferLearning.GraphBuilder(src)
+           .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(1e-2)))
+           .set_feature_extractor("mid")             # freezes mid AND trunk
+           .remove_vertex_and_connections("out")
+           .add_layer("new_out", OutputLayer(n_in=8, n_out=4,
+                                             activation="softmax",
+                                             loss="mcxent"), "mid")
+           .set_outputs("new_out")
+           .build())
+    for name in ("trunk", "mid"):
+        np.testing.assert_array_equal(np.asarray(new.params[name]["W"]),
+                                      np.asarray(src.params[name]["W"]))
+    from deeplearning4j_tpu.data.dataset import DataSet
+    y4 = np.eye(4, dtype=np.float32)[R.integers(0, 4, 32)]
+    wt = np.asarray(new.params["trunk"]["W"]).copy()
+    wm = np.asarray(new.params["mid"]["W"]).copy()
+    s0 = new.score(DataSet(X, y4))
+    for _ in range(10):
+        new.fit(DataSet(X, y4))
+    # frozen trunk+mid untouched; the grafted head learned
+    np.testing.assert_array_equal(np.asarray(new.params["trunk"]["W"]), wt)
+    np.testing.assert_array_equal(np.asarray(new.params["mid"]["W"]), wm)
+    assert new.score(DataSet(X, y4)) < s0
+
+
+def test_graph_transfer_nout_replace_invalidates_consumers():
+    src = _src_graph()
+    new = (TransferLearning.GraphBuilder(src)
+           .nout_replace("mid", 12)
+           .build())
+    # trunk retained; mid (replaced) and out (consumer) re-initialized
+    np.testing.assert_array_equal(np.asarray(new.params["trunk"]["W"]),
+                                  np.asarray(src.params["trunk"]["W"]))
+    assert np.asarray(new.params["mid"]["W"]).shape == (10, 12)
+    assert np.asarray(new.params["out"]["W"]).shape == (12, 3)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    assert np.isfinite(new.score(DataSet(X, Y)))
+
+
+def test_transfer_does_not_alias_source_buffers():
+    """The copied weights must be COPIES: the train step donates params, so
+    aliasing would let the new net's first fit() delete the source's
+    arrays (use-after-donate)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    src = _src_graph()
+    new = (TransferLearning.GraphBuilder(src)
+           .set_feature_extractor("trunk").build())
+    for _ in range(3):
+        new.fit(DataSet(X, Y))
+    out = np.asarray(src.output([X]))          # source must still work
+    assert np.isfinite(out).all()
+    src.fit(DataSet(X, Y))                     # and still train
+
+    src2 = _src_mln()
+    new2 = TransferLearning.Builder(src2).set_feature_extractor(0) \
+        .set_input_shape((6,)).build()
+    new2.fit(X, Y, epochs=2)
+    assert np.isfinite(np.asarray(src2.output(X))).all()
+
+
+def test_graph_transfer_graft_same_name():
+    """Removing a vertex and grafting a replacement under the SAME name is
+    the standard DL4J workflow and must validate."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    src = _src_graph()
+    new = (TransferLearning.GraphBuilder(src)
+           .remove_vertex_and_connections("mid")
+           .add_layer("mid", DenseLayer(n_in=10, n_out=8, activation="relu"),
+                      "trunk")
+           .build())
+    assert np.isfinite(new.score(DataSet(X, Y)))
+    # trunk retained, mid freshly initialized (relu layer, new params)
+    np.testing.assert_array_equal(np.asarray(new.params["trunk"]["W"]),
+                                  np.asarray(src.params["trunk"]["W"]))
+    assert not np.array_equal(np.asarray(new.params["mid"]["W"]),
+                              np.asarray(src.params["mid"]["W"]))
+
+
+def test_graph_transfer_validation_errors():
+    src = _src_graph()
+    with pytest.raises(ValueError, match="still consume removed"):
+        TransferLearning.GraphBuilder(src) \
+            .remove_vertex_and_connections("mid").build()
+    with pytest.raises(ValueError, match="unknown feature-extractor"):
+        TransferLearning.GraphBuilder(src) \
+            .set_feature_extractor("nope").build()
+    with pytest.raises(ValueError, match="no layer"):
+        TransferLearning.GraphBuilder(src).nout_replace("nope", 4).build()
