@@ -22,10 +22,13 @@ from .layers.core import (ActivationLayer, AlphaDropout,
                           DropoutLayer, ElementWiseMultiplicationLayer,
                           EmbeddingLayer, EmbeddingSequenceLayer,
                           GaussianDropout, GaussianNoise, LossLayer,
-                          OutputLayer, PReLULayer, RnnOutputLayer,
-                          SpatialDropout)
+                          MaskLayer, OCNNOutputLayer, OutputLayer, PReLULayer,
+                          RnnOutputLayer, SpatialDropout)
 from .layers.objdetect import (DetectedObject, Yolo2OutputLayer,
                                get_predicted_objects, nms)
+from .layers.samediff_layer import (SameDiffLambdaLayer, SameDiffLambdaVertex,
+                                    SameDiffLayer, SameDiffOutputLayer,
+                                    SameDiffVertex, SDLayerParams)
 from .layers.variational import VariationalAutoencoder
 from .layers.wrappers import (FrozenLayer, FrozenLayerWithBackprop,
                               MaskZeroLayer, RepeatVector,

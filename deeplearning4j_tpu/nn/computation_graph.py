@@ -21,6 +21,7 @@ from .graph import ComputationGraphConfiguration
 from .layers.base import Ctx, Layer
 from .layers.wrappers import unwrap
 from .layers.core import LossLayer, OutputLayer
+from .layers.samediff_layer import SameDiffOutputLayer
 from .preprocessors import CnnToFeedForwardPreProcessor
 from .vertices import GraphVertex
 
@@ -57,6 +58,13 @@ class ComputationGraph:
             in_shapes = [shapes[i] for i in node.inputs]
             if isinstance(node.op, Layer):
                 from .multi_layer_network import _is_ff_layer
+                if getattr(node.op, "multi_input", False):
+                    key, sub = jax.random.split(key)
+                    p, st, out = node.op.init(sub, in_shapes)
+                    self.params[name] = p
+                    self.states[name] = st
+                    shapes[name] = out
+                    continue
                 s = in_shapes[0]
                 if (_is_ff_layer(node.op) or isinstance(unwrap(node.op), OutputLayer)) \
                         and len(s) == 3:
@@ -86,6 +94,22 @@ class ComputationGraph:
             node = self.conf.nodes[name]
             xs = [acts[i] for i in node.inputs]
             if isinstance(node.op, Layer):
+                if getattr(node.op, "multi_input", False):
+                    lrng = None if rng is None else jax.random.fold_in(rng, idx)
+                    ctx = Ctx(train=train, rng=lrng, mask=fmask, label_mask=lmask)
+                    if train and node.op.dropout > 0.0 and lrng is not None:
+                        keep = 1.0 - node.op.dropout
+                        dropped = []
+                        for j, h in enumerate(xs):
+                            m = jax.random.bernoulli(
+                                jax.random.fold_in(lrng, 997 + j), keep, h.shape)
+                            dropped.append(
+                                jnp.where(m, h / keep, 0.0).astype(h.dtype))
+                        xs = dropped
+                    h, s_new = node.op.apply(params[name], states[name], xs, ctx)
+                    new_states[name] = s_new
+                    acts[name] = h
+                    continue
                 h = xs[0]
                 if name in self._preprocessors:
                     h = self._preprocessors[name](h)
@@ -96,7 +120,8 @@ class ComputationGraph:
                     m = jax.random.bernoulli(jax.random.fold_in(lrng, 997), keep, h.shape)
                     h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
                 if stop_at_output_preact and name in self.conf.outputs and \
-                        isinstance(unwrap(node.op), (OutputLayer, LossLayer)):
+                        isinstance(unwrap(node.op),
+                                   (OutputLayer, LossLayer, SameDiffOutputLayer)):
                     pre_acts[name] = h
                     new_states[name] = states[name]
                     acts[name] = h
@@ -129,7 +154,7 @@ class ComputationGraph:
             op = unwrap(self.conf.nodes[name].op)
             y = labels[name]
             w = self.output_loss_weights.get(name, 1.0)
-            if isinstance(op, OutputLayer):
+            if isinstance(op, (OutputLayer, SameDiffOutputLayer)):
                 total = total + w * op.compute_loss(params[name], pre_acts[name], y, mask=lmask)
             elif isinstance(op, LossLayer):
                 total = total + w * op.compute_loss(pre_acts[name], y, mask=lmask)

@@ -369,3 +369,77 @@ class CenterLossOutputLayer(OutputLayer):
         delta = jnp.zeros_like(centers).at[cls].add(diff)
         delta = delta / (1.0 + counts)[:, None]
         return {**state, "centers": centers - self.alpha * delta}
+
+
+@dataclass
+class MaskLayer(Layer):
+    """Zeroes activations at masked timesteps and otherwise passes through
+    (org.deeplearning4j.nn.conf.layers.util.MaskLayer). Useful after layers
+    that pollute padded steps (e.g. bidirectional RNNs)."""
+
+    def init(self, key, input_shape):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, ctx: Ctx):
+        if ctx.mask is None:
+            return x, state
+        if x.ndim == 3:
+            return apply_time_mask(x, ctx.mask), state
+        return x * ctx.mask.reshape(ctx.mask.shape[0],
+                                    *([1] * (x.ndim - 1))).astype(x.dtype), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class OCNNOutputLayer(Layer):
+    """One-class neural network output layer for anomaly detection
+    (org.deeplearning4j.nn.conf.ocnn.OCNNOutputLayer; Chalapathy et al. 2018).
+
+    score(x) = w . act(V x); loss = 0.5||V||^2 + 0.5||w||^2
+               + (1/nu) * mean(relu(r - score)) - r.
+    The margin r tracks the nu-quantile of scores via an EMA held in state
+    (the reference recomputes r from a score window every epoch; an in-jit
+    EMA of the batch quantile is the streaming TPU-friendly equivalent).
+    `labels` are ignored (one-class training uses only inliers) — evaluate
+    with `score < r` => anomaly.
+    """
+
+    n_in: Optional[int] = None
+    hidden_size: int = 32
+    nu: float = 0.04
+    activation: Any = "sigmoid"
+    window_size: int = 10000      # kept for reference-API compatibility
+    initial_r_value: float = 0.1
+    r_update_rate: float = 0.1    # EMA rate for the quantile target
+
+    def init(self, key, input_shape):
+        n_in = self.n_in or input_shape[-1]
+        k1, k2 = jax.random.split(key)
+        params = {"V": self._make_weight(k1, (n_in, self.hidden_size)),
+                  "w": self._make_weight(k2, (self.hidden_size, 1))}
+        state = {"r": jnp.asarray(self.initial_r_value, self.dtype)}
+        return params, state, (1,)
+
+    def ocnn_score(self, params, x):
+        h = self.activation_fn()(x @ params["V"])
+        return (h @ params["w"])[..., 0]
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return self.ocnn_score(params, x)[:, None], state
+
+    def compute_loss(self, params, x, labels, mask=None, state=None):
+        score = self.ocnn_score(params, x)
+        r = state["r"] if state is not None else jnp.asarray(
+            self.initial_r_value, score.dtype)
+        reg = 0.5 * jnp.sum(jnp.square(params["V"])) \
+            + 0.5 * jnp.sum(jnp.square(params["w"]))
+        hinge = jnp.mean(jax.nn.relu(r - score)) / self.nu
+        return reg + hinge - r
+
+    def update_state(self, state, x, params):
+        score = jax.lax.stop_gradient(self.ocnn_score(params, x))
+        q = jnp.quantile(score, self.nu)
+        r = state["r"] * (1.0 - self.r_update_rate) + self.r_update_rate * q
+        return {**state, "r": r.astype(state["r"].dtype)}

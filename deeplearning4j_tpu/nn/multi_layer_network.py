@@ -25,7 +25,8 @@ from ..train.updaters import NoOp, build_optimizer, gradient_normalization
 from .conf import MultiLayerConfiguration
 from .layers.base import Ctx, Layer
 from .layers.wrappers import unwrap
-from .layers.core import LossLayer, OutputLayer
+from .layers.core import LossLayer, OCNNOutputLayer, OutputLayer
+from .layers.samediff_layer import SameDiffOutputLayer
 from .preprocessors import CnnToFeedForwardPreProcessor
 
 
@@ -105,7 +106,10 @@ class MultiLayerNetwork:
         n = len(self.layers)
         for i, layer in enumerate(self.layers):
             is_last = i == n - 1
-            if stop_before_output and is_last and isinstance(unwrap(layer), (OutputLayer, LossLayer)):
+            if stop_before_output and is_last and isinstance(
+                    unwrap(layer),
+                    (OutputLayer, LossLayer, SameDiffOutputLayer,
+                     OCNNOutputLayer)):
                 new_states[f"layer_{i}"] = states[f"layer_{i}"]
                 break
             if i in self._preprocessors:
@@ -168,6 +172,17 @@ class MultiLayerNetwork:
                     states[f"layer_{i}"], jax.lax.stop_gradient(h), y)
             else:
                 loss = out_layer.compute_loss(params[f"layer_{i}"], h, y, mask=lmask)
+        elif isinstance(out_layer, SameDiffOutputLayer):
+            if i in self._preprocessors:
+                h = self._preprocessors[i](h)
+            loss = out_layer.compute_loss(params[f"layer_{i}"], h, y, mask=lmask)
+        elif isinstance(out_layer, OCNNOutputLayer):
+            if i in self._preprocessors:
+                h = self._preprocessors[i](h)
+            loss = out_layer.compute_loss(params[f"layer_{i}"], h, y, mask=lmask,
+                                          state=states[f"layer_{i}"])
+            new_states[f"layer_{i}"] = out_layer.update_state(
+                states[f"layer_{i}"], h, params[f"layer_{i}"])
         elif isinstance(out_layer, LossLayer):
             loss = out_layer.compute_loss(h, y, mask=lmask)
         else:

@@ -198,7 +198,7 @@ class TransferLearning:
             for name, op, inputs, is_layer in self._added:
                 (b.add_layer if is_layer else b.add_vertex)(name, op, *inputs)
             outputs = self._outputs if self._outputs is not None else [
-                o for o in src.conf.outputs if o not in self._removed]
+                o for o in src.conf.outputs if o not in gone]
             if not outputs:
                 raise ValueError("no outputs left — set_outputs() required")
             b.set_outputs(*outputs)

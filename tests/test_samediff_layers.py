@@ -1,0 +1,267 @@
+"""SameDiff custom layers/vertices inside MLN + ComputationGraph.
+
+Reference parity: org.deeplearning4j.nn.conf.layers.samediff (SameDiffLayer,
+SameDiffLambdaLayer, SameDiffOutputLayer, SameDiffVertex, SameDiffLambdaVertex)
+— the reference's extension point for user-defined layers.
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    Ctx, DenseLayer, NeuralNetConfiguration, OutputLayer, SDLayerParams,
+    SameDiffLambdaLayer, SameDiffLambdaVertex, SameDiffLayer,
+    SameDiffOutputLayer, SameDiffVertex)
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn import InputType
+from deeplearning4j_tpu.data import DataSet, MultiDataSet
+from deeplearning4j_tpu.train import Adam
+
+KEY = jax.random.PRNGKey(0)
+
+
+@dataclass
+class MyDense(SameDiffLayer):
+    """Custom dense+relu, the canonical SameDiffLayer example."""
+
+    n_in: int = 4
+    n_out: int = 8
+
+    def define_parameters(self, p: SDLayerParams):
+        p.add_weight_param("W", self.n_in, self.n_out)
+        p.add_bias_param("b", self.n_out)
+
+    def define_layer(self, sd, x, params, mask=None):
+        return sd.nn.relu(sd.nn.linear(x, params["W"], params["b"]))
+
+
+def test_samediff_layer_matches_dense():
+    layer = MyDense(n_in=4, n_out=8)
+    params, state, out_shape = layer.init(KEY, (4,))
+    assert out_shape == (8,)
+    assert params["W"].shape == (4, 8) and params["b"].shape == (8,)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((5, 4)), jnp.float32)
+    y, _ = layer.apply(params, state, x, Ctx())
+    ref = jax.nn.relu(x @ params["W"] + params["b"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-6)
+
+
+def test_samediff_layer_gradcheck():
+    layer = MyDense(n_in=3, n_out=4)
+    params, state, _ = layer.init(KEY, (3,))
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 3)), jnp.float32)
+
+    def loss(p):
+        y, _ = layer.apply(p, state, x, Ctx())
+        return jnp.sum(jnp.square(y))
+
+    g = jax.grad(loss)(params)
+    eps = 1e-3
+    W = np.asarray(params["W"], np.float64)
+    for idx in [(0, 0), (2, 3), (1, 2)]:
+        Wp, Wm = W.copy(), W.copy()
+        Wp[idx] += eps
+        Wm[idx] -= eps
+        num = (loss({"W": jnp.asarray(Wp, jnp.float32), "b": params["b"]})
+               - loss({"W": jnp.asarray(Wm, jnp.float32), "b": params["b"]})) / (2 * eps)
+        np.testing.assert_allclose(float(num), float(g["W"][idx]), rtol=5e-2, atol=1e-4)
+
+
+def test_samediff_layer_in_mln_fit():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(5e-2))
+            .list()
+            .layer(MyDense(n_in=4, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    ds = DataSet(x, labels)
+    s0 = net.score(ds)
+    net.fit(ds, epochs=60)
+    assert net.score(ds) < s0 * 0.6
+
+
+def test_lambda_layer():
+    lam = SameDiffLambdaLayer(fn=lambda sd, x: x * 2.0 + 1.0)
+    params, state, out_shape = lam.init(KEY, (5,))
+    assert params == {} and out_shape == (5,)
+    x = jnp.ones((3, 5))
+    y, _ = lam.apply(params, state, x, Ctx())
+    np.testing.assert_allclose(np.asarray(y), 3.0)
+
+
+@dataclass
+class MySoftmaxOut(SameDiffOutputLayer):
+    n_in: int = 8
+    n_out: int = 3
+
+    def define_parameters(self, p: SDLayerParams):
+        p.add_weight_param("W", self.n_in, self.n_out)
+        p.add_bias_param("b", self.n_out)
+
+    def define_layer(self, sd, x, labels, params):
+        logits = sd.nn.linear(x, params["W"], params["b"]).rename("logits")
+        sd.nn.softmax(logits).rename("out")
+        return sd.loss.softmax_cross_entropy(labels, logits).rename("loss")
+
+    def activations_vertex_name(self):
+        return "out"
+
+
+def test_samediff_output_layer_matches_reference_head():
+    sd_head = MySoftmaxOut(n_in=6, n_out=3)
+    params, state, out_shape = sd_head.init(KEY, (6,))
+    assert out_shape == (3,)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((10, 6)), jnp.float32)
+    labels = jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, 10)])
+    # activations are a softmax
+    y, _ = sd_head.apply(params, state, x, Ctx())
+    np.testing.assert_allclose(np.asarray(y.sum(-1)), 1.0, rtol=1e-5)
+    # loss equals the builtin head's loss with the same params
+    ref = OutputLayer(n_in=6, n_out=3, activation="softmax", loss="mcxent")
+    ref_loss = ref.compute_loss({"W": params["W"], "b": params["b"]}, x, labels)
+    got = sd_head.compute_loss(params, x, labels)
+    np.testing.assert_allclose(float(got), float(ref_loss), rtol=1e-5)
+
+
+def test_samediff_output_layer_mln_fit():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(5e-2))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(MySoftmaxOut(n_in=16, n_out=3))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    ds = DataSet(x, labels)
+    s0 = net.score(ds)
+    net.fit(ds, epochs=60)
+    assert net.score(ds) < s0 * 0.6
+    out = net.output(x)
+    assert out.shape == (64, 3)
+    np.testing.assert_allclose(np.asarray(out.sum(-1)), 1.0, rtol=1e-5)
+
+
+@dataclass
+class BilinearMerge(SameDiffVertex):
+    """z = relu(x1 @ W1 + x2 @ W2 + b): a learnable two-input merge."""
+
+    n_in1: int = 4
+    n_in2: int = 4
+    n_out: int = 8
+
+    def define_parameters(self, p: SDLayerParams):
+        p.add_weight_param("W1", self.n_in1, self.n_out)
+        p.add_weight_param("W2", self.n_in2, self.n_out)
+        p.add_bias_param("b", self.n_out)
+
+    def define_vertex(self, sd, inputs, params):
+        x1, x2 = inputs
+        return sd.nn.relu(x1.mmul(params["W1"]) + x2.mmul(params["W2"])
+                          + params["b"])
+
+
+def _bilinear_graph():
+    b = (NeuralNetConfiguration.builder().seed(3).updater(Adam(3e-2))
+         .graph_builder())
+    b.add_inputs("a", "b")
+    b.add_layer("merge", BilinearMerge(n_in1=4, n_in2=3, n_out=16), "a", "b")
+    b.add_layer("out", OutputLayer(n_in=16, n_out=2, activation="softmax",
+                                   loss="mcxent"), "merge")
+    b.set_outputs("out")
+    return ComputationGraph(b.build()).init([(4,), (3,)])
+
+
+def test_samediff_vertex_in_graph():
+    g = _bilinear_graph()
+    assert g.params["merge"]["W1"].shape == (4, 16)
+    assert g.params["merge"]["W2"].shape == (3, 16)
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((32, 4)).astype(np.float32)
+    b = rng.standard_normal((32, 3)).astype(np.float32)
+    labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+    out = g.output(a, b)
+    out = out[0] if isinstance(out, list) else out
+    assert np.asarray(out).shape == (32, 2)
+    mds = MultiDataSet([a, b], [labels])
+    s0 = g.score(mds)
+    g.fit(mds, epochs=60)
+    assert g.score(mds) < s0 * 0.6
+
+
+def test_lambda_vertex():
+    v = SameDiffLambdaVertex(lambda sd, x1, x2: x1 * x2)
+    assert v.out_shape([(4,), (4,)]) == (4,)
+    got = v.apply([jnp.full((2, 4), 3.0), jnp.full((2, 4), 2.0)])
+    np.testing.assert_allclose(np.asarray(got), 6.0)
+    b = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+         .graph_builder())
+    b.add_inputs("a", "b")
+    b.add_vertex("prod", v, "a", "b")
+    b.add_layer("out", OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                   loss="mcxent"), "prod")
+    b.set_outputs("out")
+    g = ComputationGraph(b.build()).init([(4,), (4,)])
+    out = g.output(jnp.ones((2, 4)), jnp.ones((2, 4)))
+    out = out[0] if isinstance(out, list) else out
+    assert np.asarray(out).shape == (2, 2)
+
+
+@dataclass
+class MaskedMseOut(SameDiffOutputLayer):
+    """Mask-aware custom head: mean over unmasked squared errors."""
+
+    n_in: int = 4
+    n_out: int = 2
+
+    def define_parameters(self, p: SDLayerParams):
+        p.add_weight_param("W", self.n_in, self.n_out)
+
+    def define_layer(self, sd, x, labels, params, mask=None):
+        pred = x.mmul(params["W"]).rename("out")
+        se = ((pred - labels) ** 2.0).sum(-1)
+        if mask is not None:
+            return ((se * mask).sum() / mask.sum()).rename("loss")
+        return se.mean().rename("loss")
+
+    def activations_vertex_name(self):
+        return "out"
+
+
+def test_samediff_output_layer_mask():
+    head = MaskedMseOut(n_in=3, n_out=2)
+    params, state, _ = head.init(KEY, (3,))
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.standard_normal((6, 3)), jnp.float32)
+    labels = jnp.asarray(rng.standard_normal((6, 2)), jnp.float32)
+    mask = jnp.asarray([1, 1, 0, 1, 0, 1], jnp.float32)
+    got = float(head.compute_loss(params, x, labels, mask=mask))
+    pred = np.asarray(x @ params["W"])
+    se = ((pred - np.asarray(labels)) ** 2).sum(-1)
+    want = (se * np.asarray(mask)).sum() / np.asarray(mask).sum()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_samediff_output_layer_rejects_unhandled_mask():
+    head = MySoftmaxOut(n_in=4, n_out=3)   # define_layer has no mask kwarg
+    params, state, _ = head.init(KEY, (4,))
+    x = jnp.ones((2, 4))
+    labels = jnp.asarray(np.eye(3, dtype=np.float32)[[0, 1]])
+    try:
+        head.compute_loss(params, x, labels, mask=jnp.ones((2,)))
+        raise AssertionError("expected ValueError for unhandled mask")
+    except ValueError as e:
+        assert "mask" in str(e)

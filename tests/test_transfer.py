@@ -179,3 +179,20 @@ def test_graph_transfer_validation_errors():
             .set_feature_extractor("nope").build()
     with pytest.raises(ValueError, match="no layer"):
         TransferLearning.GraphBuilder(src).nout_replace("nope", 4).build()
+
+
+def test_graph_transfer_readded_output_keeps_default_outputs():
+    """remove 'out' then re-add under the same name WITHOUT set_outputs():
+    the default-outputs fallback must keep the re-added node."""
+    src = _src_graph()
+    new = (TransferLearning.GraphBuilder(src)
+           .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(1e-2)))
+           .remove_vertex_and_connections("out")
+           .add_layer("out", OutputLayer(n_in=8, n_out=5,
+                                         activation="softmax",
+                                         loss="mcxent"), "mid")
+           .build())
+    assert new.conf.outputs == ["out"]
+    out = new.output(X)
+    out = out[0] if isinstance(out, list) else out
+    assert np.asarray(out).shape == (X.shape[0], 5)
