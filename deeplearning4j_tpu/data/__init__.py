@@ -11,6 +11,14 @@ from .iterators import (ArrayDataSetIterator, BaseDatasetIterator,
                         KFoldIterator, ListDataSetIterator,
                         MnistDataSetIterator, MultipleEpochsIterator,
                         RandomDataSetIterator, make_synthetic_mnist)
+from .image import (ImageDataSetIterator, ImageRecordReader,
+                    NativeImageLoader, ParentPathLabelGenerator)
+from .transforms import (Condition, ConvertToSequence, DataAnalysis,
+                         DataQualityAnalysis, Join, Reducer, analyze,
+                         analyze_quality, column_condition,
+                         invalid_value_condition, sequence_difference,
+                         sequence_moving_window_reduce, sequence_offset,
+                         sequence_trim, split_sequences_by_length)
 from .normalizers import (CompositeDataSetPreProcessor,
                           ImagePreProcessingScaler,
                           MultiNormalizerMinMaxScaler,

@@ -278,6 +278,215 @@ class TransformProcess:
             self._steps.append(step)
             return self
 
+        # ---- column math (DoubleMathOpTransform / IntegerMathOpTransform /
+        # MathOpTransform between columns) --------------------------------
+        _MATH_OPS = {
+            "add": lambda a, b: a + b, "subtract": lambda a, b: a - b,
+            "multiply": lambda a, b: a * b, "divide": lambda a, b: a / b,
+            "modulus": lambda a, b: a % b, "pow": lambda a, b: a ** b,
+            "min": min, "max": max,
+        }
+
+        def math_op(self, name, op, scalar):
+            """column <- column <op> scalar (DoubleMathOpTransform)."""
+            fn = self._MATH_OPS[op]
+
+            def step(records, schema):
+                i = schema.index_of(name)
+                for r in records:
+                    r[i] = fn(r[i], scalar)
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def math_op_between_columns(self, new_name, op, col_a, col_b):
+            """new column <- colA <op> colB (MathOpTransform)."""
+            fn = self._MATH_OPS[op]
+
+            def step(records, schema):
+                ia, ib = schema.index_of(col_a), schema.index_of(col_b)
+                for r in records:
+                    r.append(fn(r[ia], r[ib]))
+                schema.columns.append(Column(new_name, "numeric"))
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        # ---- column surgery (RenameColumns / DuplicateColumns /
+        # ReorderColumns / RemoveAllColumnsExceptFor) ---------------------
+        def rename_column(self, old, new):
+            def step(records, schema):
+                i = schema.index_of(old)
+                c = schema.columns[i]
+                schema.columns[i] = Column(new, c.kind, c.categories)
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def duplicate_column(self, name, new_name):
+            def step(records, schema):
+                i = schema.index_of(name)
+                for r in records:
+                    r.append(r[i])
+                c = schema.columns[i]
+                schema.columns.append(Column(new_name, c.kind, c.categories))
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def reorder_columns(self, *names):
+            def step(records, schema):
+                idx = [schema.index_of(n) for n in names]
+                rest = [i for i in range(len(schema.columns)) if i not in idx]
+                perm = idx + rest
+                for k, r in enumerate(records):
+                    records[k] = [r[i] for i in perm]
+                schema.columns = [schema.columns[i] for i in perm]
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def remove_all_columns_except_for(self, *names):
+            def step(records, schema):
+                keep = [schema.index_of(n) for n in names]
+                for k, r in enumerate(records):
+                    records[k] = [r[i] for i in keep]
+                schema.columns = [schema.columns[i] for i in keep]
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        # ---- string transforms (Append/ChangeCase/Replace/Map) ----------
+        def _map_column(self, name, fn):
+            def step(records, schema):
+                i = schema.index_of(name)
+                for r in records:
+                    r[i] = fn(r[i])
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def append_string(self, name, suffix):
+            return self._map_column(name, lambda v: str(v) + suffix)
+
+        def prepend_string(self, name, prefix):
+            return self._map_column(name, lambda v: prefix + str(v))
+
+        def to_lower_case(self, name):
+            return self._map_column(name, lambda v: str(v).lower())
+
+        def to_upper_case(self, name):
+            return self._map_column(name, lambda v: str(v).upper())
+
+        def replace_string(self, name, old, new):
+            return self._map_column(name, lambda v: str(v).replace(old, new))
+
+        def regex_replace(self, name, pattern, replacement):
+            import re as _re
+            pat = _re.compile(pattern)
+            return self._map_column(
+                name, lambda v: pat.sub(replacement, str(v)))
+
+        def string_to_categorical(self, name, categories):
+            def step(records, schema):
+                i = schema.index_of(name)
+                schema.columns[i] = Column(name, "categorical",
+                                           list(categories))
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        # ---- conditional / invalid-value replacement --------------------
+        def conditional_replace_value(self, name, condition, new_value):
+            """Replace value where condition(row dict) holds
+            (ConditionalReplaceValueTransform). `condition` is a
+            transforms.Condition or any row-dict predicate."""
+            def step(records, schema):
+                i = schema.index_of(name)
+                names = schema.names()
+                for r in records:
+                    if condition(dict(zip(names, r))):
+                        r[i] = new_value
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def replace_invalid_with(self, name, value):
+            """Replace non-numeric entries of a numeric column
+            (ReplaceInvalidWithIntegerTransform analogue)."""
+            def step(records, schema):
+                i = schema.index_of(name)
+                for r in records:
+                    try:
+                        float(r[i])
+                    except (TypeError, ValueError):
+                        r[i] = value
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        # ---- time (StringToTimeTransform / DeriveColumnsFromTime) -------
+        def string_to_time(self, name, fmt="%Y-%m-%d %H:%M:%S"):
+            """Parse a string column to integer epoch seconds."""
+            import datetime as _dt
+
+            def step(records, schema):
+                i = schema.index_of(name)
+                for r in records:
+                    t = _dt.datetime.strptime(str(r[i]), fmt)
+                    r[i] = int(t.replace(tzinfo=_dt.timezone.utc).timestamp())
+                schema.columns[i] = Column(name, "integer")
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        def derive_columns_from_time(self, name, fields=("hour", "dayofweek")):
+            """Append derived integer columns from an epoch-seconds column:
+            hour, minute, dayofweek (Mon=0), dayofmonth, month, year."""
+            import datetime as _dt
+            getters = {
+                "hour": lambda t: t.hour, "minute": lambda t: t.minute,
+                "dayofweek": lambda t: t.weekday(),
+                "dayofmonth": lambda t: t.day, "month": lambda t: t.month,
+                "year": lambda t: t.year,
+            }
+            for f in fields:
+                if f not in getters:
+                    raise ValueError(f"unknown time field '{f}'")
+
+            def step(records, schema):
+                i = schema.index_of(name)
+                for r in records:
+                    t = _dt.datetime.fromtimestamp(int(r[i]),
+                                                   _dt.timezone.utc)
+                    for f in fields:
+                        r.append(getters[f](t))
+                for f in fields:
+                    schema.columns.append(Column(f"{name}.{f}", "integer"))
+                return records, schema
+            self._steps.append(step)
+            return self
+
+        # ---- integration with the catalog (transforms.py) ---------------
+        def filter_by_condition(self, condition):
+            """Remove rows matching condition (ConditionFilter removes
+            matching examples — note the inversion vs filter_rows)."""
+            return self.filter_rows(lambda row: not condition(row))
+
+        def reduce(self, reducer):
+            """Group-by + aggregate via transforms.Reducer."""
+            def step(records, schema):
+                recs, new_schema = reducer.reduce(records, schema)
+                schema.columns = new_schema.columns
+                return recs, schema
+            self._steps.append(step)
+            return self
+
+        def transform(self, fn):
+            """Escape hatch: fn(records, schema) -> (records, schema)."""
+            self._steps.append(fn)
+            return self
+
         def build(self) -> "TransformProcess":
             return TransformProcess(self._schema, self._steps)
 

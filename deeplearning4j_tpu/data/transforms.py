@@ -1,0 +1,498 @@
+"""DataVec transform catalog: conditions, reducers, joins, sequences, analysis.
+
+Reference parity: ``org.datavec.api.transform`` —
+`condition.column.*` + `condition.BooleanCondition` (Condition),
+`reduce.Reducer` (group-by + per-column aggregations),
+`join.Join` (Inner/LeftOuter/RightOuter/FullOuter on key columns),
+`sequence.ConvertToSequence` + sequence transforms
+(SequenceDifferenceTransform, SequenceMovingWindowReduceTransform,
+SequenceOffsetTransform), and `AnalyzeLocal` / `DataQualityAnalysis`.
+
+Host-side by design — ETL shapes the records that feed the device; the
+numeric heavy lifting happens later on the TPU. Everything operates on the
+same (records: list[list], Schema) pair as `datavec.TransformProcess`.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import statistics
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .datavec import Column, Schema
+
+
+# ------------------------------------------------------------------ conditions
+class Condition:
+    """Boolean predicate over a row dict, with &, |, ~ combinators.
+
+    Reference: org.datavec.api.transform.condition.Condition +
+    BooleanCondition.AND/OR/NOT.
+    """
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], bool], desc: str = ""):
+        self._fn = fn
+        self.desc = desc
+
+    def __call__(self, row: Dict[str, Any]) -> bool:
+        return bool(self._fn(row))
+
+    def __and__(self, other: "Condition") -> "Condition":
+        return Condition(lambda r: self(r) and other(r),
+                         f"({self.desc} AND {other.desc})")
+
+    def __or__(self, other: "Condition") -> "Condition":
+        return Condition(lambda r: self(r) or other(r),
+                         f"({self.desc} OR {other.desc})")
+
+    def __invert__(self) -> "Condition":
+        return Condition(lambda r: not self(r), f"(NOT {self.desc})")
+
+
+_COND_OPS = {
+    "eq": lambda v, t: v == t,
+    "neq": lambda v, t: v != t,
+    "lt": lambda v, t: v < t,
+    "lte": lambda v, t: v <= t,
+    "gt": lambda v, t: v > t,
+    "gte": lambda v, t: v >= t,
+    "in": lambda v, t: v in t,
+    "not_in": lambda v, t: v not in t,
+}
+
+
+def column_condition(name: str, op: str, value: Any = None) -> Condition:
+    """DoubleColumnCondition / CategoricalColumnCondition / ... in one factory.
+
+    op: eq|neq|lt|lte|gt|gte|in|not_in|is_null|regex
+    """
+    if op == "is_null":
+        return Condition(lambda r: r[name] is None or r[name] == "",
+                         f"{name} is null")
+    if op == "regex":
+        pat = re.compile(value)
+        return Condition(lambda r: pat.search(str(r[name])) is not None,
+                         f"{name} ~ /{value}/")
+    if op not in _COND_OPS:
+        raise ValueError(f"unknown condition op '{op}' "
+                         f"(choose from {sorted(_COND_OPS)} | is_null | regex)")
+    fn = _COND_OPS[op]
+    return Condition(lambda r: fn(r[name], value), f"{name} {op} {value!r}")
+
+
+def invalid_value_condition(name: str) -> Condition:
+    """True when the column value is not parseable as a number
+    (FilterInvalidValues analogue for numeric columns)."""
+
+    def bad(r):
+        v = r[name]
+        try:
+            return math.isnan(float(v))
+        except (TypeError, ValueError):
+            return True
+
+    return Condition(bad, f"{name} invalid")
+
+
+# -------------------------------------------------------------------- reducer
+_AGG_FNS = {
+    "sum": lambda vs: float(np.sum(vs)),
+    "mean": lambda vs: float(np.mean(vs)),
+    "min": lambda vs: float(np.min(vs)),
+    "max": lambda vs: float(np.max(vs)),
+    "stdev": lambda vs: float(statistics.stdev(vs)) if len(vs) > 1 else 0.0,
+    "count": lambda vs: len(vs),
+    "count_unique": lambda vs: len(set(vs)),
+    "range": lambda vs: float(np.max(vs) - np.min(vs)),
+    "first": lambda vs: vs[0],
+    "last": lambda vs: vs[-1],
+}
+_NUMERIC_AGGS = {"sum", "mean", "min", "max", "stdev", "range"}
+
+
+class Reducer:
+    """Group rows by key column(s), aggregate the rest.
+
+    Reference: org.datavec.api.transform.reduce.Reducer (Builder pattern:
+    keyColumns + per-column ReduceOp).
+    """
+
+    def __init__(self, keys: Sequence[str], ops: Dict[str, str],
+                 default_op: Optional[str] = None):
+        self.keys = list(keys)
+        self.ops = dict(ops)
+        self.default_op = default_op
+
+    class Builder:
+        def __init__(self, *keys: str):
+            self._keys = list(keys)
+            self._ops: Dict[str, str] = {}
+            self._default: Optional[str] = None
+
+        def _add(self, op, names):
+            if op not in _AGG_FNS:
+                raise ValueError(f"unknown reduce op '{op}'")
+            for n in names:
+                self._ops[n] = op
+            return self
+
+        def sum_columns(self, *names):
+            return self._add("sum", names)
+
+        def mean_columns(self, *names):
+            return self._add("mean", names)
+
+        def min_columns(self, *names):
+            return self._add("min", names)
+
+        def max_columns(self, *names):
+            return self._add("max", names)
+
+        def stdev_columns(self, *names):
+            return self._add("stdev", names)
+
+        def count_columns(self, *names):
+            return self._add("count", names)
+
+        def count_unique_columns(self, *names):
+            return self._add("count_unique", names)
+
+        def range_columns(self, *names):
+            return self._add("range", names)
+
+        def first_columns(self, *names):
+            return self._add("first", names)
+
+        def last_columns(self, *names):
+            return self._add("last", names)
+
+        def default_op(self, op: str):
+            if op not in _AGG_FNS:
+                raise ValueError(f"unknown reduce op '{op}'")
+            self._default = op
+            return self
+
+        def build(self) -> "Reducer":
+            return Reducer(self._keys, self._ops, self._default)
+
+    @staticmethod
+    def builder(*keys: str) -> "Reducer.Builder":
+        return Reducer.Builder(*keys)
+
+    def reduce(self, records: Iterable[Sequence[Any]],
+               schema: Schema) -> Tuple[List[List[Any]], Schema]:
+        names = schema.names()
+        key_idx = [schema.index_of(k) for k in self.keys]
+        groups: Dict[tuple, List[List[Any]]] = {}
+        order: List[tuple] = []
+        for r in records:
+            k = tuple(r[i] for i in key_idx)
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(list(r))
+
+        out_cols: List[Column] = [schema.column(k) for k in self.keys]
+        agg_plan: List[Tuple[int, str, str]] = []   # (col idx, op, out name)
+        for i, n in enumerate(names):
+            if n in self.keys:
+                continue
+            op = self.ops.get(n, self.default_op)
+            if op is None:
+                continue
+            out_name = f"{op}({n})"
+            kind = schema.columns[i].kind
+            if op in ("count", "count_unique"):
+                kind = "integer"
+            elif op in _NUMERIC_AGGS:
+                kind = "numeric"
+            agg_plan.append((i, op, out_name))
+            out_cols.append(Column(out_name, kind))
+
+        out_records = []
+        for k in order:
+            rows = groups[k]
+            rec = list(k)
+            for i, op, _ in agg_plan:
+                vals = [row[i] for row in rows]
+                rec.append(_AGG_FNS[op](vals))
+            out_records.append(rec)
+        return out_records, Schema(out_cols)
+
+
+# ----------------------------------------------------------------------- join
+class Join:
+    """Relational join of two record sets on key columns.
+
+    Reference: org.datavec.api.transform.join.Join (Inner, LeftOuter,
+    RightOuter, FullOuter). Missing values fill with None.
+    """
+
+    TYPES = ("Inner", "LeftOuter", "RightOuter", "FullOuter")
+
+    def __init__(self, join_type: str, keys: Sequence[str],
+                 left_schema: Schema, right_schema: Schema):
+        if join_type not in self.TYPES:
+            raise ValueError(f"join_type must be one of {self.TYPES}")
+        self.join_type = join_type
+        self.keys = list(keys)
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+
+    def out_schema(self) -> Schema:
+        cols = [Column(c.name, c.kind, c.categories)
+                for c in self.left_schema.columns]
+        for c in self.right_schema.columns:
+            if c.name not in self.keys:
+                cols.append(Column(c.name, c.kind, c.categories))
+        return Schema(cols)
+
+    def execute(self, left: Iterable[Sequence[Any]],
+                right: Iterable[Sequence[Any]]) -> List[List[Any]]:
+        lkeys = [self.left_schema.index_of(k) for k in self.keys]
+        rkeys = [self.right_schema.index_of(k) for k in self.keys]
+        r_nonkey = [i for i in range(len(self.right_schema.columns))
+                    if i not in rkeys]
+        l_width = len(self.left_schema.columns)
+
+        rindex: Dict[tuple, List[List[Any]]] = {}
+        right_rows = [list(r) for r in right]
+        for r in right_rows:
+            rindex.setdefault(tuple(r[i] for i in rkeys), []).append(r)
+
+        out: List[List[Any]] = []
+        matched_right = set()
+        for l in left:
+            l = list(l)
+            k = tuple(l[i] for i in lkeys)
+            matches = rindex.get(k)
+            if matches:
+                matched_right.add(k)
+                for r in matches:
+                    out.append(l + [r[i] for i in r_nonkey])
+            elif self.join_type in ("LeftOuter", "FullOuter"):
+                out.append(l + [None] * len(r_nonkey))
+        if self.join_type in ("RightOuter", "FullOuter"):
+            # right-only rows: key values land in the key columns' positions
+            for k, rows in rindex.items():
+                if k in matched_right:
+                    continue
+                for r in rows:
+                    rec = [None] * l_width
+                    for kn, kv in zip(self.keys, k):
+                        rec[self.left_schema.index_of(kn)] = kv
+                    out.append(rec + [r[i] for i in r_nonkey])
+        return out
+
+
+# ------------------------------------------------------------------ sequences
+class ConvertToSequence:
+    """Group flat records into sequences by key, sorted within each group.
+
+    Reference: TransformProcess.convertToSequence(keyColumn, comparator).
+    Returns (list_of_sequences, per-sequence key values).
+    """
+
+    def __init__(self, schema: Schema, key: str, sort_by: Optional[str] = None):
+        self.schema = schema
+        self.key = key
+        self.sort_by = sort_by
+
+    def execute(self, records: Iterable[Sequence[Any]]):
+        ki = self.schema.index_of(self.key)
+        si = None if self.sort_by is None else self.schema.index_of(self.sort_by)
+        groups: Dict[Any, List[List[Any]]] = {}
+        order = []
+        for r in records:
+            r = list(r)
+            if r[ki] not in groups:
+                groups[r[ki]] = []
+                order.append(r[ki])
+            groups[r[ki]].append(r)
+        seqs = []
+        for k in order:
+            rows = groups[k]
+            if si is not None:
+                rows = sorted(rows, key=lambda r: r[si])
+            seqs.append(rows)
+        return seqs, order
+
+
+def sequence_difference(seqs: List[List[List[Any]]], schema: Schema,
+                        name: str, lookback: int = 1):
+    """x[t] -= x[t-lookback]; first `lookback` steps become 0
+    (SequenceDifferenceTransform)."""
+    i = schema.index_of(name)
+    out = []
+    for seq in seqs:
+        new = [list(r) for r in seq]
+        for t in range(len(new) - 1, -1, -1):
+            new[t][i] = (new[t][i] - new[t - lookback][i]
+                         if t >= lookback else 0)
+        out.append(new)
+    return out
+
+
+def sequence_offset(seqs: List[List[List[Any]]], schema: Schema, name: str,
+                    offset: int, *, edge: str = "trim"):
+    """Shift one column by `offset` steps within each sequence
+    (SequenceOffsetTransform). edge='trim' drops rows without a shifted
+    value; edge='pad' keeps length and fills with None."""
+    i = schema.index_of(name)
+    out = []
+    for seq in seqs:
+        n = len(seq)
+        new = []
+        for t in range(n):
+            src = t - offset
+            row = list(seq[t])
+            if 0 <= src < n:
+                row[i] = seq[src][i]
+                new.append(row)
+            elif edge == "pad":
+                row[i] = None
+                new.append(row)
+        out.append(new)
+    return out
+
+
+def sequence_moving_window_reduce(seqs: List[List[List[Any]]], schema: Schema,
+                                  name: str, window: int, op: str = "mean"):
+    """Append `<op>(<name>,w)` column: aggregate over the trailing window
+    (SequenceMovingWindowReduceTransform). Returns (seqs, new_schema)."""
+    if op not in _AGG_FNS:
+        raise ValueError(f"unknown reduce op '{op}'")
+    i = schema.index_of(name)
+    fn = _AGG_FNS[op]
+    out = []
+    for seq in seqs:
+        new = []
+        for t, r in enumerate(seq):
+            vals = [seq[s][i] for s in range(max(0, t - window + 1), t + 1)]
+            new.append(list(r) + [fn(vals)])
+        out.append(new)
+    new_schema = Schema([Column(c.name, c.kind, c.categories)
+                         for c in schema.columns]
+                        + [Column(f"{op}({name},{window})", "numeric")])
+    return out, new_schema
+
+
+def sequence_trim(seqs, n: int, from_front: bool = True):
+    """Drop n steps from the front (or back) of every sequence
+    (SequenceTrimTransform)."""
+    return [s[n:] if from_front else s[:len(s) - n] for s in seqs]
+
+
+def split_sequences_by_length(seqs, max_length: int):
+    """Split long sequences into chunks of at most max_length
+    (SequenceSplit / SplitMaxLengthSequence)."""
+    out = []
+    for s in seqs:
+        for i in range(0, len(s), max_length):
+            out.append(s[i:i + max_length])
+    return out
+
+
+# ------------------------------------------------------------------- analysis
+class ColumnAnalysis:
+    def __init__(self, name: str, kind: str, stats: Dict[str, Any]):
+        self.name, self.kind, self.stats = name, kind, stats
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v}" for k, v in self.stats.items())
+        return f"ColumnAnalysis({self.name}: {inner})"
+
+
+class DataAnalysis:
+    """Per-column statistics over a record set (AnalyzeLocal.analyze)."""
+
+    def __init__(self, schema: Schema,
+                 columns: Dict[str, ColumnAnalysis], n_rows: int):
+        self.schema = schema
+        self.columns = columns
+        self.n_rows = n_rows
+
+    def column_analysis(self, name: str) -> ColumnAnalysis:
+        return self.columns[name]
+
+    def stats(self) -> str:
+        lines = [f"rows: {self.n_rows}"]
+        for c in self.schema.names():
+            lines.append(repr(self.columns[c]))
+        return "\n".join(lines)
+
+
+def analyze(schema: Schema, records: Iterable[Sequence[Any]]) -> DataAnalysis:
+    rows = [list(r) for r in records]
+    cols: Dict[str, ColumnAnalysis] = {}
+    for i, c in enumerate(schema.columns):
+        vals = [r[i] for r in rows]
+        if c.kind in ("numeric", "integer"):
+            nums = [v for v in vals if isinstance(v, (int, float))
+                    and not (isinstance(v, float) and math.isnan(v))]
+            if nums:
+                arr = np.asarray(nums, np.float64)
+                st = {"count": len(nums), "min": float(arr.min()),
+                      "max": float(arr.max()), "mean": float(arr.mean()),
+                      "stdev": float(arr.std(ddof=1)) if len(nums) > 1 else 0.0,
+                      "n_missing": len(vals) - len(nums)}
+                if c.kind == "integer":
+                    st["n_unique"] = len(set(nums))
+            else:
+                st = {"count": 0, "n_missing": len(vals)}
+        elif c.kind == "categorical":
+            counts: Dict[Any, int] = {}
+            for v in vals:
+                counts[v] = counts.get(v, 0) + 1
+            st = {"count": len(vals), "counts": counts,
+                  "n_unique": len(counts)}
+        else:   # string
+            lens = [len(str(v)) for v in vals]
+            st = {"count": len(vals),
+                  "min_length": min(lens) if lens else 0,
+                  "max_length": max(lens) if lens else 0,
+                  "mean_length": (sum(lens) / len(lens)) if lens else 0.0}
+        cols[c.name] = ColumnAnalysis(c.name, c.kind, st)
+    return DataAnalysis(schema, cols, len(rows))
+
+
+class DataQualityAnalysis:
+    """Missing/invalid counts per column (DataQualityAnalysis)."""
+
+    def __init__(self, schema: Schema, quality: Dict[str, Dict[str, int]]):
+        self.schema = schema
+        self.quality = quality
+
+    def column_quality(self, name: str) -> Dict[str, int]:
+        return self.quality[name]
+
+
+def analyze_quality(schema: Schema,
+                    records: Iterable[Sequence[Any]]) -> DataQualityAnalysis:
+    rows = [list(r) for r in records]
+    q: Dict[str, Dict[str, int]] = {}
+    for i, c in enumerate(schema.columns):
+        missing = invalid = 0
+        for r in rows:
+            v = r[i]
+            if v is None or v == "":
+                missing += 1
+                continue
+            if c.kind in ("numeric", "integer"):
+                try:
+                    f = float(v)
+                    if math.isnan(f):
+                        missing += 1
+                    elif c.kind == "integer" and int(f) != f:
+                        invalid += 1
+                except (TypeError, ValueError):
+                    invalid += 1
+            elif c.kind == "categorical":
+                if c.categories is not None and v not in c.categories:
+                    invalid += 1
+        q[c.name] = {"missing": missing, "invalid": invalid,
+                     "total": len(rows)}
+    return DataQualityAnalysis(schema, q)

@@ -1,0 +1,241 @@
+"""DataVec transform catalog tests (conditions, reducers, joins, sequences,
+analysis). Reference parity: org.datavec.api.transform.* unit behavior."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (Condition, ConvertToSequence, Join,
+                                     Reducer, Schema, TransformProcess,
+                                     analyze, analyze_quality,
+                                     column_condition,
+                                     invalid_value_condition,
+                                     sequence_difference,
+                                     sequence_moving_window_reduce,
+                                     sequence_offset, sequence_trim,
+                                     split_sequences_by_length)
+
+
+def _schema():
+    return (Schema.builder()
+            .add_column_string("name")
+            .add_column_categorical("city", ["NYC", "SF", "LA"])
+            .add_column_double("spend")
+            .add_column_integer("visits")
+            .build())
+
+
+RECORDS = [
+    ["alice", "NYC", 10.0, 3],
+    ["bob", "SF", 20.0, 1],
+    ["carol", "NYC", 30.0, 2],
+    ["dave", "LA", 5.0, 7],
+]
+
+
+# ------------------------------------------------------------------ conditions
+def test_column_conditions_and_combinators():
+    rows = [dict(zip(_schema().names(), r)) for r in RECORDS]
+    c_nyc = column_condition("city", "eq", "NYC")
+    assert [c_nyc(r) for r in rows] == [True, False, True, False]
+    c_big = column_condition("spend", "gte", 20.0)
+    both = c_nyc & c_big
+    assert [both(r) for r in rows] == [False, False, True, False]
+    either = c_nyc | c_big
+    assert [either(r) for r in rows] == [True, True, True, False]
+    assert [(~c_nyc)(r) for r in rows] == [False, True, False, True]
+    c_in = column_condition("city", "in", {"SF", "LA"})
+    assert [c_in(r) for r in rows] == [False, True, False, True]
+    c_re = column_condition("name", "regex", "^[ab]")
+    assert [c_re(r) for r in rows] == [True, True, False, False]
+    with pytest.raises(ValueError):
+        column_condition("name", "frobnicate", 1)
+
+
+def test_invalid_value_condition():
+    cond = invalid_value_condition("spend")
+    assert cond({"spend": "oops"}) and not cond({"spend": 3.5})
+    assert cond({"spend": float("nan")}) and not cond({"spend": "42"})
+
+
+def test_filter_by_condition_removes_matching():
+    tp = (TransformProcess.builder(_schema())
+          .filter_by_condition(column_condition("city", "eq", "NYC"))
+          .build())
+    out = tp.execute(RECORDS)
+    assert [r[0] for r in out] == ["bob", "dave"]
+
+
+# -------------------------------------------------------------- column steps
+def test_math_and_column_surgery():
+    tp = (TransformProcess.builder(_schema())
+          .math_op("spend", "multiply", 2.0)
+          .math_op_between_columns("per_visit", "divide", "spend", "visits")
+          .rename_column("visits", "n_visits")
+          .duplicate_column("spend", "spend2")
+          .build())
+    out = tp.execute(RECORDS)
+    s = tp.final_schema()
+    assert s.names() == ["name", "city", "spend", "n_visits", "per_visit",
+                         "spend2"]
+    assert out[0][2] == 20.0 and out[0][4] == 20.0 / 3 and out[0][5] == 20.0
+
+
+def test_reorder_and_remove_except():
+    tp = (TransformProcess.builder(_schema())
+          .reorder_columns("spend", "name")
+          .build())
+    out = tp.execute(RECORDS)
+    assert tp.final_schema().names() == ["spend", "name", "city", "visits"]
+    assert out[1] == [20.0, "bob", "SF", 1]
+    tp2 = (TransformProcess.builder(_schema())
+           .remove_all_columns_except_for("name", "spend")
+           .build())
+    assert tp2.execute(RECORDS)[0] == ["alice", 10.0]
+
+
+def test_string_transforms():
+    tp = (TransformProcess.builder(_schema())
+          .to_upper_case("name")
+          .append_string("name", "!")
+          .replace_string("name", "ALICE", "A.")
+          .regex_replace("name", "[AEIOU]", "_")
+          .build())
+    out = tp.execute(RECORDS)
+    assert out[0][0] == "_." + "!"   # ALICE! -> A.! -> _.!
+    assert out[1][0] == "B_B!"
+
+
+def test_conditional_replace_and_invalid():
+    recs = [["a", "NYC", "bad", 1], ["b", "SF", 50.0, 2]]
+    tp = (TransformProcess.builder(_schema())
+          .replace_invalid_with("spend", 0.0)
+          .conditional_replace_value(
+              "spend", column_condition("spend", "gte", 40.0), 40.0)
+          .build())
+    out = tp.execute(recs)
+    assert out[0][2] == 0.0 and out[1][2] == 40.0
+
+
+def test_time_transforms():
+    sch = (Schema.builder().add_column_string("ts").build())
+    tp = (TransformProcess.builder(sch)
+          .string_to_time("ts", "%Y-%m-%d %H:%M:%S")
+          .derive_columns_from_time("ts", fields=("hour", "dayofweek",
+                                                  "month"))
+          .build())
+    out = tp.execute([["2026-07-30 14:30:00"]])
+    s = tp.final_schema()
+    assert s.names() == ["ts", "ts.hour", "ts.dayofweek", "ts.month"]
+    assert out[0][1] == 14 and out[0][3] == 7
+    assert out[0][2] == 3      # 2026-07-30 is a Thursday
+
+
+# ------------------------------------------------------------------- reducer
+def test_reducer_group_by():
+    red = (Reducer.builder("city")
+           .sum_columns("spend")
+           .mean_columns("visits")
+           .count_columns("name")
+           .build())
+    out, schema = red.reduce(RECORDS, _schema())
+    assert schema.names() == ["city", "count(name)", "sum(spend)",
+                              "mean(visits)"]
+    rows = {r[0]: r for r in out}
+    assert rows["NYC"] == ["NYC", 2, 40.0, 2.5]
+    assert rows["SF"] == ["SF", 1, 20.0, 1.0]
+    assert rows["LA"][2] == 5.0
+
+
+def test_reducer_in_transform_process():
+    red = Reducer.builder("city").max_columns("spend").build()
+    tp = TransformProcess.builder(_schema()).reduce(red).build()
+    out = tp.execute(RECORDS)
+    assert tp.final_schema().names() == ["city", "max(spend)"]
+    assert {tuple(r) for r in out} == {("NYC", 30.0), ("SF", 20.0),
+                                       ("LA", 5.0)}
+
+
+# ---------------------------------------------------------------------- join
+def _join_schemas():
+    left = (Schema.builder().add_column_integer("id")
+            .add_column_string("name").build())
+    right = (Schema.builder().add_column_integer("id")
+             .add_column_double("score").build())
+    return left, right
+
+
+def test_joins_all_types():
+    left_s, right_s = _join_schemas()
+    L = [[1, "a"], [2, "b"], [3, "c"]]
+    R = [[2, 20.0], [3, 30.0], [4, 40.0]]
+    inner = Join("Inner", ["id"], left_s, right_s)
+    assert inner.out_schema().names() == ["id", "name", "score"]
+    assert inner.execute(L, R) == [[2, "b", 20.0], [3, "c", 30.0]]
+    louter = Join("LeftOuter", ["id"], left_s, right_s).execute(L, R)
+    assert [1, "a", None] in louter and len(louter) == 3
+    router = Join("RightOuter", ["id"], left_s, right_s).execute(L, R)
+    assert [4, None, 40.0] in router and len(router) == 3
+    full = Join("FullOuter", ["id"], left_s, right_s).execute(L, R)
+    assert len(full) == 4
+    with pytest.raises(ValueError):
+        Join("Sideways", ["id"], left_s, right_s)
+
+
+def test_join_duplicate_right_keys():
+    left_s, right_s = _join_schemas()
+    out = Join("Inner", ["id"], left_s, right_s).execute(
+        [[1, "a"]], [[1, 10.0], [1, 11.0]])
+    assert out == [[1, "a", 10.0], [1, "a", 11.0]]
+
+
+# ----------------------------------------------------------------- sequences
+def _seq_schema():
+    return (Schema.builder().add_column_string("key")
+            .add_column_integer("t").add_column_double("v").build())
+
+
+def test_convert_to_sequence_and_ops():
+    sch = _seq_schema()
+    recs = [["a", 2, 3.0], ["b", 1, 10.0], ["a", 1, 1.0], ["a", 3, 6.0],
+            ["b", 2, 20.0]]
+    seqs, keys = ConvertToSequence(sch, "key", sort_by="t").execute(recs)
+    assert keys == ["a", "b"]
+    assert [r[2] for r in seqs[0]] == [1.0, 3.0, 6.0]
+
+    diff = sequence_difference(seqs, sch, "v")
+    assert [r[2] for r in diff[0]] == [0, 2.0, 3.0]
+
+    off = sequence_offset(seqs, sch, "v", offset=1)
+    assert [r[2] for r in off[0]] == [1.0, 3.0]    # trimmed first step
+
+    win, s2 = sequence_moving_window_reduce(seqs, sch, "v", window=2,
+                                            op="mean")
+    assert s2.names()[-1] == "mean(v,2)"
+    assert [r[-1] for r in win[0]] == [1.0, 2.0, 4.5]
+
+    assert [len(s) for s in sequence_trim(seqs, 1)] == [2, 1]
+    assert [len(s) for s in split_sequences_by_length(seqs, 2)] == [2, 1, 2]
+
+
+# ------------------------------------------------------------------ analysis
+def test_analyze_numeric_categorical_string():
+    da = analyze(_schema(), RECORDS)
+    spend = da.column_analysis("spend").stats
+    np.testing.assert_allclose(spend["mean"], 16.25)
+    assert spend["min"] == 5.0 and spend["max"] == 30.0
+    city = da.column_analysis("city").stats
+    assert city["counts"] == {"NYC": 2, "SF": 1, "LA": 1}
+    name = da.column_analysis("name").stats
+    assert name["min_length"] == 3 and name["max_length"] == 5
+    assert "rows: 4" in da.stats()
+
+
+def test_analyze_quality():
+    recs = [["a", "NYC", 1.0, 1], ["b", "Boston", "x", None],
+            ["c", "SF", float("nan"), 2.5]]
+    dq = analyze_quality(_schema(), recs)
+    assert dq.column_quality("city")["invalid"] == 1     # Boston
+    assert dq.column_quality("spend")["invalid"] == 1    # "x"
+    assert dq.column_quality("spend")["missing"] == 1    # nan
+    assert dq.column_quality("visits")["missing"] == 1   # None
+    assert dq.column_quality("visits")["invalid"] == 1   # 2.5 not integer
