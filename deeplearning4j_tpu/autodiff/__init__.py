@@ -1,5 +1,5 @@
 """deeplearning4j_tpu.autodiff — SameDiff graph API (whole-graph XLA)."""
 
-from .samediff import SameDiff, SDVariable, TrainingConfig
+from .samediff import History, SameDiff, SDVariable, TrainingConfig
 from .onnx_import import import_onnx
 from .tf_import import import_frozen_graph

@@ -236,6 +236,25 @@ _LOSS = {
 }
 
 
+class History:
+    """Training record returned by ``SameDiff.fit`` (reference:
+    ``org.nd4j.autodiff.listeners.records.History``): per-iteration loss
+    curve, per-epoch means, optional per-epoch validation scores."""
+
+    def __init__(self):
+        self.loss_curve: List[float] = []
+        self.epoch_losses: List[float] = []
+        self.validation: List[float] = []
+
+    def final_loss(self):
+        return self.loss_curve[-1] if self.loss_curve else None
+
+    def __repr__(self):
+        return (f"History(iterations={len(self.loss_curve)}, "
+                f"epochs={len(self.epoch_losses)}, "
+                f"final_loss={self.final_loss()})")
+
+
 class TrainingConfig:
     """Reference parity: org.nd4j.autodiff.samediff.TrainingConfig."""
 
@@ -442,8 +461,17 @@ class SameDiff:
         self._loss_vars = [n.name if isinstance(n, SDVariable) else n for n in names]
         return self
 
-    def fit(self, dataset=None, epochs: int = 1, iterator=None, feeds_fn=None):
-        """Train on a DataSet/iterator using TrainingConfig mappings."""
+    def fit(self, dataset=None, epochs: int = 1, iterator=None, feeds_fn=None,
+            listeners=None, validation_iterator=None, validation_fn=None):
+        """Train on a DataSet/iterator using TrainingConfig mappings.
+
+        Returns a `History` (reference:
+        ``org.nd4j.autodiff.listeners.records.History`` from SameDiff.fit).
+        `listeners` take the nn TrainingListener protocol
+        (iteration_done/on_epoch_end); `validation_fn(sd) -> float` (or a
+        validation_iterator scored with the training loss) records a
+        per-epoch validation metric in the history.
+        """
         cfg = self._training_config
         if cfg is None:
             raise ValueError("call set_training_config first")
@@ -477,8 +505,30 @@ class SameDiff:
         data = iterator if iterator is not None else ([dataset] if dataset is not None else None)
         if data is None:
             raise ValueError("provide dataset or iterator")
-        last = None
-        for _ in range(epochs):
+        listeners = list(listeners or [])
+        history = History()
+        # same one-step score-fetch deferral as MultiLayerNetwork.fit: when
+        # every listener opts in (deferred_score_ok), fetch step k-1's loss
+        # while step k runs so the host never stalls the device pipeline
+        defer_ok = all(getattr(l, "deferred_score_ok", False)
+                       for l in listeners)
+        pending = None
+
+        def flush_pending():
+            nonlocal pending
+            if pending is not None:
+                loss_d, it_i, ep_i = pending
+                pending = None
+                lv = float(loss_d)
+                for l in listeners:
+                    l.iteration_done(self, it_i, ep_i, lv)
+
+        val_fn = None
+        if validation_iterator is not None and validation_fn is None:
+            val_fn = jax.jit(self.make_function(
+                self._vars[self._loss_vars[0]], ph_names))
+        for epoch in range(epochs):
+            epoch_losses = []
             for ds in data:
                 arrays = [jnp.asarray(a) for a in
                           ([ds.features] if not isinstance(ds.features, list) else ds.features)]
@@ -488,10 +538,75 @@ class SameDiff:
                 vv = self._values_snapshot()
                 vv, self._opt_state, loss = step(vv, self._opt_state, *feed_vals)
                 self._values.update(vv)
-                last = loss
+                epoch_losses.append(loss)      # device value; fetched lazily
+                self._iter_count = getattr(self, "_iter_count", 0) + 1
+                if listeners:
+                    if defer_ok:
+                        flush_pending()
+                        pending = (loss, self._iter_count, epoch)
+                    else:
+                        lv = float(loss)
+                        for l in listeners:
+                            l.iteration_done(self, self._iter_count, epoch, lv)
             if hasattr(data, "reset"):
                 data.reset()
-        return None if last is None else float(last)
+            flush_pending()
+            history.loss_curve.extend(float(l) for l in epoch_losses)
+            if epoch_losses:
+                history.epoch_losses.append(
+                    sum(history.loss_curve[-len(epoch_losses):])
+                    / len(epoch_losses))
+            if validation_fn is not None:
+                history.validation.append(float(validation_fn(self)))
+            elif val_fn is not None:
+                vs = []
+                for ds in validation_iterator:
+                    feats = [jnp.asarray(a) for a in (
+                        [ds.features] if not isinstance(ds.features, list)
+                        else ds.features)]
+                    labs = [jnp.asarray(a) for a in (
+                        [ds.labels] if not isinstance(ds.labels, list)
+                        else ds.labels)]
+                    vs.append(float(val_fn(self._values_snapshot(),
+                                           *(feats + labs))))
+                if hasattr(validation_iterator, "reset"):
+                    validation_iterator.reset()
+                if vs:
+                    history.validation.append(sum(vs) / len(vs))
+            for l in listeners:
+                if hasattr(l, "on_epoch_end"):
+                    l.on_epoch_end(self)
+        return history
+
+    def evaluate(self, iterator, output, label_index: int = 0,
+                 evaluation=None):
+        """Accumulate an Evaluation over an iterator (reference:
+        SameDiff.evaluate(DataSetIterator, outputVariable, Evaluation)).
+        Features feed via TrainingConfig.feature_mapping; `output` is the
+        prediction variable (name or SDVariable); labels come from the
+        DataSet's labels (list index `label_index` for MultiDataSet)."""
+        cfg = self._training_config
+        if cfg is None:
+            raise ValueError("call set_training_config first "
+                             "(feature_mapping names the input placeholders)")
+        if evaluation is None:
+            from ..eval.classification import Evaluation as _Eval
+            evaluation = _Eval()
+        out = output if isinstance(output, SDVariable) else self._vars[output]
+        fn = None
+        for ds in iterator:
+            feats = ([ds.features] if not isinstance(ds.features, list)
+                     else ds.features)
+            labs = (ds.labels if not isinstance(ds.labels, list)
+                    else ds.labels[label_index])
+            if fn is None:
+                fn = jax.jit(self.make_function(out, cfg.feature_mapping))
+            preds = fn(self._values_snapshot(),
+                       *[jnp.asarray(a) for a in feats])
+            evaluation.eval(labs, preds)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return evaluation
 
     # ----------------------------------------------------------- control flow
     def lambda_op(self, name, fn, *inputs) -> SDVariable:

@@ -76,3 +76,38 @@ def test_stablehlo_export():
     assert "dot_general" in hlo or "dot " in hlo
     jaxpr = sd.to_jaxpr(sd.get_variable("out"), {"input": (2, 4), "label": (2, 3)})
     assert "dot_general" in str(jaxpr)
+
+
+def test_fit_returns_history_with_listeners_and_validation():
+    from deeplearning4j_tpu.autodiff import History
+    from deeplearning4j_tpu.nn.listeners import CollectScoresListener
+
+    sd = _mlp(SameDiff.create())
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["input"],
+        data_set_label_mapping=["label"]))
+    it = IrisDataSetIterator(batch_size=50)
+    collector = CollectScoresListener(frequency=1)
+    hist = sd.fit(iterator=it, epochs=5, listeners=[collector],
+                  validation_iterator=IrisDataSetIterator(batch_size=75))
+    assert isinstance(hist, History)
+    assert len(hist.loss_curve) == 5 * 3           # 150/50 batches per epoch
+    assert len(hist.epoch_losses) == 5
+    assert len(hist.validation) == 5
+    assert hist.epoch_losses[-1] < hist.epoch_losses[0]
+    assert hist.final_loss() == hist.loss_curve[-1]
+    assert len(collector.scores) == 15
+    assert "iterations=15" in repr(hist)
+
+
+def test_samediff_evaluate():
+    sd = _mlp(SameDiff.create())
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["input"],
+        data_set_label_mapping=["label"]))
+    it = IrisDataSetIterator(batch_size=50)
+    sd.fit(iterator=it, epochs=60)
+    ev = sd.evaluate(IrisDataSetIterator(batch_size=50), "out")
+    assert ev.accuracy() > 0.9
