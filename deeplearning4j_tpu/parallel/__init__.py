@@ -10,6 +10,7 @@ from .pipeline import (make_pipeline_loss, make_pipeline_train_step,
                        place_params_for_pipeline)
 from .ring_attention import (ring_attention, ring_attention_inner,
                              ring_attention_sharded)
+from .param_avg import ParameterAveragingTrainer
 from .wrapper import ParallelInference, ParallelWrapper
 
 __all__ = [
@@ -19,4 +20,5 @@ __all__ = [
     "make_pipeline_loss", "make_pipeline_train_step",
     "place_params_for_pipeline", "ring_attention", "ring_attention_inner",
     "ring_attention_sharded", "ParallelInference", "ParallelWrapper",
+    "ParameterAveragingTrainer",
 ]

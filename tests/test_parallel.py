@@ -149,3 +149,131 @@ def test_moe_forward_and_balance():
     logits, aux = tfm.forward(params, cfg, ids)
     assert logits.shape == (4, 8, 61)
     assert float(aux) > 0.0  # load-balance loss is live
+
+
+def test_parameter_averaging_freq1_sgd_matches_sync_dp():
+    """averaging params after ONE local Sgd step == stepping on the
+    averaged gradient: freq=1 ParameterAveragingTrainer must equal the
+    synchronous ParallelWrapper result (ParameterAveragingTrainingMaster
+    semantics check)."""
+    from deeplearning4j_tpu.parallel import (ParameterAveragingTrainer,
+                                             ParallelWrapper, make_mesh)
+    from deeplearning4j_tpu.train import Sgd
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(5e-2))
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(6))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((64, 6)).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    # 8 microbatches of 8: one param-avg round at freq=1 over dp=8 equals
+    # one sync step on the concatenated batch ONLY for linear updaters —
+    # compare against ParallelWrapper stepping per microbatch group
+    it1 = ListDataSetIterator([DataSet(X[i * 8:(i + 1) * 8],
+                                       Y[i * 8:(i + 1) * 8])
+                               for i in range(8)], batch_size=None)
+    net_pa = build()
+    pa = ParameterAveragingTrainer(net_pa, mesh=make_mesh(dp=8),
+                                   averaging_frequency=1)
+    pa.fit(it1, epochs=1)
+
+    net_pw = build()
+    pw = ParallelWrapper(net_pw, mesh=make_mesh(dp=8))
+    # same data as ONE sharded batch of 64 (dp=8 x 8 per shard): gradient
+    # mean over the whole batch == mean of the 8 microbatch gradients
+    it2 = ListDataSetIterator([DataSet(X, Y)], batch_size=None)
+    pw.fit(it2, epochs=1)
+
+    for k in net_pa.params:
+        for name in net_pa.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(net_pa.params[k][name]),
+                np.asarray(net_pw.params[k][name]), rtol=2e-4, atol=2e-5)
+
+
+def test_parameter_averaging_freq_gt1_converges():
+    from deeplearning4j_tpu.parallel import ParameterAveragingTrainer, make_mesh
+    from deeplearning4j_tpu.train import Adam
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(2e-2))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((128, 4)).astype(np.float32)
+    W = rng.standard_normal((4, 3))
+    Y = np.eye(3, dtype=np.float32)[(X @ W).argmax(1)]
+    batches = [DataSet(X[i * 8:(i + 1) * 8], Y[i * 8:(i + 1) * 8])
+               for i in range(16)]   # 16 = one round of dp8 * freq2
+    it = ListDataSetIterator(batches, batch_size=None)
+    pa = ParameterAveragingTrainer(net, mesh=make_mesh(dp=8),
+                                   averaging_frequency=2)
+    from deeplearning4j_tpu.data.dataset import DataSet as DS
+    s0 = net.score(DS(X, Y))
+    for _ in range(15):
+        pa.fit(it, epochs=1)
+    assert net.score(DS(X, Y)) < s0 * 0.5
+    # replicas were averaged back into a single consistent copy
+    out = net.output(X)
+    assert out.shape == (128, 3)
+
+
+def test_parameter_averaging_respects_label_masks():
+    """Masked DataSets must flow into the local steps (not be dropped):
+    training with a labels mask that zeroes half the timesteps must give
+    different parameters than training with the mask ignored."""
+    from deeplearning4j_tpu.parallel import ParameterAveragingTrainer, make_mesh
+    from deeplearning4j_tpu.train import Sgd
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration,
+                                       RnnOutputLayer, SimpleRnn)
+    from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(5e-2))
+                .list()
+                .layer(SimpleRnn(n_in=3, n_out=8, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(3, 6))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((64, 6, 3)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (64, 6))]
+    M = np.zeros((64, 6), np.float32)
+    M[:, :3] = 1.0
+    mk = lambda use_mask: ListDataSetIterator(  # noqa: E731
+        [DataSet(X[i*8:(i+1)*8], Y[i*8:(i+1)*8],
+                 labels_mask=M[i*8:(i+1)*8] if use_mask else None)
+         for i in range(8)], batch_size=None)
+
+    net_m = build()
+    ParameterAveragingTrainer(net_m, mesh=make_mesh(dp=8),
+                              averaging_frequency=1).fit(mk(True), epochs=1)
+    net_u = build()
+    ParameterAveragingTrainer(net_u, mesh=make_mesh(dp=8),
+                              averaging_frequency=1).fit(mk(False), epochs=1)
+    w_m = np.asarray(net_m.params["layer_1"]["W"])
+    w_u = np.asarray(net_u.params["layer_1"]["W"])
+    assert not np.allclose(w_m, w_u), "labels mask was silently dropped"
