@@ -202,12 +202,26 @@ class Word2Vec:
         m.syn0 = np.load(path + ".npy")
         return m
 
-    def save_word2vec_format(self, path: str, include_header: bool = True):
-        """The interchange text format every word2vec/fastText/GloVe tool
-        reads (reference WordVectorSerializer.writeWord2VecModel): optional
-        "V D" header line, then one `word v1 v2 ... vD` line per word.
-        UNK (index 0) is skipped — it is an internal slot, not a word."""
+    def save_word2vec_format(self, path: str, include_header: bool = True,
+                             binary: bool = False):
+        """The interchange formats every word2vec/fastText/GloVe tool reads
+        (reference WordVectorSerializer.writeWord2VecModel): text — optional
+        "V D" header line then one `word v1 v2 ... vD` line per word; or the
+        word2vec.c binary format — "V D\\n" header then `word` + space +
+        D little-endian float32s + newline per word. UNK (index 0) is
+        skipped — it is an internal slot, not a word."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if binary:
+            with open(path, "wb") as f:
+                f.write(f"{len(self.vocab.index_to_word) - 1} "
+                        f"{self.layer_size}\n".encode())
+                for i, word in enumerate(self.vocab.index_to_word):
+                    if i == 0:
+                        continue
+                    f.write(word.encode("utf-8") + b" ")
+                    f.write(np.asarray(self.syn0[i], "<f4").tobytes())
+                    f.write(b"\n")
+            return
         with open(path, "w", encoding="utf-8") as f:
             if include_header:
                 f.write(f"{len(self.vocab.index_to_word) - 1} "
@@ -219,9 +233,77 @@ class Word2Vec:
                 f.write(f"{word} {vec}\n")
 
     @classmethod
-    def load_word2vec_format(cls, path: str) -> "Word2Vec":
-        """Read the text interchange format (reference
-        WordVectorSerializer.readWord2VecModel); header line optional."""
+    def _from_words_rows(cls, words, rows, d) -> "Word2Vec":
+        """Assemble a model from loaded (word, vector) pairs, prepending
+        the internal UNK slot (index 0, zero vector)."""
+        m = cls(layer_size=d)
+        m.vocab = VocabCache()
+        m.vocab.index_to_word = [VocabCache.UNK] + words
+        m.vocab.word_to_index = {w: i for i, w in
+                                 enumerate(m.vocab.index_to_word)}
+        m.syn0 = np.concatenate([np.zeros((1, d), np.float32),
+                                 np.stack(rows)])
+        return m
+
+    @classmethod
+    def _load_word2vec_binary(cls, path: str) -> "Word2Vec":
+        """word2vec.c binary: header "V D\\n", then per word a
+        whitespace-terminated utf-8 token followed by D raw float32s and an
+        optional trailing newline."""
+        with open(path, "rb") as f:
+            header = f.readline().split()
+            if len(header) != 2:
+                raise ValueError(f"{path}: binary word2vec needs a 'V D' "
+                                 "header line")
+            v, d = int(header[0]), int(header[1])
+            words, rows = [], []
+            for _ in range(v):
+                chars = bytearray()
+                while True:
+                    c = f.read(1)
+                    if not c:
+                        raise ValueError(f"{path}: truncated binary "
+                                         f"word2vec file after "
+                                         f"{len(words)} words")
+                    if c in b" ":
+                        break
+                    if c not in b"\n":      # leading newline from prev row
+                        chars.extend(c)
+                words.append(chars.decode("utf-8"))
+                vec = np.frombuffer(f.read(4 * d), "<f4")
+                if vec.size != d:
+                    raise ValueError(f"{path}: truncated vector for "
+                                     f"'{words[-1]}'")
+                rows.append(vec.astype(np.float32))
+        return cls._from_words_rows(words, rows, d)
+
+    @classmethod
+    def load_word2vec_format(cls, path: str,
+                             binary: Optional[bool] = None) -> "Word2Vec":
+        """Read the text or binary interchange format (reference
+        WordVectorSerializer.readWord2VecModel); header line optional for
+        text. binary=None sniffs: a 'V D' header followed by bytes that
+        don't decode as clean text means word2vec.c binary."""
+        if binary is None:
+            with open(path, "rb") as f:
+                head = f.readline()
+                chunk = f.read(4096)
+            parts = head.split()
+            looks_header = (len(parts) == 2 and parts[0].isdigit()
+                            and parts[1].isdigit())
+            # a multibyte utf-8 char may straddle the 4096-byte boundary —
+            # trim up to 3 trailing bytes before declaring "not text"
+            is_text = False
+            for trim in range(4):
+                try:
+                    chunk[:len(chunk) - trim].decode("utf-8")
+                    is_text = True
+                    break
+                except UnicodeDecodeError:
+                    continue
+            binary = looks_header and not is_text
+        if binary:
+            return cls._load_word2vec_binary(path)
         words, rows = [], []
         with open(path, encoding="utf-8") as f:
             for ln_no, ln in enumerate(f):
@@ -241,15 +323,7 @@ class Word2Vec:
         dims = {len(r) for r in rows}
         if len(dims) != 1:
             raise ValueError(f"inconsistent vector sizes in {path}: {dims}")
-        d = dims.pop()
-        m = cls(layer_size=d)
-        m.vocab = VocabCache()
-        m.vocab.index_to_word = [VocabCache.UNK] + words
-        m.vocab.word_to_index = {w: i for i, w in
-                                 enumerate(m.vocab.index_to_word)}
-        m.syn0 = np.concatenate([np.zeros((1, d), np.float32),
-                                 np.stack(rows)])
-        return m
+        return cls._from_words_rows(words, rows, dims.pop())
 
 
 @dataclass

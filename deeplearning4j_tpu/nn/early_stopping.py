@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional
 
 import jax
+import jax.numpy as jnp
 
 
 # --- termination conditions -------------------------------------------------
@@ -129,7 +130,7 @@ class EarlyStoppingTrainer:
         epoch = 0
         reason, details = "MaxEpochs", ""
         while True:
-            self.model.fit(self.iterator, epochs=1)
+            self._fit_epoch()
             if (epoch + 1) % cfg.evaluate_every_n_epochs == 0:
                 score = cfg.score_calculator.calculate_score(self.model) \
                     if cfg.score_calculator else self._train_score()
@@ -137,8 +138,13 @@ class EarlyStoppingTrainer:
                 scores[epoch] = score
                 if score < best_score:
                     best_score, best_epoch = score, epoch
-                    best_params = jax.tree_util.tree_map(lambda a: a, self.model.params)
-                    best_states = jax.tree_util.tree_map(lambda a: a, self.model.states)
+                    # real copies, not references: the next epoch's jitted
+                    # step DONATES the current param buffers (no-op on CPU,
+                    # but on TPU a bare reference would be a deleted array)
+                    best_params = jax.tree_util.tree_map(
+                        jnp.copy, self.model.params)
+                    best_states = jax.tree_util.tree_map(
+                        jnp.copy, self.model.states)
                 stop = False
                 for cond in cfg.epoch_termination_conditions:
                     if cond.terminate(epoch, score, history):
@@ -157,8 +163,31 @@ class EarlyStoppingTrainer:
         return EarlyStoppingResult(reason, details, best_epoch, best_score,
                                    epoch + 1, best_model, scores)
 
+    def _fit_epoch(self):
+        self.model.fit(self.iterator, epochs=1)
+
     def _train_score(self):
         ds = next(iter(self.iterator))
         if hasattr(self.iterator, "reset"):
             self.iterator.reset()
         return self.model.score(ds)
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping around a multi-device trainer (reference:
+    ``org.deeplearning4j.earlystopping.trainer.EarlyStoppingParallelTrainer``
+    wrapping ParallelWrapper). Accepts any trainer with
+    ``fit(iterator, epochs=1)`` and a ``.net`` (ParallelWrapper,
+    ParameterAveragingTrainer); scoring/condition logic runs on the wrapped
+    net whose params the trainer keeps in sync."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, trainer,
+                 train_iterator):
+        if not hasattr(trainer, "net") or not hasattr(trainer, "fit"):
+            raise TypeError("trainer must expose .net and .fit (e.g. "
+                            "ParallelWrapper / ParameterAveragingTrainer)")
+        super().__init__(config, trainer.net, train_iterator)
+        self.trainer = trainer
+
+    def _fit_epoch(self):
+        self.trainer.fit(self.iterator, epochs=1)

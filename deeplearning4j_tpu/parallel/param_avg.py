@@ -64,8 +64,6 @@ class ParameterAveragingTrainer:
             net._build_optimizer(1)
         optimizer = net._optimizer
         freq, n = self.freq, self.n
-        rep = NamedSharding(self.mesh, P())
-        stacked = NamedSharding(self.mesh, P("dp"))
 
         def local_round(params, opt_state, states, xs, ys, rngs, fms, lms):
             """Runs on ONE device's replica. shard_map blocks keep the
@@ -117,7 +115,6 @@ class ParameterAveragingTrainer:
                       rngs, fms, lms)
 
         self._round = jax.jit(round_fn, donate_argnums=(0, 1, 2))
-        self._rep, self._stacked = rep, stacked
         return self._round
 
     # ------------------------------------------------------------------- fit
@@ -160,13 +157,16 @@ class ParameterAveragingTrainer:
                                                        buf)
                     buf = []
             if buf:
-                # flush the remainder synchronously on the averaged params;
-                # ONE net.fit call = one epoch_count bump + one on_epoch_end
+                # flush the remainder synchronously on the averaged params,
+                # one step PER microbatch (batch_size keeps the source
+                # granularity); ONE net.fit call = one epoch_count bump +
+                # one on_epoch_end
                 from ..data.iterators import ListDataSetIterator
                 net.params = self._unstack(sp)
                 net._opt_state = self._unstack(so)
                 net.states = self._unstack(ss)
-                last_f = net.fit(ListDataSetIterator(buf, batch_size=None))
+                last_f = net.fit(ListDataSetIterator(
+                    buf, batch_size=buf[0].num_examples()))
                 last = jnp.asarray(last_f if last_f is not None else 0.0)
                 tail_handled = True
                 sp, so, ss = (self._stack(net.params),
@@ -219,6 +219,12 @@ class ParameterAveragingTrainer:
             None if lms is None else jnp.asarray(lms))
         net._step_count += self.n * self.freq
         if net.listeners:
+            # listeners read model state (checkpoint/eval): expose the
+            # just-averaged replica, not the pre-fit params (a[0] makes a
+            # fresh buffer, safe across the next round's donation)
+            net.params = self._unstack(sp)
+            net._opt_state = self._unstack(so)
+            net.states = self._unstack(ss)
             lv = float(loss)
             for listener in net.listeners:
                 listener.iteration_done(net, net._step_count,

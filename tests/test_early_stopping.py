@@ -1,0 +1,111 @@
+"""Early stopping trainer + termination conditions + parallel variant.
+
+Reference parity: org.deeplearning4j.earlystopping (EarlyStoppingTrainer,
+EarlyStoppingParallelTrainer, termination conditions, score calculators).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.nn.early_stopping import (
+    ClassificationScoreCalculator, DataSetLossCalculator,
+    EarlyStoppingConfiguration, EarlyStoppingParallelTrainer,
+    EarlyStoppingResult, EarlyStoppingTrainer, InvalidScoreTerminationCondition,
+    MaxEpochsTerminationCondition, MaxScoreTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.train import Adam
+
+R = np.random.default_rng(0)
+X = R.standard_normal((96, 5)).astype(np.float32)
+W = R.standard_normal((5, 3))
+Y = np.eye(3, dtype=np.float32)[(X @ W).argmax(1)]
+
+
+def _net(seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(2e-2))
+            .list()
+            .layer(DenseLayer(n_in=5, n_out=24, activation="relu"))
+            .layer(OutputLayer(n_in=24, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iter():
+    return ListDataSetIterator(
+        [DataSet(X[i * 24:(i + 1) * 24], Y[i * 24:(i + 1) * 24])
+         for i in range(4)], batch_size=None)
+
+
+def test_max_epochs_termination():
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+        score_calculator=DataSetLossCalculator(_iter()))
+    result = EarlyStoppingTrainer(cfg, _net(), _iter()).fit()
+    assert isinstance(result, EarlyStoppingResult)
+    assert result.termination_reason == "MaxEpochsTerminationCondition"
+    assert result.total_epochs == 5
+    assert 0 <= result.best_model_epoch < 5
+    assert len(result.score_vs_epoch) == 5
+    # scores trended down on this learnable task
+    assert result.best_model_score < result.score_vs_epoch[0]
+
+
+def test_score_improvement_patience_stops_early():
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(500),
+            ScoreImprovementEpochTerminationCondition(
+                max_epochs_without_improvement=4, min_improvement=1e-3)],
+        score_calculator=DataSetLossCalculator(_iter()))
+    result = EarlyStoppingTrainer(cfg, _net(), _iter()).fit()
+    assert result.termination_reason == \
+        "ScoreImprovementEpochTerminationCondition"
+    assert result.total_epochs < 500
+
+
+def test_max_score_termination_divergence_guard():
+    # MaxScore is a divergence guard: stop as soon as score EXCEEDS bound
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(200),
+            MaxScoreTerminationCondition(0.05)],   # below initial loss
+        score_calculator=DataSetLossCalculator(_iter()))
+    result = EarlyStoppingTrainer(cfg, _net(), _iter()).fit()
+    assert result.termination_reason == "MaxScoreTerminationCondition"
+    assert result.total_epochs == 1
+
+
+def test_classification_score_calculator_and_best_model():
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(10)],
+        score_calculator=ClassificationScoreCalculator(_iter()))
+    result = EarlyStoppingTrainer(cfg, _net(), _iter()).fit()
+    best = result.best_model
+    acc = (np.asarray(best.output(X)).argmax(1) == Y.argmax(1)).mean()
+    assert acc >= 1.0 - result.best_model_score - 1e-9
+
+
+def test_invalid_score_condition():
+    cond = InvalidScoreTerminationCondition()
+    assert cond.terminate(0, float("nan"), [])
+    assert cond.terminate(0, float("inf"), [])
+    assert not cond.terminate(0, 0.5, [])
+
+
+def test_early_stopping_parallel_trainer():
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+    net = _net(seed=7)
+    pw = ParallelWrapper(net, mesh=make_mesh(dp=8))
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(6)],
+        score_calculator=DataSetLossCalculator(_iter()))
+    result = EarlyStoppingParallelTrainer(cfg, pw, _iter()).fit()
+    assert result.total_epochs == 6
+    assert result.best_model_score < result.score_vs_epoch[0]
+    with pytest.raises(TypeError):
+        EarlyStoppingParallelTrainer(cfg, object(), _iter())

@@ -176,3 +176,55 @@ def test_paragraph_vectors_infer():
     assert pv.doc_vectors.shape == (20, 16)
     v = pv.infer_vector("cat on a mat")
     assert v.shape == (16,) and np.isfinite(v).all()
+
+
+def test_word2vec_binary_format_roundtrip(tmp_path):
+    """word2vec.c binary interchange: write binary, read back (sniffed and
+    explicit), vectors bit-equal; text path unaffected."""
+    import numpy as np
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    m = Word2Vec(layer_size=8, min_word_frequency=1, epochs=2,
+                 batch_size=64, subsample=0.0)
+    m.fit(["the quick brown fox jumps over the lazy dog",
+           "the dog sleeps quick"] * 10)
+    p = str(tmp_path / "vecs.bin")
+    m.save_word2vec_format(p, binary=True)
+    for kwargs in ({"binary": True}, {}):     # explicit + sniffed
+        m2 = Word2Vec.load_word2vec_format(p, **kwargs)
+        assert m2.layer_size == 8
+        assert set(m2.vocab.index_to_word[1:]) == set(m.vocab.index_to_word[1:])
+        for w in ("dog", "quick"):
+            np.testing.assert_array_equal(m2.get_word_vector(w),
+                                          m.get_word_vector(w))
+    # text format still sniffs as text
+    pt = str(tmp_path / "vecs.txt")
+    m.save_word2vec_format(pt)
+    m3 = Word2Vec.load_word2vec_format(pt)
+    np.testing.assert_allclose(m3.get_word_vector("dog"),
+                               m.get_word_vector("dog"), atol=1e-5)
+
+
+def test_word2vec_sniffer_multibyte_at_chunk_boundary(tmp_path):
+    """A TEXT .vec file whose 4096-byte sniff chunk ends mid-way through a
+    multibyte utf-8 char must still be detected as text (regression: it
+    was silently mis-read as word2vec.c binary)."""
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    body = b"".join(b"x%03d 0.5 0.5\n" % i for i in range(214))  # 2996 B
+    if (4096 - len(body)) % 2 == 0:      # make the offset into the run odd
+        body += b"padd 0.5 0.5\n"       # 13 B
+    off = 4096 - len(body)
+    assert off % 2 == 1 and 0 < off < 1400
+    word = ("é" * 700).encode()                                # 1400 B run
+    body += word + b" 0.5 0.5\n"
+    n_words = body.decode().count("\n")
+    path = str(tmp_path / "boundary.vec")
+    with open(path, "wb") as f:
+        f.write(f"{n_words} 2\n".encode())
+        f.write(body)
+    m = Word2Vec.load_word2vec_format(path)    # sniffed: must be TEXT
+    assert "é" * 700 in m.vocab.word_to_index
+    assert m.layer_size == 2
+    import numpy as np
+    np.testing.assert_allclose(m.get_word_vector("x000"), [0.5, 0.5])

@@ -182,11 +182,12 @@ def test_parameter_averaging_freq1_sgd_matches_sync_dp():
     # compare against ParallelWrapper stepping per microbatch group
     it1 = ListDataSetIterator([DataSet(X[i * 8:(i + 1) * 8],
                                        Y[i * 8:(i + 1) * 8])
-                               for i in range(8)], batch_size=None)
+                               for i in range(8)], batch_size=8)
     net_pa = build()
     pa = ParameterAveragingTrainer(net_pa, mesh=make_mesh(dp=8),
                                    averaging_frequency=1)
     pa.fit(it1, epochs=1)
+    assert pa._round is not None   # the shard_map ROUND ran, not the tail
 
     net_pw = build()
     pw = ParallelWrapper(net_pw, mesh=make_mesh(dp=8))
@@ -224,7 +225,7 @@ def test_parameter_averaging_freq_gt1_converges():
     Y = np.eye(3, dtype=np.float32)[(X @ W).argmax(1)]
     batches = [DataSet(X[i * 8:(i + 1) * 8], Y[i * 8:(i + 1) * 8])
                for i in range(16)]   # 16 = one round of dp8 * freq2
-    it = ListDataSetIterator(batches, batch_size=None)
+    it = ListDataSetIterator(batches, batch_size=8)
     pa = ParameterAveragingTrainer(net, mesh=make_mesh(dp=8),
                                    averaging_frequency=2)
     from deeplearning4j_tpu.data.dataset import DataSet as DS
@@ -266,7 +267,7 @@ def test_parameter_averaging_respects_label_masks():
     mk = lambda use_mask: ListDataSetIterator(  # noqa: E731
         [DataSet(X[i*8:(i+1)*8], Y[i*8:(i+1)*8],
                  labels_mask=M[i*8:(i+1)*8] if use_mask else None)
-         for i in range(8)], batch_size=None)
+         for i in range(8)], batch_size=8)
 
     net_m = build()
     ParameterAveragingTrainer(net_m, mesh=make_mesh(dp=8),
