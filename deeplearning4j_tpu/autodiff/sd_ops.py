@@ -892,3 +892,133 @@ NAMESPACES = {
 
 def op_count():
     return sum(len(t) for t in NAMESPACES.values())
+
+
+# -------------------------------------------------------- r2 widening #3 --
+# SDImage color-space conversions + hue/saturation, group/instance norm,
+# adaptive pooling, col2im. Reference: nd4j-api ops/impl/image (RgbToHsv,
+# RgbToYiq, RgbToYuv, AdjustHue, AdjustSaturation), SDCNN, and the keras/
+# torch adaptive-pooling semantics DL4J users expect via model import.
+
+_YIQ_M = jnp.array([[0.299, 0.587, 0.114],
+                    [0.59590059, -0.27455667, -0.32134392],
+                    [0.21153661, -0.52273617, 0.31119955]], jnp.float32)
+_YUV_M = jnp.array([[0.299, 0.587, 0.114],
+                    [-0.14714119, -0.28886916, 0.43601035],
+                    [0.61497538, -0.51496512, -0.10001026]], jnp.float32)
+# constant inverses precomputed once at import (not per call/trace)
+import numpy  # noqa: E402
+_YIQ_INV = jnp.asarray(numpy.linalg.inv(numpy.asarray(_YIQ_M)))
+_YUV_INV = jnp.asarray(numpy.linalg.inv(numpy.asarray(_YUV_M)))
+
+
+def _rgb_to_hsv(rgb):
+    """Channel-last float rgb in [0,1] -> hsv (same shape/convention as
+    tf.image.rgb_to_hsv)."""
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    mx = jnp.max(rgb, -1)
+    mn = jnp.min(rgb, -1)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0))
+    h = jnp.where(d == 0, 0.0, h) / 6.0
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], -1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0] * 6.0, hsv[..., 1], hsv[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], -1)
+
+
+def _adjust_hue(img, delta):
+    hsv = _rgb_to_hsv(img)
+    h = (hsv[..., 0] + delta) % 1.0
+    return _hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], -1))
+
+
+def _adjust_saturation(img, factor):
+    hsv = _rgb_to_hsv(img)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return _hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], -1))
+
+
+def _group_norm(x, gamma, beta, groups, eps=1e-5):
+    """Channel-last group norm: normalize over all non-batch dims within
+    each channel group (tf-addons/torch GroupNorm semantics)."""
+    shp = x.shape
+    c = shp[-1]
+    g = int(groups)
+    xg = x.reshape(shp[0], -1, g, c // g)          # (B, spatial, G, C/G)
+    mu = jnp.mean(xg, (1, 3), keepdims=True)
+    var = jnp.var(xg, (1, 3), keepdims=True)
+    xn = ((xg - mu) * lax.rsqrt(var + eps)).reshape(shp)
+    return xn * gamma + beta
+
+
+def _instance_norm(x, gamma, beta, eps=1e-5):
+    """Channel-last instance norm: normalize each (sample, channel) over
+    the spatial dims."""
+    axes = tuple(range(1, x.ndim - 1))
+    mu = jnp.mean(x, axes, keepdims=True)
+    var = jnp.var(x, axes, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * gamma + beta
+
+
+def _adaptive_pool2d(x, out_h, out_w, op):
+    """torch adaptive_{avg,max}_pool2d semantics, NHWC: output cell (i,j)
+    pools input[floor(i*H/oh):ceil((i+1)*H/oh), ...]. Static out sizes."""
+    B, H, W, C = x.shape
+    oh, ow = int(out_h), int(out_w)
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * H) // oh, -((-(i + 1) * H) // oh)
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * W) // ow, -((-(j + 1) * W) // ow)
+            win = x[:, h0:h1, w0:w1, :]
+            cols.append(op(win, axis=(1, 2)))
+        rows.append(jnp.stack(cols, 1))
+    return jnp.stack(rows, 1)
+
+
+def _sd_col2im(cols, x_shape, kh, kw, sh=1, sw=1):
+    from ..ndarray.factory import col2im as _c2i
+    return _c2i(cols, tuple(x_shape), (int(kh), int(kw)),
+                (int(sh), int(sw)))
+
+
+IMAGE.update({
+    "rgb_to_hsv": _rgb_to_hsv,
+    "hsv_to_rgb": _hsv_to_rgb,
+    "rgb_to_yiq": lambda x: jnp.einsum("...c,kc->...k", x, _YIQ_M),
+    "yiq_to_rgb": lambda x: jnp.einsum("...c,kc->...k", x, _YIQ_INV),
+    "rgb_to_yuv": lambda x: jnp.einsum("...c,kc->...k", x, _YUV_M),
+    "yuv_to_rgb": lambda x: jnp.einsum("...c,kc->...k", x, _YUV_INV),
+    "adjust_hue": _adjust_hue,
+    "adjust_saturation": _adjust_saturation,
+})
+
+NN_EXT.update({
+    "group_norm": _group_norm,
+    "instance_norm": _instance_norm,
+})
+
+CNN.update({
+    "adaptive_avg_pooling2d": lambda x, oh, ow: _adaptive_pool2d(
+        x, oh, ow, jnp.mean),
+    "adaptive_max_pooling2d": lambda x, oh, ow: _adaptive_pool2d(
+        x, oh, ow, jnp.max),
+    "col2im": _sd_col2im,
+})

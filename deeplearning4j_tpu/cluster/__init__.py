@@ -1,0 +1,12 @@
+"""deeplearning4j_tpu.cluster — clustering + nearest-neighbor search.
+
+Reference parity: ``deeplearning4j-nearestneighbors-parent`` —
+`clustering/kmeans/KMeansClustering`, `nearestneighbor-core` VPTree
+search, and `RandomProjectionLSH`.
+"""
+
+from .kmeans import KMeansClustering
+from .knn import NearestNeighborsSearch, RandomProjectionLSH
+
+__all__ = ["KMeansClustering", "NearestNeighborsSearch",
+           "RandomProjectionLSH"]

@@ -301,7 +301,11 @@ class Word2Vec:
                     break
                 except UnicodeDecodeError:
                     continue
-            binary = looks_header and not is_text
+            # raw float32 payload almost always contains control bytes
+            # (e.g. the low-order NULs of 0.5 = 00 00 00 3f) which CAN be
+            # valid utf-8 — text .vec files never contain them
+            has_ctrl = any(b < 9 for b in chunk)
+            binary = looks_header and (has_ctrl or not is_text)
         if binary:
             return cls._load_word2vec_binary(path)
         words, rows = [], []

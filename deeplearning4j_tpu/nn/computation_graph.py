@@ -144,6 +144,95 @@ class ComputationGraph:
         outs = self._infer_fn(self.params, self.states, ins)
         return outs[0] if len(outs) == 1 else outs
 
+    # ------------------------------------------------------- rnn streaming
+    def rnn_time_step(self, *inputs):
+        """Streaming inference through the DAG (reference:
+        ComputationGraph.rnnTimeStep): feed a (B, T, C) chunk — or a (B, C)
+        float single step — per graph input; recurrent layer carries
+        persist on device across calls until rnn_clear_previous_state().
+        Same one-jitted-scan design as MultiLayerNetwork.rnn_time_step."""
+        from .layers.recurrent import (BaseRecurrent, Bidirectional,
+                                       LastTimeStep)
+        from .layers.wrappers import TimeDistributedLayer
+        for name in self.conf.topo_order:
+            op = self.conf.nodes[name].op
+            if isinstance(op, Layer) and isinstance(
+                    unwrap(op), (Bidirectional, LastTimeStep,
+                                 TimeDistributedLayer)):
+                raise NotImplementedError(
+                    f"rnn_time_step cannot stream through node '{name}' "
+                    f"({type(unwrap(op)).__name__}): it needs the full "
+                    "sequence (reference rnnTimeStep has the same limit)")
+        xs = [jnp.asarray(x) for x in inputs]
+        integer = jnp.issubdtype(xs[0].dtype, jnp.integer)
+        single = (xs[0].ndim == 2 and not integer) or \
+            (xs[0].ndim == 1 and integer)
+        if single:
+            xs = [x[:, None] if x.ndim == 1 else x[:, None, :] for x in xs]
+        batch = xs[0].shape[0]
+
+        old = getattr(self, "_rnn_carries", None) or {}
+        if getattr(self, "_rnn_carry_batch", None) != batch:
+            old = {}
+        carries = {}
+        for name in self.conf.topo_order:
+            op = self.conf.nodes[name].op
+            ul = unwrap(op) if isinstance(op, Layer) else None
+            if isinstance(ul, BaseRecurrent):
+                carries[name] = old.get(name)
+                if carries[name] is None:
+                    dtype = ul.compute_dtype or (
+                        xs[0].dtype if jnp.issubdtype(xs[0].dtype,
+                                                      jnp.floating)
+                        else self._g.param_dtype)
+                    carries[name] = ul.init_carry(batch, dtype)
+        self._rnn_carry_batch = batch
+
+        if getattr(self, "_rnn_stream_fn", None) is None:
+            def stream(params, states, carries, ins):
+                def step(cs, xt):
+                    acts = dict(xt)
+                    new_cs = {}
+                    for name in self.conf.topo_order:
+                        node = self.conf.nodes[name]
+                        vals = [acts[i] for i in node.inputs]
+                        if isinstance(node.op, Layer):
+                            h = vals if getattr(node.op, "multi_input",
+                                                False) else vals[0]
+                            if name in self._preprocessors:
+                                h = self._preprocessors[name](h)
+                            ul = unwrap(node.op)
+                            if isinstance(ul, BaseRecurrent):
+                                h, c = ul.step_apply(params[name], cs[name],
+                                                     h, Ctx(train=False))
+                                new_cs[name] = c
+                            else:
+                                h, _ = node.op.apply(params[name],
+                                                     states[name], h,
+                                                     Ctx(train=False))
+                            acts[name] = h
+                        else:
+                            acts[name] = node.op.apply(vals)
+                    return new_cs, [acts[o] for o in self.conf.outputs]
+
+                cs, ys = jax.lax.scan(
+                    step, carries,
+                    {n: v.swapaxes(0, 1) for n, v in ins.items()})
+                return [y.swapaxes(0, 1) for y in ys], cs
+
+            self._rnn_stream_fn = jax.jit(stream)
+
+        ins = {n: x for n, x in zip(self.conf.inputs, xs)}
+        ys, carries = self._rnn_stream_fn(self.params, self.states,
+                                          carries, ins)
+        self._rnn_carries = carries
+        ys = [y[:, 0] for y in ys] if single else ys
+        return ys[0] if len(ys) == 1 else ys
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+        self._rnn_carry_batch = None
+
     # ----------------------------------------------------------------- loss
     def _loss(self, params, states, inputs, labels, rng, fmask, lmask):
         acts, pre_acts, new_states = self._forward(

@@ -2,6 +2,9 @@
 
 from .a2c import A2C, A2CConfiguration
 from .a3c import A3C, A3CConfiguration, A3CDiscrete
+from .async_nstep_q import (AsyncNStepQLearning,
+                            AsyncNStepQLearningConfiguration,
+                            AsyncNStepQLearningDiscrete)
 from .dqn import DQN, QLearningConfiguration
 from .env import (CartPoleEnv, Environment, VectorizedCartPole, cartpole_init,
                   cartpole_step)

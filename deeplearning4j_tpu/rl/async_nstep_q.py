@@ -1,0 +1,162 @@
+"""Asynchronous n-step Q-learning — parity with RL4J's
+``org.deeplearning4j.rl4j.learning.async.nstep.discrete
+.AsyncNStepQLearningDiscrete`` (the Hogwild counterpart of A3C with an
+eps-greedy Q policy and a periodically-synced target network).
+
+TPU-first redesign mirrors :mod:`.a3c`: the reference's ``numThreads``
+CPU workers become one XLA program per iteration — a ``vmap`` over
+workers, each rolling out ``nStep`` transitions in its own envs with its
+own STALE local Q-network, then a sequential ``lax.scan`` pushing each
+worker's gradient through the SHARED optimizer (true Hogwild staleness,
+deterministic order), after which each worker pulls the fresh globals.
+The n-step target bootstraps from a TARGET network copied from the
+globals every ``target_update_freq`` iterations (reference
+``targetDqnUpdateFreq``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .actor_critic import nstep_returns
+from .env import cartpole_init, cartpole_step
+from .networks import build_mlp
+
+
+@dataclass
+class AsyncNStepQLearningConfiguration:
+    gamma: float = 0.99
+    learning_rate: float = 1e-3
+    n_workers: int = 8              # reference numThreads
+    n_envs_per_worker: int = 2
+    rollout_length: int = 8         # reference nStep
+    eps_start: float = 1.0          # eps-greedy anneal (reference epsilon)
+    eps_end: float = 0.05
+    eps_anneal_iters: int = 150
+    target_update_freq: int = 20    # reference targetDqnUpdateFreq (iters)
+    max_grad_norm: float = 1.0
+    seed: int = 0
+    hidden: Sequence[int] = (64, 64)
+
+
+class AsyncNStepQLearning:
+    """AsyncNStepQLearningDiscrete analogue over vectorized envs."""
+
+    def __init__(self, config: AsyncNStepQLearningConfiguration = None,
+                 env_init=cartpole_init, env_step=cartpole_step,
+                 obs_dim: int = 4, n_actions: int = 2):
+        self.cfg = cfg = config or AsyncNStepQLearningConfiguration()
+        self.n_actions = n_actions
+        init_fn, self._q_fn = build_mlp((obs_dim, *cfg.hidden, n_actions))
+        key = jax.random.PRNGKey(cfg.seed)
+        pkey, self._key = jax.random.split(key)
+        self.params = init_fn(pkey)                      # global Q network
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self._opt = optax.chain(optax.clip_by_global_norm(cfg.max_grad_norm),
+                                optax.adam(cfg.learning_rate))
+        self._opt_state = self._opt.init(self.params)
+        self._locals = jax.tree_util.tree_map(
+            lambda p: jnp.broadcast_to(p, (cfg.n_workers,) + p.shape),
+            self.params)
+
+        q_fn, opt = self._q_fn, self._opt
+        W, E, T = cfg.n_workers, cfg.n_envs_per_worker, cfg.rollout_length
+
+        def rollout(local_params, states, key, eps):
+            """T eps-greedy steps on E envs. Returns trajectories + final
+            states (auto-reset on done, like the vectorized cartpole)."""
+            def step(carry, _):
+                st, k = carry
+                k, ka, kr = jax.random.split(k, 3)
+                obs = st
+                q = q_fn(local_params, obs)                  # (E, A)
+                greedy = jnp.argmax(q, -1)
+                rand = jax.random.randint(ka, (E,), 0, n_actions)
+                explore = jax.random.bernoulli(kr, eps, (E,))
+                act = jnp.where(explore, rand, greedy)
+                nxt, rew, done = jax.vmap(env_step)(st, act)
+                k, kreset = jax.random.split(k)
+                fresh = jax.vmap(env_init)(jax.random.split(kreset, E))
+                nxt = jnp.where(done[:, None], fresh, nxt)
+                return (nxt, k), (obs, act, rew, done)
+            (states, key), traj = jax.lax.scan(step, (states, key),
+                                               None, length=T)
+            return states, key, traj
+
+        def worker_grad(local_params, target_params, states, key, eps):
+            states, key, (obs, act, rew, done) = rollout(
+                local_params, states, key, eps)
+            boot = jnp.max(q_fn(target_params, states), -1)   # V_target(s_T)
+            returns = nstep_returns(cfg.gamma, boot, rew, done)  # (T, E)
+            flat_obs = obs.reshape((T * E,) + obs.shape[2:])
+            flat_act = act.reshape(T * E)
+            flat_ret = returns.reshape(T * E)
+
+            def loss(p):
+                q = q_fn(p, flat_obs)
+                qa = jnp.take_along_axis(q, flat_act[:, None], 1)[:, 0]
+                return jnp.mean(optax.huber_loss(qa, flat_ret))
+
+            l, grads = jax.value_and_grad(loss)(local_params)
+            return grads, l, done.sum(), states
+
+        @jax.jit
+        def iteration(global_params, target_params, opt_state, locals_,
+                      states, key, eps):
+            keys = jax.random.split(key, W + 1)
+            grads, losses, dones, states = jax.vmap(
+                worker_grad, in_axes=(0, None, 0, 0, None))(
+                locals_, target_params, states, keys[:W], eps)
+
+            def push_pull(carry, g):
+                gp, os_ = carry
+                updates, os_ = opt.update(g, os_, gp)
+                gp = optax.apply_updates(gp, updates)
+                return (gp, os_), gp
+            (global_params, opt_state), new_locals = jax.lax.scan(
+                push_pull, (global_params, opt_state), grads)
+            return (global_params, opt_state, new_locals, states, keys[W],
+                    dones.sum(), losses.mean())
+
+        self._iteration = iteration
+        self._env_init = env_init
+        self._iter_count = 0
+
+    def epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self._iter_count / max(1, cfg.eps_anneal_iters))
+        return float(cfg.eps_start + (cfg.eps_end - cfg.eps_start) * frac)
+
+    def choose_action(self, obs) -> int:
+        """Greedy policy for play (reference DQNPolicy)."""
+        q = self._q_fn(self.params, jnp.asarray(obs)[None])
+        return int(jnp.argmax(q, -1)[0])
+
+    def train(self, iterations: int) -> List[float]:
+        """Returns episode terminations per iteration (lower = better on
+        the vectorized cartpole: fewer resets = longer balancing)."""
+        cfg = self.cfg
+        self._key, rkey = jax.random.split(self._key)
+        states = jax.vmap(lambda k: jax.vmap(self._env_init)(
+            jax.random.split(k, cfg.n_envs_per_worker)))(
+            jax.random.split(rkey, cfg.n_workers))
+        dones = []
+        for _ in range(iterations):
+            (self.params, self._opt_state, self._locals, states, self._key,
+             d, _) = self._iteration(
+                self.params, self.target_params, self._opt_state,
+                self._locals, states, self._key, self.epsilon())
+            self._iter_count += 1
+            if self._iter_count % cfg.target_update_freq == 0:
+                self.target_params = jax.tree_util.tree_map(
+                    jnp.copy, self.params)
+            dones.append(float(d))
+        return dones
+
+
+AsyncNStepQLearningDiscrete = AsyncNStepQLearning  # reference alias

@@ -1,0 +1,90 @@
+"""KMeans + nearest-neighbor search + LSH.
+
+Reference parity: deeplearning4j-nearestneighbors-parent
+(KMeansClustering, VPTree NearestNeighborsSearch, RandomProjectionLSH).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cluster import (KMeansClustering,
+                                        NearestNeighborsSearch,
+                                        RandomProjectionLSH)
+
+
+def _blobs(n_per=60, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.asarray([[5, 0, 0], [-5, 4, 0], [0, -6, 3]], np.float32)
+    pts = np.concatenate([
+        rng.normal(c, 0.4, (n_per, 3)).astype(np.float32) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts, labels, centers
+
+
+def test_kmeans_recovers_blobs():
+    pts, labels, centers = _blobs()
+    km = KMeansClustering.setup(3, max_iterations=50)
+    km.fit(pts)
+    assert km.cluster_centers_.shape == (3, 3)
+    # every found center is near a true one (in some order)
+    d = np.linalg.norm(km.cluster_centers_[:, None] - centers[None], axis=-1)
+    assert d.min(axis=1).max() < 0.5
+    # cluster assignments are pure wrt true labels
+    for c in range(3):
+        members = labels[km.labels_ == c]
+        assert (members == members[0]).mean() > 0.98
+    assert km.inertia_ < pts.shape[0] * 1.0
+    # predict matches fit labels
+    np.testing.assert_array_equal(km.predict(pts), km.labels_)
+
+
+def test_kmeans_cosine_and_validation():
+    pts, _, _ = _blobs(seed=3)
+    km = KMeansClustering(3, distance="cosine").fit(pts)
+    assert len(set(km.labels_.tolist())) == 3
+    with pytest.raises(ValueError):
+        KMeansClustering(3, distance="hamming")
+    with pytest.raises(ValueError):
+        KMeansClustering(10).fit(pts[:5])
+
+
+def test_knn_exact_matches_numpy_oracle():
+    rng = np.random.default_rng(1)
+    corpus = rng.standard_normal((200, 8)).astype(np.float32)
+    queries = rng.standard_normal((5, 8)).astype(np.float32)
+    nns = NearestNeighborsSearch(corpus)
+    idx, dist = nns.search(queries, k=7)
+    assert idx.shape == (5, 7) and dist.shape == (5, 7)
+    for qi in range(5):
+        d = ((corpus - queries[qi]) ** 2).sum(-1)
+        want = np.argsort(d)[:7]
+        np.testing.assert_array_equal(np.sort(idx[qi]), np.sort(want))
+        assert (np.diff(dist[qi]) >= -1e-5).all()   # sorted ascending
+    # single-query convenience shape
+    i1, d1 = nns.search(queries[0], k=3)
+    assert i1.shape == (3,)
+    np.testing.assert_array_equal(i1, idx[0][:3])
+
+
+def test_knn_cosine():
+    rng = np.random.default_rng(2)
+    corpus = rng.standard_normal((50, 4)).astype(np.float32)
+    q = corpus[17] * 3.0          # same direction, different norm
+    idx, _ = NearestNeighborsSearch(corpus, distance="cosine").search(q, k=1)
+    assert idx[0] == 17
+
+
+def test_lsh_approximate_recall():
+    rng = np.random.default_rng(4)
+    corpus = rng.standard_normal((2000, 16)).astype(np.float32)
+    lsh = RandomProjectionLSH(corpus, n_bits=10, n_tables=8, seed=1)
+    exact = NearestNeighborsSearch(corpus)
+    hits = 0
+    for qi in range(20):
+        q = corpus[qi] + rng.normal(0, 0.01, 16).astype(np.float32)
+        got, _ = lsh.search(q, k=1)
+        want, _ = exact.search(q, k=1)
+        hits += int(got[0] == want[0])
+    assert hits >= 16        # near-duplicate queries: high recall@1
+    # candidate sets are genuinely sublinear
+    assert len(lsh.candidates(corpus[0])) < 2000

@@ -365,3 +365,48 @@ def test_rnn_time_step_integer_token_chunks():
     net.rnn_clear_previous_state()
     stepped = [np.asarray(net.rnn_time_step(ids[:, t])) for t in range(7)]
     np.testing.assert_allclose(np.stack(stepped, axis=1), full, atol=1e-5)
+
+
+def test_graph_rnn_time_step_matches_full_forward():
+    """ComputationGraph.rnn_time_step (reference ComputationGraph
+    .rnnTimeStep): streamed DAG inference == full-sequence output(),
+    including a two-input graph merging a recurrent and a static branch."""
+    from deeplearning4j_tpu.nn import (DenseLayer, NeuralNetConfiguration,
+                                       RnnOutputLayer)
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    rng = np.random.default_rng(9)
+    b = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+         .graph_builder())
+    b.add_inputs("in")
+    b.add_layer("rnn", LSTM(n_in=3, n_out=6), "in")
+    b.add_layer("out", RnnOutputLayer(n_in=6, n_out=4, activation="softmax",
+                                      loss="mcxent"), "rnn")
+    b.set_outputs("out")
+    g = ComputationGraph(b.build()).init([(5, 3)])
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    full = np.asarray(g.output(x))
+    g.rnn_clear_previous_state()
+    stepped = [np.asarray(g.rnn_time_step(x[:, t, :])) for t in range(5)]
+    np.testing.assert_allclose(np.stack(stepped, 1), full, atol=1e-5)
+    # chunked streaming carries state
+    g.rnn_clear_previous_state()
+    first = np.asarray(g.rnn_time_step(x[:, :2, :]))
+    rest = np.asarray(g.rnn_time_step(x[:, 2:, :]))
+    np.testing.assert_allclose(np.concatenate([first, rest], 1), full,
+                               atol=1e-5)
+    # Bidirectional is rejected loudly
+    b2 = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+          .graph_builder())
+    b2.add_inputs("in")
+    b2.add_layer("rnn", Bidirectional(LSTM(n_in=3, n_out=6)), "in")
+    b2.add_layer("out", RnnOutputLayer(n_in=12, n_out=4, activation="softmax",
+                                       loss="mcxent"), "rnn")
+    b2.set_outputs("out")
+    g2 = ComputationGraph(b2.build()).init([(5, 3)])
+    try:
+        g2.rnn_time_step(x[:, 0, :])
+        raise AssertionError("expected NotImplementedError")
+    except NotImplementedError as e:
+        assert "Bidirectional" in str(e)

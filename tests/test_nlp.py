@@ -228,3 +228,21 @@ def test_word2vec_sniffer_multibyte_at_chunk_boundary(tmp_path):
     assert m.layer_size == 2
     import numpy as np
     np.testing.assert_allclose(m.get_word_vector("x000"), [0.5, 0.5])
+
+
+def test_word2vec_binary_sniffed_even_when_payload_is_utf8(tmp_path):
+    """Binary files whose float payload happens to decode as utf-8 (e.g.
+    zero vectors = all NUL bytes) must still sniff as BINARY."""
+    import numpy as np
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    m = Word2Vec(layer_size=4, min_word_frequency=1, epochs=1,
+                 batch_size=64, subsample=0.0)
+    m.fit(["aa bb cc dd"] * 10)
+    m.syn0 = np.zeros_like(m.syn0)          # worst case: all-NUL payload
+    m.syn0[1:, 0] = 0.5                     # 0.5 -> 00 00 00 3f (has NULs)
+    p = str(tmp_path / "zeros.bin")
+    m.save_word2vec_format(p, binary=True)
+    m2 = Word2Vec.load_word2vec_format(p)   # sniffed, must route binary
+    np.testing.assert_array_equal(m2.get_word_vector("aa"),
+                                  [0.5, 0, 0, 0])

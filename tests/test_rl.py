@@ -114,3 +114,41 @@ def test_a2c_learns_cartpole():
     # terminations per rollout drop as the policy balances longer
     assert np.mean(dones[-100:]) < np.mean(dones[:100]) * 0.75
     assert agent.play(CartPoleEnv(seed=9, max_steps=300)) > 80
+
+
+def test_async_nstep_q_hogwild_and_target_sync():
+    from deeplearning4j_tpu.rl import (AsyncNStepQLearning,
+                                       AsyncNStepQLearningConfiguration)
+    cfg = AsyncNStepQLearningConfiguration(seed=1, n_workers=4,
+                                           n_envs_per_worker=2,
+                                           rollout_length=4,
+                                           target_update_freq=3)
+    agent = AsyncNStepQLearning(cfg)
+    p0 = jax.tree_util.tree_map(jnp.copy, agent.params)
+    t0 = jax.tree_util.tree_map(jnp.copy, agent.target_params)
+    agent.train(2)
+    # globals moved, target frozen until the sync iteration
+    moved = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+        lambda a, b: jnp.any(a != b), agent.params, p0))
+    assert any(bool(m) for m in moved)
+    same = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+        lambda a, b: jnp.all(a == b), agent.target_params, t0))
+    assert all(bool(s) for s in same)
+    agent.train(1)            # iteration 3 -> target syncs to globals
+    synced = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+        lambda a, b: jnp.all(a == b), agent.target_params, agent.params))
+    assert all(bool(s) for s in synced)
+    # epsilon anneals
+    assert agent.epsilon() < cfg.eps_start
+
+
+def test_async_nstep_q_learns_cartpole():
+    from deeplearning4j_tpu.rl import (AsyncNStepQLearning,
+                                       AsyncNStepQLearningConfiguration)
+    cfg = AsyncNStepQLearningConfiguration(seed=0, n_workers=8,
+                                           n_envs_per_worker=2,
+                                           rollout_length=8,
+                                           eps_anneal_iters=200)
+    agent = AsyncNStepQLearning(cfg)
+    dones = agent.train(600)
+    assert np.mean(dones[-100:]) < np.mean(dones[:100]) * 0.6

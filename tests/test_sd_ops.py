@@ -526,3 +526,107 @@ def test_crop_and_resize_identity_and_quadrant():
     out = sd_ops.IMAGE["crop_and_resize"](img, [[0.5, 0.5, 1.5, 1.5]],
                                           [0], (4, 4))
     assert np.asarray(out)[0, -1, -1].tolist() == [0.0, 0.0, 0.0]
+
+
+# ---------------------------------------------------------------------------
+# r2 widening #3: SDImage color conversions, group/instance norm, adaptive
+# pooling, col2im (oracles: colorsys, torch, roundtrips)
+# ---------------------------------------------------------------------------
+
+def test_rgb_hsv_roundtrip_and_colorsys_oracle():
+    import colorsys
+    rng = np.random.default_rng(0)
+    rgb = rng.uniform(0, 1, (5, 4, 3)).astype(np.float32)
+    sd = SameDiff.create()
+    x = sd.constant("x", rgb)
+    hsv = np.asarray(sd.eval(sd.image.rgb_to_hsv(x)))
+    for idx in [(0, 0), (2, 3), (4, 1)]:
+        want = colorsys.rgb_to_hsv(*rgb[idx])
+        np.testing.assert_allclose(hsv[idx], want, atol=1e-5)
+    back = np.asarray(sd.eval(sd.image.hsv_to_rgb(sd.constant("h", hsv))))
+    np.testing.assert_allclose(back, rgb, atol=1e-5)
+
+
+def test_yiq_yuv_roundtrip():
+    rng = np.random.default_rng(1)
+    rgb = rng.uniform(0, 1, (3, 3, 3)).astype(np.float32)
+    sd = SameDiff.create()
+    x = sd.constant("x", rgb)
+    yiq = sd.image.rgb_to_yiq(x)
+    np.testing.assert_allclose(
+        np.asarray(sd.eval(sd.image.yiq_to_rgb(yiq))), rgb, atol=1e-5)
+    yuv = sd.image.rgb_to_yuv(x)
+    np.testing.assert_allclose(
+        np.asarray(sd.eval(sd.image.yuv_to_rgb(yuv))), rgb, atol=1e-5)
+    # grayscale has zero chroma in both spaces
+    gray = np.full((2, 2, 3), 0.4, np.float32)
+    got = np.asarray(sd.eval(sd.image.rgb_to_yiq(sd.constant("g", gray))))
+    np.testing.assert_allclose(got[..., 1:], 0.0, atol=1e-6)
+
+
+def test_adjust_hue_saturation():
+    rng = np.random.default_rng(2)
+    rgb = rng.uniform(0.1, 0.9, (4, 4, 3)).astype(np.float32)
+    sd = SameDiff.create()
+    x = sd.constant("x", rgb)
+    same = np.asarray(sd.eval(sd.image.adjust_saturation(x, 1.0)))
+    np.testing.assert_allclose(same, rgb, atol=1e-5)
+    zero_sat = np.asarray(sd.eval(sd.image.adjust_saturation(x, 0.0)))
+    np.testing.assert_allclose(zero_sat[..., 0], zero_sat[..., 1], atol=1e-5)
+    full_circle = np.asarray(sd.eval(sd.image.adjust_hue(x, 1.0)))
+    np.testing.assert_allclose(full_circle, rgb, atol=1e-4)
+
+
+def test_group_and_instance_norm_torch_oracle():
+    import torch
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 5, 6, 8)).astype(np.float32)   # NHWC, C=8
+    gamma = rng.standard_normal(8).astype(np.float32)
+    beta = rng.standard_normal(8).astype(np.float32)
+    sd = SameDiff.create()
+    xv = sd.constant("x", x)
+    got = np.asarray(sd.eval(sd.nn.group_norm(
+        xv, sd.constant("g", gamma), sd.constant("b", beta), 4)))
+    tx = torch.from_numpy(x).permute(0, 3, 1, 2)
+    gn = torch.nn.GroupNorm(4, 8)
+    gn.weight.data = torch.from_numpy(gamma)
+    gn.bias.data = torch.from_numpy(beta)
+    want = gn(tx).permute(0, 2, 3, 1).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+    got_in = np.asarray(sd.eval(sd.nn.instance_norm(
+        xv, sd.constant("g2", gamma), sd.constant("b2", beta))))
+    inorm = torch.nn.InstanceNorm2d(8, affine=True)
+    inorm.weight.data = torch.from_numpy(gamma)
+    inorm.bias.data = torch.from_numpy(beta)
+    want_in = inorm(tx).permute(0, 2, 3, 1).detach().numpy()
+    np.testing.assert_allclose(got_in, want_in, atol=2e-5)
+
+
+def test_adaptive_pooling_torch_oracle():
+    import torch
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 7, 5, 3)).astype(np.float32)
+    sd = SameDiff.create()
+    xv = sd.constant("x", x)
+    tx = torch.from_numpy(x).permute(0, 3, 1, 2)
+    got = np.asarray(sd.eval(sd.cnn.adaptive_avg_pooling2d(xv, 3, 2)))
+    want = torch.nn.functional.adaptive_avg_pool2d(tx, (3, 2)) \
+        .permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    got_m = np.asarray(sd.eval(sd.cnn.adaptive_max_pooling2d(xv, 3, 2)))
+    want_m = torch.nn.functional.adaptive_max_pool2d(tx, (3, 2)) \
+        .permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got_m, want_m, atol=1e-6)
+
+
+def test_col2im_roundtrip():
+    from deeplearning4j_tpu.ndarray.factory import im2col
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 6, 6, 3)).astype(np.float32)
+    cols = im2col(jnp.asarray(x), (2, 2), stride=(2, 2))
+    sd = SameDiff.create()
+    back = np.asarray(sd.eval(sd.cnn.col2im(
+        sd.constant("c", cols), (2, 6, 6, 3), 2, 2, 2, 2)))
+    # non-overlapping stride==kernel: col2im exactly inverts im2col
+    np.testing.assert_allclose(back, x, atol=1e-6)
