@@ -1,0 +1,18 @@
+"""Shared distance kernels for clustering / k-NN."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def sq_dists(q, x):
+    """(Q, D), (N, D) -> (Q, N) squared euclidean distances as one MXU
+    matmul-shaped computation: ||q||^2 - 2 q·x^T + ||x||^2 (clamped at 0 —
+    float error can dip slightly negative for near-identical rows)."""
+    qq = jnp.sum(jnp.square(q), -1, keepdims=True)
+    xx = jnp.sum(jnp.square(x), -1)
+    return jnp.maximum(qq - 2.0 * (q @ x.T) + xx, 0.0)
+
+
+def l2_normalize(x, eps: float = 1e-12):
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), eps)

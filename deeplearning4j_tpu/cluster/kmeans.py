@@ -21,17 +21,11 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _sq_dists(x, c):
-    """(N, D), (K, D) -> (N, K) squared euclidean distances (MXU matmul)."""
-    xx = jnp.sum(jnp.square(x), -1, keepdims=True)
-    cc = jnp.sum(jnp.square(c), -1)
-    return xx - 2.0 * (x @ c.T) + cc
+from ._distance import l2_normalize, sq_dists as _sq_dists
 
 
-def _cosine_dists(x, c, eps=1e-12):
-    xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), eps)
-    cn = c / jnp.maximum(jnp.linalg.norm(c, axis=-1, keepdims=True), eps)
-    return 1.0 - xn @ cn.T
+def _cosine_dists(x, c):
+    return 1.0 - l2_normalize(x) @ l2_normalize(c).T
 
 
 _DISTANCES = {"euclidean": _sq_dists, "cosine": _cosine_dists,
@@ -60,6 +54,65 @@ class KMeansClustering:
         self.cluster_centers_: Optional[np.ndarray] = None
         self.labels_: Optional[np.ndarray] = None
         self.inertia_: Optional[float] = None
+        self._build_kernels()       # jit ONCE per instance (re-fits and
+        # same-shape sweeps reuse the compiled programs)
+
+    def _build_kernels(self):
+        dist = _DISTANCES[self.distance]
+        K, max_it, tol = self.k, self.max_iterations, self.tol
+
+        @jax.jit
+        def seed_pp(key, x):
+            """k-means++: iteratively pick centers ∝ distance-squared."""
+            n = x.shape[0]
+            k0, key = jax.random.split(key)
+            first = x[jax.random.randint(k0, (), 0, n)]
+            centers0 = jnp.zeros((K, x.shape[1])).at[0].set(first)
+
+            def pick(carry, i):
+                centers, key = carry
+                d = dist(x, centers)                       # (N, K)
+                # distance to the nearest ALREADY-CHOSEN center
+                masked = jnp.where(jnp.arange(K)[None, :] < i, d, jnp.inf)
+                dmin = jnp.min(masked, -1)
+                key, kc = jax.random.split(key)
+                idx = jax.random.categorical(
+                    kc, jnp.log(jnp.maximum(dmin, 1e-12)))
+                return (centers.at[i].set(x[idx]), key), None
+
+            (centers, _), _ = jax.lax.scan(
+                pick, (centers0, key), jnp.arange(1, K))
+            return centers
+
+        @jax.jit
+        def lloyd(centers, x):
+            n = x.shape[0]
+
+            def body(state):
+                centers, _, it, _ = state
+                d = dist(x, centers)
+                assign = jnp.argmin(d, -1)
+                one_hot = jax.nn.one_hot(assign, K, dtype=x.dtype)
+                counts = one_hot.sum(0)
+                sums = one_hot.T @ x
+                new_centers = jnp.where(
+                    counts[:, None] > 0,
+                    sums / jnp.maximum(counts, 1)[:, None], centers)
+                shift = jnp.max(jnp.sum(jnp.square(new_centers - centers), -1))
+                return new_centers, assign, it + 1, shift
+
+            def cond(state):
+                _, _, it, shift = state
+                return (it < max_it) & (shift > tol)
+
+            init = (centers, jnp.zeros((n,), jnp.int32), 0, jnp.inf)
+            centers, assign, _, _ = jax.lax.while_loop(cond, body, init)
+            d = dist(x, centers)
+            assign = jnp.argmin(d, -1)
+            inertia = jnp.sum(jnp.min(d, -1))
+            return centers, assign, inertia
+
+        self._seed_pp, self._lloyd = seed_pp, lloyd
 
     # ------------------------------------------------------------------ setup
     @classmethod
@@ -71,61 +124,11 @@ class KMeansClustering:
     # -------------------------------------------------------------------- fit
     def fit(self, points) -> "KMeansClustering":
         x = jnp.asarray(points, jnp.float32)
-        n = x.shape[0]
-        if n < self.k:
-            raise ValueError(f"need at least k={self.k} points, got {n}")
-        dist = _DISTANCES[self.distance]
+        if x.shape[0] < self.k:
+            raise ValueError(
+                f"need at least k={self.k} points, got {x.shape[0]}")
         key = jax.random.PRNGKey(self.seed)
-
-        @jax.jit
-        def seed_pp(key):
-            """k-means++: iteratively pick centers ∝ distance-squared."""
-            k0, key = jax.random.split(key)
-            first = x[jax.random.randint(k0, (), 0, n)]
-            centers0 = jnp.zeros((self.k, x.shape[1])).at[0].set(first)
-
-            def pick(carry, i):
-                centers, key = carry
-                d = dist(x, centers)                       # (N, K)
-                # distance to the nearest ALREADY-CHOSEN center
-                masked = jnp.where(jnp.arange(self.k)[None, :] < i, d, jnp.inf)
-                dmin = jnp.min(masked, -1)
-                key, kc = jax.random.split(key)
-                idx = jax.random.categorical(
-                    kc, jnp.log(jnp.maximum(dmin, 1e-12)))
-                return (centers.at[i].set(x[idx]), key), None
-
-            (centers, _), _ = jax.lax.scan(
-                pick, (centers0, key), jnp.arange(1, self.k))
-            return centers
-
-        @jax.jit
-        def lloyd(centers):
-            def body(state):
-                centers, _, it, _ = state
-                d = dist(x, centers)
-                assign = jnp.argmin(d, -1)
-                one_hot = jax.nn.one_hot(assign, self.k, dtype=x.dtype)
-                counts = one_hot.sum(0)
-                sums = one_hot.T @ x
-                new_centers = jnp.where(
-                    counts[:, None] > 0, sums / jnp.maximum(counts, 1)[:, None],
-                    centers)
-                shift = jnp.max(jnp.sum(jnp.square(new_centers - centers), -1))
-                return new_centers, assign, it + 1, shift
-
-            def cond(state):
-                _, _, it, shift = state
-                return (it < self.max_iterations) & (shift > self.tol)
-
-            init = (centers, jnp.zeros((n,), jnp.int32), 0, jnp.inf)
-            centers, assign, _, _ = jax.lax.while_loop(cond, body, init)
-            d = dist(x, centers)
-            assign = jnp.argmin(d, -1)
-            inertia = jnp.sum(jnp.min(d, -1))
-            return centers, assign, inertia
-
-        centers, assign, inertia = lloyd(seed_pp(key))
+        centers, assign, inertia = self._lloyd(self._seed_pp(key, x), x)
         self.cluster_centers_ = np.asarray(centers)
         self.labels_ = np.asarray(assign)
         self.inertia_ = float(inertia)

@@ -21,11 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-def _sq_dists(q, x):
-    qq = jnp.sum(jnp.square(q), -1, keepdims=True)
-    xx = jnp.sum(jnp.square(x), -1)
-    return qq - 2.0 * (q @ x.T) + xx
+from ._distance import l2_normalize, sq_dists as _sq_dists
 
 
 class NearestNeighborsSearch:
@@ -37,14 +33,12 @@ class NearestNeighborsSearch:
         self.distance = distance
         self._x = jnp.asarray(points, jnp.float32)
         if distance == "cosine":
-            self._xn = self._x / jnp.maximum(
-                jnp.linalg.norm(self._x, axis=-1, keepdims=True), 1e-12)
+            self._xn = l2_normalize(self._x)
         self._knn = jax.jit(self._knn_impl, static_argnums=(1,))
 
     def _knn_impl(self, q, k):
         if self.distance == "cosine":
-            qn = q / jnp.maximum(jnp.linalg.norm(q, -1, keepdims=True), 1e-12)
-            d = 1.0 - qn @ self._xn.T
+            d = 1.0 - l2_normalize(q) @ self._xn.T
         else:
             d = _sq_dists(q, self._x)
         neg, idx = jax.lax.top_k(-d, k)

@@ -11,6 +11,9 @@ from .iterators import (ArrayDataSetIterator, BaseDatasetIterator,
                         KFoldIterator, ListDataSetIterator,
                         MnistDataSetIterator, MultipleEpochsIterator,
                         RandomDataSetIterator, make_synthetic_mnist)
+from .extra_datasets import (SvhnDataSetIterator,
+                             TinyImageNetDataSetIterator,
+                             UciSequenceDataSetIterator)
 from .image import (ImageDataSetIterator, ImageRecordReader,
                     NativeImageLoader, ParentPathLabelGenerator)
 from .transforms import (Condition, ConvertToSequence, DataAnalysis,

@@ -45,5 +45,6 @@ from .listeners import (CheckpointListener, CollectScoresListener,
                         StatsListener, TimeIterationListener)
 from .losses import Loss
 from .multi_layer_network import MultiLayerNetwork
-from .transfer import FineTuneConfiguration, TransferLearning
+from .transfer import (FineTuneConfiguration, TransferLearning,
+                       TransferLearningHelper)
 from .weights import WeightInit

@@ -79,6 +79,7 @@ class MultiLayerNetwork:
                 input_shape = (int(n_in),)
         key = jax.random.PRNGKey(self._g.seed)
         shape = tuple(input_shape)
+        self._init_input_shape = shape      # for TransferLearningHelper et al
         for i, layer in enumerate(self.layers):
             # auto preprocessor: conv/rnn activations into a flat FF layer
             if _is_ff_layer(layer) and len(shape) == 3:

@@ -14,9 +14,11 @@ import dataclasses
 from typing import Any, List, Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from .conf import GlobalConf, MultiLayerConfiguration, resolve_layer_defaults
-from .layers.base import Layer
+from .layers.base import Ctx, Layer
 from .multi_layer_network import MultiLayerNetwork
 
 
@@ -285,6 +287,8 @@ class TransferLearning:
             in_shape = self._input_shape
             if in_shape is None and src.conf.input_type is not None:
                 in_shape = tuple(src.conf.input_type[1])
+            if in_shape is None:    # the source net recorded its init shape
+                in_shape = getattr(src, "_init_input_shape", None)
             if in_shape is None:
                 raise ValueError("set_input_shape() required when source conf has no input type")
             net.init(in_shape)
@@ -303,3 +307,99 @@ class TransferLearning:
                 if copied is not None:
                     net.params[f"layer_{i}"], net.states[f"layer_{i}"] = copied
             return net
+
+
+class TransferLearningHelper:
+    """Featurized transfer learning (reference:
+    ``org.deeplearning4j.nn.transferlearning.TransferLearningHelper``).
+
+    Splits a MultiLayerNetwork at the frozen boundary: ``featurize`` runs
+    the frozen trunk once per DataSet (one jitted forward — the expensive
+    pretrained conv stack is never re-executed during head training),
+    ``fit_featurized`` trains only the unfrozen head, and trained head
+    params write back into the source network.
+    """
+
+    def __init__(self, net: MultiLayerNetwork, frozen_till: Optional[int] = None):
+        if not net.initialized:
+            raise ValueError("initialize the network first (net.init(...))")
+        if frozen_till is None:
+            if not net.layers[0].frozen:
+                raise ValueError(
+                    "no frozen PREFIX: layer 0 is trainable — pass "
+                    "frozen_till explicitly or freeze a prefix "
+                    "(TransferLearning builder / FrozenLayer)")
+            frozen_till = 0
+            while (frozen_till + 1 < len(net.layers)
+                   and net.layers[frozen_till + 1].frozen):
+                frozen_till += 1
+        self._src = net
+        self._k = int(frozen_till) + 1
+        if not 0 < self._k < len(net.layers):
+            raise ValueError(f"frozen_till={frozen_till} must leave at least "
+                             "one frozen and one trainable layer")
+
+        def trunk(params, states, x):
+            h = x
+            for i in range(self._k):
+                if i in net._preprocessors:
+                    h = net._preprocessors[i](h)
+                h, _ = net.layers[i].apply(params[f"layer_{i}"],
+                                           states[f"layer_{i}"], h,
+                                           Ctx(train=False))
+            return h
+        self._trunk = jax.jit(trunk)
+
+        # head network over the unfrozen tail (fresh conf, shared weights)
+        g = copy.deepcopy(net.conf.globals_)
+        head_layers = [copy.deepcopy(l) for l in net.layers[self._k:]]
+        for l in head_layers:
+            l.frozen = False
+        feat_shape = self._feature_shape()
+        conf = MultiLayerConfiguration(g, head_layers, None)
+        self._head = MultiLayerNetwork(conf).init(feat_shape)
+        for i in range(len(head_layers)):
+            self._head.params[f"layer_{i}"] = net.params[f"layer_{self._k + i}"]
+            self._head.states[f"layer_{i}"] = net.states[f"layer_{self._k + i}"]
+
+    def _feature_shape(self):
+        net = self._src
+        in_shape = getattr(net, "_init_input_shape", None)
+        if in_shape is None and net.conf.input_type is not None:
+            in_shape = tuple(net.conf.input_type[1])
+        if in_shape is None:
+            raise ValueError("source net has no recorded input shape")
+        out = jax.eval_shape(
+            lambda p, s, x: self._trunk(p, s, x), net.params, net.states,
+            jax.ShapeDtypeStruct((1,) + tuple(in_shape), jnp.float32))
+        return tuple(out.shape[1:])
+
+    # ------------------------------------------------------------------- api
+    def featurize(self, ds):
+        """DataSet -> DataSet whose features are the frozen trunk's output
+        (reference featurize)."""
+        from ..data.dataset import DataSet
+        feats = self._trunk(self._src.params, self._src.states,
+                            jnp.asarray(ds.features))
+        return DataSet(np.asarray(feats), ds.labels,
+                       features_mask=ds.features_mask,
+                       labels_mask=ds.labels_mask)
+
+    def fit_featurized(self, data, *, epochs: int = 1):
+        """Train the head on featurized DataSets/iterators; head params
+        write back into the source network (reference fitFeaturized)."""
+        out = self._head.fit(data, epochs=epochs)
+        for i in range(len(self._head.layers)):
+            self._src.params[f"layer_{self._k + i}"] = \
+                self._head.params[f"layer_{i}"]
+            self._src.states[f"layer_{self._k + i}"] = \
+                self._head.states[f"layer_{i}"]
+        self._src._invalidate()
+        return out
+
+    def output_from_featurized(self, feats):
+        return self._head.output(feats)
+
+    def unfrozen_mln(self) -> MultiLayerNetwork:
+        """The trainable submodel (reference unfrozenMLN)."""
+        return self._head

@@ -88,3 +88,25 @@ def test_lsh_approximate_recall():
     assert hits >= 16        # near-duplicate queries: high recall@1
     # candidate sets are genuinely sublinear
     assert len(lsh.candidates(corpus[0])) < 2000
+
+
+def test_knn_cosine_distance_values():
+    """Regression: cosine distances must be true per-row cosine distances
+    (a wrong `ord` arg once divided by a scalar matrix norm)."""
+    a = np.asarray([[1.0, 0.0], [1.0, 1.0], [0.0, 1.0]], np.float32)
+    q = np.asarray([[1.0, 0.0]], np.float32) * 7.0      # norm-invariant
+    idx, d = NearestNeighborsSearch(a, distance="cosine").search(q, k=3)
+    order = {int(i): float(v) for i, v in zip(idx[0], d[0])}
+    np.testing.assert_allclose(order[0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(order[1], 1 - 1 / np.sqrt(2), atol=1e-6)
+    np.testing.assert_allclose(order[2], 1.0, atol=1e-6)
+
+
+def test_kmeans_refit_reuses_kernels():
+    pts, _, _ = _blobs()
+    km = KMeansClustering(3)
+    km.fit(pts)
+    f1 = km._lloyd
+    km.fit(pts + 1.0)            # same shape: no kernel rebuild
+    assert km._lloyd is f1
+    assert km.cluster_centers_.mean() > 0.5   # actually refit on new data

@@ -196,3 +196,51 @@ def test_graph_transfer_readded_output_keeps_default_outputs():
     out = new.output(X)
     out = out[0] if isinstance(out, list) else out
     assert np.asarray(out).shape == (X.shape[0], 5)
+
+
+def test_transfer_learning_helper_featurized_training():
+    """TransferLearningHelper (reference class): featurize once through the
+    frozen trunk, train only the head, params write back to the source."""
+    from deeplearning4j_tpu.nn import TransferLearningHelper
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    src = _src_mln()                      # trained 3-layer net from above
+    frozen = (TransferLearning.Builder(src)
+              .fine_tune_configuration(FineTuneConfiguration(updater=Adam(5e-3)))
+              .set_feature_extractor(1)   # freeze layers 0..1
+              .build())
+    helper = TransferLearningHelper(frozen)
+    assert len(helper.unfrozen_mln().layers) == 1
+
+    ds = DataSet(X, Y)
+    fds = helper.featurize(ds)
+    assert fds.features.shape == (X.shape[0], 8)   # trunk output width
+    np.testing.assert_array_equal(fds.labels, Y)
+
+    w_trunk = np.asarray(frozen.params["layer_0"]["W"]).copy()
+    s0 = frozen.score(ds)
+    for _ in range(30):
+        helper.fit_featurized(fds)
+    # trunk untouched; head trained; source net sees the improvement
+    np.testing.assert_array_equal(np.asarray(frozen.params["layer_0"]["W"]),
+                                  w_trunk)
+    assert frozen.score(ds) < s0
+    # featurized head output == full-network output
+    np.testing.assert_allclose(
+        np.asarray(helper.output_from_featurized(fds.features)),
+        np.asarray(frozen.output(X)), atol=1e-5)
+
+
+def test_transfer_learning_helper_validation():
+    from deeplearning4j_tpu.nn import TransferLearningHelper
+    src = _src_mln()
+    try:
+        TransferLearningHelper(src)      # nothing frozen
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "frozen" in str(e)
+    try:
+        TransferLearningHelper(src, frozen_till=len(src.layers) - 1)
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "trainable" in str(e)
