@@ -29,7 +29,7 @@ class SDVariable:
 
     def __init__(self, sd: "SameDiff", name: str, kind: str, shape=None,
                  dtype=None, op: Optional[Callable] = None,
-                 inputs: Sequence["SDVariable"] = ()):
+                 inputs: Sequence["SDVariable"] = (), meta=None):
         self.sd = sd
         self.name = name
         self.kind = kind            # placeholder | variable | constant | op
@@ -37,11 +37,13 @@ class SDVariable:
         self.dtype = dtype
         self.op = op
         self.inputs = list(inputs)
+        self.meta = meta            # replay record for serialization
 
     # --- arithmetic sugar --------------------------------------------------
     def _bin(self, other, fn, opname):
         other = self.sd._wrap(other)
-        return self.sd._op(opname, fn, [self, other])
+        return self.sd._op(opname, fn, [self, other],
+                           meta=("operator", opname))
 
     def __add__(self, o):
         return self._bin(o, jnp.add, "add")
@@ -69,7 +71,8 @@ class SDVariable:
         return self._bin(o, jnp.power, "pow")
 
     def __neg__(self):
-        return self.sd._op("neg", jnp.negative, [self])
+        return self.sd._op("neg", jnp.negative, [self],
+                           meta=("operator", "neg"))
 
     def __matmul__(self, o):
         return self._bin(o, jnp.matmul, "mmul")
@@ -92,37 +95,46 @@ class SDVariable:
 
     def sum(self, *axes, keepdims=False):
         ax = axes if axes else None
-        return self.sd._op("sum", lambda x: jnp.sum(x, axis=ax, keepdims=keepdims), [self])
+        return self.sd._op("sum", lambda x: jnp.sum(x, axis=ax, keepdims=keepdims), [self],
+                           meta=("method", "sum", axes, {"keepdims": keepdims}))
 
     def mean(self, *axes, keepdims=False):
         ax = axes if axes else None
-        return self.sd._op("mean", lambda x: jnp.mean(x, axis=ax, keepdims=keepdims), [self])
+        return self.sd._op("mean", lambda x: jnp.mean(x, axis=ax, keepdims=keepdims), [self],
+                           meta=("method", "mean", axes, {"keepdims": keepdims}))
 
     def std(self, *axes):
         ax = axes if axes else None
-        return self.sd._op("std", lambda x: jnp.std(x, axis=ax), [self])
+        return self.sd._op("std", lambda x: jnp.std(x, axis=ax), [self],
+                           meta=("method", "std", axes, {}))
 
     def max(self, *axes):
         ax = axes if axes else None
-        return self.sd._op("max", lambda x: jnp.max(x, axis=ax), [self])
+        return self.sd._op("max", lambda x: jnp.max(x, axis=ax), [self],
+                           meta=("method", "max", axes, {}))
 
     def min(self, *axes):
         ax = axes if axes else None
-        return self.sd._op("min", lambda x: jnp.min(x, axis=ax), [self])
+        return self.sd._op("min", lambda x: jnp.min(x, axis=ax), [self],
+                           meta=("method", "min", axes, {}))
 
     def argmax(self, axis=-1):
-        return self.sd._op("argmax", lambda x: jnp.argmax(x, axis=axis), [self])
+        return self.sd._op("argmax", lambda x: jnp.argmax(x, axis=axis), [self],
+                           meta=("method", "argmax", (axis,), {}))
 
     def reshape(self, *shape):
-        return self.sd._op("reshape", lambda x: jnp.reshape(x, shape), [self])
+        return self.sd._op("reshape", lambda x: jnp.reshape(x, shape), [self],
+                           meta=("method", "reshape", shape, {}))
 
     def transpose(self, *axes):
         ax = axes if axes else None
-        return self.sd._op("transpose", lambda x: jnp.transpose(x, ax), [self])
+        return self.sd._op("transpose", lambda x: jnp.transpose(x, ax), [self],
+                           meta=("method", "transpose", axes, {}))
 
     def norm2(self, *axes):
         ax = axes if axes else None
-        return self.sd._op("norm2", lambda x: jnp.sqrt(jnp.sum(jnp.square(x), axis=ax)), [self])
+        return self.sd._op("norm2", lambda x: jnp.sqrt(jnp.sum(jnp.square(x), axis=ax)), [self],
+                           meta=("method", "norm2", axes, {}))
 
     def rename(self, new_name):
         self.sd._rename(self, new_name)
@@ -138,9 +150,10 @@ class SDVariable:
 class _Namespace:
     """Op namespace (sd.math / sd.nn / sd.loss ...)."""
 
-    def __init__(self, sd, table: Dict[str, Callable]):
+    def __init__(self, sd, table: Dict[str, Callable], ns_name: str = ""):
         self._sd = sd
         self._table = table
+        self._name = ns_name
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -151,14 +164,18 @@ class _Namespace:
 
         def make(*args, **kw):
             vars_ = [a for a in args if isinstance(a, SDVariable)]
-            consts = [a for a in args if not isinstance(a, SDVariable)]
+            # replay record: args with variable positions marked ("$var", i)
+            vi = iter(range(len(vars_)))
+            pattern = [("$var", next(vi)) if isinstance(a, SDVariable) else a
+                       for a in args]
 
             def apply_fn(*vals):
                 it = iter(vals)
                 full = [next(it) if isinstance(a, SDVariable) else a for a in args]
                 return fn(*full, **kw)
 
-            return self._sd._op(name, apply_fn, vars_)
+            return self._sd._op(name, apply_fn, vars_,
+                                meta=("ns", self._name, name, pattern, kw))
         return make
 
 
@@ -276,20 +293,20 @@ class SameDiff:
         self._values: Dict[str, jnp.ndarray] = {}   # variables + constants
         self._counter = 0
         from . import sd_ops
-        self.math = _Namespace(self, {**_MATH, **sd_ops.MATH_EXT})
-        self.nn = _Namespace(self, {**_NN, **sd_ops.NN_EXT})
-        self.loss = _Namespace(self, {**_LOSS, **sd_ops.LOSS_EXT})
+        self.math = _Namespace(self, {**_MATH, **sd_ops.MATH_EXT}, "math")
+        self.nn = _Namespace(self, {**_NN, **sd_ops.NN_EXT}, "nn")
+        self.loss = _Namespace(self, {**_LOSS, **sd_ops.LOSS_EXT}, "loss")
         # upstream parity: SDBaseOps methods live on SameDiff itself; here
         # they're both a namespace (sd.base.*) and direct attrs (sd.<op>)
         # via __getattr__ below. SDLinalg/SDBitwise/SDRandom/SDCNN/SDRNN/
         # SDImage mirror nd4j's namespace objects.
-        self.base = _Namespace(self, sd_ops.BASE)
-        self.linalg = _Namespace(self, sd_ops.LINALG)
-        self.bitwise = _Namespace(self, sd_ops.BITWISE)
-        self.random = _Namespace(self, sd_ops.RANDOM)
-        self.cnn = _Namespace(self, sd_ops.CNN)
-        self.rnn = _Namespace(self, sd_ops.RNN)
-        self.image = _Namespace(self, sd_ops.IMAGE)
+        self.base = _Namespace(self, sd_ops.BASE, "base")
+        self.linalg = _Namespace(self, sd_ops.LINALG, "linalg")
+        self.bitwise = _Namespace(self, sd_ops.BITWISE, "bitwise")
+        self.random = _Namespace(self, sd_ops.RANDOM, "random")
+        self.cnn = _Namespace(self, sd_ops.CNN, "cnn")
+        self.rnn = _Namespace(self, sd_ops.RNN, "rnn")
+        self.image = _Namespace(self, sd_ops.IMAGE, "image")
         self._training_config: Optional[TrainingConfig] = None
         self._loss_vars: List[str] = []
         self._opt_state = None
@@ -333,9 +350,9 @@ class SameDiff:
             return value
         return self.constant(self._fresh("const"), jnp.asarray(value))
 
-    def _op(self, opname, fn, inputs) -> SDVariable:
+    def _op(self, opname, fn, inputs, meta=None) -> SDVariable:
         return self._register(SDVariable(self, self._fresh(opname), "op",
-                                         op=fn, inputs=inputs))
+                                         op=fn, inputs=inputs, meta=meta))
 
     # ------------------------------------------------------- public surface
     def placeholder(self, name, shape=None, dtype=jnp.float32) -> SDVariable:
@@ -482,7 +499,8 @@ class SameDiff:
         from ..train.updaters import build_optimizer
         if self._optimizer is None:
             self._optimizer = build_optimizer(cfg.updater, l1=cfg.l1, l2=cfg.l2)
-            self._opt_state = self._optimizer.init(self._values_snapshot())
+            if self._opt_state is None:     # may be restored by load()
+                self._opt_state = self._optimizer.init(self._values_snapshot())
         ph_names = cfg.feature_mapping + cfg.label_mapping
         step_key = ("__fit_step__", tuple(ph_names), self._loss_vars[0])
         if step_key not in self._compiled:
@@ -648,6 +666,137 @@ class SameDiff:
         args = [jnp.zeros(s, jnp.float32) for s in
                 (placeholder_shapes[n] for n in names)]
         return jax.jit(fn).lower(self._values_snapshot(), *args).as_text()
+
+    # ---------------------------------------------------------- serialization
+    def save(self, path, save_training_config: bool = True,
+             save_updater: bool = False):
+        """Serialize graph + values (reference: SameDiff.save / FlatBuffers
+        sd format; ours is a zip of replay records + npz values).
+
+        Every op node carries a replay record (namespace, op name, const
+        args) captured at build time; ops built from raw Python closures
+        (`lambda_op`, `while_loop`, `cond`, `scan`, importer internals)
+        have none and raise a clear error — lower those graphs with
+        `to_stablehlo()` instead.
+        """
+        import io
+        import pickle
+        import zipfile
+        from pathlib import Path
+
+        unserializable = [v.name for v in self._vars.values()
+                          if v.kind == "op" and v.meta is None]
+        if unserializable:
+            raise ValueError(
+                "graph has op nodes without replay records (built via "
+                f"lambda_op/control-flow/closures): {unserializable[:8]} — "
+                "use to_stablehlo() for a compiler-level artifact instead")
+        # topological order (renames can leave dict order non-topological:
+        # _rename reinserts the node at the end)
+        ordered, seen = [], set()
+        def visit(v):
+            if v.name in seen:
+                return
+            for i in v.inputs:
+                visit(i)
+            seen.add(v.name)
+            ordered.append(v)
+        for v in self._vars.values():
+            visit(v)
+        records = []
+        for v in ordered:
+            rec = {"name": v.name, "kind": v.kind}
+            if v.kind == "placeholder":
+                rec["shape"] = v.shape
+                rec["dtype"] = np.dtype(v.dtype).name if v.dtype else None
+            elif v.kind == "variable":
+                rec["dtype"] = np.dtype(v.dtype).name if v.dtype else None
+            elif v.kind == "op":
+                rec["meta"] = v.meta
+                rec["inputs"] = [i.name for i in v.inputs]
+            records.append(rec)
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("graph.pkl", pickle.dumps(
+                {"records": records, "loss_vars": self._loss_vars}))
+            buf = io.BytesIO()
+            np.savez(buf, **{n: np.asarray(val)
+                             for n, val in self._values.items()})
+            zf.writestr("values.npz", buf.getvalue())
+            if save_training_config and self._training_config is not None:
+                zf.writestr("training.pkl",
+                            pickle.dumps(self._training_config))
+            if save_updater and self._opt_state is not None:
+                zf.writestr("updater.pkl", pickle.dumps(
+                    jax.tree_util.tree_map(lambda a: np.asarray(a),
+                                           self._opt_state)))
+        return path
+
+    _OPERATOR_REPLAY = {
+        "add": lambda a, b: a + b, "sub": lambda a, b: a - b,
+        "rsub": lambda a, b: a - b, "mul": lambda a, b: a * b,
+        "div": lambda a, b: a / b, "rdiv": lambda a, b: a / b,
+        "pow": lambda a, b: a ** b, "mmul": lambda a, b: a @ b,
+        "neg": lambda a: -a,
+    }
+
+    @classmethod
+    def load(cls, path) -> "SameDiff":
+        """Rebuild a saved graph by replaying its op records."""
+        import io
+        import pickle
+        import zipfile
+
+        with zipfile.ZipFile(path) as zf:
+            graph = pickle.loads(zf.read("graph.pkl"))
+            values = dict(np.load(io.BytesIO(zf.read("values.npz")),
+                                  allow_pickle=False))
+            training = (pickle.loads(zf.read("training.pkl"))
+                        if "training.pkl" in zf.namelist() else None)
+            updater = (pickle.loads(zf.read("updater.pkl"))
+                       if "updater.pkl" in zf.namelist() else None)
+        sd = cls.create()
+        # replay generates fresh op names; advance the counter past every
+        # recorded numeric suffix so they can never collide with recorded
+        # names registered by earlier replays
+        for rec in graph["records"]:
+            tail = rec["name"].rsplit("_", 1)
+            if len(tail) == 2 and tail[1].isdigit():
+                sd._counter = max(sd._counter, int(tail[1]))
+        for rec in graph["records"]:
+            name, kind = rec["name"], rec["kind"]
+            if kind == "placeholder":
+                dt = rec.get("dtype")
+                sd.placeholder(name, rec.get("shape"),
+                               np.dtype(dt) if dt else jnp.float32)
+            elif kind == "variable":
+                dt = rec.get("dtype")
+                sd.var(name, value=values[name],
+                       dtype=np.dtype(dt) if dt else jnp.float32)
+            elif kind == "constant":
+                sd.constant(name, values[name])
+            else:
+                ins = [sd._vars[i] for i in rec["inputs"]]
+                meta = rec["meta"]
+                if meta[0] == "operator":
+                    v = cls._OPERATOR_REPLAY[meta[1]](*ins)
+                elif meta[0] == "method":
+                    _, mname, consts, kw = meta
+                    v = getattr(ins[0], mname)(*consts, **kw)
+                else:   # ("ns", ns_name, op_name, pattern, kw)
+                    _, ns_name, op_name, pattern, kw = meta
+                    args = [ins[a[1]] if (isinstance(a, tuple) and len(a) == 2
+                                          and a[0] == "$var") else a
+                            for a in pattern]
+                    v = getattr(getattr(sd, ns_name), op_name)(*args, **kw)
+                sd._rename(v, name)
+        sd._loss_vars = list(graph.get("loss_vars") or [])
+        if training is not None:
+            sd._training_config = training
+        if updater is not None:
+            sd._opt_state = jax.tree_util.tree_map(jnp.asarray, updater)
+        return sd
 
     def summary(self) -> str:
         lines = [f"{'name':<24}{'kind':<12}{'shape'}"]

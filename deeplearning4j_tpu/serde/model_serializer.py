@@ -76,6 +76,13 @@ def _unflatten_into(template, flat):
 
 
 def save_model(model, path, save_updater: bool = False, normalizer=None):
+    from ..autodiff.samediff import SameDiff
+    if isinstance(model, SameDiff):
+        # SameDiff graphs carry their own replay-record format
+        if normalizer is not None:
+            raise ValueError("normalizers are not part of the SameDiff "
+                             "format — save it separately")
+        return model.save(path, save_updater=save_updater)
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
@@ -99,6 +106,9 @@ def load_model(path):
     from ..nn.computation_graph import ComputationGraph
     from ..nn.multi_layer_network import MultiLayerNetwork
     with zipfile.ZipFile(path) as zf:
+        if "graph.pkl" in zf.namelist():      # a saved SameDiff graph
+            from ..autodiff.samediff import SameDiff
+            return SameDiff.load(path)
         meta = pickle.loads(zf.read("conf.pkl"))
         cls = {"MultiLayerNetwork": MultiLayerNetwork,
                "ComputationGraph": ComputationGraph}[meta["kind"]]
