@@ -692,17 +692,23 @@ class SameDiff:
                 f"lambda_op/control-flow/closures): {unserializable[:8]} — "
                 "use to_stablehlo() for a compiler-level artifact instead")
         # topological order (renames can leave dict order non-topological:
-        # _rename reinserts the node at the end)
+        # _rename reinserts the node at the end); iterative DFS — deep
+        # chains would blow Python's recursion limit (same reason _trace
+        # is iterative)
         ordered, seen = [], set()
-        def visit(v):
-            if v.name in seen:
-                return
-            for i in v.inputs:
-                visit(i)
-            seen.add(v.name)
-            ordered.append(v)
-        for v in self._vars.values():
-            visit(v)
+        for root in self._vars.values():
+            stack = [(root, False)]
+            while stack:
+                v, expanded = stack.pop()
+                if v.name in seen:
+                    continue
+                if expanded:
+                    seen.add(v.name)
+                    ordered.append(v)
+                else:
+                    stack.append((v, True))
+                    stack.extend((i, False) for i in v.inputs
+                                 if i.name not in seen)
         records = []
         for v in ordered:
             rec = {"name": v.name, "kind": v.kind}

@@ -1,6 +1,7 @@
 """deeplearning4j_tpu.nlp — Word2Vec/ParagraphVectors/GloVe/
 SequenceVectors + tokenizers (DL4J deeplearning4j-nlp analogue)."""
 
+from .bert_iterator import BertIterator, BertWordPieceTokenizer
 from .glove import GloVe
 from .sequencevectors import SequenceVectors
 from .tokenizers import (BasicLineIterator, BPETokenizer, CharTokenizer,

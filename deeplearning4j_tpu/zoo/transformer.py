@@ -378,8 +378,12 @@ def bert_forward(params, cfg: BertConfig, ids, type_ids=None, attn_mask=None):
 
 def bert_classifier_loss(params, cfg: BertConfig, ids, labels, type_ids=None,
                          attn_mask=None):
+    """labels: integer class ids (B,) or one-hot (B, num_labels) — the
+    latter is what BertIterator emits (reference MultiDataSet contract)."""
     logits, _ = bert_forward(params, cfg, ids, type_ids, attn_mask)
     logp = jax.nn.log_softmax(logits, -1)
+    if labels.ndim == 2:
+        return -(logp * labels.astype(logp.dtype)).sum(-1).mean()
     return -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), -1).mean()
 
 
@@ -426,15 +430,24 @@ def bert_mlm_loss(params, cfg: BertConfig, masked_ids, labels, weights,
 
 
 def make_bert_mlm_train_step(cfg: BertConfig, optimizer, mask_token_id,
-                             mask_prob: float = 0.15):
+                             mask_prob: float = 0.15, special_ids=None):
     """Jittable MLM pretrain step: (params, opt_state, rng, ids) ->
-    (params, opt_state, rng, loss). Masking happens on-device inside jit."""
+    (params, opt_state, rng, loss). Masking happens on-device inside jit.
+    `special_ids` (e.g. PAD/CLS/SEP ids) are never selected as MLM targets;
+    pass `attn_mask` so attention ignores padding (BertIterator provides
+    both)."""
     import optax
+
+    specials = (None if special_ids is None
+                else jnp.asarray(list(special_ids), jnp.int32))
 
     def step(params, opt_state, rng, ids, type_ids=None, attn_mask=None):
         rng, sub = jax.random.split(rng)
+        special_mask = (None if specials is None
+                        else jnp.isin(ids, specials))
         masked_ids, labels, weights = bert_mask_tokens(
-            sub, ids, cfg, mask_token_id, mask_prob)
+            sub, ids, cfg, mask_token_id, mask_prob,
+            special_mask=special_mask)
         loss, grads = jax.value_and_grad(bert_mlm_loss)(
             params, cfg, masked_ids, labels, weights, type_ids, attn_mask)
         updates, opt_state = optimizer.update(grads, opt_state, params)

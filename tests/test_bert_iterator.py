@@ -1,0 +1,153 @@
+"""BertWordPieceTokenizer + BertIterator.
+
+Reference parity: BertWordPieceTokenizerFactory (greedy longest-match
+wordpiece) and org.deeplearning4j.iterator.BertIterator (features
+[ids, segments], attention masks, SEQ_CLASSIFICATION / UNSUPERVISED).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import BertIterator, BertWordPieceTokenizer
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
+         "dog", "lazy", "over", ",", ".", "un", "##break", "##able"]
+
+
+def _tok():
+    return BertWordPieceTokenizer(VOCAB)
+
+
+def test_wordpiece_tokenization():
+    tok = _tok()
+    assert tok.tokenize("the quick fox") == ["the", "quick", "fox"]
+    # greedy longest-match with ## continuations
+    assert tok.tokenize("jumps") == ["jump", "##s"]
+    assert tok.tokenize("jumping") == ["jump", "##ing"]
+    assert tok.tokenize("unbreakable") == ["un", "##break", "##able"]
+    # punctuation separates; unknown words -> [UNK]
+    assert tok.tokenize("fox, dog.") == ["fox", ",", "dog", "."]
+    assert tok.tokenize("zebra") == ["[UNK]"]
+    # case folding
+    assert tok.tokenize("The QUICK") == ["the", "quick"]
+    assert tok.encode("the") == [VOCAB.index("the")]
+
+
+def test_vocab_file_and_missing_specials(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    tok = BertWordPieceTokenizer.load_vocab(str(p))
+    assert tok.tokenize("lazy dog") == ["lazy", "dog"]
+    with pytest.raises(ValueError):
+        BertWordPieceTokenizer(["just", "words"])
+
+
+def test_bert_iterator_classification_batches():
+    sents = ["the quick fox", "the lazy dog", "fox jumps over the dog",
+             "the dog"]
+    it = BertIterator(_tok(), sents, labels=[0, 1, 0, 1], max_length=10,
+                      batch_size=2)
+    b = next(iter(it))
+    ids, seg = b.features
+    assert ids.shape == (2, 10) and seg.shape == (2, 10)
+    attn = b.features_masks[0]
+    # [CLS] the quick fox [SEP] = 5 live positions
+    assert attn[0].sum() == 5
+    cls_id, sep_id = VOCAB.index("[CLS]"), VOCAB.index("[SEP]")
+    assert ids[0, 0] == cls_id and ids[0, 4] == sep_id
+    assert ids[0, 5] == VOCAB.index("[PAD]")
+    assert b.labels[0].shape == (2, 2)
+    # iteration covers everything then stops
+    n = sum(batch.num_examples() for batch in it)
+    assert n == 4
+
+
+def test_bert_iterator_sentence_pairs_segments():
+    it = BertIterator(_tok(), ["the fox"], labels=[1], num_classes=3,
+                      max_length=12, batch_size=1,
+                      pair_sentences=["lazy dog"])
+    b = next(iter(it))
+    ids, seg = b.features
+    # [CLS] the fox [SEP] lazy dog [SEP]
+    sep_id = VOCAB.index("[SEP]")
+    assert list(np.where(ids[0] == sep_id)[0]) == [3, 6]
+    np.testing.assert_array_equal(seg[0, :7], [0, 0, 0, 0, 1, 1, 1])
+    assert b.labels[0].shape == (1, 3)
+
+
+def test_bert_iterator_unsupervised_targets():
+    sents = ["the quick fox", "jumping dog"]
+    it = BertIterator(_tok(), sents, task=BertIterator.UNSUPERVISED,
+                      max_length=8, batch_size=2)
+    b = next(iter(it))
+    ids, _ = b.features
+    np.testing.assert_array_equal(b.labels[0], ids)   # targets = raw ids
+    assert it.mask_id == VOCAB.index("[MASK]")
+    with pytest.raises(ValueError):
+        BertIterator(_tok(), sents, task="SEQ_CLASSIFICATION")  # no labels
+
+
+def test_bert_iterator_feeds_mlm_training():
+    """End-to-end: BertIterator UNSUPERVISED batches drive the zoo BERT MLM
+    step (on-device masking) and the loss drops."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    tok = _tok()
+    sents = ["the quick fox jumps over the lazy dog",
+             "the dog jumps", "the quick dog", "fox jumping over the dog"] * 4
+    it = BertIterator(tok, sents, task=BertIterator.UNSUPERVISED,
+                      max_length=12, batch_size=8)
+    cfg = tfm.BertConfig(vocab_size=len(VOCAB), d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=12,
+                         dtype=jnp.float32)
+    params = tfm.bert_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adam(1e-3)
+    ost = opt.init(params)
+    step = jax.jit(tfm.make_bert_mlm_train_step(cfg, opt,
+                                                mask_token_id=it.mask_id))
+    key = jax.random.PRNGKey(1)
+    losses = []
+    for epoch in range(60):
+        for b in it:
+            params, ost, key, loss = step(params, ost, key,
+                                          jnp.asarray(b.features[0]))
+            losses.append(float(loss))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.7
+
+
+def test_mlm_step_respects_special_and_attn_masks():
+    """Regression: MLM training via BertIterator must exclude PAD/CLS/SEP
+    from masking targets and feed the attention mask."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    tok = _tok()
+    it = BertIterator(tok, ["the quick fox", "dog"], max_length=10,
+                      batch_size=2, task=BertIterator.UNSUPERVISED)
+    assert it.special_ids == (VOCAB.index("[PAD]"), VOCAB.index("[CLS]"),
+                              VOCAB.index("[SEP]"))
+    cfg = tfm.BertConfig(vocab_size=len(VOCAB), d_model=16, n_heads=2,
+                         n_layers=1, d_ff=32, max_seq=10, dtype=jnp.float32)
+    ids = jnp.asarray(it._ids)
+    specials = jnp.asarray(list(it.special_ids))
+    # masking with the special mask never selects special positions
+    sel_counts = 0
+    for trial in range(20):
+        _, _, weights = tfm.bert_mask_tokens(
+            jax.random.PRNGKey(trial), ids, cfg, it.mask_id, 0.5,
+            special_mask=jnp.isin(ids, specials))
+        assert float((weights * jnp.isin(ids, specials)).sum()) == 0.0
+        sel_counts += float(weights.sum())
+    assert sel_counts > 0           # non-special positions DO get selected
+
+
+def test_vocab_file_crlf(tmp_path):
+    p = tmp_path / "vocab_crlf.txt"
+    p.write_bytes(("\r\n".join(VOCAB) + "\r\n").encode())
+    tok = BertWordPieceTokenizer.load_vocab(str(p))
+    assert tok.tokenize("quick dog") == ["quick", "dog"]

@@ -195,3 +195,22 @@ def test_samediff_save_load_updater_state(tmp_path):
     # identical continued trajectory == updater state survived
     np.testing.assert_allclose(np.asarray(sd_resumed._values["w"]),
                                np.asarray(sd._values["w"]), atol=1e-6)
+
+
+
+def test_samediff_save_deep_chain(tmp_path):
+    """Regression: save()'s topo sort must be iterative — a 1500-op chain
+    used to hit Python's recursion limit."""
+    from deeplearning4j_tpu.autodiff import SameDiff
+    import numpy as np
+    sd = SameDiff.create()
+    x = sd.var("x", value=np.ones(2, np.float32))
+    v = x
+    for _ in range(1500):
+        v = v + 1.0
+    v.rename("out")
+    p = str(tmp_path / "deep.sdz")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    np.testing.assert_allclose(np.asarray(sd2.eval(sd2.get_variable("out"))),
+                               [1501.0, 1501.0])
