@@ -11,6 +11,8 @@ from .iterators import (ArrayDataSetIterator, BaseDatasetIterator,
                         KFoldIterator, ListDataSetIterator,
                         MnistDataSetIterator, MultipleEpochsIterator,
                         RandomDataSetIterator, make_synthetic_mnist)
+from .audio import (AudioDataSetIterator, WavFileRecordReader,
+                    make_spectrogram_fn, read_wav, write_wav)
 from .extra_datasets import (SvhnDataSetIterator,
                              TinyImageNetDataSetIterator,
                              UciSequenceDataSetIterator)

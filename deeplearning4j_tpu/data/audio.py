@@ -1,0 +1,170 @@
+"""Audio ETL — WAV reading + on-device spectrogram features.
+
+Reference parity: ``datavec-audio`` (WavFileRecordReader,
+spectrogram/MFCC-style featurization via its DSP helpers).
+
+TPU-first split: WAV decode is host ETL (stdlib ``wave`` — no external
+deps); the featurization (STFT → power spectrogram → mel filterbank →
+log) is a single jitted XLA program over the whole batch
+(`jnp.fft.rfft` on framed windows), replacing the reference's per-clip
+host DSP loop.
+"""
+
+from __future__ import annotations
+
+import os
+import wave
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .iterators import ArrayDataSetIterator
+
+
+# ------------------------------------------------------------------ wav io
+def read_wav(path: str) -> Tuple[np.ndarray, int]:
+    """(samples float32 in [-1, 1] (mono-mixed), sample_rate)."""
+    with wave.open(path, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        ch = w.getnchannels()
+        width = w.getsampwidth()
+        raw = w.readframes(n)
+    if width == 2:
+        x = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    elif width == 1:     # 8-bit wav is unsigned
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    elif width == 4:
+        x = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported sample width {width} in {path}")
+    if ch > 1:
+        x = x.reshape(-1, ch).mean(-1)
+    return x, sr
+
+
+def write_wav(path: str, samples, sample_rate: int = 16000):
+    """float [-1, 1] mono → 16-bit PCM wav (test-fixture helper)."""
+    x = np.clip(np.asarray(samples, np.float32), -1.0, 1.0)
+    with wave.open(path, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sample_rate)
+        w.writeframes((x * 32767.0).astype("<i2").tobytes())
+
+
+class WavFileRecordReader:
+    """Walk a directory tree of .wav files; record = [samples..., label]
+    with labels from parent dirs (reference WavFileRecordReader +
+    ParentPathLabelGenerator). Clips are padded/trimmed to
+    ``max_samples`` so records are fixed-length."""
+
+    def __init__(self, max_samples: int = 16000):
+        self.max_samples = int(max_samples)
+        self.labels: List[str] = []
+        self._files: List[str] = []
+        self.sample_rate: Optional[int] = None
+
+    def initialize(self, root_dir: str) -> "WavFileRecordReader":
+        files = []
+        for dirpath, _, names in os.walk(root_dir):
+            for nm in sorted(names):
+                if nm.lower().endswith(".wav"):
+                    files.append(os.path.join(dirpath, nm))
+        if not files:
+            raise ValueError(f"no .wav files under {root_dir}")
+        self._files = sorted(files)
+        self.labels = sorted({os.path.basename(os.path.dirname(f))
+                              for f in self._files})
+        return self
+
+    def _clip(self, path):
+        x, sr = read_wav(path)
+        if self.sample_rate is None:
+            self.sample_rate = sr
+        if len(x) < self.max_samples:
+            x = np.pad(x, (0, self.max_samples - len(x)))
+        return x[:self.max_samples]
+
+    def load_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        lut = {l: i for i, l in enumerate(self.labels)}
+        xs = np.stack([self._clip(f) for f in self._files])
+        ys = np.asarray([lut[os.path.basename(os.path.dirname(f))]
+                         for f in self._files], np.int32)
+        return xs.astype(np.float32), ys
+
+    def __iter__(self):
+        lut = {l: i for i, l in enumerate(self.labels)}
+        for f in self._files:
+            yield list(self._clip(f)) + [
+                lut[os.path.basename(os.path.dirname(f))]]
+
+
+# --------------------------------------------------------- on-device DSP
+def _mel_filterbank(n_mels: int, n_fft: int, sample_rate: int,
+                    fmin: float = 0.0, fmax: Optional[float] = None):
+    """Triangular mel filterbank (n_mels, n_fft//2 + 1), HTK mel scale."""
+    fmax = fmax or sample_rate / 2.0
+    mel = lambda f: 2595.0 * np.log10(1.0 + f / 700.0)   # noqa: E731
+    imel = lambda m: 700.0 * (10.0 ** (m / 2595.0) - 1.0)  # noqa: E731
+    pts = imel(np.linspace(mel(fmin), mel(fmax), n_mels + 2))
+    bins = np.floor((n_fft + 1) * pts / sample_rate).astype(int)
+    fb = np.zeros((n_mels, n_fft // 2 + 1), np.float32)
+    for i in range(n_mels):
+        a, b, c = bins[i], bins[i + 1], bins[i + 2]
+        if b > a:
+            fb[i, a:b] = (np.arange(a, b) - a) / (b - a)
+        if c > b:
+            fb[i, b:c] = (c - np.arange(b, c)) / (c - b)
+    return fb
+
+
+def make_spectrogram_fn(*, n_fft: int = 512, hop: int = 256,
+                        n_mels: Optional[int] = None,
+                        sample_rate: int = 16000, log: bool = True,
+                        eps: float = 1e-6):
+    """Build a jitted ``(B, samples) -> (B, frames, bins)`` featurizer.
+
+    STFT (Hann window, rfft) → power → optional mel projection → optional
+    log. One XLA program for the whole batch — the TPU-native replacement
+    for datavec-audio's per-clip host DSP.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    window = jnp.asarray(np.hanning(n_fft).astype(np.float32))
+    mel_fb = (None if n_mels is None
+              else jnp.asarray(_mel_filterbank(n_mels, n_fft, sample_rate)))
+
+    def features(batch):
+        batch = jnp.asarray(batch, jnp.float32)
+        n = batch.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop
+        idx = (jnp.arange(n_frames)[:, None] * hop
+               + jnp.arange(n_fft)[None, :])          # (frames, n_fft)
+        frames = batch[:, idx] * window               # (B, frames, n_fft)
+        spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** 2
+        if mel_fb is not None:
+            spec = jnp.einsum("bfk,mk->bfm", spec, mel_fb)
+        if log:
+            spec = jnp.log(spec + eps)
+        return spec
+
+    return jax.jit(features)
+
+
+class AudioDataSetIterator(ArrayDataSetIterator):
+    """WavFileRecordReader → batched spectrogram DataSets (features
+    (B, frames, bins), one-hot labels). The featurizer runs once on
+    device over the whole corpus."""
+
+    def __init__(self, reader: WavFileRecordReader, batch_size: int,
+                 n_fft: int = 512, hop: int = 256,
+                 n_mels: Optional[int] = 64, log: bool = True):
+        xs, ys = reader.load_arrays()
+        fn = make_spectrogram_fn(n_fft=n_fft, hop=hop, n_mels=n_mels,
+                                 sample_rate=reader.sample_rate or 16000,
+                                 log=log)
+        feats = np.asarray(fn(xs))
+        labels = np.eye(len(reader.labels), dtype=np.float32)[ys]
+        super().__init__(feats, labels, batch_size)

@@ -6,8 +6,10 @@ a BERT vocab file) and ``org.deeplearning4j.iterator.BertIterator``
 (sentences → fixed-length [ids, segment ids] features + attention masks,
 Task.SEQ_CLASSIFICATION labels or Task.UNSUPERVISED MLM masking).
 
-TPU-first notes: tokenization is host ETL; everything it emits is
-fixed-shape (padded to ``max_length``) so the training step compiles once.
+TPU-first notes: tokenization is host ETL; sequences are padded to
+``max_length``. A dataset not divisible by batch_size yields one ragged
+final batch (one extra jit compile) — pass ``drop_last=True`` to keep
+every batch identically shaped.
 For UNSUPERVISED the 15%/80-10-10 masking runs ON DEVICE per step
 (``zoo.transformer.bert_mask_tokens``) — the iterator just supplies ids —
 which keeps masking re-randomized every epoch for free, unlike the
@@ -56,11 +58,13 @@ class BertWordPieceTokenizer:
                 if word:
                     out.append("".join(word))
                     word = []
-            elif not (ch.isalnum() or ch == "'"):
+            elif not ch.isalnum():
+                # ALL punctuation splits (BERT BasicTokenizer semantics:
+                # "don't" -> don ' t — matches pretrained checkpoints)
                 if word:
                     out.append("".join(word))
                     word = []
-                out.append(ch)          # punctuation is its own token
+                out.append(ch)
             else:
                 word.append(ch)
         if word:
@@ -122,13 +126,15 @@ class BertIterator:
                  labels: Optional[Sequence[int]] = None,
                  num_classes: Optional[int] = None,
                  task: str = "SEQ_CLASSIFICATION", max_length: int = 128,
-                 batch_size: int = 32, pair_sentences=None):
+                 batch_size: int = 32, pair_sentences=None,
+                 drop_last: bool = False):
         if task not in (self.SEQ_CLASSIFICATION, self.UNSUPERVISED):
             raise ValueError(f"unknown task {task}")
         if task == self.SEQ_CLASSIFICATION and labels is None:
             raise ValueError("SEQ_CLASSIFICATION needs labels")
         self.tok = tokenizer
         self.task = task
+        self.drop_last = drop_last
         self.max_length = max_length
         self.batch_size = batch_size
         sentences = list(sentences)
@@ -171,7 +177,10 @@ class BertIterator:
         return self
 
     def __next__(self) -> MultiDataSet:
-        if self._pos >= len(self._ids):
+        remaining = len(self._ids) - self._pos
+        if remaining <= 0 or (self.drop_last and remaining < self.batch_size):
+            # drop_last keeps every batch the same shape so a jitted train
+            # step never recompiles for a ragged tail
             raise StopIteration
         lo, hi = self._pos, min(self._pos + self.batch_size, len(self._ids))
         self._pos = hi

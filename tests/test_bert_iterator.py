@@ -151,3 +151,20 @@ def test_vocab_file_crlf(tmp_path):
     p.write_bytes(("\r\n".join(VOCAB) + "\r\n").encode())
     tok = BertWordPieceTokenizer.load_vocab(str(p))
     assert tok.tokenize("quick dog") == ["quick", "dog"]
+
+
+def test_apostrophe_splits_like_bert_basic_tokenizer():
+    vocab = VOCAB + ["don", "'", "t"]
+    tok = BertWordPieceTokenizer(vocab)
+    assert tok.tokenize("don't") == ["don", "'", "t"]
+
+
+def test_drop_last_keeps_batches_uniform():
+    sents = ["the fox"] * 5
+    it = BertIterator(_tok(), sents, labels=[0] * 5, max_length=6,
+                      batch_size=2, drop_last=True)
+    sizes = [b.num_examples() for b in it]
+    assert sizes == [2, 2]
+    it2 = BertIterator(_tok(), sents, labels=[0] * 5, max_length=6,
+                       batch_size=2)
+    assert [b.num_examples() for b in it2] == [2, 2, 1]
