@@ -2,6 +2,7 @@
 
 from .dataset import DataSet, MultiDataSet
 from .datavec import (CSVRecordReader, CollectionRecordReader,
+                      JDBCRecordReader,
                       LineRecordReader, RecordReader,
                       RecordReaderDataSetIterator, Schema, TransformProcess,
                       make_image_augmenter, resize_images)

@@ -82,6 +82,11 @@ class WavFileRecordReader:
         x, sr = read_wav(path)
         if self.sample_rate is None:
             self.sample_rate = sr
+        elif sr != self.sample_rate:
+            raise ValueError(
+                f"mixed sample rates: {path} is {sr} Hz but the corpus "
+                f"started at {self.sample_rate} Hz — resample first (the "
+                "mel filterbank is built for ONE rate)")
         if len(x) < self.max_samples:
             x = np.pad(x, (0, self.max_samples - len(x)))
         return x[:self.max_samples]
@@ -139,6 +144,9 @@ def make_spectrogram_fn(*, n_fft: int = 512, hop: int = 256,
     def features(batch):
         batch = jnp.asarray(batch, jnp.float32)
         n = batch.shape[-1]
+        if n < n_fft:
+            raise ValueError(f"clips have {n} samples < n_fft={n_fft} — "
+                             "pad the clips or shrink n_fft")
         n_frames = 1 + (n - n_fft) // hop
         idx = (jnp.arange(n_frames)[:, None] * hop
                + jnp.arange(n_fft)[None, :])          # (frames, n_fft)

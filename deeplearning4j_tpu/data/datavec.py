@@ -591,3 +591,51 @@ def resize_images(images, height: int, width: int, method: str = "bilinear"):
     images = jnp.asarray(images)
     B, _, _, C = images.shape
     return jax.image.resize(images, (B, height, width, C), method=method)
+
+
+class JDBCRecordReader(RecordReader):
+    """SQL-backed records (reference: ``datavec-jdbc``'s JDBCRecordReader).
+
+    The JVM reference takes a JDBC DataSource + query; the Python-native
+    analogue takes a DB-API connection (or a sqlite file path — stdlib,
+    no drivers needed) + query. Each record is one row; column names come
+    from the cursor description (``column_names()``).
+    """
+
+    def __init__(self, conn_or_path, query: str, params: Sequence = ()):
+        import os as _os
+        self._own = isinstance(conn_or_path, (str, bytes, _os.PathLike))
+        if self._own:
+            import sqlite3
+            self._conn = sqlite3.connect(conn_or_path)
+        else:
+            self._conn = conn_or_path
+        self.query = query
+        self.params = tuple(params)
+        self._cols: Optional[List[str]] = None
+
+    def _execute(self):
+        # DB-API 2.0: only cursors execute (conn.execute is a sqlite3 extra)
+        cur = self._conn.cursor()
+        cur.execute(self.query, self.params)
+        return cur
+
+    def column_names(self) -> List[str]:
+        if self._cols is None:
+            cur = self._execute()
+            self._cols = [d[0] for d in cur.description]
+            cur.close()
+        return self._cols
+
+    def __iter__(self):
+        cur = self._execute()
+        self._cols = [d[0] for d in cur.description]
+        try:
+            for row in cur:
+                yield list(row)
+        finally:
+            cur.close()
+
+    def close(self):
+        if self._own:
+            self._conn.close()

@@ -193,7 +193,10 @@ class BertIterator:
         return self.__next__()
 
     def has_next(self) -> bool:
-        return self._pos < len(self._ids)
+        remaining = len(self._ids) - self._pos
+        if self.drop_last:
+            return remaining >= self.batch_size
+        return remaining > 0
 
     def reset(self):
         self._pos = 0

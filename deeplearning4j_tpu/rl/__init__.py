@@ -2,6 +2,7 @@
 
 from .a2c import A2C, A2CConfiguration
 from .a3c import A3C, A3CConfiguration, A3CDiscrete
+from .policies import BoltzmannPolicy, DQNPolicy, EpsGreedy, Policy
 from .async_nstep_q import (AsyncNStepQLearning,
                             AsyncNStepQLearningConfiguration,
                             AsyncNStepQLearningDiscrete)

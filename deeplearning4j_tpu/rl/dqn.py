@@ -94,11 +94,15 @@ class DQN:
         frac = min(1.0, self._steps / max(cfg.eps_decay_steps, 1))
         return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
 
+    def q_values(self, obs):
+        """Q(obs) -> (A,) values — the hook policies wrap (DQNPolicy(
+        agent.q_values))."""
+        return self._q_jit(self.params, jnp.asarray(obs)[None, :])[0]
+
     def act(self, obs, greedy: bool = False) -> int:
         if not greedy and self._rng.random() < self.epsilon():
             return int(self._rng.integers(self.env.action_space_size))
-        q = self._q_jit(self.params, jnp.asarray(obs)[None, :])
-        return int(jnp.argmax(q[0]))
+        return int(jnp.argmax(self.q_values(obs)))
 
     def train(self, episodes: int, callback: Optional[Callable] = None) -> List[float]:
         """Reference QLearningDiscrete.train — returns per-episode rewards."""

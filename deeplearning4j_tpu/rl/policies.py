@@ -1,0 +1,96 @@
+"""Action-selection policies.
+
+Reference parity: ``org.deeplearning4j.rl4j.policy`` — `Policy` (play),
+`DQNPolicy` (greedy), `EpsGreedy` (annealed exploration wrapper),
+`BoltzmannPolicy` (softmax over Q with temperature).
+
+Policies wrap any ``q_fn(obs) -> (A,) values`` callable (e.g. a DQN's
+network or ``AsyncNStepQLearning.params`` via a lambda) and select
+discrete actions; the softmax/argmax math runs through jax so a policy
+can also be vmapped inside a jitted rollout.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Policy:
+    """Base: next_action(obs) + play(env) rollout scoring."""
+
+    def next_action(self, obs, key=None) -> int:
+        raise NotImplementedError
+
+    def play(self, env, max_steps: int = 1000, seed: int = 0) -> float:
+        """Run one episode, returning the cumulative reward (reference
+        Policy.play)."""
+        key = jax.random.PRNGKey(seed)
+        obs = env.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            key, sub = jax.random.split(key)
+            out = env.step(self.next_action(obs, sub))
+            obs, reward, done = out[0], out[1], out[2]   # (+info) gym-style
+            total += float(reward)
+            if done:
+                break
+        return total
+
+
+class DQNPolicy(Policy):
+    """Greedy argmax over Q (reference DQNPolicy)."""
+
+    def __init__(self, q_fn: Callable):
+        self.q_fn = q_fn
+
+    def next_action(self, obs, key=None) -> int:
+        return int(jnp.argmax(self.q_fn(jnp.asarray(obs)), -1))
+
+
+class EpsGreedy(Policy):
+    """Annealed eps-greedy wrapper around another policy (reference
+    EpsGreedy: epsilonNbStep linear anneal from eps=1 to min_epsilon)."""
+
+    def __init__(self, inner: Policy, n_actions: int,
+                 eps_start: float = 1.0, min_epsilon: float = 0.1,
+                 anneal_steps: int = 10000):
+        self.inner = inner
+        self.n_actions = n_actions
+        self.eps_start, self.min_eps = eps_start, min_epsilon
+        self.anneal_steps = max(1, anneal_steps)
+        self.step_count = 0
+
+    def epsilon(self) -> float:
+        frac = min(1.0, self.step_count / self.anneal_steps)
+        return self.eps_start + (self.min_eps - self.eps_start) * frac
+
+    def next_action(self, obs, key=None) -> int:
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        k1, k2 = jax.random.split(key)
+        eps = self.epsilon()
+        self.step_count += 1
+        if float(jax.random.uniform(k1)) < eps:
+            return int(jax.random.randint(k2, (), 0, self.n_actions))
+        return self.inner.next_action(obs, k2)
+
+
+class BoltzmannPolicy(Policy):
+    """Sample actions ∝ softmax(Q / temperature) (reference
+    BoltzmannPolicy); temperature → 0 approaches greedy."""
+
+    def __init__(self, q_fn: Callable, temperature: float = 1.0):
+        if temperature <= 0:
+            raise ValueError("temperature must be > 0")
+        self.q_fn = q_fn
+        self.temperature = float(temperature)
+
+    def next_action(self, obs, key=None) -> int:
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        logits = self.q_fn(jnp.asarray(obs)) / self.temperature
+        return int(jax.random.categorical(key, logits))
