@@ -622,7 +622,15 @@ class JDBCRecordReader(RecordReader):
 
     def column_names(self) -> List[str]:
         if self._cols is None:
-            cur = self._execute()
+            cur = self._conn.cursor()
+            try:
+                # zero-row probe: avoids materializing the full result set
+                # on eager DB-API drivers just to read the description
+                cur.execute(f"SELECT * FROM ({self.query}) AS _probe "
+                            "LIMIT 0", self.params)
+            except Exception:   # noqa: BLE001 — driver without subquery
+                cur.close()     # support: fall back to the real query
+                cur = self._execute()
             self._cols = [d[0] for d in cur.description]
             cur.close()
         return self._cols

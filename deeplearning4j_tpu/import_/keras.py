@@ -256,6 +256,23 @@ def _map_layer(kcfg: dict):
         return LayerNormalization(eps=c.get("epsilon", 1e-3))
     if cls == "Embedding":
         return EmbeddingSequenceLayer(n_in=c["input_dim"], n_out=c["output_dim"])
+    if cls == "Reshape":
+        from ..nn.layers.core import ReshapeLayer
+        return ReshapeLayer(target_shape=tuple(c["target_shape"]))
+    if cls == "Permute":
+        from ..nn.layers.core import PermuteLayer
+        return PermuteLayer(dims=tuple(c["dims"]))
+    if cls == "RepeatVector":
+        from ..nn.layers.wrappers import RepeatVector
+        return RepeatVector(n=c["n"])
+    if cls == "TimeDistributed":
+        from ..nn.layers.wrappers import TimeDistributedLayer
+        inner = _map_layer(c["layer"])
+        if inner is None:
+            raise NotImplementedError(
+                f"TimeDistributed({c['layer'].get('class_name')}) "
+                "wraps a structural layer")
+        return TimeDistributedLayer(layer=inner)
     if cls in ("LSTM", "GRU", "SimpleRNN"):
         if cls == "LSTM":
             rnn = LSTM(n_out=c["units"],
@@ -340,8 +357,11 @@ def _depthwise_reshape(k):
 def _set_layer_weights(layer, pdict: Dict, sdict: Dict, ws: List[np.ndarray]):
     """Write one keras layer's weight list into our (params, state) dicts."""
     from ..nn.layers.recurrent import LastTimeStep
+    from ..nn.layers.wrappers import TimeDistributedLayer
     if isinstance(layer, LastTimeStep):  # return_sequences=False wrapper
         layer = layer.inner
+    if isinstance(layer, TimeDistributedLayer):   # weights live on the inner
+        layer = layer.layer
     assign = getattr(layer, "_keras_assign", None)
     if assign is not None:
         assign(layer, pdict, sdict, ws)
@@ -460,9 +480,54 @@ def _assign_weights(net: MultiLayerNetwork, model_weights, layer_names_in_order)
     net._invalidate()
 
 
-def import_keras_sequential(path, input_shape=None):
-    """KerasModelImport.importKerasSequentialModelAndWeights analogue."""
+_KERAS_LOSSES = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "sparse_mcxent",
+    "binary_crossentropy": "binary_xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "hinge": "hinge", "squared_hinge": "squared_hinge",
+    "kl_divergence": "kl_divergence",
+    "kullback_leibler_divergence": "kl_divergence",
+    "poisson": "poisson", "cosine_similarity": "cosine_proximity",
+}
+
+
+def _h5_training_loss(f) -> Optional[str]:
+    """The compiled loss from the h5 training_config attr, mapped to our
+    loss name (reference enforceTrainingConfig path)."""
+    raw = f.attrs.get("training_config")
+    if raw is None:
+        return None
+    try:
+        tc = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+        loss = tc.get("loss")
+        if isinstance(loss, dict):        # keras-3 serialized loss object
+            loss = (loss.get("config", {}) or {}).get("name") \
+                or loss.get("class_name")
+        if isinstance(loss, str):
+            key = loss.lower()
+            # CamelCase class names -> snake ("CategoricalCrossentropy")
+            import re as _re
+            key = _re.sub(r"(?<!^)(?=[A-Z])", "_",
+                          loss).lower() if loss != key else key
+            return _KERAS_LOSSES.get(key)
+    except Exception:   # noqa: BLE001 — absent/odd config = inference-only
+        return None
+    return None
+
+
+def import_keras_sequential(path, input_shape=None, loss=None):
+    """KerasModelImport.importKerasSequentialModelAndWeights analogue.
+
+    When the h5 carries a compiled loss (training_config) — or `loss=` is
+    given — a trailing Dense becomes an OutputLayer with that loss, so the
+    imported net is trainable with fit() (the reference's
+    enforceTrainingConfig behavior). Without either, the import is
+    inference-only like an uncompiled keras save.
+    """
     import h5py
+    from ..nn.layers.core import OutputLayer
     with h5py.File(path, "r") as f:
         raw = f.attrs["model_config"]
         cfg = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
@@ -470,16 +535,26 @@ def import_keras_sequential(path, input_shape=None):
             raise ValueError("use import_keras_model for Functional models")
         layer_cfgs = cfg["config"]["layers"] if isinstance(cfg["config"], dict) \
             else cfg["config"]
+        loss = loss or _h5_training_loss(f)
         b = NeuralNetConfiguration.builder().list()
         names = []
         itype = None
+        mapped = []
         for kc in layer_cfgs:
             if itype is None:
                 itype = _keras_input_type(kc)
             lyr = _map_layer(kc)
             if lyr is not None:
-                b.layer(lyr)
-                names.append(kc["config"]["name"])
+                mapped.append((lyr, kc["config"]["name"]))
+        if loss is not None and mapped and \
+                type(mapped[-1][0]) is DenseLayer:
+            last, nm = mapped[-1]
+            mapped[-1] = (OutputLayer(
+                n_out=last.n_out, activation=last.activation,
+                has_bias=last.has_bias, loss=loss), nm)
+        for lyr, nm in mapped:
+            b.layer(lyr)
+            names.append(nm)
         if itype is not None:
             b.set_input_type(itype)
         net = MultiLayerNetwork(b.build())

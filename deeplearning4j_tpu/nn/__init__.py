@@ -23,7 +23,8 @@ from .layers.core import (ActivationLayer, AlphaDropout,
                           EmbeddingLayer, EmbeddingSequenceLayer,
                           GaussianDropout, GaussianNoise, LossLayer,
                           MaskLayer, OCNNOutputLayer, OutputLayer, PReLULayer,
-                          RnnOutputLayer, SpatialDropout)
+                          PermuteLayer, ReshapeLayer, RnnOutputLayer,
+                          SpatialDropout)
 from .layers.objdetect import (DetectedObject, Yolo2OutputLayer,
                                get_predicted_objects, nms)
 from .layers.samediff_layer import (SameDiffLambdaLayer, SameDiffLambdaVertex,

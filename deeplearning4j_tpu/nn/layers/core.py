@@ -443,3 +443,55 @@ class OCNNOutputLayer(Layer):
         q = jnp.quantile(score, self.nu)
         r = state["r"] * (1.0 - self.r_update_rate) + self.r_update_rate * q
         return {**state, "r": r.astype(state["r"].dtype)}
+
+
+@dataclass
+class ReshapeLayer(Layer):
+    """Reshape per-example activations (keras Reshape / reference
+    ReshapeVertex as a sequential layer). target_shape excludes batch."""
+
+    target_shape: Any = None
+
+    def init(self, key, input_shape):
+        import numpy as _npm
+        if self.target_shape is None:
+            raise ValueError("target_shape required")
+        tgt = tuple(int(t) for t in self.target_shape)
+        n_in = int(_npm.prod(input_shape))
+        if tgt.count(-1) > 1:
+            raise ValueError(f"at most one -1 wildcard allowed, got {tgt}")
+        if -1 in tgt:                       # keras Reshape wildcard
+            known = int(-_npm.prod(tgt))    # product of the fixed dims
+            if known == 0 or n_in % known:
+                raise ValueError(f"cannot reshape {input_shape} -> {tgt}")
+            tgt = tuple(n_in // known if t == -1 else t for t in tgt)
+        elif int(_npm.prod(tgt)) != n_in:
+            raise ValueError(f"cannot reshape {input_shape} -> {tgt}")
+        return {}, {}, tgt
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return x.reshape((x.shape[0],) + tuple(self.target_shape)), state
+
+    def has_params(self):
+        return False
+
+
+@dataclass
+class PermuteLayer(Layer):
+    """Permute per-example dims, 1-indexed like keras Permute((2, 1))."""
+
+    dims: Any = None
+
+    def init(self, key, input_shape):
+        if self.dims is None:
+            raise ValueError("dims required")
+        d = tuple(int(i) for i in self.dims)
+        if sorted(d) != list(range(1, len(input_shape) + 1)):
+            raise ValueError(f"dims {d} must permute 1..{len(input_shape)}")
+        return {}, {}, tuple(input_shape[i - 1] for i in d)
+
+    def apply(self, params, state, x, ctx: Ctx):
+        return x.transpose((0,) + tuple(self.dims)), state
+
+    def has_params(self):
+        return False

@@ -650,3 +650,83 @@ def test_keras_import_bidirectional(tmp_path):
         got = np.asarray(net.output(x))
         np.testing.assert_allclose(got, want, atol=1e-4,
                                    err_msg=f"case {rnn_kw} {mode}")
+
+
+def test_keras_import_reshape_permute_repeat_timedistributed(tmp_path):
+    """Keras structural layers: Reshape, Permute, RepeatVector,
+    TimeDistributed(Dense) import with exact output parity."""
+    import tensorflow as tf
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.RepeatVector(4),          # (B, 4, 8)
+        keras.layers.TimeDistributed(keras.layers.Dense(5,
+                                                        activation="tanh")),
+        keras.layers.Permute((2, 1)),          # (B, 5, 4)
+        keras.layers.Reshape((20,)),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    p = str(tmp_path / "structural.h5")
+    m.save(p)
+    from deeplearning4j_tpu.import_.keras import import_keras_sequential
+    net = import_keras_sequential(str(p))
+    x = np.random.default_rng(0).standard_normal((3, 6)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_keras_import_compiled_model_is_trainable(tmp_path):
+    """A compiled keras model's loss (h5 training_config) converts the
+    trailing Dense into an OutputLayer so fit() works — reference
+    enforceTrainingConfig; uncompiled saves stay inference-only unless
+    loss= is passed."""
+    import tensorflow as tf
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((5,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="adam")
+    p = str(tmp_path / "compiled.h5")
+    m.save(p)
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.import_.keras import import_keras_sequential
+    from deeplearning4j_tpu.nn import OutputLayer
+    net = import_keras_sequential(p)
+    assert isinstance(net.layers[-1], OutputLayer)
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((32, 5)).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    np.testing.assert_allclose(np.asarray(net.output(X)),
+                               m.predict(X, verbose=0), atol=1e-5)
+    s0 = net.score(DataSet(X, Y))
+    net.fit(DataSet(X, Y), epochs=15)
+    assert net.score(DataSet(X, Y)) < s0
+
+    m2 = keras.Sequential([keras.layers.Input((5,)),
+                           keras.layers.Dense(3, activation="softmax")])
+    p2 = str(tmp_path / "uncompiled.h5")
+    m2.save(p2)
+    net2 = import_keras_sequential(p2)
+    assert not isinstance(net2.layers[-1], OutputLayer)   # inference-only
+    net3 = import_keras_sequential(p2, loss="mcxent")
+    assert isinstance(net3.layers[-1], OutputLayer)
+
+
+def test_reshape_layer_wildcard():
+    from deeplearning4j_tpu.nn import ReshapeLayer
+    import jax
+    lyr = ReshapeLayer(target_shape=(-1,))
+    _, _, out = lyr.init(jax.random.PRNGKey(0), (3, 4))
+    assert out == (12,)
+    lyr2 = ReshapeLayer(target_shape=(2, -1))
+    _, _, out2 = lyr2.init(jax.random.PRNGKey(0), (3, 4))
+    assert out2 == (2, 6)
+    import pytest
+    with pytest.raises(ValueError):
+        ReshapeLayer(target_shape=(-1, -1)).init(jax.random.PRNGKey(0), (4,))
+    with pytest.raises(ValueError):
+        ReshapeLayer(target_shape=(5, -1)).init(jax.random.PRNGKey(0), (3, 4))
