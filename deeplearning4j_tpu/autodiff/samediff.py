@@ -384,6 +384,13 @@ class SameDiff:
     def variables(self):
         return {n: v for n, v in self._vars.items() if v.kind == "variable"}
 
+    @property
+    def params(self):
+        """Trainable values, grouped like a network's param table — the
+        surface StatsListener/UIServer ratio reporting reads (upstream's
+        SameDiff UIListener role)."""
+        return {"variables": self._values_snapshot()}
+
     def get_variable(self, name):
         return self._vars[name]
 

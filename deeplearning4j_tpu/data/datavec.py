@@ -637,7 +637,10 @@ class JDBCRecordReader(RecordReader):
 
     def __iter__(self):
         cur = self._execute()
-        self._cols = [d[0] for d in cur.description]
+        if self._cols is None:   # keep one consistent naming view: the
+            # LIMIT-0 probe may disambiguate duplicate column names
+            # ('id', 'id:1') differently from the raw query
+            self._cols = [d[0] for d in cur.description]
         try:
             for row in cur:
                 yield list(row)

@@ -267,11 +267,16 @@ def _map_layer(kcfg: dict):
         return RepeatVector(n=c["n"])
     if cls == "TimeDistributed":
         from ..nn.layers.wrappers import TimeDistributedLayer
+        inner_cls = c["layer"].get("class_name")
         inner = _map_layer(c["layer"])
-        if inner is None:
+        if inner is None or not isinstance(
+                inner, (DenseLayer, ActivationLayer, DropoutLayer,
+                        PReLULayer)):
             raise NotImplementedError(
-                f"TimeDistributed({c['layer'].get('class_name')}) "
-                "wraps a structural layer")
+                f"TimeDistributed({inner_cls}): only feed-forward inners "
+                "(Dense/Activation/Dropout) stream per-timestep here — "
+                "spatial inners need a Cnn3D layout the reference also "
+                "special-cases")
         return TimeDistributedLayer(layer=inner)
     if cls in ("LSTM", "GRU", "SimpleRNN"):
         if cls == "LSTM":
@@ -546,12 +551,33 @@ def import_keras_sequential(path, input_shape=None, loss=None):
             lyr = _map_layer(kc)
             if lyr is not None:
                 mapped.append((lyr, kc["config"]["name"]))
-        if loss is not None and mapped and \
-                type(mapped[-1][0]) is DenseLayer:
-            last, nm = mapped[-1]
-            mapped[-1] = (OutputLayer(
-                n_out=last.n_out, activation=last.activation,
-                has_bias=last.has_bias, loss=loss), nm)
+        explicit_loss = loss is not None
+        if loss is not None and mapped:
+            # Dense + separate Activation('softmax'/...) is a common keras
+            # ending: fold the activation into the converted OutputLayer
+            if (len(mapped) >= 2 and isinstance(mapped[-1][0], ActivationLayer)
+                    and type(mapped[-2][0]) is DenseLayer):
+                act_layer, _ = mapped.pop()
+                last, nm = mapped[-1]
+                mapped[-1] = (OutputLayer(
+                    n_out=last.n_out, activation=act_layer.activation,
+                    has_bias=last.has_bias, loss=loss), nm)
+            elif type(mapped[-1][0]) is DenseLayer:
+                last, nm = mapped[-1]
+                mapped[-1] = (OutputLayer(
+                    n_out=last.n_out, activation=last.activation,
+                    has_bias=last.has_bias, loss=loss), nm)
+            elif explicit_loss:
+                raise ValueError(
+                    f"loss={loss!r} was requested but the model's last "
+                    f"layer is {type(mapped[-1][0]).__name__}, not Dense — "
+                    "cannot build a trainable OutputLayer head")
+            else:
+                import warnings
+                warnings.warn(
+                    "h5 carries a compiled loss but the final layer is "
+                    f"{type(mapped[-1][0]).__name__}; importing "
+                    "inference-only", stacklevel=2)
         for lyr, nm in mapped:
             b.layer(lyr)
             names.append(nm)

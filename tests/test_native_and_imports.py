@@ -730,3 +730,48 @@ def test_reshape_layer_wildcard():
         ReshapeLayer(target_shape=(-1, -1)).init(jax.random.PRNGKey(0), (4,))
     with pytest.raises(ValueError):
         ReshapeLayer(target_shape=(5, -1)).init(jax.random.PRNGKey(0), (3, 4))
+
+
+def test_keras_import_dense_plus_activation_head_and_guards(tmp_path):
+    import tensorflow as tf
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((5,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(3),
+        keras.layers.Activation("softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="adam")
+    p = str(tmp_path / "densact.h5")
+    m.save(p)
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.import_.keras import import_keras_sequential
+    from deeplearning4j_tpu.nn import OutputLayer
+    net = import_keras_sequential(p)
+    assert isinstance(net.layers[-1], OutputLayer)
+    assert str(net.layers[-1].activation) == "softmax"
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((16, 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(X)),
+                               m.predict(X, verbose=0), atol=1e-5)
+    Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net.fit(DataSet(X, Y), epochs=2)
+
+    # explicit loss on an unconvertible head raises, not silently ignores
+    m2 = keras.Sequential([keras.layers.Input((4,)),
+                           keras.layers.Dense(6, activation="relu"),
+                           keras.layers.Dropout(0.5)])
+    p2 = str(tmp_path / "noend.h5")
+    m2.save(p2)
+    with pytest.raises(ValueError):
+        import_keras_sequential(p2, loss="mse")
+
+    # TimeDistributed(Conv2D) rejected loudly at import time
+    m3 = keras.Sequential([
+        keras.layers.Input((3, 8, 8, 2)),
+        keras.layers.TimeDistributed(keras.layers.Conv2D(4, 3)),
+    ])
+    p3 = str(tmp_path / "tdconv.h5")
+    m3.save(p3)
+    with pytest.raises(NotImplementedError):
+        import_keras_sequential(p3)

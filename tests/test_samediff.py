@@ -111,3 +111,30 @@ def test_samediff_evaluate():
     sd.fit(iterator=it, epochs=60)
     ev = sd.evaluate(IrisDataSetIterator(batch_size=50), "out")
     assert ev.accuracy() > 0.9
+
+
+def test_samediff_stats_listener_writes_records(tmp_path):
+    """sd.fit + StatsListener = the upstream UIListener story: score +
+    per-variable update ratios land in the UI log."""
+    import json as _json
+    from deeplearning4j_tpu.nn.listeners import StatsListener
+
+    sd = _mlp(SameDiff.create())
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["input"],
+        data_set_label_mapping=["label"]))
+    log_dir = str(tmp_path / "ui")
+    listener = StatsListener(log_dir=log_dir, frequency=1)
+    sd.fit(iterator=IrisDataSetIterator(batch_size=75), epochs=3,
+           listeners=[listener])
+    listener.close()
+    import glob
+    files = glob.glob(log_dir + "/*.jsonl")
+    assert files
+    recs = [_json.loads(l) for l in open(files[0]) if l.strip()]
+    data = [r for r in recs if "run_start" not in r]
+    assert len(data) >= 6
+    assert all("score" in r for r in data)
+    assert any("update_ratios" in r and "variables" in r["update_ratios"]
+               for r in data[1:])
