@@ -262,3 +262,14 @@ def test_masked_loss_gradients():
         return float(jnp.sum(per * mask) / jnp.sum(mask))
 
     assert abs(loss_with(x) - loss_with(x2)) < 1e-5
+
+
+def test_ocnn_loss_gradcheck():
+    """Central-difference check on the OC-NN objective wrt V and w."""
+    from deeplearning4j_tpu.nn import OCNNOutputLayer
+
+    layer = OCNNOutputLayer(n_in=4, hidden_size=3, nu=0.1)
+    params, state, _ = layer.init(jax.random.PRNGKey(2), (4,))
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((6, 4)),
+                    jnp.float32)
+    grad_check(lambda p: layer.compute_loss(p, x, None, state=state), params)
