@@ -18,8 +18,8 @@ Methodology (why this is trustworthy on the axon tunnel):
   utils/tracing.py), derived TFLOP/s, and MFU vs the v5e bf16 peak. An MFU
   > 1 is physically impossible and flags the record `timing_valid: false`.
 
-Secondary configs (LeNet, char-RNN, BERT fine-tune, Transformer-LM, 8-way
-dp scaling) run after the headline and are written to `bench_secondary.json`
+Secondary configs (LeNet bf16, char-RNN, BERT fine-tune, Transformer-LM,
+dp-8 overhead) run after the headline and are written to `bench_secondary.json`
 (stderr progress only, stdout stays one line). `--model NAME [batch steps]`
 runs a single config and prints its record alone.
 
@@ -35,6 +35,7 @@ import time
 
 BASELINE_SAMPLES_PER_SEC = 360.0  # DL4J ResNet-50 V100 cuDNN (BASELINE.md)
 V5E_BF16_PEAK = 197e12  # TPU v5 lite bf16 peak FLOP/s (public spec)
+DPOVERHEAD_METRIC = "dp-8 per-step overhead vs single device (virtual CPU mesh)"
 
 
 def _peak_flops(dtype="bf16"):
@@ -132,14 +133,18 @@ def _mln_chain(net, x, y):
     return run_chain, flops
 
 
-def build_lenet(batch):
+def build_lenet(batch, compute_dtype="bf16"):
     """(run_chain, flops) for the LeNet config — importable by tests so the
-    bench code path compiles in CI, not only at round end."""
+    bench code path compiles in CI, not only at round end. Runs the mixed
+    bf16 policy by default (params f32, compute bf16 — the framework's
+    recommended TPU config); pass compute_dtype=None for the pure-f32
+    DL4J-default comparison."""
     import jax.numpy as jnp
     import numpy as np
     from deeplearning4j_tpu.zoo import LeNet
 
-    net = LeNet(num_classes=10).init()
+    cd = jnp.bfloat16 if compute_dtype == "bf16" else None
+    net = LeNet(num_classes=10, compute_dtype=cd).init()
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.random((batch, 28, 28, 1), np.float32))
     y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
@@ -147,10 +152,10 @@ def build_lenet(batch):
 
 
 def bench_lenet(batch, steps):
-    run_chain, flops = build_lenet(batch)
+    run_chain, flops = build_lenet(batch, compute_dtype="bf16")
     timing = measure_marginal(run_chain, n1=5, n2=steps)
-    return _record("LeNet MNIST train-step samples/sec/chip",
-                   "samples/sec/chip", batch, timing, flops, dtype="f32",
+    return _record("LeNet MNIST train-step samples/sec/chip (bf16)",
+                   "samples/sec/chip", batch, timing, flops, dtype="bf16",
                    batch=batch)
 
 
@@ -258,8 +263,16 @@ def bench_transformer(batch, steps):
         batch=batch, seq=cfg.max_seq)
 
 
-def bench_dpscale(batch, steps):
-    """8-way dp scaling efficiency on the virtual CPU mesh (SURVEY §6).
+def bench_dpoverhead(batch, steps):
+    """Per-step wall-clock overhead of the dp-8 path vs single-device at the
+    SAME global batch (8-device virtual CPU mesh).
+
+    Unlike a "scaling efficiency" number — meaningless when 8 virtual
+    devices share one host's cores — this isolates a real quantity: the
+    extra per-step latency added by the ParallelWrapper machinery (sharding,
+    psum collectives, multi-device dispatch) at equal total compute. ICI
+    scaling itself is validated by the loss-equivalence tests in
+    tests/test_parallel.py.
 
     Runs in a subprocess with a CPU-forced env (same reason as
     __graft_entry__.dryrun_multichip): the calling process may hold the TPU.
@@ -273,24 +286,25 @@ def bench_dpscale(batch, steps):
     env, preamble = cpu_forced_env(8)
     code = (
         preamble + "import bench; import json;"
-        f"print('DPSCALE ' + json.dumps(bench._dpscale_impl({batch}, {steps})))"
+        f"print('DPOVERHEAD ' + json.dumps("
+        f"bench._dpoverhead_impl({batch}, {steps})))"
     )
     repo = os.path.dirname(os.path.abspath(__file__))
+    metric = DPOVERHEAD_METRIC
     try:
         proc = subprocess.run([sys.executable, "-c", code], env=env,
                               cwd=repo, capture_output=True, text=True,
                               timeout=900)
     except subprocess.TimeoutExpired as e:
-        return {"metric": "dp scaling efficiency (8-way virtual CPU mesh)",
-                "error": f"timeout after {e.timeout}s"}
-    m = re.search(r"DPSCALE (\{.*\})", proc.stdout)
+        return {"metric": metric, "error": f"timeout after {e.timeout}s"}
+    m = re.search(r"DPOVERHEAD (\{.*\})", proc.stdout)
     if proc.returncode != 0 or not m:
-        return {"metric": "dp scaling efficiency (8-way virtual CPU mesh)",
+        return {"metric": metric,
                 "error": (proc.stdout + proc.stderr)[-500:]}
     return json.loads(m.group(1))
 
 
-def _dpscale_impl(batch, steps):
+def _dpoverhead_impl(batch, steps):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -313,26 +327,30 @@ def _dpscale_impl(batch, steps):
     x = jnp.asarray(rng.random((batch, 256), np.float32))
     y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
 
-    def throughput(fit_once):
+    def per_step_ms(fit_once):
         fit_once()  # compile
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            fit_once()
-        return batch * steps / (time.perf_counter() - t0)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                fit_once()
+            best = min(best, time.perf_counter() - t0)
+        return best / steps * 1e3
 
     from deeplearning4j_tpu.data.dataset import DataSet
     ds = DataSet(x, y)
     net1 = build()
-    t1 = throughput(lambda: net1.fit(ds))
+    t1 = per_step_ms(lambda: net1.fit(ds))
     net8 = build()
     pw = ParallelWrapper(net8, mesh=make_mesh(jax.devices()[:8], dp=8))
-    t8 = throughput(lambda: pw.fit([ds]))
-    eff = t8 / (t1 * 8)
-    return {"metric": "dp scaling efficiency (8-way virtual CPU mesh)",
-            "value": round(eff, 3), "unit": "eff(8dev)/(8*eff(1dev))",
-            "single_sps": round(t1, 1), "dp8_sps": round(t8, 1),
-            "note": "virtual devices share host cores; ICI scaling is "
-                    "validated by tests/test_parallel.py equivalence instead"}
+    t8 = per_step_ms(lambda: pw.fit([ds]))
+    return {"metric": DPOVERHEAD_METRIC,
+            "value": round(t8 - t1, 3), "unit": "ms/step",
+            "single_ms": round(t1, 3), "dp8_ms": round(t8, 3),
+            "global_batch": batch,
+            "note": "equal global batch, equal total compute; the delta is "
+                    "the sharding/collective/dispatch cost of the dp path. "
+                    "ICI scaling equivalence: tests/test_parallel.py"}
 
 
 def build_resnet50(batch, num_classes=1000):
@@ -393,7 +411,7 @@ CONFIGS = {
     "charnn": bench_charnn,
     "bert": bench_bert,
     "transformer": bench_transformer,
-    "dpscale": bench_dpscale,
+    "dpoverhead": bench_dpoverhead,
 }
 
 DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
@@ -405,7 +423,7 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     # transformer: batch 16 + remat off + auto-attention (XLA fused wins at
     # T=1024; pallas flash only from T>=2048) measured +15% tokens/s on-chip
     "transformer": (16, 13),
-    "dpscale": (1024, 20),
+    "dpoverhead": (1024, 20),
 }
 
 
@@ -442,7 +460,7 @@ def main():
     secondary = {}
     script = os.path.abspath(__file__)
     repo = os.path.dirname(script)
-    for name in ("lenet", "charnn", "bert", "transformer", "dpscale"):
+    for name in ("lenet", "charnn", "bert", "transformer", "dpoverhead"):
         if time.perf_counter() - t_start > 1200:
             secondary[name] = {"skipped": "time budget"}
         else:

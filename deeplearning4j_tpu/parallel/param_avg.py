@@ -25,7 +25,7 @@ import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from .mesh import data_parallel_mesh
 
@@ -110,7 +110,7 @@ class ParameterAveragingTrainer:
                 local_round, mesh=self.mesh,
                 in_specs=(P("dp"),) * 8,
                 out_specs=(P("dp"), P("dp"), P("dp"), P()),
-                check_rep=False)
+                check_vma=False)
             return sm(stacked_params, stacked_opt, stacked_states, xs, ys,
                       rngs, fms, lms)
 
