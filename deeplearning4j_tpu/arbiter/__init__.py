@@ -5,5 +5,6 @@ from .runner import (BestScoreCondition, CandidateResult,
                      OptimizationRunner, TerminationCondition)
 from .space import (CandidateGenerator, ContinuousParameterSpace,
                     DiscreteParameterSpace, FixedValue,
+                    GeneticSearchCandidateGenerator,
                     GridSearchCandidateGenerator, IntegerParameterSpace,
                     ParameterSpace, RandomSearchGenerator)

@@ -104,6 +104,9 @@ class OptimizationRunner:
             res = CandidateResult(i, candidate, float(score),
                                   time.time() - t0, extra)
             self.results.append(res)
+            report = getattr(self.generator, "report", None)
+            if report is not None:   # genetic search closes its feedback loop
+                report(candidate, res.score, self.minimize)
             if self.on_result:
                 self.on_result(res)
         return self.best_result()

@@ -25,6 +25,10 @@ class ParameterSpace:
         """n representative values for grid search."""
         raise NotImplementedError
 
+    def mutate(self, value, rng: np.random.Generator):
+        """Genetic-search mutation — default: resample the gene."""
+        return self.sample(rng)
+
 
 class ContinuousParameterSpace(ParameterSpace):
     def __init__(self, low: float, high: float, log_scale: bool = False):
@@ -43,6 +47,17 @@ class ContinuousParameterSpace(ParameterSpace):
                 math.log(self.low), math.log(self.high), n))]
         return [float(v) for v in np.linspace(self.low, self.high, n)]
 
+    def mutate(self, value, rng):
+        """Local gaussian step (10% of the span); log-scale steps in log
+        space — keeps evolution's fine-convergence while sample() handles
+        exploration."""
+        if self.log_scale:
+            lo, hi = math.log(self.low), math.log(self.high)
+            lv = math.log(value) + rng.normal(0.0, 0.1 * (hi - lo))
+            return float(math.exp(min(max(lv, lo), hi)))
+        v = value + rng.normal(0.0, 0.1 * (self.high - self.low))
+        return float(min(max(v, self.low), self.high))
+
 
 class IntegerParameterSpace(ParameterSpace):
     def __init__(self, low: int, high: int):
@@ -54,6 +69,10 @@ class IntegerParameterSpace(ParameterSpace):
     def grid(self, n):
         vals = np.unique(np.linspace(self.low, self.high, n).round().astype(int))
         return [int(v) for v in vals]
+
+    def mutate(self, value, rng):
+        step = 1 if rng.random() < 0.5 else -1
+        return int(min(max(value + step, self.low), self.high))
 
 
 class DiscreteParameterSpace(ParameterSpace):
@@ -124,3 +143,87 @@ class GridSearchCandidateGenerator(CandidateGenerator):
             np.random.default_rng(self.seed).shuffle(combos)
         for combo in combos:
             yield dict(zip(keys, combo))
+
+
+class GeneticSearchCandidateGenerator(CandidateGenerator):
+    """Evolutionary candidate search — parity with Arbiter's
+    ``GeneticSearchCandidateGenerator`` (+ its selection / crossover /
+    mutation operators collapsed into tournament selection, per-gene uniform
+    crossover, and resample-mutation on the typed ParameterSpaces directly,
+    so no numeric chromosome encoding layer is needed).
+
+    Feedback loop: ``OptimizationRunner`` calls :meth:`report` after scoring
+    each candidate (the upstream generator receives results the same way).
+    Until ``population_size`` scored results exist, candidates are random
+    samples; afterwards each candidate is bred from two tournament-selected
+    parents.
+    """
+
+    def __init__(self, space, population_size: int = 12,
+                 tournament_size: int = 3, mutation_prob: float = 0.15,
+                 crossover_prob: float = 0.85, max_candidates: int = 50,
+                 seed: int = 0, minimize: bool = True):
+        super().__init__(space)
+        if population_size < 2:
+            raise ValueError("population_size must be >= 2")
+        self.population_size = population_size
+        self.tournament_size = tournament_size
+        self.mutation_prob = mutation_prob
+        self.crossover_prob = crossover_prob
+        self.max_candidates = max_candidates
+        self.seed = seed
+        self.minimize = minimize
+        self._scored: List[tuple] = []   # (candidate dict, score)
+
+    # ---- runner feedback -------------------------------------------------
+    def report(self, candidate: Dict[str, Any], score: float,
+               minimize: Optional[bool] = None):
+        """Record a scored candidate; the breeding pool keeps the best
+        ``population_size`` seen so far."""
+        if minimize is not None:
+            self.minimize = minimize
+        if not math.isfinite(score):
+            return
+        self._scored.append((dict(candidate), float(score)))
+        self._scored.sort(key=lambda cs: cs[1] if self.minimize else -cs[1])
+        del self._scored[self.population_size:]
+
+    # ---- breeding --------------------------------------------------------
+    def _tournament(self, rng) -> Dict[str, Any]:
+        k = min(self.tournament_size, len(self._scored))
+        picks = rng.choice(len(self._scored), size=k, replace=False)
+        best = min(picks, key=lambda i: self._scored[i][1]) if self.minimize \
+            else max(picks, key=lambda i: self._scored[i][1])
+        return self._scored[best][0]
+
+    def _breed(self, rng) -> Dict[str, Any]:
+        pa, pb = self._tournament(rng), self._tournament(rng)
+        child = {}
+        for k, s in self.space.items():
+            va, vb = pa[k], pb[k]
+            if rng.random() < self.mutation_prob:
+                child[k] = s.mutate(va, rng)        # local step (or resample)
+            elif rng.random() < self.crossover_prob:
+                # arithmetic crossover (upstream ArithmeticCrossover) only on
+                # ranged spaces — a convex blend stays inside the range.
+                # Discrete/Fixed genes must stay MEMBERS of the space, so
+                # they get a uniform parent pick instead.
+                if isinstance(s, ContinuousParameterSpace):
+                    u = rng.random()
+                    child[k] = u * va + (1 - u) * vb
+                elif isinstance(s, IntegerParameterSpace):
+                    u = rng.random()
+                    child[k] = round(u * va + (1 - u) * vb)
+                else:
+                    child[k] = va if rng.random() < 0.5 else vb
+            else:
+                child[k] = va
+        return child
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.max_candidates):
+            if len(self._scored) < self.population_size:
+                yield {k: s.sample(rng) for k, s in self.space.items()}
+            else:
+                yield self._breed(rng)

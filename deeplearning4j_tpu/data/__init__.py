@@ -4,7 +4,8 @@ from .dataset import DataSet, MultiDataSet
 from .datavec import (CSVRecordReader, CollectionRecordReader,
                       JDBCRecordReader,
                       LineRecordReader, RecordReader,
-                      RecordReaderDataSetIterator, Schema, TransformProcess,
+                      RecordReaderDataSetIterator, SVMLightRecordReader,
+                      Schema, TransformProcess,
                       make_image_augmenter, resize_images)
 from .iterators import (ArrayDataSetIterator, BaseDatasetIterator,
                         Cifar10DataSetIterator, EmnistDataSetIterator,

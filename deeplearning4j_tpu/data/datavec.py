@@ -90,6 +90,62 @@ class CSVRecordReader(RecordReader):
             src.close()
 
 
+class SVMLightRecordReader(RecordReader):
+    """SVMLight / LibSVM sparse-format reader — parity with datavec-api
+    ``SVMLightRecordReader``. Lines look like::
+
+        <label>[,<label2>...] [qid:<n>] <idx>:<val> <idx>:<val> ... # comment
+
+    Records come out dense: ``num_features`` feature floats followed by the
+    label value(s) (feed through ``RecordReaderDataSetIterator`` with
+    ``num_classes`` to one-hot, exactly like the CSV path). Indices are
+    1-based per the SVMLight convention unless ``zero_based_indexing``;
+    ``qid:`` tokens and ``#`` comments are skipped like upstream.
+    """
+
+    def __init__(self, path: Optional[str] = None, num_features: int = 0,
+                 text: Optional[str] = None,
+                 zero_based_indexing: bool = False):
+        if num_features <= 0:
+            raise ValueError("num_features must be set (upstream "
+                             "SVMLightRecordReader.NUM_FEATURES is required)")
+        self.path, self.text = path, text
+        self.num_features = num_features
+        self.zero_based_indexing = zero_based_indexing
+
+    @staticmethod
+    def _label(tok: str):
+        f = float(tok)
+        i = int(f)
+        return i if i == f else f
+
+    def __iter__(self):
+        src = io.StringIO(self.text) if self.text is not None \
+            else open(self.path, "r", encoding="utf-8")
+        off = 0 if self.zero_based_indexing else 1
+        try:
+            for lineno, line in enumerate(src, 1):
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                feats = [0.0] * self.num_features
+                for tok in parts[1:]:
+                    if tok.startswith("qid:"):
+                        continue
+                    idx_s, val_s = tok.split(":", 1)
+                    i = int(idx_s) - off
+                    if not 0 <= i < self.num_features:
+                        raise ValueError(
+                            f"line {lineno}: feature index {idx_s} outside "
+                            f"num_features={self.num_features} "
+                            f"(zero_based_indexing={self.zero_based_indexing})")
+                    feats[i] = float(val_s)
+                yield feats + [self._label(t) for t in parts[0].split(",")]
+        finally:
+            src.close()
+
+
 def read_csv_matrix(path: Optional[str] = None, n_cols: int = 0,
                     text: Optional[bytes] = None) -> "np.ndarray":
     """All-numeric CSV → (rows, n_cols) float32 via the native parser

@@ -33,18 +33,22 @@ import numpy as np
 
 from ..nn.conf import NeuralNetConfiguration
 from ..nn.layers.base import InputType, Layer
-from ..nn.layers.conv import (Convolution1DLayer, ConvolutionLayer,
-                              Cropping1D, Cropping2D, Deconvolution2D,
+from ..nn.layers.conv import (Convolution1DLayer, Convolution3DLayer,
+                              ConvolutionLayer, Cropping1D, Cropping2D,
+                              Cropping3D, Deconvolution2D, Deconvolution3D,
                               DepthwiseConvolution2D, GlobalPoolingLayer,
                               SeparableConvolution2D, Subsampling1DLayer,
-                              SubsamplingLayer, Upsampling1D, Upsampling2D,
-                              ZeroPadding1DLayer, ZeroPaddingLayer)
+                              Subsampling3DLayer, SubsamplingLayer,
+                              Upsampling1D, Upsampling2D, Upsampling3D,
+                              ZeroPadding1DLayer, ZeroPadding3DLayer,
+                              ZeroPaddingLayer)
 from ..nn.layers.core import (ActivationLayer, AlphaDropout, DenseLayer,
                               DropoutLayer, EmbeddingSequenceLayer,
                               GaussianDropout, GaussianNoise, PReLULayer,
                               SpatialDropout)
 from ..nn.layers.norm import BatchNormalization, LayerNormalization
-from ..nn.layers.recurrent import GRU, LSTM, Bidirectional, SimpleRnn
+from ..nn.layers.recurrent import (GRU, LSTM, Bidirectional, ConvLSTM2D,
+                                   SimpleRnn)
 from ..nn.multi_layer_network import MultiLayerNetwork
 from ..nn.preprocessors import CnnToFeedForwardPreProcessor
 from ..nn.vertices import (ElementWiseVertex, MergeVertex, PreprocessorVertex)
@@ -136,6 +140,10 @@ def _one(v):
     return v[0] if isinstance(v, (list, tuple)) else v
 
 
+def _trip(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
+
+
 def _map_layer(kcfg: dict):
     """keras layer config dict → our layer (or None for structural layers)."""
     cls = kcfg["class_name"]
@@ -197,6 +205,46 @@ def _map_layer(kcfg: dict):
             dilation=_one(c.get("dilation_rate", 1)),
             convolution_mode="same" if pad == "same" else "truncate",
             padding=0, activation=_act(c), has_bias=c.get("use_bias", True))
+    if cls == "Conv3D":
+        pad = c.get("padding", "valid")
+        return Convolution3DLayer(
+            n_out=c["filters"], kernel_size=_trip(c["kernel_size"]),
+            stride=_trip(c.get("strides", 1)),
+            dilation=_trip(c.get("dilation_rate", 1)),
+            convolution_mode="same" if pad == "same" else "truncate",
+            padding=0, activation=_act(c), has_bias=c.get("use_bias", True))
+    if cls == "Conv3DTranspose":
+        pad = c.get("padding", "valid")
+        return Deconvolution3D(
+            n_out=c["filters"], kernel_size=_trip(c["kernel_size"]),
+            stride=_trip(c.get("strides", 1)),
+            convolution_mode="same" if pad == "same" else "truncate",
+            padding=0, activation=_act(c), has_bias=c.get("use_bias", True))
+    if cls == "ConvLSTM2D":
+        return ConvLSTM2D(
+            n_out=c["filters"], kernel_size=_pair(c["kernel_size"]),
+            stride=_pair(c.get("strides", 1)),
+            convolution_mode=("same" if c.get("padding", "valid") == "same"
+                              else "truncate"),
+            activation=_act({"activation": c.get("activation", "tanh")}),
+            gate_activation=_ACT.get(c.get("recurrent_activation", "sigmoid"),
+                                     "sigmoid"),
+            forget_gate_bias=(1.0 if c.get("unit_forget_bias", True) else 0.0),
+            return_sequences=c.get("return_sequences", False),
+            has_bias=c.get("use_bias", True))
+    if cls in ("MaxPooling3D", "AveragePooling3D"):
+        pad = c.get("padding", "valid")
+        return Subsampling3DLayer(
+            kernel_size=_trip(c.get("pool_size", 2)),
+            stride=_trip(c.get("strides") or c.get("pool_size", 2)),
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            convolution_mode="same" if pad == "same" else "truncate")
+    if cls == "UpSampling3D":
+        return Upsampling3D(size=_trip(c.get("size", 2)))
+    if cls == "ZeroPadding3D":
+        return ZeroPadding3DLayer(padding=c.get("padding", 1))
+    if cls == "Cropping3D":
+        return Cropping3D(cropping=c.get("cropping", 1))
     if cls in ("MaxPooling2D", "AveragePooling2D"):
         pad = c.get("padding", "valid")
         return SubsamplingLayer(
@@ -211,9 +259,11 @@ def _map_layer(kcfg: dict):
             stride=_one(c.get("strides") or c.get("pool_size", 2)),
             pooling_type="max" if cls.startswith("Max") else "avg",
             convolution_mode="same" if pad == "same" else "truncate")
-    if cls in ("GlobalAveragePooling2D", "GlobalAveragePooling1D"):
+    if cls in ("GlobalAveragePooling3D", "GlobalAveragePooling2D",
+               "GlobalAveragePooling1D"):
         return GlobalPoolingLayer(pooling_type="avg")
-    if cls in ("GlobalMaxPooling2D", "GlobalMaxPooling1D"):
+    if cls in ("GlobalMaxPooling3D", "GlobalMaxPooling2D",
+               "GlobalMaxPooling1D"):
         return GlobalPoolingLayer(pooling_type="max")
     if cls == "UpSampling2D":
         return Upsampling2D(size=_pair(c.get("size", 2)))
@@ -331,6 +381,8 @@ def _keras_input_type(kcfg):
     if shape is None:
         return None
     dims = tuple(d for d in shape[1:])
+    if len(dims) == 4:  # (T,H,W,C) ConvLSTM sequences or (D,H,W,C) volumes
+        return InputType.convolutional_3d(*dims)
     if len(dims) == 3:
         return InputType.convolutional(*dims)
     if len(dims) == 2:
@@ -350,6 +402,12 @@ def _gru_reorder(k, units):
     """keras [z, r, h] gate columns → ours [r, z, n]."""
     z, r, hh = (k[:, j * units:(j + 1) * units] for j in range(3))
     return np.concatenate([r, z, hh], axis=1)
+
+
+def _convlstm_reorder(k, units):
+    """keras ConvLSTM gate blocks [i, f, c, o] (last axis) → ours [i, f, o, g]."""
+    i, f, cc, o = (k[..., j * units:(j + 1) * units] for j in range(4))
+    return np.concatenate([i, f, o, cc], axis=-1)
 
 
 def _depthwise_reshape(k):
@@ -406,8 +464,24 @@ def _set_layer_weights(layer, pdict: Dict, sdict: Dict, ws: List[np.ndarray]):
         pdict["W"] = jnp.asarray(_depthwise_reshape(ws[0]))
         if layer.has_bias and len(ws) > 1:
             pdict["b"] = jnp.asarray(ws[1])
-    elif isinstance(layer, (ConvolutionLayer, Convolution1DLayer)):
-        pdict["W"] = jnp.asarray(ws[0])  # HWIO / TIO as-is
+    elif isinstance(layer, Deconvolution3D):
+        # keras (kd,kh,kw,cout,cin) gradient-of-conv (flipped) → our
+        # unflipped DHWIO conv_transpose: flip spatial + swap I/O
+        pdict["W"] = jnp.asarray(
+            np.transpose(ws[0][::-1, ::-1, ::-1], (0, 1, 2, 4, 3)))
+        if layer.has_bias and len(ws) > 1:
+            pdict["b"] = jnp.asarray(ws[1])
+    elif isinstance(layer, ConvLSTM2D):
+        units = layer.n_out
+        kernel, rec, bias = ws[:3]
+        pdict["W"] = jnp.asarray(_convlstm_reorder(kernel, units))
+        pdict["RW"] = jnp.asarray(_convlstm_reorder(rec, units))
+        if layer.has_bias and len(ws) > 2:
+            pdict["b"] = jnp.asarray(
+                _convlstm_reorder(bias[None, :], units)[0])
+    elif isinstance(layer, (ConvolutionLayer, Convolution1DLayer,
+                            Convolution3DLayer)):
+        pdict["W"] = jnp.asarray(ws[0])  # HWIO / TIO / DHWIO as-is
         if layer.has_bias and len(ws) > 1:
             pdict["b"] = jnp.asarray(ws[1])
     elif isinstance(layer, BatchNormalization):

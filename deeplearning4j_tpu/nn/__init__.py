@@ -7,7 +7,8 @@ from .layers.attention import (LearnedSelfAttentionLayer,
 from .layers.base import Ctx, InputType, Layer
 from .layers.conv import (Convolution1DLayer, Convolution3DLayer,
                           ConvolutionLayer, Cropping1D, Cropping2D,
-                          Cropping3D, Deconvolution2D, DepthToSpaceLayer,
+                          Cropping3D, Deconvolution2D, Deconvolution3D,
+                          DepthToSpaceLayer,
                           DepthwiseConvolution2D, GlobalPoolingLayer,
                           LocallyConnected1D, LocallyConnected2D, PoolingType,
                           SeparableConvolution2D, SpaceToDepthLayer,
@@ -37,9 +38,9 @@ from .layers.wrappers import (FrozenLayer, FrozenLayerWithBackprop,
 from .layers.norm import (BatchNormalization, LayerNormalization,
                           LocalResponseNormalization, RMSNorm)
 from .layers.recurrent import (GRU, LSTM, BaseRecurrent, Bidirectional,
-                               BidirectionalMode, GravesBidirectionalLSTM,
-                               GravesLSTM, LastTimeStep, SimpleRnn,
-                               TimeDistributed)
+                               BidirectionalMode, ConvLSTM2D,
+                               GravesBidirectionalLSTM, GravesLSTM,
+                               LastTimeStep, SimpleRnn, TimeDistributed)
 from .listeners import (CheckpointListener, CollectScoresListener,
                         EvaluativeListener, NanScoreWatchdog,
                         PerformanceListener, ScoreIterationListener,

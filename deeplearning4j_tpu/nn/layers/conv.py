@@ -237,6 +237,53 @@ class Deconvolution2D(ConvolutionLayer):
 
 
 @dataclass
+class Deconvolution3D(Convolution3DLayer):
+    """Transposed 3-D conv over (B, D, H, W, C) [NDHWC].
+
+    Reference parity: ``org.deeplearning4j.nn.conf.layers.Deconvolution3D``
+    (the reference runs NCDHW through cuDNN; here one XLA
+    ``lax.conv_transpose`` in the TPU-native NDHWC layout).
+    """
+
+    def init(self, key, input_shape):
+        d, h, w, c = input_shape
+        c = self.n_in or c
+        kd, kh, kw = _triple(self.kernel_size)
+        kshape = (kd, kh, kw, c, self.n_out)  # DHWIO for conv_transpose
+        params = {"W": self._make_weight(key, kshape, kd * kh * kw * c,
+                                         kd * kh * kw * self.n_out)}
+        if self.has_bias:
+            params["b"] = self._make_bias((self.n_out,))
+        sd, sh, sw = _triple(self.stride)
+        if self.convolution_mode == "same":
+            out = (d * sd, h * sh, w * sw, self.n_out)
+        else:
+            pd, ph, pw = _triple(self.padding)
+            out = (sd * (d - 1) + kd - 2 * pd,
+                   sh * (h - 1) + kh - 2 * ph,
+                   sw * (w - 1) + kw - 2 * pw, self.n_out)
+        return params, {}, out
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        w = params["W"].astype(x.dtype)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            pd, ph, pw = _triple(self.padding)
+            kd, kh, kw = _triple(self.kernel_size)
+            pad = ((kd - 1 - pd, kd - 1 - pd), (kh - 1 - ph, kh - 1 - ph),
+                   (kw - 1 - pw, kw - 1 - pw))
+        y = lax.conv_transpose(
+            x, w, strides=_triple(self.stride), padding=pad,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        y = y.astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self.activation_fn()(y), state
+
+
+@dataclass
 class DepthwiseConvolution2D(Layer):
     n_in: Optional[int] = None
     depth_multiplier: int = 1

@@ -426,3 +426,118 @@ class TimeDistributed(Layer):
         flat = x.reshape((b * t,) + x.shape[2:])
         y, s = self.inner.apply(params, state, flat, ctx)
         return y.reshape((b, t) + y.shape[1:]), s
+
+
+@dataclass
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM (Shi et al. 2015) over (B, T, H, W, C) sequences.
+
+    Reference parity: the keras ``ConvLSTM2D`` layer that upstream imports
+    via ``KerasConvLSTM2D`` (deeplearning4j keras-import). TPU-native
+    design mirrors the dense LSTM here: the input convolution over ALL
+    timesteps is hoisted out of the scan as one batched (B*T) conv on the
+    MXU; only the small recurrent conv (stride 1, same-padded on the output
+    grid) runs inside the ``lax.scan``. Gate order [i, f, o, g] like our
+    LSTM, so keras [i, f, c, o] kernels are reordered at import.
+
+    ``return_sequences=True`` yields (B, T, H', W', F); False yields the
+    (masked) last step (B, H', W', F).
+    """
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    convolution_mode: str = "same"   # "same" | "truncate" (keras "valid")
+    activation: Any = "tanh"
+    gate_activation: Any = "sigmoid"
+    forget_gate_bias: float = 1.0
+    return_sequences: bool = True
+    has_bias: bool = True
+
+    def _pair(self, v):
+        from .conv import _pair
+        return _pair(v)
+
+    def _out_hw(self, h, w):
+        kh, kw = self._pair(self.kernel_size)
+        sh, sw = self._pair(self.stride)
+        if self.convolution_mode == "same":
+            return -(-h // sh), -(-w // sw)
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def init(self, key, input_shape):
+        t, h, w, c = input_shape
+        c = self.n_in or c
+        kh, kw = self._pair(self.kernel_size)
+        f = self.n_out
+        k1, k2 = _split_key(key, 2)
+        b = jnp.zeros((4 * f,), self.dtype)
+        b = b.at[f:2 * f].set(self.forget_gate_bias)
+        params = {
+            "W": self._make_weight(k1, (kh, kw, c, 4 * f),
+                                   kh * kw * c, kh * kw * f),
+            "RW": self._make_weight(k2, (kh, kw, f, 4 * f),
+                                    kh * kw * f, kh * kw * f),
+        }
+        if self.has_bias:
+            params["b"] = b
+        ho, wo = self._out_hw(h, w)
+        out = (t, ho, wo, f) if self.return_sequences else (ho, wo, f)
+        return params, {}, out
+
+    def apply(self, params, state, x, ctx: Ctx):
+        x = self._cast_in(x)
+        bsz, t = x.shape[0], x.shape[1]
+        f = self.n_out
+        w = params["W"].astype(x.dtype)
+        pad = "SAME" if self.convolution_mode == "same" else "VALID"
+        # hoisted input conv over all timesteps at once
+        xw = lax.conv_general_dilated(
+            x.reshape((bsz * t,) + x.shape[2:]), w,
+            window_strides=self._pair(self.stride), padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            xw = xw + params["b"].astype(x.dtype)
+        ho, wo = xw.shape[1], xw.shape[2]
+        xw = xw.reshape(bsz, t, ho, wo, 4 * f)
+        rw = params["RW"].astype(x.dtype)
+        from .. import activations as _a
+        act, gate_act = self.activation_fn(), _a.get(self.gate_activation)
+        mask = ctx.mask
+
+        def cell(carry, xt):
+            h_prev, c_prev = carry
+            z = xt + lax.conv_general_dilated(
+                h_prev, rw, window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            i = gate_act(z[..., :f])
+            fg = gate_act(z[..., f:2 * f])
+            o = gate_act(z[..., 2 * f:3 * f])
+            g = act(z[..., 3 * f:])
+            c_new = fg * c_prev + i * g
+            h_new = o * act(c_new)
+            return h_new, c_new
+
+        def step(carry, inp):
+            xt, mt = inp
+            h_new, c_new = cell(carry, xt)
+            if mt is not None:
+                keep = mt[:, None, None, None] > 0
+                h_new = jnp.where(keep, h_new, carry[0])
+                c_new = jnp.where(keep, c_new, carry[1])
+            return (h_new, c_new), h_new
+
+        z0 = jnp.zeros((bsz, ho, wo, f), x.dtype)
+        xs = xw.swapaxes(0, 1)  # (T, B, H', W', 4F)
+        if mask is None:
+            (hT, _), hs = lax.scan(
+                lambda cr, xt: step(cr, (xt, None)), (z0, z0), xs)
+        else:
+            (hT, _), hs = lax.scan(step, (z0, z0), (xs, mask.swapaxes(0, 1)))
+        if not self.return_sequences:
+            return hT, state  # masked steps froze the state -> hT is last valid
+        y = hs.swapaxes(0, 1)
+        if mask is not None:
+            y = y * mask[:, :, None, None, None].astype(y.dtype)
+        return y, state

@@ -82,11 +82,11 @@ class MultiLayerNetwork:
         self._init_input_shape = shape      # for TransferLearningHelper et al
         for i, layer in enumerate(self.layers):
             # auto preprocessor: conv/rnn activations into a flat FF layer
-            if _is_ff_layer(layer) and len(shape) == 3:
+            if _is_ff_layer(layer) and len(shape) in (3, 4):  # cnn or cnn3d
                 pp = CnnToFeedForwardPreProcessor()
                 self._preprocessors[i] = pp
                 shape = pp.out_shape(shape)
-            if isinstance(unwrap(layer), OutputLayer) and not _is_rnn_layer(layer) and len(shape) == 3:
+            if isinstance(unwrap(layer), OutputLayer) and not _is_rnn_layer(layer) and len(shape) in (3, 4):
                 pp = CnnToFeedForwardPreProcessor()
                 self._preprocessors[i] = pp
                 shape = pp.out_shape(shape)

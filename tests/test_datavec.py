@@ -197,3 +197,48 @@ def test_jdbc_record_reader_sqlite(tmp_path):
     ds = next(iter(it))
     assert ds.features.shape == (5, 2) and ds.labels.shape == (5, 2)
     rr.close()
+
+
+class TestSVMLightRecordReader:
+    TEXT = ("1 1:0.5 3:2.0 # a comment\n"
+            "0 qid:7 2:-1.5\n"
+            "\n"
+            "2 1:1 2:2 4:4\n")
+
+    def test_parse_dense(self):
+        from deeplearning4j_tpu.data import SVMLightRecordReader
+        recs = list(SVMLightRecordReader(text=self.TEXT, num_features=4))
+        assert recs == [
+            [0.5, 0.0, 2.0, 0.0, 1],
+            [0.0, -1.5, 0.0, 0.0, 0],
+            [1.0, 2.0, 0.0, 4.0, 2],
+        ]
+
+    def test_zero_based_and_bounds(self):
+        from deeplearning4j_tpu.data import SVMLightRecordReader
+        recs = list(SVMLightRecordReader(text="3 0:1.5 2:9\n", num_features=3,
+                                         zero_based_indexing=True))
+        assert recs == [[1.5, 0.0, 9.0, 3]]
+        with pytest.raises(ValueError, match="outside"):
+            list(SVMLightRecordReader(text="1 4:1\n", num_features=3))
+        with pytest.raises(ValueError, match="num_features"):
+            SVMLightRecordReader(text="1 1:1\n")
+
+    def test_multilabel_and_float_labels(self):
+        from deeplearning4j_tpu.data import SVMLightRecordReader
+        recs = list(SVMLightRecordReader(text="1,3 1:2\n0.75 2:1\n",
+                                         num_features=2))
+        assert recs[0] == [2.0, 0.0, 1, 3]
+        assert recs[1] == [0.0, 1.0, 0.75]
+
+    def test_to_dataset_iterator(self):
+        from deeplearning4j_tpu.data import (RecordReaderDataSetIterator,
+                                             SVMLightRecordReader)
+        reader = SVMLightRecordReader(text=self.TEXT, num_features=4)
+        it = RecordReaderDataSetIterator(reader, batch_size=3, label_index=-1,
+                                         num_classes=3)
+        ds = next(iter(it))
+        assert ds.features.shape == (3, 4)
+        assert ds.labels.shape == (3, 3)
+        np.testing.assert_array_equal(np.argmax(np.asarray(ds.labels), 1),
+                                      [1, 0, 2])

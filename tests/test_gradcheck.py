@@ -13,8 +13,9 @@ import pytest
 
 from deeplearning4j_tpu.nn import (
     GRU, LSTM, RMSNorm, ActivationLayer, BatchNormalization, Bidirectional,
-    CapsuleLayer, CapsuleStrengthLayer, Convolution1DLayer,
-    Convolution3DLayer, ConvolutionLayer, Ctx, Deconvolution2D, DenseLayer,
+    CapsuleLayer, CapsuleStrengthLayer, ConvLSTM2D, Convolution1DLayer,
+    Convolution3DLayer, ConvolutionLayer, Ctx, Deconvolution2D,
+    Deconvolution3D, DenseLayer,
     DepthwiseConvolution2D, ElementWiseMultiplicationLayer, EmbeddingLayer,
     EmbeddingSequenceLayer, GlobalPoolingLayer, GravesBidirectionalLSTM,
     GravesLSTM, LastTimeStep, LayerNormalization, LearnedSelfAttentionLayer,
@@ -100,6 +101,12 @@ MATRIX = [
     ("deconv2d", lambda: Deconvolution2D(n_out=3, kernel_size=(3, 3),
                                          stride=(2, 2), activation="tanh"),
      (4, 4, 2), {}),
+    ("deconv3d", lambda: Deconvolution3D(n_out=2, kernel_size=(2, 2, 2),
+                                         stride=(2, 2, 2), activation="tanh"),
+     (3, 3, 3, 2), {}),
+    ("conv_lstm2d", lambda: ConvLSTM2D(n_out=2, kernel_size=(3, 3),
+                                       convolution_mode="same"),
+     (3, 4, 4, 2), {}),
     ("separable_conv", lambda: SeparableConvolution2D(
         n_out=4, kernel_size=(3, 3), convolution_mode="same",
         activation="tanh"), (5, 5, 3), {}),

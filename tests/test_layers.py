@@ -410,3 +410,48 @@ def test_graph_rnn_time_step_matches_full_forward():
         raise AssertionError("expected NotImplementedError")
     except NotImplementedError as e:
         assert "Bidirectional" in str(e)
+
+
+def test_convlstm_mln_trains_and_deconv3d_stack():
+    """ConvLSTM2D and Deconvolution3D work inside MultiLayerNetwork,
+    including the 4-D (cnn3d) auto-flatten into the output layer."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import (ConvLSTM2D, Deconvolution3D,
+                                       Convolution3DLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(ConvLSTM2D(n_out=4, kernel_size=(3, 3),
+                              return_sequences=False))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional_3d(5, 6, 6, 2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((8, 5, 6, 6, 2), np.float32))
+    y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+    l0 = net.fit(DataSet(x, y))
+    for _ in range(10):
+        l1 = net.fit(DataSet(x, y))
+    assert np.isfinite(l1) and l1 < l0
+    assert net.output(x).shape == (8, 3)
+
+    conf2 = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+             .list()
+             .layer(Convolution3DLayer(n_out=3, kernel_size=(3, 3, 3),
+                                       stride=(2, 2, 2),
+                                       convolution_mode="same",
+                                       activation="relu"))
+             .layer(Deconvolution3D(n_out=2, kernel_size=(2, 2, 2),
+                                    stride=(2, 2, 2)))
+             .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+             .set_input_type(InputType.convolutional_3d(4, 4, 4, 1))
+             .build())
+    net2 = MultiLayerNetwork(conf2).init()
+    x2 = jnp.asarray(rng.random((4, 4, 4, 4, 1), np.float32))
+    # deconv3d upsamples back: (2,2,2,3) -> (4,4,4,2) -> flatten 128 -> 2
+    assert net2.output(x2).shape == (4, 2)

@@ -775,3 +775,50 @@ def test_keras_import_dense_plus_activation_head_and_guards(tmp_path):
     m3.save(p3)
     with pytest.raises(NotImplementedError):
         import_keras_sequential(p3)
+
+
+def test_keras_import_conv3d_family(tmp_path):
+    """Conv3D / MaxPooling3D / Conv3DTranspose import numerics (upstream
+    KerasConvolution3D / KerasDeconvolution3D parity)."""
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((6, 6, 6, 2)),
+        keras.layers.Conv3D(4, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling3D(2),
+        keras.layers.Conv3DTranspose(3, 3, strides=2, padding="same"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    x = np.random.default_rng(11).random((2, 6, 6, 6, 2)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = tmp_path / "c3d.h5"
+    m.save(p)
+    from deeplearning4j_tpu.import_.keras import import_keras_sequential
+    net = import_keras_sequential(str(p))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_keras_import_convlstm2d(tmp_path):
+    """ConvLSTM2D import (upstream KerasConvLSTM2D parity): both
+    return_sequences modes, gate reorder [i,f,c,o] -> [i,f,o,g]."""
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    from deeplearning4j_tpu.import_.keras import import_keras_sequential
+    x = np.random.default_rng(12).random((2, 4, 6, 6, 3)).astype(np.float32)
+    for i, ret_seq in enumerate((False, True)):
+        layers = [
+            keras.layers.Input((4, 6, 6, 3)),
+            keras.layers.ConvLSTM2D(4, 3, padding="same",
+                                    return_sequences=ret_seq),
+        ]
+        layers += [keras.layers.Flatten(), keras.layers.Dense(3)]
+        m = keras.Sequential(layers)
+        want = m.predict(x, verbose=0)
+        p = tmp_path / f"clstm{i}.h5"
+        m.save(p)
+        net = import_keras_sequential(str(p))
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-4,
+                                   err_msg=f"return_sequences={ret_seq}")
