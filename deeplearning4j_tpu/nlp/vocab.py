@@ -82,6 +82,54 @@ class VocabCache:
             self._neg_table = (freqs / freqs.sum()).astype(np.float32)
         return self._neg_table
 
+    def huffman_tree(self):
+        """Frequency-Huffman coding of the vocab — parity with the tree the
+        reference's ``HierarchicSoftmax`` walks (upstream ``Huffman`` /
+        word2vec.c CreateBinaryTree). Returns padded device-ready arrays
+        ``(codes (V, L) int32 0/1, points (V, L) int32 inner-node ids,
+        mask (V, L) float32)`` where L is the longest code. Built with a
+        heap, so it does not require count-sorted indices (our index 0 is
+        UNK, out of frequency order)."""
+        import heapq
+        V = len(self.index_to_word)
+        if V < 2:
+            raise ValueError("hierarchical softmax needs a vocab of >= 2")
+        counts = [max(self.word_counts.get(w, 0), 1)
+                  for w in self.index_to_word]
+        heap = [(c, i) for i, c in enumerate(counts)]
+        heapq.heapify(heap)
+        parent: Dict[int, int] = {}
+        branch: Dict[int, int] = {}
+        nxt = V
+        while len(heap) > 1:
+            c1, i1 = heapq.heappop(heap)
+            c2, i2 = heapq.heappop(heap)
+            parent[i1], branch[i1] = nxt, 0
+            parent[i2], branch[i2] = nxt, 1
+            heapq.heappush(heap, (c1 + c2, nxt))
+            nxt += 1
+        root = heap[0][1]
+        codes, points = [], []
+        for wi in range(V):
+            code, pts = [], []
+            node = wi
+            while node != root:
+                code.append(branch[node])
+                pts.append(parent[node] - V)   # inner nodes 0..V-2
+                node = parent[node]
+            codes.append(code[::-1])           # root-first (canonical;
+            points.append(pts[::-1])           #  the HS loss sums the path)
+        L = max(len(c) for c in codes)
+        cd = np.zeros((V, L), np.int32)
+        pt = np.zeros((V, L), np.int32)
+        mk = np.zeros((V, L), np.float32)
+        for wi in range(V):
+            n = len(codes[wi])
+            cd[wi, :n] = codes[wi]
+            pt[wi, :n] = points[wi]
+            mk[wi, :n] = 1.0
+        return cd, pt, mk
+
     def subsample_keep_prob(self, t: float = 1e-3) -> np.ndarray:
         """Mikolov frequent-word subsampling: keep prob per word index."""
         if self._keep_prob is None:

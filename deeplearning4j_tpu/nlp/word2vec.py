@@ -1,16 +1,21 @@
 """Word2Vec + ParagraphVectors — parity with DL4J's
-``org.deeplearning4j.models.word2vec.Word2Vec`` (skip-gram, negative
-sampling, frequent-word subsampling, linear lr decay, wordsNearest /
-similarity surface) and ``org.deeplearning4j.models.paragraphvectors
-.ParagraphVectors`` (PV-DBOW + inferVector).
+``org.deeplearning4j.models.word2vec.Word2Vec`` (skip-gram AND CBOW
+elements learning — upstream ``learning.impl.elements.{SkipGram, CBOW}``;
+negative sampling AND hierarchical softmax outputs — upstream
+``HierarchicSoftmax``; frequent-word subsampling, linear lr decay,
+wordsNearest / similarity surface) and
+``org.deeplearning4j.models.paragraphvectors.ParagraphVectors``
+(PV-DBOW + PV-DM — upstream ``learning.impl.sequence.{DBOW, DM}`` — with
+inferVector for both).
 
 TPU-first redesign: the reference trains with per-pair Hogwild SGD
-across threads. Here a whole batch of (center, context) pairs is one
-jitted SGNS step — negatives are sampled *inside* jit from the
-unigram^0.75 distribution, the loss is
-``-logσ(u·v⁺) - Σ logσ(-u·v⁻)``, and XLA turns the embedding-gather
-gradients into scatter-adds. One program, MXU-friendly, no locks —
-the batch plays the role the reference's threads did.
+across threads. Here a whole batch of examples is one jitted step —
+negatives are sampled *inside* jit from the unigram^0.75 distribution
+(or the Huffman path is gathered for HS), the loss is
+``-logσ(u·v⁺) - Σ logσ(-u·v⁻)`` (NS) / the path-sigmoid sum (HS), and
+XLA turns the embedding-gather gradients into scatter-adds. One program,
+MXU-friendly, no locks — the batch plays the role the reference's
+threads did.
 """
 
 from __future__ import annotations
@@ -32,6 +37,15 @@ def _log_sigmoid(x):
     return -jax.nn.softplus(-x)
 
 
+def ns_loss_from_u(u, target, neg, syn1):
+    """Negative-sampling loss for predictor vectors u (B, D) against the
+    output table syn1: ``-logσ(u·v⁺) - Σ logσ(-u·v⁻)``, SUMMED over the
+    batch. The single objective body shared by skip-gram, CBOW and PV-DM."""
+    pos = jnp.einsum("bd,bd->b", u, syn1[target])
+    negs = jnp.einsum("bd,bkd->bk", u, syn1[neg])
+    return -(_log_sigmoid(pos).sum() + _log_sigmoid(-negs).sum())
+
+
 def sgns_loss(params, center, context, neg):
     """Skip-gram negative-sampling loss, SUMMED over the batch.
 
@@ -41,22 +55,33 @@ def sgns_loss(params, center, context, neg):
     batch step equivalent to the reference's B sequential per-pair SGD
     updates at the same learning rate (modulo within-batch staleness).
     """
-    u = params["syn0"][center]                    # (B, D)
-    v_pos = params["syn1"][context]               # (B, D)
-    v_neg = params["syn1"][neg]                   # (B, K, D)
-    pos = jnp.einsum("bd,bd->b", u, v_pos)
-    negs = jnp.einsum("bd,bkd->bk", u, v_neg)
-    return -(_log_sigmoid(pos).sum()
-             + _log_sigmoid(-negs).sum())
+    return ns_loss_from_u(params["syn0"][center], context, neg,
+                          params["syn1"])
+
+
+def hs_path_loss(u, codes, points, mask, syn1h):
+    """Hierarchical-softmax loss, SUMMED over the batch — the Huffman-path
+    walk of the reference's ``HierarchicSoftmax``: for predictor u (B, D)
+    and the target word's padded path (codes/points/mask (B, L)),
+    ``-Σ_l logσ((1 - 2·code_l)·(u · syn1h[point_l]))``."""
+    v = syn1h[points]                             # (B, L, D)
+    s = jnp.einsum("bd,bld->bl", u, v)
+    return -(_log_sigmoid((1.0 - 2.0 * codes) * s) * mask).sum()
 
 
 @dataclass
 class Word2Vec:
-    """Skip-gram/NS word embeddings with the reference's Builder knobs."""
+    """Word embeddings with the reference's Builder knobs.
+
+    ``elements_learning_algorithm``: "skipgram" (default) or "cbow" —
+    upstream ``elementsLearningAlgorithm(SkipGram/CBOW)``.
+    ``use_hierarchic_softmax``: Huffman-tree output layer instead of
+    negative sampling — upstream ``useHierarchicSoftmax(true)``.
+    """
 
     layer_size: int = 100            # reference layerSize
     window_size: int = 5
-    negative: int = 5                # negative samples per pair
+    negative: int = 5                # negative samples per pair (NS mode)
     min_word_frequency: int = 5
     learning_rate: float = 0.025
     min_learning_rate: float = 1e-4
@@ -64,6 +89,8 @@ class Word2Vec:
     batch_size: int = 2048
     epochs: int = 1
     seed: int = 42
+    elements_learning_algorithm: str = "skipgram"   # "skipgram" | "cbow"
+    use_hierarchic_softmax: bool = False
     tokenizer_factory: TokenizerFactory = field(default_factory=DefaultTokenizerFactory)
 
     vocab: Optional[VocabCache] = None
@@ -81,7 +108,14 @@ class Word2Vec:
         self.vocab = VocabCache(self.min_word_frequency).fit(tok)
         ids = [self.vocab.encode(t) for t in tok]
 
-        centers, contexts = self._build_pairs(ids)
+        cbow = self.elements_learning_algorithm.lower() == "cbow"
+        hs = self.use_hierarchic_softmax
+        if cbow:
+            centers, ctxs, cmask = self._build_cbow_examples(ids)
+            batch_arrays = (centers, ctxs, cmask)
+        else:
+            centers, contexts = self._build_pairs(ids)
+            batch_arrays = (centers, contexts)
         if len(centers) == 0:
             raise ValueError("no training pairs — corpus too small for vocab settings")
 
@@ -90,29 +124,63 @@ class Word2Vec:
         k0, key = jax.random.split(key)
         params = {
             "syn0": (jax.random.uniform(k0, (V, D), jnp.float32) - 0.5) / D,
-            "syn1": jnp.zeros((V, D), jnp.float32),
         }
+        if hs:
+            hcodes, hpoints, hmask = (jnp.asarray(a)
+                                      for a in self.vocab.huffman_tree())
+            params["syn1h"] = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+        else:
+            params["syn1"] = jnp.zeros((V, D), jnp.float32)
         neg_logits = jnp.log(jnp.asarray(self.vocab.negative_table()) + 1e-30)
 
+        def batch_loss(params, batch, neg):
+            if cbow:
+                tgt, ctx, cm = batch
+                # CBOW predictor: mean of the window's input vectors
+                # (upstream CBOW; word2vec.c cbow with mean)
+                u = ((params["syn0"][ctx] * cm[..., None]).sum(1)
+                     / jnp.maximum(cm.sum(1, keepdims=True), 1.0))
+            else:
+                ctr, tgt = batch
+                u = params["syn0"][ctr]
+            if hs:
+                return hs_path_loss(u, hcodes[tgt], hpoints[tgt],
+                                    hmask[tgt], params["syn1h"])
+            return ns_loss_from_u(u, tgt, neg, params["syn1"])
+
         @jax.jit
-        def step(params, key, center, context, lr):
+        def step(params, key, batch, lr):
+            B = batch[0].shape[0]
             nkey, key = jax.random.split(key)
-            neg = jax.random.categorical(
-                nkey, neg_logits[None, :], shape=(center.shape[0], self.negative))
-            loss, grads = jax.value_and_grad(sgns_loss)(params, center, context, neg)
+            neg = (None if hs else jax.random.categorical(
+                nkey, neg_logits[None, :], shape=(B, self.negative)))
+            loss, grads = jax.value_and_grad(batch_loss)(params, batch, neg)
             # Per-row occurrence normalisation: a row hit k times in the batch
             # takes the AVERAGE of its k per-pair gradients at full lr. With a
             # large vocab k≈1 and this is exactly the reference's per-pair
             # SGD; with heavy collisions it stays stable where a raw sum
             # diverges (the reference is safe only because it's sequential).
-            c0 = jnp.zeros(V).at[center].add(1.0)
-            c1 = (jnp.zeros(V).at[context].add(1.0)
-                  .at[neg.ravel()].add(1.0))
-            params = {
-                "syn0": params["syn0"] - lr * grads["syn0"] / jnp.maximum(c0, 1.0)[:, None],
-                "syn1": params["syn1"] - lr * grads["syn1"] / jnp.maximum(c1, 1.0)[:, None],
-            }
-            return params, key, loss / center.shape[0]
+            if cbow:
+                tgt, ctx, cm = batch
+                c0 = jnp.zeros(V).at[ctx.ravel()].add(cm.ravel())
+            else:
+                ctr, tgt = batch
+                c0 = jnp.zeros(V).at[ctr].add(1.0)
+            new = {"syn0": params["syn0"]
+                   - lr * grads["syn0"] / jnp.maximum(c0, 1.0)[:, None]}
+            if hs:
+                ch = (jnp.zeros(params["syn1h"].shape[0])
+                      .at[hpoints[tgt].ravel()].add(hmask[tgt].ravel()))
+                new["syn1h"] = (params["syn1h"] - lr * grads["syn1h"]
+                                / jnp.maximum(ch, 1.0)[:, None])
+            else:
+                c1 = jnp.zeros(V).at[tgt].add(1.0).at[neg.ravel()].add(1.0)
+                new["syn1"] = (params["syn1"] - lr * grads["syn1"]
+                               / jnp.maximum(c1, 1.0)[:, None])
+            return new, key, loss / B
+
+        def take(idx):
+            return tuple(jnp.asarray(a[idx]) for a in batch_arrays)
 
         n = len(centers)
         steps_total = max(1, self.epochs * ((n + self.batch_size - 1) // self.batch_size))
@@ -125,15 +193,12 @@ class Word2Vec:
                 frac = step_i / steps_total
                 lr = max(self.min_learning_rate,
                          self.learning_rate * (1.0 - frac))
-                params, key, last_loss = step(
-                    params, key, jnp.asarray(centers[idx]),
-                    jnp.asarray(contexts[idx]), lr)
+                params, key, last_loss = step(params, key, take(idx), lr)
                 step_i += 1
             if n < self.batch_size:   # tiny corpora: one padded batch per epoch
                 idx = rng.integers(0, n, size=self.batch_size)
                 params, key, last_loss = step(
-                    params, key, jnp.asarray(centers[idx]),
-                    jnp.asarray(contexts[idx]),
+                    params, key, take(idx),
                     max(self.min_learning_rate, self.learning_rate * (1 - step_i / steps_total)))
                 step_i += 1
         self.syn0 = np.asarray(params["syn0"])
@@ -157,6 +222,36 @@ class Word2Vec:
                         cs.append(sent[i])
                         xs.append(sent[j])
         return (np.asarray(cs, np.int32), np.asarray(xs, np.int32))
+
+    def _build_cbow_examples(self, ids: List[np.ndarray], rng=None):
+        """(center (N,), context (N, 2W) 0-padded, mask (N, 2W)) — one CBOW
+        example per position with a non-empty (shrinking) window. Pass a
+        shared ``rng`` when calling per-document (PV-DM) so window/subsample
+        draws stay independent across calls."""
+        if rng is None:
+            rng = np.random.default_rng(self.seed)
+        keep = self.vocab.subsample_keep_prob(self.subsample) if self.subsample else None
+        C = 2 * self.window_size
+        ctr, ctxs, masks = [], [], []
+        for sent in ids:
+            sent = sent[sent > 0]
+            if keep is not None and len(sent):
+                sent = sent[rng.random(len(sent)) < keep[sent]]
+            L = len(sent)
+            for i in range(L):
+                b = rng.integers(1, self.window_size + 1)
+                win = [int(sent[j]) for j in
+                       range(max(0, i - b), min(L, i + b + 1)) if j != i]
+                if not win:
+                    continue
+                pad = C - len(win)
+                ctr.append(sent[i])
+                ctxs.append(win + [0] * pad)
+                masks.append([1.0] * len(win) + [0.0] * pad)
+        # empty result keeps rank 2 so per-doc results concatenate (PV-DM)
+        return (np.asarray(ctr, np.int32),
+                np.asarray(ctxs, np.int32).reshape(-1, C),
+                np.asarray(masks, np.float32).reshape(-1, C))
 
     # -------------------------------------------------------------- queries
     def get_word_vector(self, word: str) -> np.ndarray:
@@ -332,14 +427,21 @@ class Word2Vec:
 
 @dataclass
 class ParagraphVectors(Word2Vec):
-    """PV-DBOW: a document-embedding table trained to predict the words of
-    its document via negative sampling (reference ParagraphVectors with
-    ``sequenceLearningAlgorithm = DBOW``). ``infer_vector`` gradient-descends
-    a fresh doc vector with the word tables frozen.
+    """Document embeddings — reference ParagraphVectors with
+    ``sequence_learning_algorithm`` "dbow" (PV-DBOW, default: the doc
+    vector alone predicts each of its words) or "dm" (PV-DM, upstream
+    ``learning.impl.sequence.DM``: the doc vector is averaged with the
+    context window to predict the center word). ``infer_vector``
+    gradient-descends a fresh doc vector with the word tables frozen,
+    using the matching objective.
     """
 
+    sequence_learning_algorithm: str = "dbow"    # "dbow" | "dm"
     doc_vectors: Optional[np.ndarray] = None
     _labels: List[str] = field(default_factory=list)
+
+    def _is_dm(self):
+        return self.sequence_learning_algorithm.lower() == "dm"
 
     def fit(self, documents: Sequence[str], labels: Optional[Sequence[str]] = None):
         docs = list(documents)
@@ -354,33 +456,60 @@ class ParagraphVectors(Word2Vec):
         syn1 = jnp.asarray(self.syn0)  # predict into trained word space
         neg_logits = jnp.log(jnp.asarray(self.vocab.negative_table()) + 1e-30)
 
-        doc_idx, word_idx = [], []
-        for di, sent in enumerate(ids):
-            for w in sent[sent > 0]:
-                doc_idx.append(di)
-                word_idx.append(w)
-        doc_idx = np.asarray(doc_idx, np.int32)
-        word_idx = np.asarray(word_idx, np.int32)
+        if self._is_dm():
+            ex_rng = np.random.default_rng(self.seed)
+            d_list, tgt_list, ctx_list, cm_list = [], [], [], []
+            for di, sent in enumerate(ids):
+                tgt, ctx, cm = self._build_cbow_examples([sent], rng=ex_rng)
+                d_list.append(np.full(len(tgt), di, np.int32))
+                tgt_list.append(tgt)
+                ctx_list.append(ctx)
+                cm_list.append(cm)
+            doc_idx = np.concatenate(d_list)
+            word_idx = np.concatenate(tgt_list)
+            ctx_idx = np.concatenate(ctx_list)
+            ctx_mask = np.concatenate(cm_list)
+            arrays = (doc_idx, word_idx, ctx_idx, ctx_mask)
 
-        def loss_fn(dvec, d, w, neg):
-            return sgns_loss({"syn0": dvec, "syn1": syn1}, d, w, neg)
+            def loss_fn(dvec, batch, neg):
+                d, w, ctx, cm = batch
+                # PV-DM predictor: mean over [doc vector, window vectors];
+                # syn1 doubles as the frozen word-input table (same array)
+                u = ((dvec[d] + (syn1[ctx] * cm[..., None]).sum(1))
+                     / (1.0 + cm.sum(1, keepdims=True)))
+                return ns_loss_from_u(u, w, neg, syn1)
+        else:
+            doc_idx, word_idx = [], []
+            for di, sent in enumerate(ids):
+                for w in sent[sent > 0]:
+                    doc_idx.append(di)
+                    word_idx.append(w)
+            doc_idx = np.asarray(doc_idx, np.int32)
+            word_idx = np.asarray(word_idx, np.int32)
+            arrays = (doc_idx, word_idx)
+
+            def loss_fn(dvec, batch, neg):
+                d, w = batch
+                return sgns_loss({"syn0": dvec, "syn1": syn1}, d, w, neg)
 
         @jax.jit
-        def step(dvec, key, d, w, lr):
+        def step(dvec, key, batch, lr):
             nkey, key = jax.random.split(key)
             neg = jax.random.categorical(nkey, neg_logits[None, :],
-                                         shape=(d.shape[0], self.negative))
-            loss, g = jax.value_and_grad(loss_fn)(dvec, d, w, neg)
-            cnt = jnp.zeros(Nd).at[d].add(1.0)
+                                         shape=(batch[0].shape[0], self.negative))
+            loss, g = jax.value_and_grad(loss_fn)(dvec, batch, neg)
+            cnt = jnp.zeros(Nd).at[batch[0]].add(1.0)
             return dvec - lr * g / jnp.maximum(cnt, 1.0)[:, None], key, loss
 
         rng = np.random.default_rng(self.seed)
         n = len(doc_idx)
-        bs = min(self.batch_size, max(n, 1))
-        for e in range(max(self.epochs, 5)):
-            idx = rng.integers(0, n, size=bs)
-            dvec, key, _ = step(dvec, key, jnp.asarray(doc_idx[idx]),
-                                jnp.asarray(word_idx[idx]), self.learning_rate)
+        if n:
+            bs = min(self.batch_size, max(n, 1))
+            for e in range(max(self.epochs, 5)):
+                idx = rng.integers(0, n, size=bs)
+                dvec, key, _ = step(
+                    dvec, key, tuple(jnp.asarray(a[idx]) for a in arrays),
+                    self.learning_rate)
         self.doc_vectors = np.asarray(dvec)
         return self
 
@@ -394,11 +523,27 @@ class ParagraphVectors(Word2Vec):
             return np.zeros(self.layer_size, np.float32)
         syn1 = jnp.asarray(self.syn0)
         neg_logits = jnp.log(jnp.asarray(self.vocab.negative_table()) + 1e-30)
-        w = jnp.asarray(ids)
-        d = jnp.zeros((len(ids),), jnp.int32)
+        if self._is_dm():
+            tgt, ctx, cm = self._build_cbow_examples([ids])
+            if len(tgt) == 0:   # single-word text: no window -> DBOW objective
+                tgt = ids
+                ctx = np.zeros((len(ids), 2 * self.window_size), np.int32)
+                cm = np.zeros_like(ctx, np.float32)
+            w = jnp.asarray(tgt)
+            ctx_j, cm_j = jnp.asarray(ctx), jnp.asarray(cm)
 
-        def loss_fn(v, neg):
-            return sgns_loss({"syn0": v[None, :], "syn1": syn1}, d, w, neg)
+            def loss_fn(v, neg):
+                u = ((v[None, :] + (syn1[ctx_j] * cm_j[..., None]).sum(1))
+                     / (1.0 + cm_j.sum(1, keepdims=True)))
+                return ns_loss_from_u(u, w, neg, syn1)
+        else:
+            w = jnp.asarray(ids)
+            d = jnp.zeros((len(ids),), jnp.int32)
+
+            def loss_fn(v, neg):
+                return sgns_loss({"syn0": v[None, :], "syn1": syn1}, d, w, neg)
+
+        B = int(w.shape[0])
 
         @jax.jit
         def run(v, key):
@@ -406,9 +551,9 @@ class ParagraphVectors(Word2Vec):
                 v, key = carry
                 nkey, key = jax.random.split(key)
                 neg = jax.random.categorical(nkey, neg_logits[None, :],
-                                             shape=(len(ids), self.negative))
+                                             shape=(B, self.negative))
                 g = jax.grad(loss_fn)(v, neg)
-                return (v - lr * g / len(ids), key), None
+                return (v - lr * g / B, key), None
             (v, _), _ = jax.lax.scan(body, (v, key), None, length=steps)
             return v
 
