@@ -580,7 +580,41 @@ HANDLERS: Dict[str, Any] = {
                                    x, axis=axis, keepdims=keepdims)),
     "IsNaN": lambda i, n: jnp.isnan(i[0]),
     "IsInf": lambda i, n: jnp.isinf(i[0]),
+    # --- opset-17/18 long tail (r3: rides the new sd_ops registry entries)
+    "DFT": lambda i, n: _onnx_dft(i, n),
+    "Shrink": lambda i, n: jnp.where(
+        i[0] > n.af("lambd", 0.5), i[0] - n.af("bias", 0.0),
+        jnp.where(i[0] < -n.af("lambd", 0.5), i[0] + n.af("bias", 0.0), 0.0)),
+    "ThresholdedRelu": lambda i, n: jnp.where(
+        i[0] > n.af("alpha", 1.0), i[0], 0.0),
+    "MeanVarianceNormalization": lambda i, n: (
+        (i[0] - jnp.mean(i[0], tuple(n.aints("axes", (0, 2, 3))),
+                         keepdims=True))
+        / jnp.sqrt(jnp.var(i[0], tuple(n.aints("axes", (0, 2, 3))),
+                           keepdims=True) + 1e-9)),
+    "Det": lambda i, n: jnp.linalg.det(i[0]),
 }
+
+
+def _onnx_dft(i, n):
+    """ONNX DFT (opset 17 attrs): input (..., 1|2) with trailing real/imag
+    dim, optional dft_length input; axis/inverse/onesided attributes.
+    Output keeps the trailing complex-pair dim."""
+    x = i[0]
+    axis = n.ai("axis", 1)
+    dft_len = (None if len(i) < 2 or i[1] is None
+               else int(_static(i[1]).item()))
+    if x.shape[-1] == 2:
+        xc = lax.complex(x[..., 0], x[..., 1])
+    else:
+        xc = x[..., 0].astype(jnp.complex64)
+    if n.ai("inverse", 0):
+        y = jnp.fft.ifft(xc, n=dft_len, axis=axis)
+    elif n.ai("onesided", 0):
+        y = jnp.fft.rfft(jnp.real(xc), n=dft_len, axis=axis)
+    else:
+        y = jnp.fft.fft(xc, n=dft_len, axis=axis)
+    return jnp.stack([jnp.real(y), jnp.imag(y)], axis=-1)
 
 
 def _onnx_cumsum(x, axis, exclusive, reverse):

@@ -307,6 +307,7 @@ class SameDiff:
         self.cnn = _Namespace(self, sd_ops.CNN, "cnn")
         self.rnn = _Namespace(self, sd_ops.RNN, "rnn")
         self.image = _Namespace(self, sd_ops.IMAGE, "image")
+        self.fft = _Namespace(self, sd_ops.FFT, "fft")
         self._training_config: Optional[TrainingConfig] = None
         self._loss_vars: List[str] = []
         self._opt_state = None

@@ -223,14 +223,18 @@ class Word2Vec:
                         xs.append(sent[j])
         return (np.asarray(cs, np.int32), np.asarray(xs, np.int32))
 
-    def _build_cbow_examples(self, ids: List[np.ndarray], rng=None):
+    def _build_cbow_examples(self, ids: List[np.ndarray], rng=None,
+                             subsample=None):
         """(center (N,), context (N, 2W) 0-padded, mask (N, 2W)) — one CBOW
         example per position with a non-empty (shrinking) window. Pass a
         shared ``rng`` when calling per-document (PV-DM) so window/subsample
-        draws stay independent across calls."""
+        draws stay independent across calls; ``subsample=0`` disables
+        frequent-word dropping (inference must see the full query)."""
         if rng is None:
             rng = np.random.default_rng(self.seed)
-        keep = self.vocab.subsample_keep_prob(self.subsample) if self.subsample else None
+        if subsample is None:
+            subsample = self.subsample
+        keep = self.vocab.subsample_keep_prob(subsample) if subsample else None
         C = 2 * self.window_size
         ctr, ctxs, masks = [], [], []
         for sent in ids:
@@ -524,7 +528,9 @@ class ParagraphVectors(Word2Vec):
         syn1 = jnp.asarray(self.syn0)
         neg_logits = jnp.log(jnp.asarray(self.vocab.negative_table()) + 1e-30)
         if self._is_dm():
-            tgt, ctx, cm = self._build_cbow_examples([ids])
+            # no subsampling at inference: upstream inferVector sees the
+            # full query, as does our DBOW branch below
+            tgt, ctx, cm = self._build_cbow_examples([ids], subsample=0)
             if len(tgt) == 0:   # single-word text: no window -> DBOW objective
                 tgt = ids
                 ctx = np.zeros((len(ids), 2 * self.window_size), np.int32)

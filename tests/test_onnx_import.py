@@ -268,3 +268,66 @@ def test_scatter_gather_nd_handlers():
     out = _onnx_scatter_nd(data, jnp.asarray([[1]]),
                            jnp.asarray([[9.0, 9, 9, 9]]))
     np.testing.assert_allclose(np.asarray(out)[1], [9, 9, 9, 9])
+
+
+class _StubNode:
+    """Minimal OnnxNode stand-in for driving HANDLERS directly."""
+
+    def __init__(self, **attrs):
+        self._a = attrs
+
+    def ai(self, name, default=0):
+        return self._a.get(name, default)
+
+    def af(self, name, default=0.0):
+        return self._a.get(name, default)
+
+    def aints(self, name, default=()):
+        return list(self._a.get(name, default))
+
+    def astr(self, name, default=""):
+        return self._a.get(name, default)
+
+
+def test_onnx_opset17_handlers_vs_numpy():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff.onnx_import import HANDLERS
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 8)).astype(np.float32)
+
+    # DFT forward: real input with trailing dim 1, axis=1
+    out = np.asarray(HANDLERS["DFT"]([jnp.asarray(x[..., None])],
+                                     _StubNode(axis=1)))
+    want = np.fft.fft(x, axis=1)
+    np.testing.assert_allclose(out[..., 0], want.real, atol=1e-4)
+    np.testing.assert_allclose(out[..., 1], want.imag, atol=1e-4)
+    # DFT inverse round-trip through the complex-pair layout
+    inv = np.asarray(HANDLERS["DFT"]([jnp.asarray(out)],
+                                     _StubNode(axis=1, inverse=1)))
+    np.testing.assert_allclose(inv[..., 0], x, atol=1e-4)
+    # onesided
+    one = np.asarray(HANDLERS["DFT"]([jnp.asarray(x[..., None])],
+                                     _StubNode(axis=1, onesided=1)))
+    np.testing.assert_allclose(one[..., 0], np.fft.rfft(x, axis=1).real,
+                               atol=1e-4)
+
+    shr = np.asarray(HANDLERS["Shrink"]([jnp.asarray(x)],
+                                        _StubNode(lambd=0.5, bias=0.1)))
+    want_shr = np.where(x > 0.5, x - 0.1, np.where(x < -0.5, x + 0.1, 0.0))
+    np.testing.assert_allclose(shr, want_shr, atol=1e-6)
+
+    tr = np.asarray(HANDLERS["ThresholdedRelu"]([jnp.asarray(x)],
+                                                _StubNode(alpha=0.3)))
+    np.testing.assert_allclose(tr, np.where(x > 0.3, x, 0.0), atol=1e-6)
+
+    img = rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+    mvn = np.asarray(HANDLERS["MeanVarianceNormalization"](
+        [jnp.asarray(img)], _StubNode()))
+    want_mvn = (img - img.mean((0, 2, 3), keepdims=True)) / np.sqrt(
+        img.var((0, 2, 3), keepdims=True) + 1e-9)
+    np.testing.assert_allclose(mvn, want_mvn, atol=1e-5)
+
+    sq = rng.standard_normal((3, 3)).astype(np.float32) + 2 * np.eye(3,
+                                                                     dtype=np.float32)
+    det = np.asarray(HANDLERS["Det"]([jnp.asarray(sq)], _StubNode()))
+    np.testing.assert_allclose(det, np.linalg.det(sq), rtol=1e-4)

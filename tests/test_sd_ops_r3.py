@@ -1,0 +1,413 @@
+"""Round-3 SameDiff registry widening vs numpy/scipy oracles (VERDICT r2
+item 3): the sd.fft spectral namespace plus the base/math/linalg/nn/cnn/
+image/random/loss/bitwise long tail. Same harness as test_sd_ops.py —
+every case drives the REAL namespace dispatch (sd.<ns>.<op> -> graph node
+-> eval) against an independent numpy/scipy oracle.
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+import scipy.special as sps
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff import sd_ops
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+R = np.random.default_rng(1)
+A = R.standard_normal((4, 5)).astype(np.float32)
+B = R.standard_normal((4, 5)).astype(np.float32)
+V = R.standard_normal(8).astype(np.float32)
+PV = np.abs(R.standard_normal(8)).astype(np.float32) + 0.5
+SQ = (R.standard_normal((4, 4)) + 4 * np.eye(4)).astype(np.float32)
+SPD = (SQ @ SQ.T + np.eye(4)).astype(np.float32)
+IMG = R.random((2, 6, 6, 3)).astype(np.float32)
+INTS = np.arange(1, 13, dtype=np.int32).reshape(3, 4)
+NANV = np.array([1.0, np.nan, 3.0, 2.0], np.float32)
+CPLX = (V[:4] + 1j * V[4:]).astype(np.complex64)
+
+CASES = [
+    # ---- fft: full spectral family vs np.fft
+    ("fft", "fft", (V,), {}, lambda: np.fft.fft(V)),
+    ("fft", "ifft", (CPLX,), {}, lambda: np.fft.ifft(CPLX)),
+    ("fft", "rfft", (V,), {}, lambda: np.fft.rfft(V)),
+    ("fft", "rfft", (V, 16), {}, lambda: np.fft.rfft(V, 16)),
+    ("fft", "irfft", (np.fft.rfft(V),), {}, lambda: np.fft.irfft(np.fft.rfft(V))),
+    ("fft", "hfft", (CPLX,), {}, lambda: np.fft.hfft(CPLX)),
+    ("fft", "ihfft", (V,), {}, lambda: np.fft.ihfft(V)),
+    ("fft", "fft2", (A,), {}, lambda: np.fft.fft2(A)),
+    ("fft", "ifft2", (A.astype(np.complex64),), {}, lambda: np.fft.ifft2(A)),
+    ("fft", "rfft2", (A,), {}, lambda: np.fft.rfft2(A)),
+    ("fft", "irfft2", (np.fft.rfft2(A),), {},
+     lambda: np.fft.irfft2(np.fft.rfft2(A))),
+    ("fft", "fftn", (A,), {}, lambda: np.fft.fftn(A)),
+    ("fft", "ifftn", (A.astype(np.complex64),), {}, lambda: np.fft.ifftn(A)),
+    ("fft", "rfftn", (A,), {}, lambda: np.fft.rfftn(A)),
+    ("fft", "irfftn", (np.fft.rfftn(A),), {},
+     lambda: np.fft.irfftn(np.fft.rfftn(A))),
+    ("fft", "fftshift", (V,), {}, lambda: np.fft.fftshift(V)),
+    ("fft", "ifftshift", (np.fft.fftshift(V),), {}, lambda: V),
+    ("fft", "fftfreq", (8,), {"d": 0.5}, lambda: np.fft.fftfreq(8, 0.5)),
+    ("fft", "rfftfreq", (8,), {"d": 0.5}, lambda: np.fft.rfftfreq(8, 0.5)),
+    # math exposes the 1-D pair directly (upstream SDMath.fft)
+    ("math", "fft", (V,), {}, lambda: np.fft.fft(V)),
+    ("math", "irfft", (np.fft.rfft(V),), {},
+     lambda: np.fft.irfft(np.fft.rfft(V))),
+    # ---- math: complex surface
+    ("math", "real", (CPLX,), {}, lambda: CPLX.real),
+    ("math", "imag", (CPLX,), {}, lambda: CPLX.imag),
+    ("math", "conj", (CPLX,), {}, lambda: CPLX.conj()),
+    ("math", "angle", (CPLX,), {}, lambda: np.angle(CPLX)),
+    ("math", "complex", (V[:4], V[4:]), {}, lambda: CPLX),
+    ("math", "complex_abs", (CPLX,), {}, lambda: np.abs(CPLX)),
+    # ---- math: signal-adjacent
+    ("math", "unwrap", (V * 3,), {}, lambda: np.unwrap(V * 3)),
+    ("math", "convolve", (V, V[:3]), {}, lambda: np.convolve(V, V[:3])),
+    ("math", "correlate", (V, V[:3]), {}, lambda: np.correlate(V, V[:3], "full")),
+    ("math", "trapz", (A,), {}, lambda: np.trapezoid(A, axis=-1)),
+    # ---- math: elementwise long tail
+    ("math", "sinc", (V,), {}, lambda: np.sinc(V)),
+    ("math", "signbit", (V,), {}, lambda: np.signbit(V)),
+    ("math", "nextafter", (V, np.float32(np.inf)), {},
+     lambda: np.nextafter(V, np.inf)),
+    ("math", "fabs", (V,), {}, lambda: np.fabs(V)),
+    ("math", "gcd", (INTS, np.int32(6)), {}, lambda: np.gcd(INTS, 6)),
+    ("math", "lcm", (INTS, np.int32(4)), {}, lambda: np.lcm(INTS, 4)),
+    ("math", "fmax", (NANV, np.float32(1.5)), {}, lambda: np.fmax(NANV, 1.5)),
+    ("math", "fmin", (NANV, np.float32(1.5)), {}, lambda: np.fmin(NANV, 1.5)),
+    ("math", "float_power", (PV, np.float32(2.5)), {},
+     lambda: np.float_power(PV, 2.5).astype(np.float32)),
+    ("math", "cummax", (A,), {"axis": 1},
+     lambda: np.maximum.accumulate(A, 1)),
+    ("math", "cummin", (A,), {"axis": 0},
+     lambda: np.minimum.accumulate(A, 0)),
+    ("math", "relative_error", (A, B), {},
+     lambda: np.abs(A - B) / np.maximum(np.maximum(np.abs(A), np.abs(B)),
+                                        1e-12)),
+    ("math", "polyval", ((1.0, -2.0, 3.0), V), {},
+     lambda: np.polyval([1.0, -2.0, 3.0], V)),
+    ("math", "ediff1d", (A,), {}, lambda: np.ediff1d(A)),
+    # ---- math: special functions vs scipy
+    ("math", "i0", (V,), {}, lambda: sps.i0(V)),
+    ("math", "i0e", (V,), {}, lambda: sps.i0e(V)),
+    ("math", "i1", (V,), {}, lambda: sps.i1(V)),
+    ("math", "i1e", (V,), {}, lambda: sps.i1e(V)),
+    ("math", "betaln", (PV, PV[::-1].copy()), {},
+     lambda: sps.betaln(PV, PV[::-1])),
+    ("math", "gamma_fn", (PV,), {}, lambda: sps.gamma(PV)),
+    ("math", "factorial", (np.arange(6, dtype=np.float32),), {},
+     lambda: sps.factorial(np.arange(6))),
+    ("math", "ndtr", (V,), {}, lambda: sps.ndtr(V)),
+    ("math", "ndtri", (np.clip(PV / 3, 0.05, 0.95),), {},
+     lambda: sps.ndtri(np.clip(PV / 3, 0.05, 0.95))),
+    ("math", "log_ndtr", (V,), {}, lambda: sps.log_ndtr(V)),
+    ("math", "rel_entr", (PV, PV[::-1].copy()), {},
+     lambda: sps.rel_entr(PV, PV[::-1])),
+    ("math", "kl_div_elem", (PV, PV[::-1].copy()), {},
+     lambda: sps.kl_div(PV, PV[::-1])),
+    ("math", "spence", (PV,), {}, lambda: sps.spence(PV.astype(np.float64))),
+    # ---- base: nan-aware reductions / order statistics
+    ("base", "nanmax", (NANV,), {}, lambda: np.nanmax(NANV)),
+    ("base", "nanmin", (NANV,), {}, lambda: np.nanmin(NANV)),
+    ("base", "nansum", (NANV,), {}, lambda: np.nansum(NANV)),
+    ("base", "nanmean", (NANV,), {}, lambda: np.nanmean(NANV)),
+    ("base", "nanstd", (NANV,), {}, lambda: np.nanstd(NANV)),
+    ("base", "nanvar", (NANV,), {}, lambda: np.nanvar(NANV)),
+    ("base", "percentile", (A, 30.0), {}, lambda: np.percentile(A, 30)),
+    ("base", "quantile", (A, 0.3), {"axis": 1},
+     lambda: np.quantile(A, 0.3, axis=1)),
+    ("base", "median", (A,), {"axis": 0}, lambda: np.median(A, 0)),
+    ("base", "ptp", (A,), {}, lambda: np.ptp(A)),
+    ("base", "average", (A,), {"weights": PV[:4], "axis": 0},
+     lambda: np.average(A, 0, PV[:4])),
+    ("base", "histogram_fixed_width", (V, (-2.0, 2.0), 5), {},
+     lambda: np.histogram(np.clip(V, -2, 2 - 1e-6), 5, (-2.0, 2.0))[0]),
+    ("base", "digitize", (V, (-1.0, 0.0, 1.0)), {},
+     lambda: np.digitize(V, [-1.0, 0.0, 1.0])),
+    # ---- base: stacking / shaping
+    ("base", "hstack", (A, B), {}, lambda: np.hstack([A, B])),
+    ("base", "vstack", (A, B), {}, lambda: np.vstack([A, B])),
+    ("base", "dstack", (A, B), {}, lambda: np.dstack([A, B])),
+    ("base", "column_stack", (V, V), {}, lambda: np.column_stack([V, V])),
+    ("base", "atleast_1d", (np.float32(3.0),), {},
+     lambda: np.atleast_1d(np.float32(3.0))),
+    ("base", "atleast_3d", (A,), {}, lambda: np.atleast_3d(A)),
+    ("base", "eye_like", (SQ,), {}, lambda: np.eye(4, dtype=np.float32)),
+    ("base", "take", (V, (0, 3, 5)), {}, lambda: V[[0, 3, 5]]),
+    ("base", "isin", (INTS, (2, 5, 9)), {},
+     lambda: np.isin(INTS, [2, 5, 9])),
+    ("base", "matrix_set_diag", (SQ, V[:4]), {},
+     lambda: SQ * (1 - np.eye(4)) + np.diag(V[:4])),
+    # ---- linalg
+    ("linalg", "block_diag", (SQ, A), {}, lambda: sla.block_diag(SQ, A)),
+    ("linalg", "toeplitz", (V,), {}, lambda: sla.toeplitz(V)),
+    ("linalg", "sqrtm", (SPD,), {}, lambda: sla.sqrtm(SPD).real),
+    ("linalg", "cho_solve", (np.linalg.cholesky(SPD), V[:4]), {},
+     lambda: np.linalg.solve(SPD, V[:4])),
+    ("linalg", "lu_solve", (SPD, V[:4]), {},
+     lambda: np.linalg.solve(SPD, V[:4])),
+    ("linalg", "multi_dot", (A, A.T @ A, A.T), {},
+     lambda: A @ (A.T @ A) @ A.T),
+    ("linalg", "cond", (SPD,), {}, lambda: np.linalg.cond(SPD)),
+    ("linalg", "svdvals", (A,), {},
+     lambda: np.linalg.svd(A, compute_uv=False)),
+    ("linalg", "norm_nuclear", (A,), {},
+     lambda: np.linalg.svd(A, compute_uv=False).sum()),
+    ("linalg", "vander", (V[:4],), {}, lambda: np.vander(V[:4])),
+    # ---- nn
+    ("nn", "gelu_tanh", (V,), {},
+     lambda: 0.5 * V * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                    * (V + 0.044715 * V ** 3)))),
+    ("nn", "gelu_exact", (V,), {}, lambda: V * sps.ndtr(V)),
+    ("nn", "hard_shrink", (V, 0.5), {},
+     lambda: np.where(np.abs(V) > 0.5, V, 0.0)),
+    ("nn", "soft_shrink", (V, 0.5), {},
+     lambda: np.sign(V) * np.maximum(np.abs(V) - 0.5, 0.0)),
+    ("nn", "tanh_shrink", (V,), {}, lambda: V - np.tanh(V)),
+    ("nn", "threshold", (V, 0.0, -7.0), {},
+     lambda: np.where(V > 0, V, -7.0)),
+    ("nn", "lp_normalize", (A,), {"p": 3},
+     lambda: A / (np.abs(A) ** 3).sum(-1, keepdims=True) ** (1 / 3)),
+    ("nn", "pairwise_distance", (A, B), {},
+     lambda: (np.abs(A - B + 1e-6) ** 2).sum(-1) ** 0.5),
+    # ---- image
+    ("image", "adjust_gamma", (IMG,), {"gamma": 2.0}, lambda: IMG ** 2.0),
+    ("image", "grayscale_to_rgb", (IMG[..., :1],), {},
+     lambda: np.repeat(IMG[..., :1], 3, -1)),
+    ("image", "rgb_to_bgr", (IMG,), {}, lambda: IMG[..., ::-1]),
+    ("image", "total_variation", (IMG,), {},
+     lambda: (np.abs(np.diff(IMG, axis=1)).sum((1, 2, 3))
+              + np.abs(np.diff(IMG, axis=2)).sum((1, 2, 3)))),
+    ("image", "crop_to_bounding_box", (IMG, 1, 2, 3, 4), {},
+     lambda: IMG[:, 1:4, 2:6, :]),
+    ("image", "pad_to_bounding_box", (IMG, 1, 0, 8, 7), {},
+     lambda: np.pad(IMG, ((0, 0), (1, 1), (0, 1), (0, 0)))),
+    # ---- loss (hand oracles)
+    ("loss", "dice_loss", (PV / 2, PV[::-1].copy() / 2), {},
+     lambda: 1 - (2 * (PV / 2 * PV[::-1] / 2).sum() + 1e-7)
+     / ((PV / 2).sum() + (PV[::-1] / 2).sum() + 1e-7)),
+    ("loss", "log_cosh_loss", (A, B), {},
+     lambda: np.mean(np.log(np.cosh(B - A)))),
+    ("loss", "quantile_loss", (A, B), {"q": 0.7},
+     lambda: np.mean(np.maximum(0.7 * (A - B), -0.3 * (A - B)))),
+    ("loss", "margin_ranking_loss",
+     (V[:4], V[4:], np.array([1.0, -1, 1, -1], np.float32)), {},
+     lambda: np.mean(np.maximum(
+         0, -np.array([1.0, -1, 1, -1]) * (V[:4] - V[4:])))),
+    # ---- bitwise
+    ("bitwise", "set_bit", (INTS, 1), {}, lambda: INTS | 2),
+    ("bitwise", "clear_bit", (INTS, 0), {}, lambda: INTS & ~1),
+    ("bitwise", "toggle_bit", (INTS, 0), {}, lambda: INTS ^ 1),
+    ("bitwise", "test_bit", (INTS, 1), {}, lambda: (INTS >> 1) % 2 == 1),
+]
+
+
+@pytest.mark.parametrize("ns,op,args,kwargs,oracle",
+                         CASES, ids=[f"{c[0]}.{c[1]}_{i}"
+                                     for i, c in enumerate(CASES)])
+def test_r3_op_vs_oracle(ns, op, args, kwargs, oracle):
+    sd = SameDiff.create()
+    out = getattr(getattr(sd, ns), op)(*args, **kwargs)
+    got = np.asarray(out.eval())
+    want = np.asarray(oracle())
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-5)
+
+
+def test_fft_roundtrip_and_grad():
+    """irfft(rfft(x)) == x, and gradients flow through the spectral ops
+    (rfft is R->C; jax needs the loss real — use power spectrum)."""
+    x = jnp.asarray(V)
+    back = sd_ops.FFT["irfft"](sd_ops.FFT["rfft"](x), V.size)
+    np.testing.assert_allclose(np.asarray(back), V, atol=1e-5)
+
+    def power(x):
+        return jnp.sum(jnp.abs(sd_ops.FFT["rfft"](x)) ** 2)
+
+    g = jax.grad(power)(x)
+    # Parseval: d/dx sum|X|^2 = 2*N'*x-ish; just require finite, nonzero
+    assert np.isfinite(np.asarray(g)).all() and float(jnp.abs(g).sum()) > 0
+
+
+def test_multi_output_r3_ops():
+    # divmod / modf return tuples
+    q, r = sd_ops.MATH_EXT["divmod"](jnp.asarray([7.0, -7.0]),
+                                     jnp.asarray([3.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(q), [2.0, -3.0])
+    np.testing.assert_allclose(np.asarray(r), [1.0, 2.0])
+    frac, whole = sd_ops.MATH_EXT["modf"](jnp.asarray([2.5, -1.25]))
+    np.testing.assert_allclose(np.asarray(frac), [0.5, -0.25])
+    dy, dx = sd_ops.IMAGE["image_gradients"](jnp.asarray(IMG))
+    np.testing.assert_allclose(np.asarray(dy)[:, :-1],
+                               np.diff(IMG, axis=1), atol=1e-6)
+    assert np.allclose(np.asarray(dy)[:, -1], 0)
+    ti, tj = sd_ops.BASE["tril_indices"](4)
+    np.testing.assert_array_equal(np.asarray(ti), np.tril_indices(4)[0])
+    # select
+    out = sd_ops.MATH_EXT["select"](
+        (jnp.asarray(V) > 1, jnp.asarray(V) < -1),
+        (jnp.ones_like(jnp.asarray(V)), -jnp.ones_like(jnp.asarray(V))),
+        0.0)
+    np.testing.assert_allclose(
+        np.asarray(out), np.select([V > 1, V < -1], [np.ones(8), -np.ones(8)]))
+
+
+def test_base_indexing_r3_ops():
+    # nonzero (static size, -1 padded)
+    nz = sd_ops.BASE["nonzero"](jnp.asarray([0.0, 3.0, 0.0, 5.0]), 4)
+    np.testing.assert_array_equal(np.asarray(nz), [1, 3, -1, -1])
+    # batch_gather: per-batch single index and (B, K) multi-index
+    x = jnp.asarray(A)
+    idx = jnp.asarray([0, 2, 1, 4])
+    got = sd_ops.BASE["batch_gather"](x, idx)
+    np.testing.assert_allclose(np.asarray(got), A[np.arange(4), [0, 2, 1, 4]])
+    idx2 = np.asarray([[0, 1], [2, 3], [4, 0], [1, 2]])
+    got2 = sd_ops.BASE["batch_gather"](x, jnp.asarray(idx2))
+    np.testing.assert_allclose(np.asarray(got2),
+                               A[np.arange(4)[:, None], idx2])
+    # scatter_nd family onto an existing tensor
+    ref = jnp.zeros((3, 3))
+    ind = jnp.asarray([[0, 1], [2, 2]])
+    upd = jnp.asarray([5.0, 7.0])
+    add = sd_ops.BASE["scatter_nd_add"](ref + 1, ind, upd)
+    assert float(add[0, 1]) == 6.0 and float(add[2, 2]) == 8.0
+    sub = sd_ops.BASE["scatter_nd_sub"](ref, ind, upd)
+    assert float(sub[0, 1]) == -5.0
+    upd2 = sd_ops.BASE["scatter_nd_update"](ref + 1, ind, upd)
+    assert float(upd2[0, 1]) == 5.0 and float(upd2[0, 0]) == 1.0
+    # split_sizes
+    parts = sd_ops.BASE["split_sizes"](jnp.asarray(V), (3, 2, 3))
+    assert [p.shape[0] for p in parts] == [3, 2, 3]
+    np.testing.assert_allclose(np.concatenate([np.asarray(p) for p in parts]), V)
+
+
+def test_linalg_factor_r3_ops():
+    c = sd_ops.LINALG["cho_factor"](jnp.asarray(SPD))
+    assert np.isfinite(np.asarray(c)).all()
+    # lu_factor returns (LU, piv); with the pivots the factorization must
+    # reconstruct a row-permuted matrix (review finding, r3: [0] alone lost
+    # the permutation)
+    perm_mat = np.array([[0, 1.0], [1.0, 0]], np.float32)
+    lu, piv = sd_ops.LINALG["lu_factor"](jnp.asarray(perm_mat))
+    import scipy.linalg as _sla
+    np.testing.assert_allclose(
+        _sla.lu_solve((np.asarray(lu), np.asarray(piv)), np.ones(2)),
+        np.linalg.solve(perm_mat, np.ones(2)), atol=1e-5)
+    kr = sd_ops.LINALG["khatri_rao"](jnp.asarray(A[:2]), jnp.asarray(B[:3]))
+    assert kr.shape == (6, 5)
+    np.testing.assert_allclose(np.asarray(kr)[0], A[0] * B[0], rtol=1e-5)
+
+
+def test_cnn_r3_ops():
+    x = jnp.asarray(R.random((1, 4, 4, 2)).astype(np.float32))
+    vals, idx = sd_ops.CNN["max_pool_with_argmax"](x, 2)
+    np.testing.assert_allclose(
+        np.asarray(vals),
+        np.asarray(x).reshape(1, 2, 2, 2, 2, 2).transpose(
+            0, 1, 3, 5, 2, 4).reshape(1, 2, 2, 2, 4).max(-1), atol=1e-6)
+    assert idx.shape == (1, 2, 2, 2) and int(idx.max()) <= 3
+    lp = sd_ops.CNN["lp_pool2d"](x, 2, p=2.0)
+    manual = (np.asarray(x).reshape(1, 2, 2, 2, 2, 2).transpose(
+        0, 1, 3, 5, 2, 4).reshape(1, 2, 2, 2, 4) ** 2).sum(-1) ** 0.5
+    np.testing.assert_allclose(np.asarray(lp), manual, rtol=1e-5)
+    # pixel shuffle/unshuffle round-trip
+    y = jnp.asarray(R.random((1, 2, 2, 8)).astype(np.float32))
+    ps = sd_ops.CNN["pixel_shuffle"](y, 2)
+    assert ps.shape == (1, 4, 4, 2)
+    back = sd_ops.CNN["pixel_unshuffle"](ps, 2)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(y))
+    up1 = sd_ops.CNN["upsampling1d"](jnp.asarray(A)[None], 2)
+    assert up1.shape == (1, 8, 5)
+    v3 = jnp.asarray(R.random((1, 2, 2, 2, 1)).astype(np.float32))
+    up3 = sd_ops.CNN["upsampling3d"](v3, 2)
+    assert up3.shape == (1, 4, 4, 4, 1)
+    # transposed convs invert stride-2 downsampling shapes
+    w1 = jnp.asarray(R.random((3, 2, 4)).astype(np.float32))
+    d1 = sd_ops.CNN["deconv1d"](jnp.asarray(R.random((1, 5, 2)),
+                                            jnp.float32), w1)
+    assert d1.shape == (1, 10, 4)
+    w3 = jnp.asarray(R.random((2, 2, 2, 1, 3)).astype(np.float32))
+    d3 = sd_ops.CNN["deconv3d"](v3, w3)
+    assert d3.shape == (1, 4, 4, 4, 3)
+
+
+def test_image_sobel_matches_scipy():
+    from scipy.ndimage import convolve as ndconv
+    g = sd_ops.IMAGE["sobel_edges"](jnp.asarray(IMG[:1, :, :, :1]))
+    ky = np.array([[-1, -2, -1], [0, 0, 0], [1, 2, 1]], np.float32)
+    want_dy = ndconv(IMG[0, :, :, 0], ky[::-1, ::-1], mode="nearest")
+    got_dy = np.asarray(g)[0, :, :, 0, 0]
+    # interior pixels must match exactly; borders differ by pad mode choice
+    np.testing.assert_allclose(got_dy[1:-1, 1:-1], want_dy[1:-1, 1:-1],
+                               atol=1e-5)
+
+
+def test_random_r3_distributions():
+    key = jax.random.PRNGKey(0)
+    d = sd_ops.RANDOM["dirichlet"](key, np.ones(4, np.float32), (500,))
+    np.testing.assert_allclose(np.asarray(d).sum(-1), np.ones(500), atol=1e-5)
+    mvn = sd_ops.RANDOM["multivariate_normal"](
+        key, jnp.zeros(3), jnp.eye(3), (2000,))
+    assert abs(float(mvn.mean())) < 0.1
+    t = sd_ops.RANDOM["student_t"](key, 5.0, (100,))
+    assert t.shape == (100,)
+    chi = sd_ops.RANDOM["chisquare"](key, 3.0, (4000,))
+    assert abs(float(chi.mean()) - 3.0) < 0.3
+    ray = sd_ops.RANDOM["rayleigh"](key, 2.0, (100,))
+    assert float(ray.min()) >= 0
+    rad = np.asarray(sd_ops.RANDOM["rademacher"](key, (1000,)))
+    assert set(np.unique(rad)) <= {-1, 1}
+    geo = sd_ops.RANDOM["geometric"](key, 0.5, (100,))
+    assert float(geo.min()) >= 1
+    par = sd_ops.RANDOM["pareto"](key, 3.0, (100,))
+    assert float(par.min()) >= 1.0 - 1e-6
+    lo = sd_ops.RANDOM["logistic"](key, (100,))
+    assert lo.shape == (100,)
+
+
+def test_nn_dropout_r3_ops():
+    key = jax.random.PRNGKey(3)
+    x = jnp.ones((4, 6, 5))
+    sp = np.asarray(sd_ops.NN_EXT["spatial_dropout_train"](key, x, 0.5))
+    # whole channels are dropped or kept together
+    per_channel = sp.reshape(4, 6, 5).transpose(0, 2, 1)
+    for b in range(4):
+        for c in range(5):
+            vals = np.unique(per_channel[b, c])
+            assert len(vals) == 1
+    ad = np.asarray(sd_ops.NN_EXT["alpha_dropout_train"](
+        jax.random.PRNGKey(0), jnp.asarray(R.standard_normal(20000),
+                                           jnp.float32), 0.3))
+    # alpha dropout approximately preserves zero mean / unit variance
+    assert abs(ad.mean()) < 0.05 and abs(ad.std() - 1.0) < 0.1
+    gs = sd_ops.NN_EXT["gumbel_softmax"](key, jnp.asarray(A), tau=0.5)
+    np.testing.assert_allclose(np.asarray(gs).sum(-1), np.ones(4), atol=1e-5)
+    sw = sd_ops.NN_EXT["swiglu"](jnp.asarray(A[:, :4]))
+    a, b = A[:, :2], A[:, 2:4]
+    np.testing.assert_allclose(np.asarray(sw), (a / (1 + np.exp(-a))) * b,
+                               rtol=1e-5)
+
+
+def test_loss_triplet_cosine_r3():
+    anchor, pos, neg = (jnp.asarray(R.standard_normal((6, 4)), jnp.float32)
+                        for _ in range(3))
+    tl = float(sd_ops.LOSS_EXT["triplet_margin_loss"](anchor, pos, neg))
+    an, po, ne = (np.asarray(v) for v in (anchor, pos, neg))
+    want = np.mean(np.maximum(
+        np.linalg.norm(an - po, axis=-1)
+        - np.linalg.norm(an - ne, axis=-1) + 1.0, 0))
+    np.testing.assert_allclose(tl, want, rtol=1e-5)
+    y = jnp.asarray([1.0, -1.0, 1.0, -1.0, 1.0, -1.0])
+    cl = float(sd_ops.LOSS_EXT["cosine_embedding_loss"](anchor, pos, y))
+    assert np.isfinite(cl)
+
+
+def test_registry_count_target():
+    """VERDICT r2 item 3 gate: >= 450 effective ops (registry + samediff
+    core tables)."""
+    from deeplearning4j_tpu.autodiff.samediff import _LOSS, _MATH, _NN
+    total = sd_ops.op_count() + len(_MATH) + len(_NN) + len(_LOSS)
+    assert sd_ops.op_count() >= 450, sd_ops.op_count()
+    assert total >= 500, total
+    assert "fft" in sd_ops.NAMESPACES and len(sd_ops.NAMESPACES["fft"]) >= 18
