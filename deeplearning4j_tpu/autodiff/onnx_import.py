@@ -602,6 +602,10 @@ def _onnx_dft(i, n):
     Output keeps the trailing complex-pair dim."""
     x = i[0]
     axis = n.ai("axis", 1)
+    if axis < 0:
+        # ONNX axis is relative to the FULL rank including the trailing
+        # real/imag dim, which the complex view below drops
+        axis += x.ndim
     dft_len = (None if len(i) < 2 or i[1] is None
                else int(_static(i[1]).item()))
     if x.shape[-1] == 2:

@@ -1102,10 +1102,12 @@ def _nonzero(x, size):
 
 
 def _matrix_set_diag(x, diag):
-    """Replace the main diagonal of the last two (square) dims with
-    ``diag`` (tf.linalg.set_diag / upstream MatrixSetDiag)."""
-    eye = jnp.eye(x.shape[-2], x.shape[-1], dtype=x.dtype)
-    return x * (1 - eye) + jnp.asarray(diag)[..., None, :] * eye
+    """Replace the main diagonal of the last two dims with ``diag`` of
+    length min(m, n) (tf.linalg.set_diag / upstream MatrixSetDiag);
+    rectangular matrices supported."""
+    m, nn = x.shape[-2], x.shape[-1]
+    k = jnp.arange(min(m, nn))
+    return jnp.asarray(x).at[..., k, k].set(jnp.asarray(diag))
 
 
 def _scatter_nd_onto(op):

@@ -8,6 +8,12 @@ from .mesh import (MeshSpec, batch_sharding, bootstrap_distributed,
                    shard_params_fsdp)
 from .pipeline import (make_pipeline_loss, make_pipeline_train_step,
                        place_params_for_pipeline)
+from .pipeline_generic import (make_mln_pipeline_loss,
+                               make_mln_pipeline_train_step, microbatches,
+                               partition_layers)
+from .tp import (ColumnParallelDense, ColumnParallelOutputLayer,
+                 RowParallelDense, ShardedSelfAttention,
+                 network_param_shardings)
 from .ring_attention import (ring_attention, ring_attention_inner,
                              ring_attention_sharded)
 from .param_avg import ParameterAveragingTrainer
@@ -21,4 +27,8 @@ __all__ = [
     "place_params_for_pipeline", "ring_attention", "ring_attention_inner",
     "ring_attention_sharded", "ParallelInference", "ParallelWrapper",
     "ParameterAveragingTrainer",
+    "ColumnParallelDense", "ColumnParallelOutputLayer", "RowParallelDense",
+    "ShardedSelfAttention", "network_param_shardings",
+    "make_mln_pipeline_loss", "make_mln_pipeline_train_step",
+    "microbatches", "partition_layers",
 ]

@@ -38,7 +38,20 @@ class ParallelWrapper:
         self._rep = NamedSharding(self.mesh, P())
         batch_axes = tuple(a for a in ("dp", "fsdp") if a in self.mesh.axis_names)
         self._batch_sh = NamedSharding(self.mesh, P(batch_axes or None))
-        if self.use_fsdp:
+        if "tp" in self.mesh.axis_names:
+            # tensor parallel: layers that declare param_pspecs (tp.py's
+            # Column/RowParallelDense, ShardedSelfAttention) get their
+            # Megatron sharding; GSPMD inserts the psums when the step
+            # compiles. With use_fsdp, params the tp resolver left
+            # replicated get the fsdp layout instead (the two compose).
+            from .tp import network_param_shardings
+            self._param_sh = network_param_shardings(self.mesh, net)
+            if self.use_fsdp:
+                fsdp_sh = shard_params_fsdp(self.mesh, net.params)
+                self._param_sh = jax.tree_util.tree_map(
+                    lambda t, f: f if t.spec == P() else t,
+                    self._param_sh, fsdp_sh)
+        elif self.use_fsdp:
             self._param_sh = shard_params_fsdp(self.mesh, net.params)
         else:
             self._param_sh = jax.tree_util.tree_map(lambda _: self._rep, net.params)
@@ -150,8 +163,29 @@ class ParallelInference:
         self._rep = NamedSharding(self.mesh, P())
         batch_axes = tuple(a for a in ("dp",) if a in self.mesh.axis_names)
         self._batch_sh = NamedSharding(self.mesh, P(batch_axes or None))
+        # Keep a LOCAL placed copy of params/states on THIS mesh: a net
+        # trained under a different mesh (e.g. dp×tp ParallelWrapper) hands
+        # us arrays from a foreign mesh, and mutating the net would break
+        # the trainer's compiled step. Layers that declare tp pspecs stay
+        # sharded when this mesh has a tp axis; everything else (including
+        # tp shards when the axis is absent) gathers to replicated.
+        from .tp import network_param_shardings
+        self._param_sh = network_param_shardings(self.mesh, net)
+        self._params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, s), net.params, self._param_sh)
+        self._states = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._rep), net.states)
         self._infer = None
         self._pending = []
+
+    def refresh(self):
+        """Re-copy the net's current params (e.g. after more training)."""
+        self._params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, s), self.net.params,
+            self._param_sh)
+        self._states = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._rep), self.net.states)
+        return self
 
     def _build(self):
         net = self.net
@@ -161,8 +195,8 @@ class ParallelInference:
             return y
 
         self._infer = jax.jit(infer, in_shardings=(
-            jax.tree_util.tree_map(lambda _: self._rep, net.params),
-            jax.tree_util.tree_map(lambda _: self._rep, net.states),
+            self._param_sh,
+            jax.tree_util.tree_map(lambda _: self._rep, self._states),
             self._batch_sh))
         return self._infer
 
@@ -173,7 +207,7 @@ class ParallelInference:
         orig = x.shape[0]
         if orig % n:
             x = np.concatenate([x, np.repeat(x[-1:], n - orig % n, 0)])
-        out = fn(self.net.params, self.net.states, jnp.asarray(x))
+        out = fn(self._params, self._states, jnp.asarray(x))
         return np.asarray(out)[:orig]
 
     def submit(self, x):

@@ -331,3 +331,16 @@ def test_onnx_opset17_handlers_vs_numpy():
                                                                      dtype=np.float32)
     det = np.asarray(HANDLERS["Det"]([jnp.asarray(sq)], _StubNode()))
     np.testing.assert_allclose(det, np.linalg.det(sq), rtol=1e-4)
+
+
+def test_onnx_dft_negative_axis():
+    """ONNX DFT axis counts the trailing real/imag dim (review finding,
+    r3): axis=-2 on (B, T, 1) input means the T axis."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff.onnx_import import HANDLERS
+    x = np.random.default_rng(1).standard_normal((2, 8)).astype(np.float32)
+    out = np.asarray(HANDLERS["DFT"]([jnp.asarray(x[..., None])],
+                                     _StubNode(axis=-2)))
+    want = np.fft.fft(x, axis=1)
+    np.testing.assert_allclose(out[..., 0], want.real, atol=1e-4)
+    np.testing.assert_allclose(out[..., 1], want.imag, atol=1e-4)

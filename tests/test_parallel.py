@@ -278,3 +278,227 @@ def test_parameter_averaging_respects_label_masks():
     w_m = np.asarray(net_m.params["layer_1"]["W"])
     w_u = np.asarray(net_u.params["layer_1"]["W"])
     assert not np.allclose(w_m, w_u), "labels mask was silently dropped"
+
+
+# ------------------------------------------------- r3: generic tp / pp ----
+def _tp_mlp(cls1, cls2, seed=7):
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .list()
+            .layer(cls1(n_in=32, n_out=64, activation="relu"))
+            .layer(cls2(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init((32,))
+
+
+def test_tp_mln_matches_single_device(devices8):
+    """VERDICT r2 item 4: Column/RowParallelDense in a user-built MLN under
+    dp2 x tp2 track the single-device trajectory exactly, with W actually
+    tp-sharded."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import DenseLayer
+    from deeplearning4j_tpu.parallel import (ColumnParallelDense,
+                                             ParallelWrapper,
+                                             RowParallelDense, make_mesh)
+
+    rng = np.random.default_rng(0)
+    X = rng.random((64, 32), np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    ds = DataSet(jnp.asarray(X), jnp.asarray(Y))
+
+    net1 = _tp_mlp(DenseLayer, DenseLayer)
+    losses1 = [net1.fit(ds) for _ in range(5)]
+
+    net2 = _tp_mlp(ColumnParallelDense, RowParallelDense)
+    pw = ParallelWrapper(net2, mesh=make_mesh(jax.devices()[:4], dp=2, tp=2))
+    losses2 = [pw.fit([ds]) for _ in range(5)]
+    np.testing.assert_allclose(losses1, losses2, atol=1e-5)
+    spec = net2.params["layer_0"]["W"].sharding.spec
+    assert tuple(spec) == (None, "tp"), spec
+    spec1 = net2.params["layer_1"]["W"].sharding.spec
+    assert spec1 and spec1[0] == "tp", spec1  # jax drops trailing Nones
+
+
+def test_tp_computation_graph_matches_single_device(devices8):
+    """A ComputationGraph MLP under dp2 x tp2: network_param_shardings
+    resolves node-keyed params; the jitted loss matches single-device."""
+    from deeplearning4j_tpu.nn.computation_graph import (
+        ComputationGraphConfiguration)
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration, OutputLayer
+    from deeplearning4j_tpu.parallel import (ColumnParallelDense,
+                                             RowParallelDense, make_mesh,
+                                             network_param_shardings)
+    from deeplearning4j_tpu.train import Adam
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    g = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3))
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("h1", ColumnParallelDense(n_in=16, n_out=32,
+                                              activation="relu"), "in")
+         .add_layer("h2", RowParallelDense(n_out=16, activation="relu"), "h1")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "h2")
+         .set_outputs("out")
+         .build())
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+    net = ComputationGraph(g).init([(16,)])
+
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.random((32, 16), np.float32))
+    Y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)])
+    inputs = {"in": X}
+    labels = {"out": Y}
+    ref = float(net._loss(net.params, net.states, inputs, labels,
+                          None, None, None)[0])
+
+    mesh = make_mesh(jax.devices()[:4], dp=2, tp=2)
+    shardings = network_param_shardings(mesh, net)
+    assert tuple(shardings["h1"]["W"].spec) == (None, "tp")
+    assert tuple(shardings["h2"]["W"].spec) == ("tp", None)
+    params = jax.tree_util.tree_map(jax.device_put, net.params, shardings)
+    batch_sh = NamedSharding(mesh, P("dp"))
+    X_sh = jax.device_put(X, batch_sh)
+    Y_sh = jax.device_put(Y, batch_sh)
+
+    @jax.jit
+    def loss_fn(params, x, y):
+        return net._loss(params, net.states, {"in": x}, {"out": y},
+                         None, None, None)[0]
+
+    got = float(loss_fn(params, X_sh, Y_sh))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    # gradients flow and stay sharded
+    g2 = jax.jit(jax.grad(loss_fn))(params, X_sh, Y_sh)
+    assert np.isfinite(float(jnp.abs(g2["h1"]["W"]).sum()))
+
+
+def test_tp_sharded_attention_compiles(devices8):
+    """ShardedSelfAttention (Megatron head sharding) runs under tp2 and
+    matches the unsharded layer's output."""
+    from deeplearning4j_tpu.nn import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.base import Ctx
+    from deeplearning4j_tpu.parallel import ShardedSelfAttention, make_mesh
+    from deeplearning4j_tpu.parallel.tp import layer_param_shardings
+
+    layer = ShardedSelfAttention(n_in=16, n_out=16, n_heads=4)
+    params, state, _ = layer.init(jax.random.PRNGKey(0), (6, 16))
+    x = jnp.asarray(np.random.default_rng(0).random((4, 6, 16), np.float32))
+    ref, _ = SelfAttentionLayer.apply(layer, params, state, x, Ctx())
+
+    mesh = make_mesh(jax.devices()[:2], tp=2)
+    sh = layer_param_shardings(mesh, layer, params)
+    assert tuple(sh["Wq"].spec) == (None, "tp")
+    p_sh = jax.tree_util.tree_map(jax.device_put, params, sh)
+    got, _ = jax.jit(lambda p, x: layer.apply(p, state, x, Ctx()))(p_sh, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def _pp_mlp():
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_in=16, n_out=48, activation="relu"))
+            .layer(DenseLayer(n_out=24, activation="relu"))
+            .layer(DenseLayer(n_out=24, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init((16,))
+
+
+def test_generic_pipeline_partitioner_balance():
+    from deeplearning4j_tpu.parallel import partition_layers
+    net = _pp_mlp()
+    stages = partition_layers(net, 2)
+    assert [i for s in stages for i in s] == [0, 1, 2, 3]
+    assert all(s for s in stages)
+    with pytest.raises(ValueError):
+        partition_layers(net, 9)
+
+
+def test_generic_pipeline_loss_matches_single_device(devices8):
+    """VERDICT r2 item 4: the generic MLN pipeline (pp2, and pp2 x dp2)
+    reproduces the single-device loss exactly and trains."""
+    from deeplearning4j_tpu.parallel import (make_mln_pipeline_loss,
+                                             make_mln_pipeline_train_step,
+                                             make_mesh, microbatches)
+
+    net = _pp_mlp()
+    rng = np.random.default_rng(0)
+    X = rng.random((32, 16), np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+    x_mb, y_mb = microbatches(X, Y, 8)
+    ref = np.mean([float(net._loss(net.params, net.states,
+                                   jnp.asarray(x_mb[i]), jnp.asarray(y_mb[i]),
+                                   None, None, None)[0]) for i in range(4)])
+
+    mesh = make_mesh(jax.devices()[:2], pp=2)
+    loss_fn = make_mln_pipeline_loss(mesh, net, microbatch=8)
+    pl = float(loss_fn(net.params, jnp.asarray(x_mb), jnp.asarray(y_mb)))
+    np.testing.assert_allclose(pl, ref, atol=1e-5)
+
+    mesh4 = make_mesh(jax.devices()[:4], pp=2, dp=2)
+    loss4 = make_mln_pipeline_loss(mesh4, net, microbatch=8)
+    pl4 = float(loss4(net.params, jnp.asarray(x_mb), jnp.asarray(y_mb)))
+    np.testing.assert_allclose(pl4, ref, atol=1e-5)
+
+    opt = optax.adam(1e-2)
+    step = make_mln_pipeline_train_step(mesh, net, opt, microbatch=8)
+    p, o = jax.tree_util.tree_map(jnp.copy, net.params), opt.init(net.params)
+    first = last = None
+    for _ in range(10):
+        p, o, l = step(p, o, jnp.asarray(x_mb), jnp.asarray(y_mb))
+        first = first if first is not None else float(l)
+        last = float(l)
+    assert last < first
+
+
+def test_generic_pipeline_rejects_stateful_layers(devices8):
+    from deeplearning4j_tpu.nn import (BatchNormalization, DenseLayer,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.parallel import make_mln_pipeline_loss, make_mesh
+    from deeplearning4j_tpu.train import Adam
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=8, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init((8,))
+    with pytest.raises(ValueError, match="stateless"):
+        make_mln_pipeline_loss(make_mesh(jax.devices()[:2], pp=2), net, 4)
+
+
+def test_parallel_inference_does_not_mutate_net(devices8):
+    """ParallelInference must not re-place the trainer's arrays (review
+    finding, r3): a ParallelWrapper compiled on one mesh keeps working
+    after a ParallelInference is built on another."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import DenseLayer
+    from deeplearning4j_tpu.parallel import (ColumnParallelDense,
+                                             ParallelInference,
+                                             ParallelWrapper,
+                                             RowParallelDense, make_mesh)
+
+    net = _tp_mlp(ColumnParallelDense, RowParallelDense)
+    pw = ParallelWrapper(net, mesh=make_mesh(jax.devices()[:4], dp=2, tp=2))
+    rng = np.random.default_rng(0)
+    X = rng.random((16, 32), np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    ds = DataSet(jnp.asarray(X), jnp.asarray(Y))
+    pw.fit([ds])
+    pi = ParallelInference(net, mesh=make_mesh(jax.devices()[4:8], dp=4))
+    out = pi.output(X[:5])
+    assert out.shape == (5, 4)
+    # trainer still works on its own mesh after inference construction
+    loss = pw.fit([ds])
+    assert np.isfinite(loss)
+    # refresh picks up newly trained params
+    out2 = pi.refresh().output(X[:5])
+    assert np.isfinite(out2).all()

@@ -411,3 +411,18 @@ def test_registry_count_target():
     assert sd_ops.op_count() >= 450, sd_ops.op_count()
     assert total >= 500, total
     assert "fft" in sd_ops.NAMESPACES and len(sd_ops.NAMESPACES["fft"]) >= 18
+
+
+def test_matrix_set_diag_rectangular():
+    """Rectangular support (review finding, r3): diag length min(m, n)."""
+    x = jnp.ones((3, 5))
+    d = jnp.asarray([7.0, 8.0, 9.0])
+    out = np.asarray(sd_ops.BASE["matrix_set_diag"](x, d))
+    want = np.ones((3, 5), np.float32)
+    want[np.arange(3), np.arange(3)] = [7, 8, 9]
+    np.testing.assert_allclose(out, want)
+    # batched square still works
+    xb = jnp.zeros((2, 4, 4))
+    db = jnp.asarray(np.arange(8, dtype=np.float32).reshape(2, 4))
+    outb = np.asarray(sd_ops.BASE["matrix_set_diag"](xb, db))
+    np.testing.assert_allclose(outb[1].diagonal(), [4, 5, 6, 7])
