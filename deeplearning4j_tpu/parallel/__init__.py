@@ -3,6 +3,8 @@ one mesh + named shardings + XLA collectives instead of replicated workers
 over NCCL/Aeron. See SURVEY.md §2.8."""
 
 from .grad_sharing import AdaptiveThreshold, GradientSharingAccumulator
+from .transport import (DistributedGradientWorker, GradientExchangeServer,
+                        SocketGradientTransport)
 from .mesh import (MeshSpec, batch_sharding, bootstrap_distributed,
                    data_parallel_mesh, hybrid_mesh_2d, make_mesh, replicated,
                    shard_params_fsdp)
@@ -31,4 +33,6 @@ __all__ = [
     "ShardedSelfAttention", "network_param_shardings",
     "make_mln_pipeline_loss", "make_mln_pipeline_train_step",
     "microbatches", "partition_layers",
+    "DistributedGradientWorker", "GradientExchangeServer",
+    "SocketGradientTransport",
 ]

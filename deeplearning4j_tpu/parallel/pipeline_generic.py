@@ -84,7 +84,7 @@ def _boundary_shapes(net, stages, batch: int):
                     break
                 if i in net._preprocessors:
                     h = net._preprocessors[i](h)
-                h, _ = layer.apply(net.params[f"layer_{i}"], {}, h,
+                h, _ = layer.apply(params[f"layer_{i}"], {}, h,
                                    Ctx(train=True, rng=None))
             return h
         return f

@@ -63,11 +63,22 @@ class ColumnParallelOutputLayer(OutputLayer):
 class ShardedSelfAttention(SelfAttentionLayer):
     """Multi-head attention with Megatron head sharding: Q/K/V projections
     column-parallel (heads split over 'tp'), output projection
-    row-parallel. Requires n_heads % tp == 0 for an even head split."""
+    row-parallel. Requires n_heads % tp == 0 for an even head split —
+    enforced at sharding resolution (``validate_tp``), since the mesh
+    isn't known at construction."""
 
     def param_pspecs(self):
         return {"Wq": P(None, "tp"), "Wk": P(None, "tp"),
                 "Wv": P(None, "tp"), "Wo": P("tp", None)}
+
+    def validate_tp(self, mesh: Mesh):
+        tp = mesh.shape.get("tp", 1)
+        if tp > 1 and self.n_heads % tp:
+            raise ValueError(
+                f"ShardedSelfAttention needs n_heads ({self.n_heads}) "
+                f"divisible by tp ({tp}); an uneven split cuts through a "
+                "head and forces cross-device resharding in every "
+                "attention reshape")
 
 
 def _resolve_spec(mesh: Mesh, spec):
@@ -80,6 +91,9 @@ def layer_param_shardings(mesh: Mesh, layer, params):
     """Sharding pytree for ONE layer's params: declared pspecs where the
     shapes divide, replicated otherwise."""
     specs = getattr(layer, "param_pspecs", lambda: {})() or {}
+    validate = getattr(layer, "validate_tp", None)
+    if validate is not None:
+        validate(mesh)
     rep = NamedSharding(mesh, P())
 
     def sh(key, leaf):

@@ -1,0 +1,221 @@
+"""Socket transport for threshold-encoded gradient sharing — the process-
+boundary half of the reference's gradient-sharing regime.
+
+Reference parity: ``org.deeplearning4j.optimize.solvers.accumulation.
+EncodedGradientsAccumulator`` + Aeron UDP transport (deeplearning4j-
+scaleout ``gradientsharing``): workers exchange ±threshold sparse token
+streams over the wire, every worker applies the same decoded aggregate,
+residual error feedback keeps the compression lossless over time. (Here
+``DistributedGradientWorker.step`` returns the decoded MEAN — divide-by-
+workers — so the learning rate keeps its single-worker meaning; the
+upstream accumulator applies the raw sum and expects lr scaled
+accordingly.)
+
+TPU-first positioning (same as grad_sharing.py): within a pod, dense psum
+over ICI always wins — this transport is for the slow-interconnect regime
+(DCN between pods, host federation) the reference built Aeron for. Design:
+a tiny hub (``GradientExchangeServer``) stands in for Aeron multicast —
+each round it gathers one length-prefixed frame per worker and broadcasts
+the full set back. Frames carry the SENDER's threshold so adaptive
+thresholds may drift per worker without corrupting decode. TCP and Unix
+domain sockets supported (``address=("127.0.0.1", port)`` or a filesystem
+path).
+
+Wire format per frame:
+  uint32  payload byte length (tokens only)
+  float32 sender threshold
+  int64[] tokens (threshold_encode output)
+Broadcast reply: uint32 worker count, then the workers' frames in order.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..utils.native import threshold_decode, threshold_encode
+from .grad_sharing import AdaptiveThreshold
+
+Address = Union[str, Tuple[str, int]]
+
+_HDR = struct.Struct("<If")  # payload bytes, sender threshold
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("gradient peer closed the connection")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(conn: socket.socket) -> Tuple[np.ndarray, float]:
+    nbytes, threshold = _HDR.unpack(_recv_exact(conn, _HDR.size))
+    payload = _recv_exact(conn, nbytes) if nbytes else b""
+    return np.frombuffer(payload, np.int64).copy(), threshold
+
+
+def _send_frame(conn: socket.socket, tokens: np.ndarray, threshold: float):
+    payload = np.ascontiguousarray(tokens, np.int64).tobytes()
+    conn.sendall(_HDR.pack(len(payload), threshold) + payload)
+
+
+def _make_socket(address: Address) -> socket.socket:
+    if isinstance(address, str):
+        return socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    return socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+
+
+class GradientExchangeServer:
+    """All-gather hub: waits for ``n_workers`` connections, then per round
+    collects one frame from each worker and broadcasts the full set back.
+    Runs in a daemon thread; ``stop()`` (or any worker disconnect after
+    training) shuts it down."""
+
+    def __init__(self, n_workers: int, address: Address = ("127.0.0.1", 0)):
+        self.n_workers = n_workers
+        self._sock = _make_socket(address)
+        if not isinstance(address, str):
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(address)
+        self._sock.listen(n_workers)
+        self.address = self._sock.getsockname()
+        self._conns: List[socket.socket] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.rounds = 0
+
+    def start(self) -> "GradientExchangeServer":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self):
+        try:
+            while len(self._conns) < self.n_workers:
+                conn, _ = self._sock.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
+                    if conn.family == socket.AF_INET else None
+                self._conns.append(conn)
+            while not self._stop.is_set():
+                frames = [_recv_frame(c) for c in self._conns]
+                count = struct.pack("<I", len(frames))
+                for c in self._conns:
+                    c.sendall(count)
+                    for tokens, thr in frames:
+                        _send_frame(c, tokens, thr)
+                self.rounds += 1
+        except (ConnectionError, OSError):
+            pass  # workers done / stop() closed the socket
+        finally:
+            for c in self._conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # unblock a serve thread parked in _recv_frame on a live worker
+        for c in self._conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if isinstance(self.address, (str, bytes)):
+            import os
+            try:
+                os.unlink(self.address)
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class SocketGradientTransport:
+    """Worker-side connection to a GradientExchangeServer."""
+
+    def __init__(self, address: Address, timeout: float = 60.0):
+        self._sock = _make_socket(address)
+        self._sock.settimeout(timeout)
+        self._sock.connect(tuple(address) if not isinstance(address, str)
+                           else address)
+        if self._sock.family == socket.AF_INET:
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def exchange(self, tokens: np.ndarray,
+                 threshold: float) -> List[Tuple[np.ndarray, float]]:
+        """Send this worker's frame; block until every worker's frame
+        arrives (the all-gather round)."""
+        _send_frame(self._sock, tokens, threshold)
+        (count,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+        return [_recv_frame(self._sock) for _ in range(count)]
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class DistributedGradientWorker:
+    """Per-PROCESS gradient-sharing worker (reference: one
+    EncodedGradientsAccumulator per machine on the Aeron bus).
+
+    ``step`` takes the POST-UPDATER update (e.g. lr·grad or the Adam step)
+    — the same contract as upstream, which encodes updates after the
+    updater, NOT raw gradients: the threshold lives in update space, so
+    the adaptive controller can track the training phase (large quanta
+    early, fine quanta near convergence — this is what makes encoded-
+    sparse training converge equivalently to dense; a gradient-space
+    threshold cannot, because the lr rescaling hides the movement scale).
+    Residual error feedback keeps the stream lossless over time.
+
+    The returned mean update is identical on every worker, so identically-
+    initialized replicas stay bit-identical — the property the 2-process
+    convergence test asserts."""
+
+    def __init__(self, n_params: int, transport: SocketGradientTransport,
+                 threshold: float = 1e-3, adaptive: bool = True,
+                 target_sparsity: float = 0.1):
+        self.n_params = n_params
+        self.transport = transport
+        self.residual = np.zeros(n_params, np.float32)
+        self.adaptive = AdaptiveThreshold(
+            threshold, target_sparsity=target_sparsity, decay=1.5,
+            max_threshold=10.0) if adaptive else None
+        self.threshold = threshold
+        self.last_encoded = 0
+
+    def step(self, update: np.ndarray) -> np.ndarray:
+        """Encode + exchange this worker's local update; returns the mean
+        decoded update across all workers (apply as ``w -= result``)."""
+        tokens = threshold_encode(
+            np.asarray(update, np.float32).ravel(), self.residual,
+            self.threshold)
+        self.last_encoded = int(tokens.size)
+        frames = self.transport.exchange(tokens, self.threshold)
+        out = np.zeros(self.n_params, np.float32)
+        for peer_tokens, peer_thr in frames:
+            out += threshold_decode(peer_tokens, peer_thr, self.n_params)
+        if self.adaptive is not None:
+            self.threshold = self.adaptive.update(self.last_encoded,
+                                                  self.n_params)
+        return out / len(frames)
+
+    def residual_norm(self) -> float:
+        return float(np.linalg.norm(self.residual))

@@ -38,6 +38,11 @@ class ParallelWrapper:
         self._rep = NamedSharding(self.mesh, P())
         batch_axes = tuple(a for a in ("dp", "fsdp") if a in self.mesh.axis_names)
         self._batch_sh = NamedSharding(self.mesh, P(batch_axes or None))
+        # batches divide only the axes they are SHARDED over — padding to
+        # mesh.size on a dp×tp mesh would add unmasked duplicate rows
+        self._batch_div = int(np.prod([self.mesh.shape[a]
+                                       for a in batch_axes])) if batch_axes \
+            else 1
         if "tp" in self.mesh.axis_names:
             # tensor parallel: layers that declare param_pspecs (tp.py's
             # Column/RowParallelDense, ShardedSelfAttention) get their
@@ -106,7 +111,7 @@ class ParallelWrapper:
             self._step = None  # detector toggled since compile — rebuild
         step_fn = self._step or self._build_step()
         last = None
-        n = self.mesh.size
+        n = self._batch_div
         anomaly_check = None
         if getattr(net, "_anomaly_detector", None) is not None:
             from ..train.anomaly import DelayedAnomalyCheck
@@ -163,6 +168,8 @@ class ParallelInference:
         self._rep = NamedSharding(self.mesh, P())
         batch_axes = tuple(a for a in ("dp",) if a in self.mesh.axis_names)
         self._batch_sh = NamedSharding(self.mesh, P(batch_axes or None))
+        self._batch_div = (self.mesh.shape["dp"]
+                           if "dp" in self.mesh.axis_names else 1)
         # Keep a LOCAL placed copy of params/states on THIS mesh: a net
         # trained under a different mesh (e.g. dp×tp ParallelWrapper) hands
         # us arrays from a foreign mesh, and mutating the net would break
@@ -203,7 +210,7 @@ class ParallelInference:
     def output(self, x):
         fn = self._infer or self._build()
         x = np.asarray(x)
-        n = self.mesh.size
+        n = self._batch_div
         orig = x.shape[0]
         if orig % n:
             x = np.concatenate([x, np.repeat(x[-1:], n - orig % n, 0)])

@@ -1,0 +1,131 @@
+"""Socket gradient-sharing transport (VERDICT r2 item 5): encoded sparse
+updates cross a REAL process boundary (two subprocesses + a TCP hub) and
+converge equivalently to dense synchronous training — the
+EncodedGradientsAccumulator + Aeron regime, minus the JVM."""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    sys.path.insert(0, {repo!r})
+    from deeplearning4j_tpu.parallel.transport import (
+        DistributedGradientWorker, SocketGradientTransport)
+
+    port = int(sys.argv[1]); wid = int(sys.argv[2]); out = sys.argv[3]
+    rng = np.random.default_rng(0)           # same data layout in each proc
+    X = rng.standard_normal((256, 64)).astype(np.float32)
+    w_true = rng.standard_normal(64).astype(np.float32)
+    y = X @ w_true
+    # each worker trains on ITS half of the data
+    lo, hi = (0, 128) if wid == 0 else (128, 256)
+    Xw, yw = X[lo:hi], y[lo:hi]
+
+    w = np.zeros(64, np.float32)             # identical init across workers
+    transport = SocketGradientTransport(("127.0.0.1", port))
+    worker = DistributedGradientWorker(64, transport, threshold=1e-3)
+    losses = []
+    for step in range(400):
+        pred = Xw @ w
+        losses.append(float(np.mean((pred - yw) ** 2)))
+        grad = 2 * Xw.T @ (pred - yw) / len(yw)
+        # encode the UPDATE (lr applied locally) — upstream's contract
+        w -= worker.step((0.02 * grad).astype(np.float32))
+    transport.close()
+    np.savez(out, w=w, losses=np.asarray(losses),
+             residual=worker.residual_norm(),
+             threshold=worker.threshold)
+""").format(repo=str(REPO))
+
+
+@pytest.mark.slow
+def test_two_process_encoded_training_matches_dense(tmp_path):
+    from deeplearning4j_tpu.parallel.transport import GradientExchangeServer
+
+    server = GradientExchangeServer(n_workers=2).start()
+    port = server.address[1]
+    procs = []
+    outs = []
+    for wid in range(2):
+        out = tmp_path / f"w{wid}.npz"
+        outs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(port), str(wid), str(out)],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=300)
+        assert p.returncode == 0, stderr[-2000:]
+    server.stop()
+    assert server.rounds == 400
+
+    r0 = np.load(outs[0])
+    r1 = np.load(outs[1])
+    # both processes applied the identical summed update stream
+    np.testing.assert_array_equal(r0["w"], r1["w"])
+
+    # dense synchronous baseline on the same problem
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((256, 64)).astype(np.float32)
+    w_true = rng.standard_normal(64).astype(np.float32)
+    y = X @ w_true
+    w = np.zeros(64, np.float32)
+    for _ in range(400):
+        grads = []
+        for lo, hi in ((0, 128), (128, 256)):
+            pred = X[lo:hi] @ w
+            grads.append(2 * X[lo:hi].T @ (pred - y[lo:hi]) / (hi - lo))
+        w -= 0.02 * (grads[0] + grads[1]) / 2
+
+    dense_final = float(np.mean((X @ w - y) ** 2))
+    sparse_final = float(r0["losses"][-1])
+    initial = float(r0["losses"][0])
+    assert sparse_final < 1e-4 * initial, (sparse_final, initial)
+    # equivalent-convergence gate: the encoded-sparse run lands in the
+    # same tiny-loss regime as dense synchronous training
+    assert sparse_final < max(2 * dense_final, 1e-3), (sparse_final,
+                                                       dense_final)
+    # residual error feedback was active
+    assert r0["residual"] >= 0
+
+
+def test_socket_transport_unix_and_tcp_roundtrip(tmp_path):
+    """In-process smoke for both socket families: 2 worker threads exchange
+    through the hub; decoded sums match the accumulator's result."""
+    import threading
+    from deeplearning4j_tpu.parallel.transport import (
+        DistributedGradientWorker, GradientExchangeServer,
+        SocketGradientTransport)
+
+    for address in [("127.0.0.1", 0), str(tmp_path / "grad.sock")]:
+        server = GradientExchangeServer(n_workers=2, address=address).start()
+        grads = [np.full(32, 0.01, np.float32),
+                 np.full(32, -0.01, np.float32)]
+        results = [None, None]
+
+        def run(wid):
+            t = SocketGradientTransport(server.address)
+            w = DistributedGradientWorker(32, t, threshold=1e-3,
+                                          adaptive=False)
+            results[wid] = w.step(grads[wid])
+            t.close()
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        server.stop()
+        # +0.01 and -0.01 encode to +1e-3/-1e-3 tokens at every index
+        # (residual keeps the rest): averaged sum = 0
+        np.testing.assert_allclose(results[0], np.zeros(32), atol=1e-7)
+        np.testing.assert_array_equal(results[0], results[1])

@@ -502,3 +502,35 @@ def test_parallel_inference_does_not_mutate_net(devices8):
     # refresh picks up newly trained params
     out2 = pi.refresh().output(X[:5])
     assert np.isfinite(out2).all()
+
+
+def test_parallel_wrapper_pads_to_batch_axes_only(devices8):
+    """Partial batches pad to the dp extent, not mesh.size (review finding,
+    r3): a 6-row batch on dp2×tp2 needs no padding and must match the
+    single-device loss."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel import (ColumnParallelDense,
+                                             ParallelWrapper,
+                                             RowParallelDense, make_mesh)
+
+    rng = np.random.default_rng(0)
+    X = rng.random((6, 32), np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 6)]
+    ds = DataSet(jnp.asarray(X), jnp.asarray(Y))
+    net1 = _tp_mlp(ColumnParallelDense, RowParallelDense)
+    ref = float(net1._loss(net1.params, net1.states, jnp.asarray(X),
+                           jnp.asarray(Y), None, None, None)[0])
+    net2 = _tp_mlp(ColumnParallelDense, RowParallelDense)
+    pw = ParallelWrapper(net2, mesh=make_mesh(jax.devices()[:4], dp=2, tp=2))
+    loss = pw.fit([ds])
+    np.testing.assert_allclose(loss, ref, atol=1e-5)
+
+
+def test_sharded_attention_rejects_uneven_heads(devices8):
+    from deeplearning4j_tpu.parallel import ShardedSelfAttention, make_mesh
+    from deeplearning4j_tpu.parallel.tp import layer_param_shardings
+    layer = ShardedSelfAttention(n_in=12, n_out=12, n_heads=3)
+    params, _, _ = layer.init(jax.random.PRNGKey(0), (4, 12))
+    with pytest.raises(ValueError, match="divisible by tp"):
+        layer_param_shardings(make_mesh(jax.devices()[:2], tp=2),
+                              layer, params)
