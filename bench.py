@@ -4,6 +4,15 @@ Prints ONE JSON line on stdout (the headline, BASELINE.json contract):
   {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N,
    "flops_per_step": ..., "derived_tflops": ..., "mfu": ..., ...}
 
+The headline routes through the REAL user entry point —
+``ComputationGraph.fit(DataSetIterator)`` (VERDICT r2 item 1): iterator
+protocol, async-wrap policy, optimizer build, donated jitted step and
+listener plumbing all engaged. Batches are pre-staged on device (DataSet
+keeps jax Arrays device-resident, like the reference's INDArray-backed
+DataSet) because the axon tunnel's host link is a network relay, not a
+TPU host's PCIe path. `resnet50_rawstep` keeps the hand-built-step
+variant for comparison.
+
 Methodology (why this is trustworthy on the axon tunnel):
 - `jax.block_until_ready` does NOT synchronize through the tunnel (measured:
   a chained 4096^2 matmul loop "finishes" at 6972 TFLOP/s, 35x over the v5e
@@ -353,6 +362,62 @@ def _dpoverhead_impl(batch, steps):
                     "ICI scaling equivalence: tests/test_parallel.py"}
 
 
+def build_resnet50_fit(batch, num_classes=1000, n_distinct=8):
+    """(run_fit(n)->last_loss, flops) through the REAL user entry point:
+    ``ComputationGraph.fit(iterator)`` — iterator protocol, async-wrap
+    check, optimizer build, jitted donated step, listener plumbing all
+    engaged. Batches are PRE-STAGED on device: the axon tunnel's
+    host->device link (a network relay) is orders of magnitude slower than
+    a real TPU host's PCIe/DMA path, so streaming fresh host batches would
+    measure the tunnel, not the framework; `n_distinct` staged batches
+    cycle so no single-buffer reuse artifact exists on device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.train import Momentum
+    from deeplearning4j_tpu.utils.tracing import total_flops
+    from deeplearning4j_tpu.zoo.resnet import ResNet50
+
+    net = ResNet50(num_classes=num_classes, compute_dtype=jnp.bfloat16,
+                   updater=Momentum(0.1, 0.9)).init()
+    rng = np.random.default_rng(0)
+    dss = []
+    for i in range(n_distinct):
+        x = jnp.asarray(rng.random((batch, 224, 224, 3), np.float32),
+                        jnp.bfloat16)
+        y = jnp.asarray(np.eye(num_classes, dtype=np.float32)[
+            rng.integers(0, num_classes, batch)])
+        dss.append(DataSet(x, y))
+
+    net._build_optimizer(1)
+    step = net._get_train_step()
+    flops = total_flops(
+        lambda p, s, o: step.__wrapped__(
+            p, s, o, {"in": dss[0].features}, {"out": dss[0].labels},
+            jax.random.PRNGKey(0), None, None)[:3],
+        net.params, net.states, net._opt_state)
+
+    def run_fit(n):
+        batches = [dss[i % n_distinct] for i in range(n)]
+        return net.fit(batches)   # float(last loss) = the host-fetch sync
+
+    return run_fit, flops
+
+
+def bench_resnet50_fit(batch, steps):
+    run_fit, flops = build_resnet50_fit(batch)
+    timing = measure_marginal(run_fit, n1=3, n2=steps)
+    rec = _record(
+        "ComputationGraph.fit(DataSetIterator) samples/sec/chip "
+        "(ResNet-50 ImageNet)",
+        "samples/sec/chip", batch, timing, flops, batch=batch,
+        data_path="pre-staged device batches (tunnel host link not "
+                  "representative; fit loop fully engaged)")
+    rec["vs_baseline"] = round(rec["value"] / BASELINE_SAMPLES_PER_SEC, 3)
+    return rec
+
+
 def build_resnet50(batch, num_classes=1000):
     import jax
     import jax.numpy as jnp
@@ -406,7 +471,8 @@ def bench_resnet50(batch, steps):
 
 
 CONFIGS = {
-    "resnet50": bench_resnet50,
+    "resnet50": bench_resnet50_fit,   # headline: the REAL fit() entry point
+    "resnet50_rawstep": bench_resnet50,
     "lenet": bench_lenet,
     "charnn": bench_charnn,
     "bert": bench_bert,
@@ -417,6 +483,7 @@ CONFIGS = {
 DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     # peaks at 256 (MFU 0.245 vs 0.077 at 64 pre-fused-kernel)
     "resnet50": (128, 13),
+    "resnet50_rawstep": (128, 13),
     "lenet": (512, 25),
     "charnn": (256, 25),
     "bert": (32, 13),
@@ -446,7 +513,7 @@ def main():
     if len(argv) > 1:
         steps = int(argv[1])
 
-    headline = bench_resnet50(batch, steps)
+    headline = bench_resnet50_fit(batch, steps)
     print(json.dumps(headline), flush=True)
 
     # Secondary configs (SURVEY §6) -> bench_secondary.json; never stdout.

@@ -3,8 +3,11 @@
 Reference parity: ``org.nd4j.linalg.dataset.DataSet`` (features, labels,
 featuresMask, labelsMask, save/load, split, shuffle, batchBy) and
 ``MultiDataSet`` (multi-input/multi-output).
-Host-side arrays are numpy (cheap slicing for the input pipeline); they move
-to device only inside the jitted step — minimizing host↔HBM traffic.
+Host arrays stay numpy (cheap slicing for the input pipeline) and move to
+device inside the jitted step; arrays that are ALREADY on device (jax
+Arrays — on-device augmentation/synthesis pipelines) are kept as-is, like
+the reference's device-backed INDArray DataSet: forcing them through
+numpy would bounce every batch device→host→device.
 """
 
 from __future__ import annotations
@@ -16,12 +19,25 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 
+def _as_host_or_device(a):
+    """numpy for host data; pass jax Arrays through untouched."""
+    if a is None or isinstance(a, np.ndarray):
+        return a
+    try:
+        import jax
+        if isinstance(a, jax.Array):
+            return a
+    except ImportError:      # pragma: no cover — jax is a hard dep anyway
+        pass
+    return np.asarray(a)
+
+
 class DataSet:
     def __init__(self, features, labels, features_mask=None, labels_mask=None):
-        self.features = np.asarray(features)
-        self.labels = np.asarray(labels)
-        self.features_mask = None if features_mask is None else np.asarray(features_mask)
-        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+        self.features = _as_host_or_device(features)
+        self.labels = _as_host_or_device(labels)
+        self.features_mask = _as_host_or_device(features_mask)
+        self.labels_mask = _as_host_or_device(labels_mask)
 
     # reference getters
     def get_features(self):
@@ -95,13 +111,13 @@ class MultiDataSet:
     """N features arrays, M labels arrays (reference MultiDataSet)."""
 
     def __init__(self, features, labels, features_masks=None, labels_masks=None):
-        self.features = [np.asarray(f) for f in _as_list(features)]
-        self.labels = [np.asarray(l) for l in _as_list(labels)]
+        self.features = [_as_host_or_device(f) for f in _as_list(features)]
+        self.labels = [_as_host_or_device(l) for l in _as_list(labels)]
         self.features_masks = (None if features_masks is None
-                               else [None if m is None else np.asarray(m)
+                               else [_as_host_or_device(m)
                                      for m in _as_list(features_masks)])
         self.labels_masks = (None if labels_masks is None
-                             else [None if m is None else np.asarray(m)
+                             else [_as_host_or_device(m)
                                    for m in _as_list(labels_masks)])
 
     def num_examples(self) -> int:

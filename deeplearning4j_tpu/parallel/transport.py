@@ -46,13 +46,15 @@ _HDR = struct.Struct("<If")  # payload bytes, sender threshold
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = conn.recv(n - len(buf))
-        if not chunk:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = conn.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("gradient peer closed the connection")
-        buf += chunk
-    return buf
+        got += r
+    return bytes(buf)
 
 
 def _recv_frame(conn: socket.socket) -> Tuple[np.ndarray, float]:
@@ -149,7 +151,11 @@ class GradientExchangeServer:
 class SocketGradientTransport:
     """Worker-side connection to a GradientExchangeServer."""
 
-    def __init__(self, address: Address, timeout: float = 60.0):
+    def __init__(self, address: Address, timeout: Optional[float] = None):
+        """``timeout=None`` (default) blocks indefinitely in the all-gather
+        — stragglers (per-worker XLA compile, checkpoint pauses) routinely
+        exceed any fixed budget in the slow-interconnect regime this
+        transport targets; pass a timeout only for fail-fast tests."""
         self._sock = _make_socket(address)
         self._sock.settimeout(timeout)
         self._sock.connect(tuple(address) if not isinstance(address, str)

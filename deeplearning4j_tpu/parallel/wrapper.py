@@ -118,11 +118,15 @@ class ParallelWrapper:
             anomaly_check = DelayedAnomalyCheck(net._anomaly_detector)
         for _ in range(epochs):
             for ds in iterator:
-                x = np.asarray(ds.features)
-                y = np.asarray(ds.labels)
-                fmask = None if ds.features_mask is None else np.asarray(ds.features_mask)
-                lmask = None if ds.labels_mask is None else np.asarray(ds.labels_mask)
+                x, y = ds.features, ds.labels
+                fmask, lmask = ds.features_mask, ds.labels_mask
                 if x.shape[0] % n:   # pad final partial batch to divide mesh
+                    # padding is host work — device-resident arrays fetch
+                    # once here (partial final batch only); full batches
+                    # pass straight through without a host bounce
+                    x, y = np.asarray(x), np.asarray(y)
+                    fmask = None if fmask is None else np.asarray(fmask)
+                    lmask = None if lmask is None else np.asarray(lmask)
                     pad = n - x.shape[0] % n
                     x = np.concatenate([x, np.repeat(x[-1:], pad, 0)])
                     y = np.concatenate([y, np.repeat(y[-1:], pad, 0)])

@@ -80,3 +80,13 @@ def test_bench_record_flags_impossible_mfu(monkeypatch):
     rec3 = bench._record("m", "u", samples_per_step=128,
                          timing=(1.0, False), flops_per_step=10**9)
     assert rec3["timing_valid"] is False
+
+
+@pytest.mark.slow
+def test_bench_resnet50_fit_path():
+    """The fit()-path headline builder runs end-to-end (tiny config)."""
+    run_fit, flops = bench.build_resnet50_fit(batch=2, num_classes=10,
+                                              n_distinct=2)
+    assert flops > 0
+    loss = run_fit(2)
+    assert loss is not None and np.isfinite(loss)

@@ -455,3 +455,27 @@ def test_convlstm_mln_trains_and_deconv3d_stack():
     x2 = jnp.asarray(rng.random((4, 4, 4, 4, 1), np.float32))
     # deconv3d upsamples back: (2,2,2,3) -> (4,4,4,2) -> flatten 128 -> 2
     assert net2.output(x2).shape == (4, 2)
+
+
+def test_batchnorm_one_pass_large_offset_precision():
+    """One-pass BN variance must not catastrophically cancel on
+    large-mean/low-variance channels once the running mean has warmed up
+    (review finding, r3: the naive E[x²]−mean² form loses var≈0.01 at
+    mean≈1000 in f32)."""
+    import jax
+    from deeplearning4j_tpu.nn import BatchNormalization
+    from deeplearning4j_tpu.nn.layers.base import Ctx
+
+    bn = BatchNormalization(decay=0.0)   # state tracks last batch exactly
+    params, state, _ = bn.init(jax.random.PRNGKey(0), (2,))
+    rng = np.random.default_rng(0)
+    x = np.stack([rng.normal(1000.0, 0.1, 8192),
+                  rng.normal(0.0, 1.0, 8192)], axis=1).astype(np.float32)
+    # first pass warms the running mean; second pass uses it as the shift
+    _, state = bn.apply(params, state, jnp.asarray(x), Ctx(train=True))
+    y, state = bn.apply(params, state, jnp.asarray(x), Ctx(train=True))
+    var = np.asarray(state["var"])
+    np.testing.assert_allclose(var[0], 0.01, rtol=0.2)
+    np.testing.assert_allclose(var[1], 1.0, rtol=0.1)
+    # normalized output is unit-ish scale, not exploded by a zero-var clamp
+    assert float(np.abs(np.asarray(y)).max()) < 10.0
