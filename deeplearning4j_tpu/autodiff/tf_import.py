@@ -35,6 +35,12 @@ def _axes(v):
     return tuple(int(a) for a in np.asarray(v).ravel())
 
 
+def _attr_f(node, name, default):
+    """Float attr with an explicit-presence check: an attr explicitly set
+    to 0.0 must NOT fall back to the default (`.f or default` would)."""
+    return node.attr[name].f if name in node.attr else default
+
+
 class TFImporter:
     def __init__(self):
         self.handlers = {
@@ -102,6 +108,106 @@ class TFImporter:
             "Select": lambda i, n: jnp.where(i[0], i[1], i[2]),
             "SelectV2": lambda i, n: jnp.where(i[0], i[1], i[2]),
             "Tanh_": lambda i, n: jnp.tanh(i[0]),
+            # --- r3 widening: the broad frozen-graph long tail ------------
+            "Floor": lambda i, n: jnp.floor(i[0]),
+            "Ceil": lambda i, n: jnp.ceil(i[0]),
+            "Round": lambda i, n: jnp.round(i[0]),
+            "Rint": lambda i, n: jnp.rint(i[0]),
+            "Sign": lambda i, n: jnp.sign(i[0]),
+            "FloorDiv": lambda i, n: jnp.floor_divide(i[0], i[1]),
+            "FloorMod": lambda i, n: jnp.mod(i[0], i[1]),
+            "Mod": lambda i, n: jnp.fmod(i[0], i[1]),   # TF Mod truncates
+            "Log1p": lambda i, n: jnp.log1p(i[0]),
+            "Expm1": lambda i, n: jnp.expm1(i[0]),
+            "Sin": lambda i, n: jnp.sin(i[0]),
+            "Cos": lambda i, n: jnp.cos(i[0]),
+            "Tan": lambda i, n: jnp.tan(i[0]),
+            "Asin": lambda i, n: jnp.arcsin(i[0]),
+            "Acos": lambda i, n: jnp.arccos(i[0]),
+            "Atan": lambda i, n: jnp.arctan(i[0]),
+            "Sinh": lambda i, n: jnp.sinh(i[0]),
+            "Cosh": lambda i, n: jnp.cosh(i[0]),
+            "Asinh": lambda i, n: jnp.arcsinh(i[0]),
+            "Acosh": lambda i, n: jnp.arccosh(i[0]),
+            "Atanh": lambda i, n: jnp.arctanh(i[0]),
+            "Atan2": lambda i, n: jnp.arctan2(i[0], i[1]),
+            "Reciprocal": lambda i, n: jnp.reciprocal(i[0]),
+            "Inv": lambda i, n: jnp.reciprocal(i[0]),
+            "Erfc": lambda i, n: jax.scipy.special.erfc(i[0]),
+            "LeakyRelu": lambda i, n: jax.nn.leaky_relu(
+                i[0], _attr_f(n, "alpha", 0.2)),
+            "Softsign": lambda i, n: jax.nn.soft_sign(i[0]),
+            "IsNan": lambda i, n: jnp.isnan(i[0]),
+            "IsInf": lambda i, n: jnp.isinf(i[0]),
+            "IsFinite": lambda i, n: jnp.isfinite(i[0]),
+            "LogicalAnd": lambda i, n: jnp.logical_and(i[0], i[1]),
+            "LogicalOr": lambda i, n: jnp.logical_or(i[0], i[1]),
+            "LogicalNot": lambda i, n: jnp.logical_not(i[0]),
+            "LessEqual": lambda i, n: jnp.less_equal(i[0], i[1]),
+            "All": self._rall, "Any": self._rany,
+            "ArgMin": lambda i, n: jnp.argmin(i[0], axis=int(np.asarray(i[1]))),
+            "Cumsum": self._cumsum, "Cumprod": self._cumprod,
+            "Pad": lambda i, n: jnp.pad(i[0], np.asarray(i[1])),
+            "PadV2": lambda i, n: jnp.pad(
+                i[0], np.asarray(i[1]),
+                constant_values=float(np.asarray(i[2]))),
+            "MirrorPad": lambda i, n: jnp.pad(
+                i[0], np.asarray(i[1]),
+                mode=("reflect" if n.attr["mode"].s == b"REFLECT"
+                      else "symmetric")),
+            "Concat": lambda i, n: jnp.concatenate(
+                i[1:], axis=int(np.asarray(i[0]))),   # legacy: axis FIRST
+            "ReverseV2": lambda i, n: jnp.flip(i[0], _axes(i[1])),
+            "Range": lambda i, n: jnp.arange(
+                np.asarray(i[0]).item(), np.asarray(i[1]).item(),
+                np.asarray(i[2]).item()),
+            "LinSpace": lambda i, n: jnp.linspace(
+                np.asarray(i[0]).item(), np.asarray(i[1]).item(),
+                int(np.asarray(i[2]))),
+            "Size": lambda i, n: jnp.asarray(np.prod(i[0].shape), jnp.int32),
+            "BroadcastTo": lambda i, n: jnp.broadcast_to(i[0], _axes(i[1])),
+            "GatherNd": self._gather_nd,
+            "ScatterNd": lambda i, n: jnp.zeros(
+                _axes(i[2]), i[1].dtype).at[
+                tuple(jnp.asarray(i[0]).astype(jnp.int32)[..., k]
+                      for k in range(i[0].shape[-1]))].add(i[1]),
+            "TensorScatterUpdate": lambda i, n: i[0].at[
+                tuple(jnp.asarray(i[1]).astype(jnp.int32)[..., k]
+                      for k in range(i[1].shape[-1]))].set(i[2]),
+            "TensorScatterAdd": lambda i, n: i[0].at[
+                tuple(jnp.asarray(i[1]).astype(jnp.int32)[..., k]
+                      for k in range(i[1].shape[-1]))].add(i[2]),
+            "InvertPermutation": lambda i, n: jnp.argsort(i[0]),
+            "MatrixBandPart": self._matrix_band_part,
+            "MatrixDiag": lambda i, n: i[0][..., None]
+                * jnp.eye(i[0].shape[-1], dtype=i[0].dtype),
+            "MatrixDiagPart": lambda i, n: jnp.diagonal(
+                i[0], axis1=-2, axis2=-1),
+            "L2Loss": lambda i, n: 0.5 * jnp.sum(jnp.square(i[0])),
+            "LRN": self._lrn,
+            "DepthwiseConv2dNative": self._depthwise_conv2d,
+            "Conv2DBackpropInput": self._conv2d_transpose,
+            "SpaceToDepth": lambda i, n: self._space_depth(i[0],
+                                                           n, to_depth=True),
+            "DepthToSpace": lambda i, n: self._space_depth(i[0],
+                                                           n, to_depth=False),
+            "ResizeBilinear": self._resize_bilinear,
+            "ResizeNearestNeighbor": self._resize_nearest,
+            # spectral family (rides the new sd_ops FFT work)
+            "FFT": lambda i, n: jnp.fft.fft(i[0]),
+            "IFFT": lambda i, n: jnp.fft.ifft(i[0]),
+            "FFT2D": lambda i, n: jnp.fft.fft2(i[0]),
+            "IFFT2D": lambda i, n: jnp.fft.ifft2(i[0]),
+            "RFFT": lambda i, n: jnp.fft.rfft(
+                i[0], n=int(_axes(i[1])[0]) if len(i) > 1 else None),
+            "IRFFT": lambda i, n: jnp.fft.irfft(
+                i[0], n=int(_axes(i[1])[0]) if len(i) > 1 else None),
+            "ComplexAbs": lambda i, n: jnp.abs(i[0]),
+            "Real": lambda i, n: jnp.real(i[0]),
+            "Imag": lambda i, n: jnp.imag(i[0]),
+            "Conj": lambda i, n: jnp.conj(i[0]),
+            "Complex": lambda i, n: lax.complex(i[0], i[1]),
+            "Angle": lambda i, n: jnp.angle(i[0]),
         }
 
     # --- handlers needing node attrs ---------------------------------------
@@ -206,6 +312,148 @@ class TFImporter:
 
     def _prod(self, i, n):
         return jnp.prod(i[0], axis=_axes(i[1]), keepdims=n.attr["keep_dims"].b)
+
+    def _rall(self, i, n):
+        return jnp.all(i[0], axis=_axes(i[1]), keepdims=n.attr["keep_dims"].b)
+
+    def _rany(self, i, n):
+        return jnp.any(i[0], axis=_axes(i[1]), keepdims=n.attr["keep_dims"].b)
+
+    def _cumsum(self, i, n):
+        ax = int(np.asarray(i[1]))
+        x = jnp.flip(i[0], ax) if n.attr["reverse"].b else i[0]
+        if n.attr["exclusive"].b:
+            y = jnp.cumsum(x, axis=ax) - x
+        else:
+            y = jnp.cumsum(x, axis=ax)
+        return jnp.flip(y, ax) if n.attr["reverse"].b else y
+
+    def _cumprod(self, i, n):
+        ax = int(np.asarray(i[1]))
+        x = jnp.flip(i[0], ax) if n.attr["reverse"].b else i[0]
+        y = jnp.cumprod(x, axis=ax)
+        if n.attr["exclusive"].b:
+            # shift-by-one with a leading 1 (zero-safe, dtype-preserving —
+            # dividing out x would be wrong at zeros and float-promote ints)
+            lead = list(x.shape)
+            lead[ax] = 1
+            y = jnp.concatenate(
+                [jnp.ones(lead, y.dtype),
+                 lax.slice_in_dim(y, 0, x.shape[ax] - 1, axis=ax)], axis=ax)
+        return jnp.flip(y, ax) if n.attr["reverse"].b else y
+
+    def _gather_nd(self, i, n):
+        idx = jnp.asarray(i[1]).astype(jnp.int32)
+        return i[0][tuple(idx[..., k] for k in range(idx.shape[-1]))]
+
+    def _matrix_band_part(self, i, n):
+        x = i[0]
+        lo, hi = int(np.asarray(i[1])), int(np.asarray(i[2]))
+        r = jnp.arange(x.shape[-2])[:, None] - jnp.arange(x.shape[-1])[None, :]
+        keep = ((r <= (lo if lo >= 0 else x.shape[-2]))
+                & (-r <= (hi if hi >= 0 else x.shape[-1])))
+        return x * keep.astype(x.dtype)
+
+    def _lrn(self, i, n):
+        r = n.attr["depth_radius"].i if "depth_radius" in n.attr else 5
+        bias = _attr_f(n, "bias", 1.0)
+        alpha = _attr_f(n, "alpha", 1.0)
+        beta = _attr_f(n, "beta", 0.5)
+        sq = lax.reduce_window(jnp.square(i[0]), 0.0, lax.add,
+                               (1, 1, 1, 2 * r + 1), (1, 1, 1, 1), "SAME")
+        return i[0] / jnp.power(bias + alpha * sq, beta)
+
+    def _depthwise_conv2d(self, i, n):
+        strides = tuple(n.attr["strides"].list.i)[1:3]
+        pad = n.attr["padding"].s.decode()
+        w = i[1]  # TF (kh, kw, cin, mult) → lax HWIO (kh, kw, 1, cin*mult)
+        kh, kw, cin, mult = w.shape
+        w = w.reshape(kh, kw, 1, cin * mult)
+        return lax.conv_general_dilated(
+            i[0], w, strides, pad, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=cin)
+
+    def _conv2d_transpose(self, i, n):
+        # inputs: output_shape (const), filters (kh,kw,Cout,Cin), dy.
+        # True gradient-of-conv: dilate dy by the stride, pad with the
+        # TRANSPOSED forward pads (derived from TF's SAME/VALID rule on the
+        # requested output size — authoritative, so odd sizes land exact),
+        # convolve with the spatially-flipped, io-swapped kernel.
+        strides = tuple(n.attr["strides"].list.i)[1:3]
+        padding = n.attr["padding"].s.decode()
+        dy = i[2]
+        oh, ow = (int(v) for v in _axes(i[0])[1:3])
+        w = i[1]
+        kh, kw = w.shape[:2]
+        wf = jnp.transpose(w[::-1, ::-1], (0, 1, 3, 2))
+
+        def grad_pad(out_sz, in_sz, k, s):
+            if padding == "SAME":
+                fwd_out = -(-out_sz // s)
+                total = max(0, (fwd_out - 1) * s + k - out_sz)
+                fwd_lo = total // 2
+            else:
+                fwd_lo = 0
+            lo = k - 1 - fwd_lo
+            dil = (in_sz - 1) * s + 1
+            hi = out_sz + k - 1 - dil - lo   # solves out == requested size
+            return lo, hi
+
+        ph = grad_pad(oh, dy.shape[1], kh, strides[0])
+        pw_ = grad_pad(ow, dy.shape[2], kw, strides[1])
+        return lax.conv_general_dilated(
+            dy, wf, (1, 1), (ph, pw_), lhs_dilation=strides,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def _space_depth(self, x, n, to_depth):
+        bs = n.attr["block_size"].i
+        b, h, w, c = x.shape
+        if to_depth:
+            return x.reshape(b, h // bs, bs, w // bs, bs, c).transpose(
+                0, 1, 3, 2, 4, 5).reshape(b, h // bs, w // bs, bs * bs * c)
+        return x.reshape(b, h, w, bs, bs, c // (bs * bs)).transpose(
+            0, 1, 3, 2, 4, 5).reshape(b, h * bs, w * bs, c // (bs * bs))
+
+    def _resize_coords(self, n, in_dim, out_dim):
+        """Source sample coordinates for the three TF resize conventions."""
+        if n.attr["align_corners"].b and out_dim > 1:
+            return jnp.linspace(0.0, in_dim - 1, out_dim)
+        if n.attr["half_pixel_centers"].b:
+            scale = in_dim / out_dim
+            return jnp.maximum((jnp.arange(out_dim) + 0.5) * scale - 0.5, 0.0)
+        return jnp.arange(out_dim) * (in_dim / out_dim)   # v1 legacy
+
+    def _resize_bilinear(self, i, n):
+        x = i[0]
+        oh, ow = (int(v) for v in _axes(i[1]))
+        b, h, w, c = x.shape
+        ys = self._resize_coords(n, h, oh)
+        xs = self._resize_coords(n, w, ow)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[None, :, None, None].astype(x.dtype)
+        wx = (xs - x0)[None, None, :, None].astype(x.dtype)
+        top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+        bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+        return top * (1 - wy) + bot * wy
+
+    def _resize_nearest(self, i, n):
+        x = i[0]
+        oh, ow = (int(v) for v in _axes(i[1]))
+        b, h, w, c = x.shape
+        ys = self._resize_coords(n, h, oh)
+        xs = self._resize_coords(n, w, ow)
+        # TF rounds half AWAY from zero (coords are >= 0, so floor(x+0.5));
+        # jnp.round's half-to-even would shift every .5 coordinate
+        round_fn = ((lambda v: jnp.floor(v + 0.5))
+                    if (n.attr["align_corners"].b
+                        or n.attr["half_pixel_centers"].b)
+                    else jnp.floor)
+        yi = jnp.clip(round_fn(ys).astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(round_fn(xs).astype(jnp.int32), 0, w - 1)
+        return x[:, yi][:, :, xi]
 
     def _fused_bn(self, i, n):
         x, gamma, beta, mean, var = i[:5]

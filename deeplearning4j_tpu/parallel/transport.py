@@ -54,7 +54,8 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
         if not r:
             raise ConnectionError("gradient peer closed the connection")
         got += r
-    return bytes(buf)
+    return buf   # bytearray: both consumers (struct.unpack, np.frombuffer)
+                 # take buffer objects — bytes(buf) would re-copy the frame
 
 
 def _recv_frame(conn: socket.socket) -> Tuple[np.ndarray, float]:

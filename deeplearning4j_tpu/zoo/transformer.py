@@ -45,6 +45,22 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16   # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # Fused (chunked) LM cross-entropy: never materializes the full
+    # (B, T, V) f32 logits — per time-chunk the head matmul, logsumexp and
+    # target gather collapse into one rematerialized scan step. Cuts the
+    # dominant HBM traffic of a 32k-vocab loss (logits f32 write+read is
+    # ~4 GB/step at B16/T1024) for ~one extra head matmul in backward.
+    # True | False | "auto" (fuse when B*T*V is large enough to matter).
+    fused_loss: Any = "auto"
+    loss_chunk: int = 1024      # rows (B*T) per chunk in the fused loss
+    # Rematerialization policy for the per-block checkpoint (remat=True):
+    # "full"  — save only block inputs, recompute everything (min HBM)
+    # "dots"  — save matmul outputs, recompute elementwise (XLA
+    #           checkpoint_policies.dots_saveable: trades HBM for the
+    #           cheap recompute only)
+    # "dots_no_batch" — dots_with_no_batch_dims_saveable (saves the
+    #           small contraction results, not the big batched ones)
+    remat_policy: str = "full"
     use_ring_attention: bool = False
     # True = always pallas flash kernel (TPU single-chip); False = XLA fused
     # attention; "auto" = flash only from `flash_min_seq` up. Measured on
@@ -224,10 +240,17 @@ def embed(params, cfg: TransformerConfig, ids):
     return _constrain(x, "dp", "sp", None)
 
 
+def _resolve_head(params, cfg: TransformerConfig):
+    """(d, V) head matrix — shared by the naive and fused loss paths so
+    tie_embeddings/untied resolution can't drift between them."""
+    return params.get("head",
+                      params["embed"].T if cfg.tie_embeddings else None)
+
+
 def head_logits(params, cfg: TransformerConfig, x):
     """Final norm + LM head → f32 logits."""
     x = _rmsnorm(x, params["ln_f"])
-    head = params.get("head", params["embed"].T if cfg.tie_embeddings else None)
+    head = _resolve_head(params, cfg)
     logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype))
     return _constrain(logits, "dp", "sp", "tp").astype(jnp.float32)
 
@@ -251,7 +274,18 @@ def apply_blocks(blocks, cfg: TransformerConfig, x):
         x = x + _constrain(m, "dp", "sp", None)
         return x, aux
 
-    blk_fn = jax.checkpoint(block) if cfg.remat else block
+    if cfg.remat:
+        policies = {
+            "full": None,
+            "dots": jax.checkpoint_policies.dots_saveable,
+            "dots_no_batch":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }
+        pol = policies[cfg.remat_policy]
+        blk_fn = (jax.checkpoint(block) if pol is None
+                  else jax.checkpoint(block, policy=pol))
+    else:
+        blk_fn = block
 
     def scan_body(carry, blk):
         x = carry
@@ -269,7 +303,61 @@ def forward(params, cfg: TransformerConfig, ids, *, train=False, rng=None):
     return head_logits(params, cfg, x), aux
 
 
+def _use_fused_loss(cfg: TransformerConfig, n_rows: int) -> bool:
+    if cfg.fused_loss is True:
+        return True
+    if cfg.fused_loss is False:
+        return False
+    # "auto": fuse once the f32 logits tensor would exceed ~64 MB — below
+    # that XLA's ordinary fusion handles it and chunking only adds scan
+    # overhead
+    return n_rows * cfg.vocab_size * 4 > 64 * 2 ** 20
+
+
+def _chunked_ce(x, head, targets, chunk):
+    """Mean NLL of (N, d) hidden rows against (N,) targets WITHOUT
+    materializing the (N, V) f32 logits: scan over row chunks; each step
+    is rematerialized so backward recomputes the chunk's logits from the
+    (small) saved hidden rows instead of saving V-wide activations."""
+    n, d = x.shape
+    chunk = min(chunk, n)
+    pad = (-n) % chunk
+    w = jnp.ones((n,), jnp.float32)
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
+        targets = jnp.concatenate(
+            [targets, jnp.zeros((pad,), targets.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((pad,), jnp.float32)])
+    xk = x.reshape(-1, chunk, d)
+    tk = targets.reshape(-1, chunk)
+    wk = w.reshape(-1, chunk)
+
+    @jax.checkpoint
+    def chunk_nll(xc, tc, wc):
+        logits = jnp.einsum("cd,dv->cv", xc, head).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(
+            logits, tc[:, None].astype(jnp.int32), -1)[:, 0]
+        return ((lse - tl) * wc).sum()      # pad rows weighted out
+
+    def body(carry, sl):
+        xc, tc, wc = sl
+        return carry + chunk_nll(xc, tc, wc), None
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xk, tk, wk))
+    return total / n
+
+
 def lm_loss(params, cfg: TransformerConfig, ids, targets, *, aux_weight=1e-2):
+    b, t = ids.shape
+    if _use_fused_loss(cfg, b * t):
+        x = embed(params, cfg, ids)
+        x, aux = apply_blocks(params["blocks"], cfg, x)
+        x = _rmsnorm(x, params["ln_f"])
+        head = _resolve_head(params, cfg)
+        nll = _chunked_ce(x.reshape(b * t, -1), head.astype(x.dtype),
+                          targets.reshape(b * t), cfg.loss_chunk)
+        return nll + aux_weight * aux
     logits, aux = forward(params, cfg, ids, train=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), -1)[..., 0]

@@ -822,3 +822,78 @@ def test_keras_import_convlstm2d(tmp_path):
         got = np.asarray(net.output(x))
         np.testing.assert_allclose(got, want, atol=1e-4,
                                    err_msg=f"return_sequences={ret_seq}")
+
+
+def test_tf_import_r3_op_breadth():
+    """r3 TF-import widening: math/shape/scatter/spectral long tail vs a
+    live TF session (VERDICT r2 missing item 8)."""
+    tf = pytest.importorskip("tensorflow")
+    tf1 = tf.compat.v1
+    g = tf1.Graph()
+    rng = np.random.default_rng(0)
+    xin = rng.standard_normal((3, 8)).astype(np.float32)
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, (None, 8), name="x")
+        a = tf.math.floor(x) + tf.math.ceil(x) + tf.math.sign(x)
+        b = tf.math.log1p(tf.abs(x)) + tf.math.expm1(x / 10) \
+            + tf.math.sin(x) * tf.math.cos(x)
+        c = tf.math.atan2(x, tf.ones_like(x) * 2) \
+            + tf.nn.leaky_relu(x, alpha=0.3)
+        cum = tf.cumsum(x, axis=1, exclusive=True, reverse=True)
+        padded = tf.pad(x, [[0, 0], [1, 2]])
+        rev = tf.reverse(padded, axis=[1])
+        sliced = rev[:, 1:9]
+        red = tf.reduce_any(x > 0, axis=1, keepdims=True)
+        total = a + b + c + cum + sliced \
+            + tf.cast(red, tf.float32)
+        tf.identity(total, name="out")
+        # spectral branch: rfft -> abs -> sum
+        spec = tf.signal.rfft(x)
+        tf.identity(tf.reduce_sum(tf.abs(spec), axis=1), name="spec_out")
+        # scatter/gather-nd branch
+        idx = tf1.constant(np.array([[0, 1], [2, 3]], np.int32))
+        tf.identity(tf.gather_nd(x, idx), name="gnd")
+
+    from deeplearning4j_tpu.autodiff.tf_import import import_frozen_graph
+    sd, _ = import_frozen_graph(g.as_graph_def())
+    with tf1.Session(graph=g) as sess:
+        want, want_spec, want_gnd = sess.run(
+            ["out:0", "spec_out:0", "gnd:0"], {"x:0": xin})
+    got = np.asarray(sd.eval(sd.get_variable("out"), {"x": xin}))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    got_spec = np.asarray(sd.eval(sd.get_variable("spec_out"), {"x": xin}))
+    np.testing.assert_allclose(got_spec, want_spec, rtol=1e-4)
+    got_gnd = np.asarray(sd.eval(sd.get_variable("gnd"), {"x": xin}))
+    np.testing.assert_allclose(got_gnd, want_gnd, atol=1e-6)
+
+
+def test_tf_import_r3_conv_variants():
+    """Depthwise conv + conv2d-transpose + LRN + resize frozen-graph
+    handlers vs live TF."""
+    tf = pytest.importorskip("tensorflow")
+    tf1 = tf.compat.v1
+    g = tf1.Graph()
+    rng = np.random.default_rng(1)
+    xin = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, (None, 8, 8, 3), name="x")
+        dw = tf1.constant(rng.standard_normal((3, 3, 3, 2)).astype(np.float32) * 0.2)
+        d = tf.nn.depthwise_conv2d(x, dw, strides=[1, 1, 1, 1], padding="SAME")
+        lrn = tf.nn.local_response_normalization(d, depth_radius=2)
+        up = tf1.image.resize_bilinear(lrn, (16, 16))
+        tf.identity(tf.reduce_mean(up, axis=(1, 2)), name="out")
+        # transposed conv: odd output + stride 2 exercises the exact
+        # gradient-padding path (review finding, r3)
+        wt = tf1.constant(rng.standard_normal((3, 3, 1, 6)).astype(np.float32) * 0.2)
+        tf.identity(tf1.nn.conv2d_transpose(
+            d, wt, output_shape=[2, 15, 15, 1], strides=[1, 2, 2, 1],
+            padding="SAME"), name="deconv")
+    from deeplearning4j_tpu.autodiff.tf_import import import_frozen_graph
+    sd, _ = import_frozen_graph(g.as_graph_def())
+    with tf1.Session(graph=g) as sess:
+        want, want_dc = sess.run(["out:0", "deconv:0"], {"x:0": xin})
+    got = np.asarray(sd.eval(sd.get_variable("out"), {"x": xin}))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    got_dc = np.asarray(sd.eval(sd.get_variable("deconv"), {"x": xin}))
+    assert got_dc.shape == (2, 15, 15, 1)
+    np.testing.assert_allclose(got_dc, want_dc, atol=1e-4)

@@ -105,3 +105,33 @@ def test_text_generation_sampling():
     streamed = np.asarray(net.rnn_time_step(jnp.asarray(seed)))[:, -1]
     full = np.asarray(net.output(jnp.asarray(seed)))[:, -1]
     np.testing.assert_allclose(streamed, full, atol=1e-5)
+
+
+def test_transformer_fused_loss_matches_naive():
+    """Chunked fused cross-entropy == naive log_softmax loss (values and
+    gradients), incl. non-dividing chunk sizes and tied embeddings."""
+    from dataclasses import replace
+    import jax
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=128, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq=16,
+                                dtype=jnp.float32, remat=False,
+                                fused_loss=False)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 128)
+    ref = float(tfm.lm_loss(params, cfg, ids, tgt))
+    gref = jax.grad(lambda p: tfm.lm_loss(p, cfg, ids, tgt))(params)
+    cfg_f = replace(cfg, fused_loss=True, loss_chunk=24)  # pad path
+    got = float(tfm.lm_loss(params, cfg_f, ids, tgt))
+    gfus = jax.grad(lambda p: tfm.lm_loss(p, cfg_f, ids, tgt))(params)
+    assert abs(ref - got) < 1e-5
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=2e-5), gref, gfus)
+    cfg_t = replace(cfg, tie_embeddings=True, fused_loss=True, loss_chunk=16)
+    cfg_tn = replace(cfg, tie_embeddings=True, fused_loss=False)
+    pt = tfm.init_params(jax.random.PRNGKey(0), cfg_t)
+    assert abs(float(tfm.lm_loss(pt, cfg_t, ids, tgt))
+               - float(tfm.lm_loss(pt, cfg_tn, ids, tgt))) < 1e-5
