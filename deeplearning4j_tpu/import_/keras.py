@@ -319,14 +319,13 @@ def _map_layer(kcfg: dict):
         from ..nn.layers.wrappers import TimeDistributedLayer
         inner_cls = c["layer"].get("class_name")
         inner = _map_layer(c["layer"])
-        if inner is None or not isinstance(
-                inner, (DenseLayer, ActivationLayer, DropoutLayer,
-                        PReLULayer)):
+        if inner is None:
             raise NotImplementedError(
-                f"TimeDistributed({inner_cls}): only feed-forward inners "
-                "(Dense/Activation/Dropout) stream per-timestep here — "
-                "spatial inners need a Cnn3D layout the reference also "
-                "special-cases")
+                f"TimeDistributed({inner_cls}): structural inner layers "
+                "(Flatten/InputLayer) have no per-timestep meaning")
+        # the fold-time-into-batch wrapper is shape-generic, so spatial
+        # inners (Conv2D per frame — upstream KerasTimeDistributed's Cnn3D
+        # special case) map the same way as feed-forward ones
         return TimeDistributedLayer(layer=inner)
     if cls in ("LSTM", "GRU", "SimpleRNN"):
         if cls == "LSTM":

@@ -70,18 +70,22 @@ FrozenLayerWithBackprop = FrozenLayer
 
 @dataclass
 class TimeDistributedLayer(BaseWrapperLayer):
-    """Applies a feed-forward layer independently per timestep:
-    (B,T,C) -> flatten to (B*T,C) -> layer -> (B,T,C') (TimeDistributed)."""
+    """Applies any per-sample layer independently per timestep by folding
+    time into batch: (B, T, *S) -> (B*T, *S) -> layer -> (B, T, *S')
+    (TimeDistributed). Works for feed-forward AND spatial inners (Conv2D
+    per frame etc.) — the fold is shape-generic."""
 
     def init(self, key, input_shape):
-        t, n = input_shape
-        params, state, out = self.layer.init(key, (n,))
-        return params, state, (t, out[-1] if isinstance(out, tuple) else out)
+        t = input_shape[0]
+        params, state, out = self.layer.init(key, tuple(input_shape[1:]))
+        out_t = tuple(out) if isinstance(out, tuple) else (out,)
+        return params, state, (t,) + out_t
 
     def apply(self, params, state, x, ctx: Ctx):
         b, t = x.shape[0], x.shape[1]
-        y, state = self.layer.apply(params, state, x.reshape(b * t, -1), ctx)
-        return y.reshape(b, t, -1), state
+        y, state = self.layer.apply(
+            params, state, x.reshape((b * t,) + x.shape[2:]), ctx)
+        return y.reshape((b, t) + y.shape[1:]), state
 
 
 @dataclass

@@ -897,3 +897,27 @@ def test_tf_import_r3_conv_variants():
     got_dc = np.asarray(sd.eval(sd.get_variable("deconv"), {"x": xin}))
     assert got_dc.shape == (2, 15, 15, 1)
     np.testing.assert_allclose(got_dc, want_dc, atol=1e-4)
+
+
+def test_keras_import_timedistributed_conv(tmp_path):
+    """TimeDistributed(Conv2D) per-frame import (upstream
+    KerasTimeDistributed's Cnn3D case) — fold-time-into-batch is
+    shape-generic, so the spatial inner round-trips numerically."""
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((3, 8, 8, 2)),
+        keras.layers.TimeDistributed(
+            keras.layers.Conv2D(4, 3, padding="same", activation="relu")),
+        keras.layers.TimeDistributed(keras.layers.MaxPooling2D(2)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    x = np.random.default_rng(3).random((2, 3, 8, 8, 2)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = tmp_path / "tdconv.h5"
+    m.save(p)
+    from deeplearning4j_tpu.import_.keras import import_keras_sequential
+    net = import_keras_sequential(str(p))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, atol=1e-4)
