@@ -314,15 +314,19 @@ def _use_fused_loss(cfg: TransformerConfig, n_rows: int) -> bool:
     return n_rows * cfg.vocab_size * 4 > 64 * 2 ** 20
 
 
-def _chunked_ce(x, head, targets, chunk):
-    """Mean NLL of (N, d) hidden rows against (N,) targets WITHOUT
+def _chunked_ce(x, head, targets, chunk, weights=None, bias=None):
+    """WEIGHTED-SUM NLL of (N, d) hidden rows against (N,) targets WITHOUT
     materializing the (N, V) f32 logits: scan over row chunks; each step
     is rematerialized so backward recomputes the chunk's logits from the
-    (small) saved hidden rows instead of saving V-wide activations."""
+    (small) saved hidden rows instead of saving V-wide activations.
+    Returns sum(w·nll) — the caller divides by its own denominator.
+    ``weights`` default to 1 per row; ``bias`` (V,) supports BERT's MLM
+    output bias."""
     n, d = x.shape
     chunk = min(chunk, n)
     pad = (-n) % chunk
-    w = jnp.ones((n,), jnp.float32)
+    w = (jnp.ones((n,), jnp.float32) if weights is None
+         else weights.astype(jnp.float32))
     if pad:
         x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
         targets = jnp.concatenate(
@@ -335,6 +339,8 @@ def _chunked_ce(x, head, targets, chunk):
     @jax.checkpoint
     def chunk_nll(xc, tc, wc):
         logits = jnp.einsum("cd,dv->cv", xc, head).astype(jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tl = jnp.take_along_axis(
             logits, tc[:, None].astype(jnp.int32), -1)[:, 0]
@@ -345,7 +351,7 @@ def _chunked_ce(x, head, targets, chunk):
         return carry + chunk_nll(xc, tc, wc), None
 
     total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xk, tk, wk))
-    return total / n
+    return total
 
 
 def lm_loss(params, cfg: TransformerConfig, ids, targets, *, aux_weight=1e-2):
@@ -356,7 +362,7 @@ def lm_loss(params, cfg: TransformerConfig, ids, targets, *, aux_weight=1e-2):
         x = _rmsnorm(x, params["ln_f"])
         head = _resolve_head(params, cfg)
         nll = _chunked_ce(x.reshape(b * t, -1), head.astype(x.dtype),
-                          targets.reshape(b * t), cfg.loss_chunk)
+                          targets.reshape(b * t), cfg.loss_chunk) / (b * t)
         return nll + aux_weight * aux
     logits, aux = forward(params, cfg, ids, train=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -507,13 +513,25 @@ def bert_mask_tokens(key, ids, cfg: BertConfig, mask_token_id,
 
 
 def bert_mlm_loss(params, cfg: BertConfig, masked_ids, labels, weights,
-                  type_ids=None, attn_mask=None):
-    """Weighted cross-entropy over masked positions only."""
+                  type_ids=None, attn_mask=None, fused: bool = True):
+    """Weighted cross-entropy over masked positions only. ``fused`` routes
+    through the chunked CE (no (B, T, V) f32 logits materialized — the MLM
+    decoder's dense+norm runs full-size, only the vocab projection is
+    chunked)."""
     _, hidden = bert_forward(params, cfg, masked_ids, type_ids, attn_mask)
+    denom = jnp.maximum(weights.sum(), 1.0)
+    if fused:
+        h = jax.nn.gelu(hidden @ params["mlm_dense"].astype(hidden.dtype))
+        h = _rmsnorm(h, params["mlm_ln"])
+        b, t, d = h.shape
+        total = _chunked_ce(
+            h.reshape(b * t, d), params["embed"].T.astype(h.dtype),
+            labels.reshape(b * t), 1024,
+            weights=weights.reshape(b * t), bias=params["mlm_bias"])
+        return total / denom
     logp = jax.nn.log_softmax(bert_mlm_logits(params, cfg, hidden), -1)
     nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
                                -1)[..., 0]
-    denom = jnp.maximum(weights.sum(), 1.0)
     return (nll * weights).sum() / denom
 
 

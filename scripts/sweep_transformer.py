@@ -1,0 +1,79 @@
+"""Transformer-LM perf sweep on the real chip (VERDICT r2 item 2 runbook).
+
+Usage: python scripts/sweep_transformer.py [phase]
+  phase 1 — fused-loss on/off + remat policies at T=1024 (find best base)
+  phase 2 — batch sweep on the best base config
+  phase 3 — flash-vs-XLA attention crossover table over T
+Each record is MFU-audited via the bench harness. Writes
+scripts/sweep_transformer_out.json (appending per phase).
+"""
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp  # noqa: E402
+
+import bench  # noqa: E402
+from deeplearning4j_tpu.zoo import transformer as tfm  # noqa: E402
+
+OUT = pathlib.Path(__file__).with_name("sweep_transformer_out.json")
+
+
+def run(tag, cfg, batch, steps=11):
+    run_chain, flops = bench.build_transformer(batch, cfg)
+    timing = bench.measure_marginal(run_chain, n1=3, n2=steps)
+    rec = bench._record(tag, "tokens/sec/chip", batch * cfg.max_seq, timing,
+                        flops, batch=batch, seq=cfg.max_seq)
+    print(tag, "->", rec["value"], "tok/s  mfu", rec["mfu"],
+          "step", rec["step_time_ms"], flush=True)
+    results = json.loads(OUT.read_text()) if OUT.exists() else []
+    results.append(rec)
+    OUT.write_text(json.dumps(results, indent=2))
+    return rec
+
+
+def base_cfg(**kw):
+    d = dict(vocab_size=32000, d_model=512, n_heads=8, n_layers=8,
+             d_ff=2048, max_seq=1024, dtype=jnp.bfloat16, remat=False,
+             fused_loss=False)
+    d.update(kw)
+    return tfm.TransformerConfig(**d)
+
+
+def phase1():
+    run("t1024 b16 naive-loss remat-off", base_cfg(), 16)
+    run("t1024 b16 fused-loss remat-off", base_cfg(fused_loss=True), 16)
+    run("t1024 b16 fused-loss chunk2048",
+        base_cfg(fused_loss=True, loss_chunk=2048), 16)
+    run("t1024 b16 fused-loss remat-dots",
+        base_cfg(fused_loss=True, remat=True, remat_policy="dots"), 16)
+    run("t1024 b16 fused-loss remat-full",
+        base_cfg(fused_loss=True, remat=True, remat_policy="full"), 16)
+
+
+def phase2():
+    for b in (8, 24, 32):
+        run(f"t1024 b{b} fused-loss", base_cfg(fused_loss=True), b)
+
+
+def phase3():
+    for t in (1024, 2048, 4096):
+        toks = 16 * 1024
+        b = max(1, toks // t)
+        for attn, tag in ((False, "xla"), (True, "flash")):
+            try:
+                run(f"t{t} b{b} fused {tag}-attn",
+                    base_cfg(max_seq=t, fused_loss=True,
+                             use_flash_attention=attn,
+                             remat=(t >= 4096), remat_policy="dots"), b)
+            except Exception as e:  # noqa: BLE001 — record and continue
+                print(f"t{t} {tag}: FAILED {type(e).__name__}: {e}",
+                      flush=True)
+
+
+if __name__ == "__main__":
+    phase = sys.argv[1] if len(sys.argv) > 1 else "1"
+    {"1": phase1, "2": phase2, "3": phase3}[phase]()

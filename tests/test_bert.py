@@ -192,3 +192,29 @@ def test_bench_bert_and_transformer_paths_compile():
     tgt = _ids(jax.random.PRNGKey(8), batch=4, seq=16)
     _, _, tloss = tstep(tparams, tostate, ids, tgt)
     assert jnp.isfinite(tloss)
+
+
+def test_bert_fused_mlm_loss_matches_naive():
+    """Chunked MLM cross-entropy == naive path (weights + mlm bias routed
+    through the fused kernel); tolerance covers f32 accumulation-order
+    differences between (btd,vd) and (cd,dv) contractions."""
+    import jax
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    cfg = tfm.BertConfig(max_seq=16, vocab_size=96, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64)
+    params = tfm.bert_init(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (3, 16), 0, 96)
+    weights = (jax.random.uniform(jax.random.PRNGKey(2), (3, 16))
+               < 0.3).astype(jnp.float32)
+    ref = float(tfm.bert_mlm_loss(params, cfg, ids, ids, weights,
+                                  fused=False))
+    got = float(tfm.bert_mlm_loss(params, cfg, ids, ids, weights,
+                                  fused=True))
+    assert abs(ref - got) < 2e-4, (ref, got)
+    gr = jax.grad(lambda p: tfm.bert_mlm_loss(p, cfg, ids, ids, weights,
+                                              fused=False))(params)
+    gf = jax.grad(lambda p: tfm.bert_mlm_loss(p, cfg, ids, ids, weights,
+                                              fused=True))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-2), gr, gf)
