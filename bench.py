@@ -527,7 +527,8 @@ def main():
     secondary = {}
     script = os.path.abspath(__file__)
     repo = os.path.dirname(script)
-    for name in ("lenet", "charnn", "bert", "transformer", "dpoverhead"):
+    for name in ("lenet", "charnn", "bert", "transformer", "dpoverhead",
+                 "resnet50_rawstep"):
         if time.perf_counter() - t_start > 1200:
             secondary[name] = {"skipped": "time budget"}
         else:

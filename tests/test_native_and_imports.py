@@ -766,15 +766,17 @@ def test_keras_import_dense_plus_activation_head_and_guards(tmp_path):
     with pytest.raises(ValueError):
         import_keras_sequential(p2, loss="mse")
 
-    # TimeDistributed(Conv2D) rejected loudly at import time
+    # TimeDistributed(Conv2D) imports since r3 (fold-time-into-batch is
+    # shape-generic) — numerics covered by
+    # test_keras_import_timedistributed_conv; here just confirm it builds
     m3 = keras.Sequential([
         keras.layers.Input((3, 8, 8, 2)),
         keras.layers.TimeDistributed(keras.layers.Conv2D(4, 3)),
     ])
     p3 = str(tmp_path / "tdconv.h5")
     m3.save(p3)
-    with pytest.raises(NotImplementedError):
-        import_keras_sequential(p3)
+    net3 = import_keras_sequential(p3)
+    assert net3.output(np.zeros((1, 3, 8, 8, 2), np.float32)).shape[1] == 3
 
 
 def test_keras_import_conv3d_family(tmp_path):
