@@ -43,6 +43,79 @@ import sys
 import time
 
 BASELINE_SAMPLES_PER_SEC = 360.0  # DL4J ResNet-50 V100 cuDNN (BASELINE.md)
+
+
+def _git_sha():
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10, cwd=__import__("os").path.dirname(
+                __import__("os").path.abspath(__file__)),
+        ).stdout.strip() or None
+    except Exception:  # noqa: BLE001 — provenance stamp, never fatal
+        return None
+
+
+def _stamp(rec):
+    """Provenance: every record carries capture time + repo SHA + backend, so
+    a stale artifact can never masquerade as current (the r3 failure mode)."""
+    import datetime
+    import jax
+    rec.setdefault("captured_at",
+                   datetime.datetime.now(datetime.timezone.utc).isoformat(
+                       timespec="seconds"))
+    rec.setdefault("git_sha", _git_sha())
+    try:
+        rec.setdefault("backend", jax.default_backend())
+    except Exception:  # noqa: BLE001
+        rec.setdefault("backend", "unavailable")
+    return rec
+
+
+def wait_for_backend(max_wait_s=300.0, attempt_timeout_s=90.0):
+    """Retry backend init with backoff. The axon tunnel flaps: a single
+    UNAVAILABLE at t=0 (the r3 round-end crash) does not mean it is down for
+    good. Each probe runs in a SUBPROCESS with its own timeout: a wedged
+    relay makes jax.devices() hang 100s+ in-process, which would blow past
+    max_wait_s and also poison this process's backend state. Returns
+    (ok, detail). Never raises."""
+    import subprocess
+    delay, detail = 5.0, ""
+    t0 = time.perf_counter()
+    while True:
+        waited = time.perf_counter() - t0
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices()[0]; "
+                 "print(d.platform + ' ' + str(d))"],
+                capture_output=True, text=True,
+                timeout=min(attempt_timeout_s, max(max_wait_s - waited, 10)))
+            found = proc.stdout.strip()
+            if proc.returncode == 0 and found:
+                platform = found.split()[0]
+                # A silent CPU fallback must NOT pass the gate: an rc=0
+                # headline measured on host CPU would masquerade as a TPU
+                # number. Opt out with BENCH_ALLOW_CPU=1 for local runs.
+                import os
+                if platform == "tpu" or os.environ.get("BENCH_ALLOW_CPU"):
+                    return True, found
+                detail = f"non-TPU backend found: {found}"
+            else:
+                detail = (proc.stderr or proc.stdout)[-300:]
+        except subprocess.TimeoutExpired:
+            detail = f"probe hang >{attempt_timeout_s:.0f}s (relay wedged?)"
+        except Exception as e:  # noqa: BLE001 — backend probe
+            detail = f"{type(e).__name__}: {e}"[:300]
+        waited = time.perf_counter() - t0
+        if waited >= max_wait_s:
+            return False, detail
+        print(f"[bench] backend unavailable, retrying in {delay:.0f}s "
+              f"({waited:.0f}/{max_wait_s:.0f}s elapsed)", file=sys.stderr,
+              flush=True)
+        time.sleep(delay)
+        delay = min(delay * 2, 60.0)
 V5E_BF16_PEAK = 197e12  # TPU v5 lite bf16 peak FLOP/s (public spec)
 DPOVERHEAD_METRIC = "dp-8 per-step overhead vs single device (virtual CPU mesh)"
 
@@ -118,7 +191,7 @@ def _record(metric, unit, samples_per_step, timing, flops_per_step,
     if not valid or (rec["mfu"] is not None and rec["mfu"] > 1.0):
         rec["timing_valid"] = False
     rec.update(extra)
-    return rec
+    return _stamp(rec)
 
 
 def _mln_chain(net, x, y):
@@ -168,12 +241,14 @@ def bench_lenet(batch, steps):
                    batch=batch)
 
 
-def build_charnn(batch, seq=60, vocab=77):
+def build_charnn(batch, seq=60, vocab=77, compute_dtype="bf16"):
     import jax.numpy as jnp
     import numpy as np
     from deeplearning4j_tpu.zoo import TextGenerationLSTM
 
-    net = TextGenerationLSTM(num_classes=vocab, input_shape=(seq, vocab)).init()
+    cd = jnp.bfloat16 if compute_dtype == "bf16" else None
+    net = TextGenerationLSTM(num_classes=vocab, input_shape=(seq, vocab),
+                             compute_dtype=cd).init()
     rng = np.random.default_rng(0)
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[
         rng.integers(0, vocab, (batch, seq))])
@@ -182,13 +257,20 @@ def build_charnn(batch, seq=60, vocab=77):
     return _mln_chain(net, x, y)
 
 
-def bench_charnn(batch, steps):
+def bench_charnn(batch, steps, compute_dtype="bf16"):
     seq = 60
-    run_chain, flops = build_charnn(batch, seq=seq)
+    run_chain, flops = build_charnn(batch, seq=seq,
+                                    compute_dtype=compute_dtype)
     timing = measure_marginal(run_chain, n1=5, n2=steps)
-    return _record("GravesLSTM char-RNN train-step tokens/sec/chip",
-                   "tokens/sec/chip", batch * seq, timing, flops,
-                   dtype="f32", batch=batch, seq=seq)
+    return _record(
+        f"GravesLSTM char-RNN train-step tokens/sec/chip ({compute_dtype})",
+        "tokens/sec/chip", batch * seq, timing, flops,
+        dtype=compute_dtype, batch=batch, seq=seq)
+
+
+def bench_charnn_f32(batch, steps):
+    """Pure-f32 variant kept for the bf16-vs-f32 delta record."""
+    return bench_charnn(batch, steps, compute_dtype="f32")
 
 
 def build_bert(batch, cfg):
@@ -475,6 +557,7 @@ CONFIGS = {
     "resnet50_rawstep": bench_resnet50,
     "lenet": bench_lenet,
     "charnn": bench_charnn,
+    "charnn_f32": bench_charnn_f32,
     "bert": bench_bert,
     "transformer": bench_transformer,
     "dpoverhead": bench_dpoverhead,
@@ -486,12 +569,26 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     "resnet50_rawstep": (128, 13),
     "lenet": (512, 25),
     "charnn": (256, 25),
+    "charnn_f32": (256, 25),
     "bert": (32, 13),
     # transformer: batch 16 + remat off + auto-attention (XLA fused wins at
     # T=1024; pallas flash only from T>=2048) measured +15% tokens/s on-chip
     "transformer": (16, 13),
     "dpoverhead": (1024, 20),
 }
+
+
+def _write_secondary(headline, secondary):
+    """Atomic write (temp + rename) after EVERY config, so a crash mid-run
+    can never leave a stale artifact claiming to be current (the r3 failure:
+    bench_secondary.json on disk was still the r2 output)."""
+    import os
+    import pathlib
+    out = {"headline": headline, "secondary": secondary}
+    path = pathlib.Path(__file__).with_name("bench_secondary.json")
+    tmp = path.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(out, indent=2) + "\n")
+    os.replace(tmp, path)
 
 
 def main():
@@ -513,8 +610,37 @@ def main():
     if len(argv) > 1:
         steps = int(argv[1])
 
+    ok, detail = wait_for_backend()
+    if not ok:
+        # One JSON line, rc=0: an explicit unavailability record beats a
+        # traceback — the driver archives stdout either way, and rc=1 left
+        # round 3 with no artifact at all.
+        unavail = _stamp({
+            "metric": "ComputationGraph.fit(DataSetIterator) samples/sec/chip"
+                      " (ResNet-50 ImageNet)",
+            "backend_unavailable": True,
+            # Pre-set so _stamp's setdefault never touches
+            # jax.default_backend() here — that would init the wedged
+            # backend in-process and hang the very record that reports it.
+            "backend": "unavailable",
+            "error": detail,
+            "note": "axon TPU backend unreachable after retries; no timing "
+                    "captured this run. Last verified numbers live in the "
+                    "previous BENCH_r*.json artifacts.",
+        })
+        print(json.dumps(unavail), flush=True)
+        # Overwrite the secondary artifact too: leaving last round's numbers
+        # on disk unmarked is the r3 stale-artifact failure mode.
+        _write_secondary(unavail, {"backend_unavailable": True})
+        return
+
+    # Mark the artifact incomplete BEFORE the headline runs: a crash during
+    # the headline (the actual r3 failure was mid-run, post-init) must not
+    # leave last round's numbers on disk unmarked.
+    _write_secondary({"_incomplete": "headline in progress"}, {})
     headline = bench_resnet50_fit(batch, steps)
     print(json.dumps(headline), flush=True)
+    _write_secondary(headline, {"_incomplete": "run in progress"})
 
     # Secondary configs (SURVEY §6) -> bench_secondary.json; never stdout.
     # Each runs in a FRESH subprocess: residual allocator/compilation state
@@ -527,8 +653,8 @@ def main():
     secondary = {}
     script = os.path.abspath(__file__)
     repo = os.path.dirname(script)
-    for name in ("lenet", "charnn", "bert", "transformer", "dpoverhead",
-                 "resnet50_rawstep"):
+    for name in ("lenet", "charnn", "bert", "transformer",
+                 "dpoverhead", "resnet50_rawstep", "charnn_f32"):
         if time.perf_counter() - t_start > 1200:
             secondary[name] = {"skipped": "time budget"}
         else:
@@ -547,10 +673,10 @@ def main():
         print(f"[bench] {name}: "
               f"{secondary[name].get('value', secondary[name])}",
               file=sys.stderr, flush=True)
-    import pathlib
-    out = {"headline": headline, "secondary": secondary}
-    pathlib.Path(__file__).with_name("bench_secondary.json").write_text(
-        json.dumps(out, indent=2) + "\n")
+        secondary["_incomplete"] = "run in progress"
+        _write_secondary(headline, secondary)
+    secondary.pop("_incomplete", None)
+    _write_secondary(headline, secondary)
 
 
 if __name__ == "__main__":

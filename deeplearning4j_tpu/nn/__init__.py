@@ -49,4 +49,7 @@ from .losses import Loss
 from .multi_layer_network import MultiLayerNetwork
 from .transfer import (FineTuneConfiguration, TransferLearning,
                        TransferLearningHelper)
+from .weightnoise import (BernoulliDistribution, DropConnect,
+                          NormalDistribution, UniformDistribution,
+                          WeightNoise)
 from .weights import WeightInit

@@ -24,6 +24,7 @@ from .layers.core import LossLayer, OutputLayer
 from .layers.samediff_layer import SameDiffOutputLayer
 from .preprocessors import CnnToFeedForwardPreProcessor
 from .vertices import GraphVertex
+from .weightnoise import maybe_apply_weight_noise
 
 
 class ComputationGraph:
@@ -106,7 +107,9 @@ class ComputationGraph:
                             dropped.append(
                                 jnp.where(m, h / keep, 0.0).astype(h.dtype))
                         xs = dropped
-                    h, s_new = node.op.apply(params[name], states[name], xs, ctx)
+                    p_n = maybe_apply_weight_noise(node.op, params[name],
+                                                   lrng, train)
+                    h, s_new = node.op.apply(p_n, states[name], xs, ctx)
                     new_states[name] = s_new
                     acts[name] = h
                     continue
@@ -126,7 +129,9 @@ class ComputationGraph:
                     new_states[name] = states[name]
                     acts[name] = h
                     continue
-                h, s_new = node.op.apply(params[name], states[name], h, ctx)
+                p_n = maybe_apply_weight_noise(node.op, params[name],
+                                               lrng, train)
+                h, s_new = node.op.apply(p_n, states[name], h, ctx)
                 new_states[name] = s_new
                 acts[name] = h
             else:

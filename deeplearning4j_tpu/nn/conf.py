@@ -35,6 +35,7 @@ class GlobalConf:
     l2: float = 0.0
     weight_decay: float = 0.0
     dropout: float = 0.0
+    weight_noise: Any = None          # IWeightNoise (WeightNoise/DropConnect)
     grad_norm: str = "none"
     grad_norm_threshold: float = 1.0
     param_dtype: Any = jnp.float32
@@ -95,6 +96,11 @@ class Builder:
 
     def dropout_rate(self, rate):
         self._g.dropout = float(rate)
+        return self
+
+    def weight_noise(self, wn):
+        """DL4J Builder.weightNoise(IWeightNoise) — WeightNoise/DropConnect."""
+        self._g.weight_noise = wn
         return self
 
     def gradient_normalization(self, gn):
@@ -187,6 +193,9 @@ def resolve_layer_defaults(layer: Layer, g: GlobalConf) -> Layer:
         layer.l2 = g.l2
     if layer.dropout == 0.0 and g.dropout and layer.has_params():
         layer.dropout = g.dropout
+    if layer.weight_noise is None and g.weight_noise is not None \
+            and layer.has_params():
+        layer.weight_noise = g.weight_noise
     if layer.constraints is None and g.weight_constraints:
         layer.constraints = list(g.weight_constraints)
     if layer.bias_constraints is None and g.bias_constraints:

@@ -80,6 +80,7 @@ class Layer:
     updater: Any = None               # per-layer updater override
     frozen: bool = False
     dropout: float = 0.0              # input dropout (DL4J layer dropOut)
+    weight_noise: Any = None          # IWeightNoise (WeightNoise/DropConnect)
     constraints: Any = None           # weight constraints (constrainWeights)
     bias_constraints: Any = None      # bias constraints (constrainBias)
 

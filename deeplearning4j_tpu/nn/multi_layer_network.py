@@ -28,6 +28,7 @@ from .layers.wrappers import unwrap
 from .layers.core import LossLayer, OCNNOutputLayer, OutputLayer
 from .layers.samediff_layer import SameDiffOutputLayer
 from .preprocessors import CnnToFeedForwardPreProcessor
+from .weightnoise import maybe_apply_weight_noise
 
 
 def _is_ff_layer(layer: Layer) -> bool:
@@ -125,7 +126,9 @@ class MultiLayerNetwork:
                 dk = jax.random.fold_in(lrng, 997)
                 m = jax.random.bernoulli(dk, keep, h.shape)
                 h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
-            h, s_new = layer.apply(params[f"layer_{i}"], states[f"layer_{i}"], h, ctx)
+            p_i = maybe_apply_weight_noise(layer, params[f"layer_{i}"],
+                                           lrng, train)
+            h, s_new = layer.apply(p_i, states[f"layer_{i}"], h, ctx)
             new_states[f"layer_{i}"] = s_new
         return h, new_states
 

@@ -1,0 +1,158 @@
+"""Weight noise — train-time parameter perturbation.
+
+Reference parity: ``org.deeplearning4j.nn.conf.weightnoise.{IWeightNoise,
+WeightNoise, DropConnect}`` and the ``org.nd4j.linalg.api.rng.distribution``
+samplers they take.
+
+TPU-first redesign: the reference mutates a cached noisy copy of each
+parameter inside Layer.preOutput; here noise is a PURE function
+``params -> noisy_params`` applied at the network-forward call site, inside
+jit, keyed off the per-layer fold of the step rng. Gradients flow through the
+noise exactly as in the reference (noise applied to the weight used in the
+forward; the gradient w.r.t. the clean parameter follows by chain rule — for
+additive noise and DropConnect masks that is the masked/unit gradient).
+
+Parameter classification: leaves with ndim >= 2 are weights (W, RW, conv
+kernels, embeddings); 1-d/0-d leaves (b, gamma, beta, running stats live in
+state, not params) are bias-like and only touched when ``apply_to_bias``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------- samplers
+@dataclass
+class NormalDistribution:
+    """org.nd4j...impl.NormalDistribution(mean, std)."""
+
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, key, shape, dtype):
+        return (self.mean
+                + self.std * jax.random.normal(key, shape)).astype(dtype)
+
+
+@dataclass
+class UniformDistribution:
+    """org.nd4j...impl.UniformDistribution(lower, upper)."""
+
+    lower: float = 0.0
+    upper: float = 1.0
+
+    def sample(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, minval=self.lower,
+                                  maxval=self.upper).astype(dtype)
+
+
+@dataclass
+class BernoulliDistribution:
+    """org.nd4j...impl.BernoulliDistribution(p) — samples {0, 1}."""
+
+    p: float = 0.5
+
+    def sample(self, key, shape, dtype):
+        return jax.random.bernoulli(key, self.p, shape).astype(dtype)
+
+
+# ------------------------------------------------------------ noise configs
+class IWeightNoise:
+    """Contract: ``apply(params, key) -> params`` (pure, jit-safe)."""
+
+    def apply(self, params, key):
+        raise NotImplementedError
+
+    # -- shared traversal ---------------------------------------------------
+    def _map_leaves(self, params, key, fn):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(key, max(len(leaves), 1))
+        out = [fn(k, leaf) for k, leaf in zip(keys, leaves)]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+
+@dataclass
+class WeightNoise(IWeightNoise):
+    """Additive or multiplicative distribution noise on weights
+    (reference WeightNoise(Distribution, applyToBias, additive))."""
+
+    distribution: Any = None
+    apply_to_bias: bool = False
+    additive: bool = True
+
+    def __post_init__(self):
+        if self.distribution is None:
+            self.distribution = NormalDistribution(0.0, 0.01)
+
+    def apply(self, params, key):
+        def one(k, w):
+            if not hasattr(w, "ndim") or not jnp.issubdtype(
+                    jnp.asarray(w).dtype, jnp.floating):
+                return w
+            if w.ndim < 2 and not self.apply_to_bias:
+                return w
+            noise = self.distribution.sample(k, w.shape, w.dtype)
+            return w + noise if self.additive else w * noise
+
+        return self._map_leaves(params, key, one)
+
+
+@dataclass
+class DropConnect(IWeightNoise):
+    """Bernoulli weight masking (reference DropConnect(weightRetainProb)):
+    each weight kept with prob p and scaled 1/p (inverted, so inference
+    needs no rescale — matches the reference's DropOut op semantics)."""
+
+    weight_retain_prob: float = 0.5
+    apply_to_bias: bool = False
+
+    def apply(self, params, key):
+        p = self.weight_retain_prob
+
+        def one(k, w):
+            if not hasattr(w, "ndim") or not jnp.issubdtype(
+                    jnp.asarray(w).dtype, jnp.floating):
+                return w
+            if w.ndim < 2 and not self.apply_to_bias:
+                return w
+            mask = jax.random.bernoulli(k, p, w.shape)
+            return jnp.where(mask, w / p, 0.0).astype(w.dtype)
+
+        return self._map_leaves(params, key, one)
+
+
+def _effective_noise(layer):
+    """Weight noise set on a layer nested inside a wrapper
+    (TimeDistributed/MaskZero/Frozen/Bidirectional) must still fire: walk
+    the wrapper chain. Wrappers delegate init(), so the wrapper-level params
+    ARE the inner layer's params and the noise map applies directly (for
+    Bidirectional it covers both directions — intended: the reference
+    resolves noise per underlying layer the same way)."""
+    seen = set()
+    while layer is not None and id(layer) not in seen:
+        wn = getattr(layer, "weight_noise", None)
+        if wn is not None:
+            return wn
+        seen.add(id(layer))
+        layer = (getattr(layer, "layer", None) or getattr(layer, "fwd", None)
+                 or getattr(layer, "inner", None))
+    return None
+
+
+def maybe_apply_weight_noise(layer, params, rng, train):
+    """Network-forward hook: returns the (possibly noisy) params to apply
+    the layer with. No-op unless the layer has weight noise, training is on,
+    and an rng is threaded."""
+    if not train or rng is None:
+        return params
+    wn = _effective_noise(layer)
+    if wn is None:
+        return params
+    # Fold constant far outside the dropout stream's 997+j range so a
+    # many-input vertex can never alias its dropout key with this one.
+    return wn.apply(params, jax.random.fold_in(rng, 100003))

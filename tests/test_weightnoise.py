@@ -1,0 +1,126 @@
+"""WeightNoise / DropConnect tests.
+
+Reference parity: ``org.deeplearning4j.nn.conf.weightnoise.{WeightNoise,
+DropConnect}`` — upstream TestWeightNoise verifies noise engages only in
+training, respects applyToBias, and nets still fit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import (DenseLayer, DropConnect,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration,
+                                   NormalDistribution, OutputLayer,
+                                   WeightNoise)
+from deeplearning4j_tpu.nn.weightnoise import maybe_apply_weight_noise
+from deeplearning4j_tpu.train import Adam
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _mk_params():
+    return {"W": jnp.ones((4, 3)), "b": jnp.zeros((3,))}
+
+
+def test_dropconnect_masks_weights_scales_by_retain():
+    dc = DropConnect(weight_retain_prob=0.6)
+    noisy = dc.apply(_mk_params(), KEY)
+    w = np.asarray(noisy["W"])
+    # Each weight is either dropped or scaled 1/p (inverted dropout).
+    assert np.all((np.abs(w) < 1e-6) | (np.abs(w - 1 / 0.6) < 1e-5))
+    assert (np.abs(w) < 1e-6).any()  # p=0.6 on 12 weights: some drop
+    np.testing.assert_array_equal(np.asarray(noisy["b"]), 0.0)  # bias untouched
+
+
+def test_dropconnect_retain_one_is_identity():
+    dc = DropConnect(weight_retain_prob=1.0)
+    noisy = dc.apply(_mk_params(), KEY)
+    np.testing.assert_allclose(np.asarray(noisy["W"]), 1.0)
+
+
+def test_weightnoise_additive_and_bias_flag():
+    wn = WeightNoise(NormalDistribution(0.0, 0.5), apply_to_bias=False)
+    noisy = wn.apply(_mk_params(), KEY)
+    assert not np.allclose(np.asarray(noisy["W"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(noisy["b"]), 0.0)
+
+    wn_b = WeightNoise(NormalDistribution(0.0, 0.5), apply_to_bias=True)
+    noisy_b = wn_b.apply(_mk_params(), KEY)
+    assert not np.allclose(np.asarray(noisy_b["b"]), 0.0)
+
+
+def test_weightnoise_multiplicative():
+    wn = WeightNoise(NormalDistribution(1.0, 0.0), additive=False)
+    noisy = wn.apply(_mk_params(), KEY)  # multiply by exactly 1.0
+    np.testing.assert_allclose(np.asarray(noisy["W"]), 1.0)
+
+
+def test_noise_on_wrapped_layer_fires():
+    from deeplearning4j_tpu.nn import TimeDistributedLayer
+    inner = DenseLayer(n_out=3, weight_noise=DropConnect(0.5))
+    wrapper = TimeDistributedLayer(layer=inner)
+    p = _mk_params()
+    noisy = maybe_apply_weight_noise(wrapper, p, KEY, train=True)
+    assert not np.allclose(np.asarray(noisy["W"]), 1.0)
+
+
+def test_hook_noop_outside_training():
+    layer = DenseLayer(n_out=3, weight_noise=DropConnect(0.5))
+    p = _mk_params()
+    assert maybe_apply_weight_noise(layer, p, KEY, train=False) is p
+    assert maybe_apply_weight_noise(layer, p, None, train=True) is p
+
+
+def _net(weight_noise=None, global_noise=None, seed=7):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+    if global_noise is not None:
+        b.weight_noise(global_noise)
+    conf = (b.list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu",
+                              weight_noise=weight_noise))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init((8,))
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_global_weight_noise_resolves_onto_layers():
+    net = _net(global_noise=DropConnect(0.9))
+    assert isinstance(net.layers[0].weight_noise, DropConnect)
+    assert isinstance(net.layers[1].weight_noise, DropConnect)
+
+
+def test_inference_unaffected_by_weight_noise():
+    x, _ = _data()
+    clean = _net()
+    noisy = _net(weight_noise=DropConnect(0.5))
+    np.testing.assert_allclose(np.asarray(clean.output(x)),
+                               np.asarray(noisy.output(x)), rtol=1e-6)
+
+
+def test_train_forward_differs_with_dropconnect():
+    net = _net(weight_noise=DropConnect(0.5))
+    x, _ = _data()
+    rng = jax.random.PRNGKey(3)
+    h_train, _ = net._forward(net.params, net.states, x, train=True, rng=rng)
+    h_infer, _ = net._forward(net.params, net.states, x, train=False, rng=None)
+    assert not np.allclose(np.asarray(h_train), np.asarray(h_infer))
+
+
+def test_net_fits_under_dropconnect():
+    from deeplearning4j_tpu.data.dataset import DataSet
+    net = _net(weight_noise=DropConnect(0.8))
+    x, y = _data(128)
+    ds = DataSet(x, y)
+    first = float(net.fit(ds))
+    for _ in range(60):
+        last = float(net.fit(ds))
+    assert last < first * 0.7, (first, last)
