@@ -2,6 +2,9 @@
 SequenceVectors + tokenizers (DL4J deeplearning4j-nlp analogue)."""
 
 from .bert_iterator import BertIterator, BertWordPieceTokenizer
+from .cnn_sentence import (CnnSentenceDataSetIterator,
+                           LabeledSentenceProvider)
+from .fasttext import FastText
 from .glove import GloVe
 from .sequencevectors import SequenceVectors
 from .tokenizers import (BasicLineIterator, BPETokenizer, CharTokenizer,

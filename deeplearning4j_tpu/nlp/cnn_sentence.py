@@ -1,0 +1,164 @@
+"""CnnSentenceDataSetIterator — the text-CNN data path.
+
+Reference parity: ``org.deeplearning4j.iterator.CnnSentenceDataSetIterator``
+(deeplearning4j-nlp): turns labelled sentences + word vectors into padded
+CNN tensors with a per-timestep feature mask, for Kim-2014-style sentence
+convolution models.
+
+Layout is TPU-native NHWC: ``format="cnn2d"`` yields features
+``(B, maxLen, vecSize, 1)`` (reference CNN2D is NCHW ``[b,1,len,vec]``);
+``format="cnn1d"``/``"rnn"`` yields ``(B, maxLen, vecSize)`` [NTC]. Labels
+are one-hot over the sorted label set; sentences shorter than the batch max
+are zero-padded with ``features_mask`` marking real tokens.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.dataset import DataSet
+from .tokenizers import DefaultTokenizerFactory, TokenizerFactory
+
+
+class LabeledSentenceProvider:
+    """Reference ``CollectionLabeledSentenceProvider``: shuffled supply of
+    (sentence, label) pairs."""
+
+    def __init__(self, sentences: Sequence[str], labels: Sequence[str],
+                 seed: Optional[int] = 123):
+        if len(sentences) != len(labels):
+            raise ValueError(
+                f"{len(sentences)} sentences vs {len(labels)} labels")
+        self.data = list(zip(sentences, labels))
+        self.all_labels = sorted(set(labels))
+        self.seed = seed
+        self.reset()
+
+    def reset(self):
+        order = np.arange(len(self.data))
+        if self.seed is not None:
+            np.random.default_rng(self.seed).shuffle(order)
+        self._order = order
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self.data)
+
+    def next(self) -> Tuple[str, str]:
+        s, l = self.data[self._order[self._pos]]
+        self._pos += 1
+        return s, l
+
+    def total_num_sentences(self):
+        return len(self.data)
+
+
+class CnnSentenceDataSetIterator:
+    """Builder args mirror the reference: sentenceProvider, wordVectors,
+    maxSentenceLength, minibatchSize, unknownWordHandling, format."""
+
+    UNKNOWN_WORD_SENTINEL = "UNKNOWN_WORD_SENTINEL"
+
+    def __init__(self, sentence_provider: LabeledSentenceProvider,
+                 word_vectors, batch_size: int = 32,
+                 max_sentence_length: int = 256,
+                 unknown_word_handling: str = "remove",  # | "use_unknown"
+                 format: str = "cnn2d",                  # | "cnn1d" | "rnn"
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        if format not in ("cnn2d", "cnn1d", "rnn"):
+            raise ValueError(f"unknown format '{format}'")
+        if unknown_word_handling not in ("remove", "use_unknown"):
+            raise ValueError(
+                f"unknown unknown_word_handling '{unknown_word_handling}'")
+        self.provider = sentence_provider
+        self.wv = word_vectors
+        self.batch_size = batch_size
+        self.max_sentence_length = max_sentence_length
+        self.unknown_word_handling = unknown_word_handling
+        self.format = format
+        self.tok = tokenizer_factory or DefaultTokenizerFactory()
+        self.labels: List[str] = list(sentence_provider.all_labels)
+        self._label_idx = {l: i for i, l in enumerate(self.labels)}
+        self._vec_size = int(np.asarray(
+            self.wv.syn0).shape[-1]) if getattr(self.wv, "syn0", None) is not None else int(self.wv.layer_size)
+
+    # ---------------------------------------------------------------- vecs
+    def _sentence_vectors(self, sentence: str) -> np.ndarray:
+        toks = self.tok.create(sentence).get_tokens()
+        rows = []
+        for t in toks:
+            if self.wv.has_word(t):
+                rows.append(self.wv.get_word_vector(t))
+            elif self.unknown_word_handling == "use_unknown":
+                rows.append(self._unknown_vector())
+            # "remove": skip (reference UnknownWordHandling.RemoveWord)
+            if len(rows) >= self.max_sentence_length:
+                break
+        if not rows:
+            rows = [np.zeros(self._vec_size, np.float32)]
+        return np.stack(rows).astype(np.float32)
+
+    def _unknown_vector(self):
+        if self.wv.has_word(self.UNKNOWN_WORD_SENTINEL):
+            return self.wv.get_word_vector(self.UNKNOWN_WORD_SENTINEL)
+        return np.zeros(self._vec_size, np.float32)
+
+    def load_single_sentence(self, sentence: str) -> np.ndarray:
+        """Inference helper (reference loadSingleSentence): one padded
+        example with batch dim 1."""
+        v = self._sentence_vectors(sentence)
+        feats = v[None]
+        if self.format == "cnn2d":
+            feats = feats[..., None]
+        return feats
+
+    # ------------------------------------------------------------ iterator
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        return self.provider.has_next()
+
+    def reset(self):
+        self.provider.reset()
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_outcomes(self) -> int:
+        return len(self.labels)
+
+    def input_columns(self) -> int:
+        return self._vec_size
+
+    def async_supported(self) -> bool:
+        return True
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        if not self.has_next():
+            raise StopIteration("iterator exhausted — call reset()")
+        n = num or self.batch_size
+        vecs, ys = [], []
+        while self.provider.has_next() and len(vecs) < n:
+            s, l = self.provider.next()
+            vecs.append(self._sentence_vectors(s))
+            ys.append(self._label_idx[l])
+        b = len(vecs)
+        t = max(v.shape[0] for v in vecs)
+        feats = np.zeros((b, t, self._vec_size), np.float32)
+        mask = np.zeros((b, t), np.float32)
+        for i, v in enumerate(vecs):
+            feats[i, :v.shape[0]] = v
+            mask[i, :v.shape[0]] = 1.0
+        labels = np.eye(len(self.labels), dtype=np.float32)[np.asarray(ys)]
+        if self.format == "cnn2d":
+            feats = feats[..., None]            # (B, T, vec, 1) NHWC
+        return DataSet(feats, labels, features_mask=mask)

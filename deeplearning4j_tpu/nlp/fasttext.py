@@ -1,0 +1,184 @@
+"""FastText — subword n-gram embeddings.
+
+Reference parity: ``org.deeplearning4j.models.fasttext.FastText`` (a JNI
+wrapper over facebookresearch/fastText). Semantics follow the fastText
+skipgram model: a word's input representation is the MEAN of its subword
+vectors — the word itself plus the character n-grams of ``<word>`` for
+n in [minn, maxn], hashed into ``bucket`` slots with FNV-1a — trained
+against negative sampling; OOV words get vectors from their n-grams alone.
+
+TPU-first redesign: upstream fastText is a sequential C++ SGD loop over one
+(center, context) pair at a time. Here the subword id matrix (V, S) is
+precomputed once, a batch's hidden vectors are one gather + masked mean on
+device, and the whole step (loss, grads, occurrence-normalized update) is a
+single jitted program — the same batched-SGD regime as our Word2Vec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import VocabCache
+from .word2vec import Word2Vec, ns_loss_from_u
+
+
+def fnv1a_32(data: bytes) -> int:
+    """FNV-1a 32-bit — the hash fastText uses for n-gram bucketing."""
+    h = 2166136261
+    for b in data:
+        h ^= b
+        h = (h * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def char_ngrams(word: str, minn: int, maxn: int):
+    """Character n-grams of ``<word>`` (with boundary markers), excluding
+    the full token itself — fastText computeSubwords."""
+    w = f"<{word}>"
+    out = []
+    for n in range(minn, maxn + 1):
+        if n >= len(w):
+            continue
+        for i in range(len(w) - n + 1):
+            out.append(w[i:i + n])
+    return out
+
+
+@dataclass
+class FastText(Word2Vec):
+    """fastText skipgram with subword enrichment. Builder knobs mirror the
+    reference: ``minn``/``maxn`` n-gram range, ``bucket`` hash buckets."""
+
+    minn: int = 3
+    maxn: int = 6
+    bucket: int = 50_000   # upstream default is 2M; sized for typical corpora
+
+    def _subword_ids(self, word: str, index: int = None):
+        """Input-row ids for a word: its own slot (in-vocab only) plus
+        hashed n-gram slots offset by V."""
+        V = self.vocab.num_words()
+        ids = [] if index is None else [index]
+        for g in char_ngrams(word, self.minn, self.maxn):
+            ids.append(V + fnv1a_32(g.encode("utf-8")) % self.bucket)
+        return ids
+
+    def _build_subword_table(self):
+        """(V, S) padded id matrix + (V, S) mask over the vocab."""
+        V = self.vocab.num_words()
+        rows = [[0] for _ in range(1)]  # UNK slot: just itself
+        for i in range(1, V):
+            rows.append(self._subword_ids(self.vocab.word_at_index(i), i))
+        S = max(len(r) for r in rows)
+        ids = np.zeros((V, S), np.int32)
+        mask = np.zeros((V, S), np.float32)
+        for i, r in enumerate(rows):
+            ids[i, :len(r)] = r
+            mask[i, :len(r)] = 1.0
+        return jnp.asarray(ids), jnp.asarray(mask)
+
+    def _fit_tokens(self, tok):
+        if self.elements_learning_algorithm.lower() != "skipgram" \
+                or self.use_hierarchic_softmax:
+            raise ValueError(
+                "FastText here trains skipgram + negative sampling only; "
+                "cbow/hierarchic-softmax subword variants are not "
+                "implemented — use Word2Vec for those modes")
+        self.vocab = VocabCache(self.min_word_frequency).fit(tok)
+        ids = [self.vocab.encode(t) for t in tok]
+        centers, contexts = self._build_pairs(ids)
+        if len(centers) == 0:
+            raise ValueError(
+                "no training pairs — corpus too small for vocab settings")
+
+        V, D = self.vocab.num_words(), self.layer_size
+        sub_ids, sub_mask = self._build_subword_table()
+        rows_total = V + self.bucket
+        key = jax.random.PRNGKey(self.seed)
+        k0, key = jax.random.split(key)
+        params = {
+            "syn0": (jax.random.uniform(k0, (rows_total, D), jnp.float32)
+                     - 0.5) / D,
+            "syn1": jnp.zeros((V, D), jnp.float32),
+        }
+        neg_logits = jnp.log(jnp.asarray(self.vocab.negative_table()) + 1e-30)
+
+        def batch_loss(params, ctr, tgt, neg):
+            sids, sm = sub_ids[ctr], sub_mask[ctr]          # (B,S), (B,S)
+            u = ((params["syn0"][sids] * sm[..., None]).sum(1)
+                 / jnp.maximum(sm.sum(1, keepdims=True), 1.0))
+            return ns_loss_from_u(u, tgt, neg, params["syn1"])
+
+        @jax.jit
+        def step(params, key, ctr, tgt, lr):
+            B = ctr.shape[0]
+            nkey, key = jax.random.split(key)
+            neg = jax.random.categorical(nkey, neg_logits[None, :],
+                                         shape=(B, self.negative))
+            loss, grads = jax.value_and_grad(batch_loss)(params, ctr, tgt,
+                                                         neg)
+            # occurrence normalization over INPUT ROWS (word + ngram slots):
+            # same stability argument as Word2Vec's batched SGD
+            sids, sm = sub_ids[ctr], sub_mask[ctr]
+            c0 = jnp.zeros(rows_total).at[sids.ravel()].add(sm.ravel())
+            c1 = jnp.zeros(V).at[tgt].add(1.0).at[neg.ravel()].add(1.0)
+            new = {
+                "syn0": params["syn0"]
+                - lr * grads["syn0"] / jnp.maximum(c0, 1.0)[:, None],
+                "syn1": params["syn1"]
+                - lr * grads["syn1"] / jnp.maximum(c1, 1.0)[:, None],
+            }
+            return new, key, loss / B
+
+        n = len(centers)
+        steps_total = max(1, self.epochs
+                          * ((n + self.batch_size - 1) // self.batch_size))
+        step_i, rng = 0, np.random.default_rng(self.seed)
+        centers = jnp.asarray(centers)
+        contexts = jnp.asarray(contexts)
+        last_loss = 0.0
+        for _ in range(self.epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n - self.batch_size + 1, self.batch_size):
+                idx = perm[s:s + self.batch_size]
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - step_i / steps_total))
+                params, key, last_loss = step(params, key, centers[idx],
+                                              contexts[idx], lr)
+                step_i += 1
+            if n < self.batch_size:
+                idx = rng.integers(0, n, size=self.batch_size)
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - step_i / steps_total))
+                params, key, last_loss = step(params, key, centers[idx],
+                                              contexts[idx], lr)
+                step_i += 1
+        self.syn0_full = np.asarray(params["syn0"])   # (V+bucket, D)
+        # composed per-word vectors so the inherited query/serde API
+        # (similarity, words_nearest, save_word2vec_format) works unchanged
+        sm = np.asarray(sub_mask)
+        comp = (self.syn0_full[np.asarray(sub_ids)] * sm[..., None]).sum(1)
+        self.syn0 = comp / np.maximum(sm.sum(1, keepdims=True), 1.0)
+        self._last_loss = float(last_loss)
+        return self
+
+    # -------------------------------------------------------------- queries
+    def get_word_vector(self, word: str) -> np.ndarray:
+        """In-vocab: composed subword mean. OOV: mean of n-gram buckets —
+        the fastText signature capability."""
+        if self.vocab.contains_word(word):
+            return self.syn0[self.vocab.index_of(word)]
+        if getattr(self, "syn0_full", None) is None:
+            raise ValueError("model not trained")
+        ids = self._subword_ids(word)
+        if not ids:
+            raise ValueError(
+                f"'{word}' is OOV and too short for [{self.minn},{self.maxn}]"
+                " n-grams")
+        return self.syn0_full[np.asarray(ids)].mean(axis=0)
+
+    def out_of_vocab_supported(self) -> bool:
+        return getattr(self, "syn0_full", None) is not None

@@ -2,7 +2,7 @@
 
 from . import activations, losses, weights
 from .conf import MultiLayerConfiguration, NeuralNetConfiguration
-from .layers.attention import (LearnedSelfAttentionLayer,
+from .layers.attention import (AttentionVertex, LearnedSelfAttentionLayer,
                                RecurrentAttentionLayer, SelfAttentionLayer)
 from .layers.base import Ctx, InputType, Layer
 from .layers.conv import (Convolution1DLayer, Convolution3DLayer,

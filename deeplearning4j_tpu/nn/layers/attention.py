@@ -32,14 +32,17 @@ def _mha_params(layer, key, n_in, n_out, n_heads, head_dim):
 
 
 def multi_head_attention(params, q_in, kv_in, n_heads, head_dim, mask=None,
-                         is_causal=False, impl=None, dtype=None):
-    """q_in (B,Tq,C), kv_in (B,Tk,C) → (B,Tq,nOut). mask: (B,Tk) key mask."""
+                         is_causal=False, impl=None, dtype=None, v_in=None):
+    """q_in (B,Tq,C), kv_in (B,Tk,C) → (B,Tq,nOut). mask: (B,Tk) key mask.
+    ``v_in`` (B,Tk,Cv) lets values come from a different input than keys
+    (AttentionVertex's 3-input form); defaults to kv_in."""
     dt = dtype or q_in.dtype
     b, tq, _ = q_in.shape
     tk = kv_in.shape[1]
+    v_src = kv_in if v_in is None else v_in
     q = (q_in @ params["Wq"].astype(dt)).reshape(b, tq, n_heads, head_dim)
     k = (kv_in @ params["Wk"].astype(dt)).reshape(b, tk, n_heads, head_dim)
-    v = (kv_in @ params["Wv"].astype(dt)).reshape(b, tk, n_heads, head_dim)
+    v = (v_src @ params["Wv"].astype(dt)).reshape(b, tk, n_heads, head_dim)
     # pallas kernel needs self-attention (Tq == Tk), no key mask, and real TPU
     # hardware ("pallas_interpret" forces interpreter mode for tests/debug)
     use_pallas = (impl == "pallas_interpret"
@@ -117,6 +120,102 @@ class LearnedSelfAttentionLayer(Layer):
         q = jnp.broadcast_to(params["Q"].astype(x.dtype), (b,) + params["Q"].shape)
         hd = self.head_size or (self.n_out or x.shape[-1]) // self.n_heads
         y = multi_head_attention(params, q, x, self.n_heads, hd, mask=ctx.mask, impl=self.impl)
+        return y, state
+
+
+@dataclass
+class AttentionVertex(Layer):
+    """Multi-head dot-product attention as a ComputationGraph vertex
+    (reference ``org.deeplearning4j.nn.conf.graph.AttentionVertex``).
+
+    Inputs (all NTC): 1 → self-attention (q = k = v); 2 → (queries,
+    keys-and-values); 3 → (queries, keys, values). With
+    ``project_input=False`` (requires ``n_heads == 1``) raw scaled
+    dot-product attention runs without projections, like the reference.
+    """
+
+    multi_input = True
+
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    project_input: bool = True
+    n_in_queries: Optional[int] = None
+    n_in_keys: Optional[int] = None
+    n_in_values: Optional[int] = None
+
+    @staticmethod
+    def _norm_shapes(input_shapes):
+        if input_shapes and not isinstance(input_shapes[0], (tuple, list)):
+            input_shapes = [input_shapes]
+        if len(input_shapes) == 1:
+            input_shapes = input_shapes * 3
+        elif len(input_shapes) == 2:
+            input_shapes = [input_shapes[0], input_shapes[1], input_shapes[1]]
+        elif len(input_shapes) != 3:
+            raise ValueError(
+                f"AttentionVertex takes 1-3 inputs, got {len(input_shapes)}")
+        return input_shapes
+
+    def init(self, key, input_shapes):
+        (tq, cq), (_, ck), (_, cv) = self._norm_shapes(input_shapes)
+        cq = self.n_in_queries or cq
+        ck = self.n_in_keys or ck
+        cv = self.n_in_values or cv
+        if not self.project_input:
+            if self.n_heads != 1:
+                raise ValueError(
+                    "AttentionVertex(project_input=False) requires "
+                    f"n_heads == 1, got {self.n_heads}")
+            if cq != ck:
+                raise ValueError(
+                    "AttentionVertex(project_input=False): query size "
+                    f"{cq} must equal key size {ck}")
+            if self.n_out and self.n_out != cv:
+                raise ValueError(
+                    "AttentionVertex(project_input=False) outputs the value "
+                    f"width {cv}; n_out={self.n_out} needs project_input="
+                    "True (there is no projection to change the width)")
+            return {}, {}, (tq, self.n_out or cv)
+        n_out = self.n_out or cv
+        hd = self.head_size or n_out // self.n_heads
+        proj = self.n_heads * hd
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        params = {
+            "Wq": self._make_weight(k1, (cq, proj), cq, proj),
+            "Wk": self._make_weight(k2, (ck, proj), ck, proj),
+            "Wv": self._make_weight(k3, (cv, proj), cv, proj),
+            "Wo": self._make_weight(k4, (proj, n_out), proj, n_out),
+        }
+        return params, {}, (tq, n_out)
+
+    def apply(self, params, state, xs, ctx: Ctx):
+        if not isinstance(xs, (list, tuple)):
+            xs = [xs]
+        xs = [self._cast_in(x) for x in xs]
+        if len(xs) == 1:
+            q_in = k_in = v_src = xs[0]
+        elif len(xs) == 2:
+            q_in, k_in = xs
+            v_src = k_in
+        else:
+            q_in, k_in, v_src = xs
+        mask = ctx.mask
+        if mask is not None and (mask.ndim != 2
+                                 or mask.shape[1] != k_in.shape[1]):
+            mask = None  # feature mask doesn't span the key axis
+        if not self.project_input:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(q_in.shape[-1], q_in.dtype))
+            scores = jnp.einsum("bqc,bkc->bqk", q_in, k_in) * scale
+            if mask is not None:
+                scores = jnp.where(mask[:, None, :] > 0, scores,
+                                   jnp.finfo(scores.dtype).min)
+            y = jax.nn.softmax(scores, axis=-1) @ v_src
+            return y, state
+        n_out = self.n_out or v_src.shape[-1]
+        hd = self.head_size or n_out // self.n_heads
+        y = multi_head_attention(params, q_in, k_in, self.n_heads, hd,
+                                 mask=mask, v_in=v_src)
         return y, state
 
 

@@ -212,6 +212,41 @@ def mixture_density(labels, preds, n_mixtures, weights=None, mask=None):
     return _mean(per_ex, mask)
 
 
+def multi_label(labels, preds, weights=None, mask=None):
+    """LossMultiLabel (reference ``LossMultiLabel``): pairwise ranking loss
+    over (positive, negative) label pairs per example —
+    ``(1/(|Y||Ybar|)) * sum_{k in Y, l in Ybar} exp(o_l - o_k)``.
+
+    Vectorized in LOG space via the factorization
+    ``exp(logsumexp_l(o_l) + logsumexp_k(-o_k))`` so the result is finite
+    whenever the true pairwise sum is representable (a naive max-shift
+    product overflows when the logit spread exceeds ~88 in f32). Examples
+    with an empty positive OR negative set contribute 0 (the reference
+    skips them); a per-output mask shrinks the label sets, a per-example
+    (B,) mask drops whole examples."""
+    if weights is not None:
+        raise ValueError(
+            "multi_label has no per-output weighting (pairwise ranking has "
+            "no per-unit term; upstream LossMultiLabel takes no weights)")
+    pos = (labels > 0.5).astype(preds.dtype)
+    neg = 1.0 - pos
+    ex_mask = None
+    if mask is not None:
+        if mask.ndim == preds.ndim:        # per-output mask: shrink the sets
+            pos = pos * mask.astype(preds.dtype)
+            neg = neg * mask.astype(preds.dtype)
+        else:                              # (B,)-style example mask
+            ex_mask = mask
+    lse_neg = jax.scipy.special.logsumexp(preds, axis=-1, b=neg)
+    lse_pos = jax.scipy.special.logsumexp(-preds, axis=-1, b=pos)
+    n_pairs = jnp.sum(pos, axis=-1) * jnp.sum(neg, axis=-1)
+    log_loss = lse_neg + lse_pos - jnp.log(jnp.maximum(n_pairs, 1.0))
+    per_ex = jnp.where(n_pairs > 0, jnp.exp(log_loss), 0.0)
+    if per_ex.ndim > 1:  # time-distributed (B, T) -> sum over time
+        per_ex = per_ex.reshape(per_ex.shape[0], -1).sum(axis=1)
+    return _mean(per_ex, ex_mask)
+
+
 class Loss:
     """DL4J-style enum: LossFunctions.LossFunction.* (string-valued)."""
 
@@ -236,6 +271,7 @@ class Loss:
     WASSERSTEIN = "wasserstein"
     FMEASURE = "fmeasure"
     MIXTURE_DENSITY = "mixture_density"
+    MULTI_LABEL = "multi_label"
 
 
 _REGISTRY = {
@@ -246,6 +282,7 @@ _REGISTRY = {
     "poisson": poisson, "hinge": hinge, "squared_hinge": squared_hinge,
     "cosine_proximity": cosine_proximity, "wasserstein": wasserstein,
     "fmeasure": fmeasure, "mixture_density": mixture_density,
+    "multi_label": multi_label, "multilabel": multi_label,
 }
 
 # losses whose stable fused-logits variant exists; OutputLayer uses these

@@ -1,0 +1,152 @@
+"""FastText subword embeddings + CnnSentenceDataSetIterator tests.
+
+Reference parity: ``org.deeplearning4j.models.fasttext.FastText`` and
+``org.deeplearning4j.iterator.CnnSentenceDataSetIterator`` (upstream
+FastTextTest / CnnSentenceDataSetIteratorTest shapes).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (CnnSentenceDataSetIterator, FastText,
+                                    LabeledSentenceProvider, Word2Vec)
+from deeplearning4j_tpu.nlp.fasttext import char_ngrams, fnv1a_32
+
+
+def _toy_corpus():
+    day = "sun day light morning bright sky"
+    night = "moon night dark evening stars sky"
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(200):
+        out.append(" ".join(rng.permutation(day.split())))
+        out.append(" ".join(rng.permutation(night.split())))
+    return out
+
+
+def test_char_ngrams_and_hash():
+    grams = char_ngrams("cat", 3, 4)
+    # "<cat>" length 5: 3-grams <ca, cat, at>; 4-grams <cat, cat>
+    assert grams == ["<ca", "cat", "at>", "<cat", "cat>"]
+    # FNV-1a 32 known vectors
+    assert fnv1a_32(b"") == 2166136261
+    assert fnv1a_32(b"a") == 0xE40C292C
+
+
+@pytest.mark.slow
+def test_fasttext_learns_cooccurrence_and_oov():
+    ft = FastText(layer_size=32, window_size=3, negative=5,
+                  min_word_frequency=5, epochs=60, batch_size=256,
+                  learning_rate=0.1, subsample=0.0, seed=7,
+                  minn=3, maxn=5, bucket=5000).fit(_toy_corpus())
+    assert ft.has_word("sun") and ft.out_of_vocab_supported()
+    assert ft.similarity("sun", "morning") > ft.similarity("sun", "stars")
+    # the fastText signature: an OOV word made of in-corpus character
+    # material still gets a finite, n-gram-composed vector
+    v = ft.get_word_vector("mornings")
+    assert v.shape == (32,) and np.isfinite(v).all()
+    # and shares n-grams with "morning", so it lands nearer to it than to
+    # an unrelated night-cluster word
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+    assert cos(v, ft.get_word_vector("morning")) > cos(
+        v, ft.get_word_vector("dark"))
+
+
+def test_fasttext_oov_too_short_raises():
+    ft = FastText(layer_size=8, min_word_frequency=1, epochs=1,
+                  batch_size=32, minn=3, maxn=4, bucket=100, seed=1,
+                  subsample=0.0)
+    ft.fit(["aa bb aa bb cc dd"] * 20)
+    with pytest.raises(ValueError, match="OOV"):
+        ft.get_word_vector("z")   # "<z>" has len 3, no grams with n>=3...
+    # ("<z>" yields no 3-gram because n >= len(w) is skipped)
+
+
+def _sentences():
+    sents = ["the quick brown fox", "lazy dogs sleep all day",
+             "quick foxes jump", "dogs sleep"]
+    labels = ["fox", "dog", "fox", "dog"]
+    return sents, labels
+
+
+def _wv():
+    return Word2Vec(layer_size=12, min_word_frequency=1, epochs=2,
+                    batch_size=64, seed=3).fit(
+        ["the quick brown fox jumps over lazy dogs sleep all day"] * 30)
+
+
+def test_cnn_sentence_iterator_shapes_and_masks():
+    sents, labels = _sentences()
+    wv = _wv()
+    it = CnnSentenceDataSetIterator(
+        LabeledSentenceProvider(sents, labels, seed=0), wv,
+        batch_size=4, max_sentence_length=8, format="cnn2d")
+    ds = it.next()
+    b, t, v, c = ds.features.shape
+    assert b == 4 and v == 12 and c == 1
+    assert ds.labels.shape == (4, 2)
+    assert ds.features_mask.shape == (b, t)
+    # padding rows are zero and masked out
+    m = np.asarray(ds.features_mask)
+    f = np.asarray(ds.features)
+    assert ((f.sum(axis=(2, 3)) != 0) == (m > 0)).all()
+    # label map is sorted label set
+    assert it.labels == ["dog", "fox"]
+    assert it.total_outcomes() == 2 and it.input_columns() == 12
+
+
+def test_cnn_sentence_iterator_rnn_format_and_reset():
+    sents, labels = _sentences()
+    it = CnnSentenceDataSetIterator(
+        LabeledSentenceProvider(sents, labels, seed=0), _wv(),
+        batch_size=2, format="rnn")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].features.ndim == 3          # (B, T, vec) NTC
+    it.reset()
+    assert it.has_next()
+    again = list(it)
+    np.testing.assert_array_equal(np.asarray(batches[0].features),
+                                  np.asarray(again[0].features))
+
+
+def test_cnn_sentence_unknown_handling_and_single_sentence():
+    sents, labels = _sentences()
+    wv = _wv()
+    it_rm = CnnSentenceDataSetIterator(
+        LabeledSentenceProvider(sents, labels), wv, batch_size=4,
+        unknown_word_handling="remove")
+    it_unk = CnnSentenceDataSetIterator(
+        LabeledSentenceProvider(sents, labels), wv, batch_size=4,
+        unknown_word_handling="use_unknown")
+    x_rm = it_rm.load_single_sentence("quick zzz fox")
+    x_unk = it_unk.load_single_sentence("quick zzz fox")
+    assert x_rm.shape[1] == 2 and x_unk.shape[1] == 3   # removed vs zero-vec
+    assert np.allclose(np.asarray(x_unk)[0, 1], 0.0)
+    # a CNN can actually train on the produced tensors (text-CNN e2e)
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn import (ConvolutionLayer,
+                                       GlobalPoolingLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.multi_layer_network import MultiLayerNetwork
+    from deeplearning4j_tpu.train import Adam
+    it = CnnSentenceDataSetIterator(
+        LabeledSentenceProvider(sents * 8, labels * 8, seed=1), wv,
+        batch_size=8, format="cnn2d")
+    ds = it.next()
+    t = ds.features.shape[1]
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 12),
+                                    convolution_mode="valid",
+                                    activation="relu"))
+            .layer(GlobalPoolingLayer(pooling_type="max"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(t, 12, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    first = float(net.fit(ds))
+    for _ in range(40):
+        last = float(net.fit(ds))
+    assert last < first, (first, last)

@@ -1,0 +1,150 @@
+"""Round-4 parity closers: LossMultiLabel, AttentionVertex.
+
+Reference parity: ``org.nd4j.linalg.lossfunctions.impl.LossMultiLabel``
+(pairwise ranking loss, Zhang & Zhou 2006) and
+``org.deeplearning4j.nn.conf.graph.AttentionVertex``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (AttentionVertex, GlobalPoolingLayer,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.nn.layers.base import Ctx
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+from deeplearning4j_tpu.nn import losses
+from deeplearning4j_tpu.train import Adam
+
+KEY = jax.random.PRNGKey(0)
+
+
+# ------------------------------------------------------------ LossMultiLabel
+def _multilabel_bruteforce(labels, preds):
+    out = []
+    for yi, oi in zip(labels, preds):
+        pos = np.where(yi > 0.5)[0]
+        neg = np.where(yi <= 0.5)[0]
+        if len(pos) == 0 or len(neg) == 0:
+            out.append(0.0)
+            continue
+        s = sum(np.exp(oi[l] - oi[k]) for k in pos for l in neg)
+        out.append(s / (len(pos) * len(neg)))
+    return float(np.mean(out))
+
+
+def test_multilabel_matches_bruteforce():
+    rng = np.random.default_rng(0)
+    preds = rng.standard_normal((6, 5)).astype(np.float32)
+    labels = (rng.random((6, 5)) > 0.5).astype(np.float32)
+    got = float(losses.multi_label(jnp.asarray(labels), jnp.asarray(preds)))
+    want = _multilabel_bruteforce(labels, preds)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_multilabel_empty_sets_contribute_zero():
+    preds = jnp.asarray(np.ones((2, 4), np.float32))
+    labels = jnp.asarray(np.array([[1, 1, 1, 1], [0, 0, 0, 0]], np.float32))
+    assert float(losses.multi_label(labels, preds)) == 0.0
+
+
+def test_multilabel_registered_and_differentiable():
+    fn = losses.get("multi_label")
+    rng = np.random.default_rng(1)
+    preds = jnp.asarray(rng.standard_normal((4, 6)).astype(np.float32))
+    labels = jnp.asarray((rng.random((4, 6)) > 0.5).astype(np.float32))
+    g = jax.grad(lambda p: fn(labels, p))(preds)
+    assert np.isfinite(np.asarray(g)).all()
+    # ranking property: pushing a positive logit up lowers the loss
+    i, j = np.where(np.asarray(labels) > 0.5)
+    assert float(np.asarray(g)[i[0], j[0]]) < 0
+
+
+def test_multilabel_example_mask():
+    preds = np.array([[1.0, 0.0, -1.0], [9.0, 0.0, 3.0]], np.float32)
+    labels = np.array([[1, 0, 0], [1, 0, 0]], np.float32)
+    only0 = _multilabel_bruteforce(labels[:1], preds[:1])
+    got = float(losses.multi_label(jnp.asarray(labels), jnp.asarray(preds),
+                                   mask=jnp.asarray([1.0, 0.0])))
+    np.testing.assert_allclose(got, only0, rtol=1e-5)
+
+
+def test_multilabel_no_overflow_on_wide_logits():
+    preds = jnp.asarray(np.array([[50.0, -50.0, 0.0]], np.float32))
+    labels = jnp.asarray(np.array([[1, 1, 0]], np.float32))
+    got = float(losses.multi_label(labels, preds))
+    want = (np.exp(-50.0) + np.exp(50.0)) / 2  # pairwise terms, both finite
+    assert np.isfinite(got)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_multilabel_rejects_weights():
+    with pytest.raises(ValueError, match="weight"):
+        losses.multi_label(jnp.ones((2, 3)), jnp.ones((2, 3)),
+                           weights=jnp.ones(3))
+
+
+# ------------------------------------------------------------ AttentionVertex
+def test_attention_vertex_shapes_and_param_inference():
+    av = AttentionVertex(n_out=12, n_heads=3)
+    params, state, out = av.init(KEY, [(7, 8), (9, 8), (9, 10)])
+    assert out == (7, 12)
+    assert params["Wq"].shape == (8, 12) and params["Wv"].shape == (10, 12)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((2, 7, 8)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((2, 9, 8)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((2, 9, 10)).astype(np.float32))
+    y, _ = av.apply(params, state, [q, k, v], Ctx(train=False))
+    assert y.shape == (2, 7, 12)
+
+
+def test_attention_vertex_unprojected_oracle():
+    av = AttentionVertex(project_input=False, n_heads=1)
+    params, state, out = av.init(KEY, [(4, 6), (5, 6), (5, 3)])
+    assert out == (4, 3) and params == {}
+    rng = np.random.default_rng(2)
+    q = rng.standard_normal((2, 4, 6)).astype(np.float32)
+    k = rng.standard_normal((2, 5, 6)).astype(np.float32)
+    v = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    y, _ = av.apply(params, state, [jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v)], Ctx(train=False))
+    # manual scaled dot-product attention
+    scores = np.einsum("bqc,bkc->bqk", q, k) / np.sqrt(6.0)
+    w = np.exp(scores - scores.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(y), np.einsum("bqk,bkc->bqc", w, v),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_attention_vertex_project_false_validates():
+    av = AttentionVertex(project_input=False, n_heads=2)
+    with pytest.raises(ValueError, match="n_heads"):
+        av.init(KEY, [(4, 6)])
+    av2 = AttentionVertex(project_input=False, n_heads=1)
+    with pytest.raises(ValueError, match="query size"):
+        av2.init(KEY, [(4, 6), (5, 7), (5, 3)])
+
+
+def test_attention_vertex_in_computation_graph_trains():
+    g = (NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-3))
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("enc", LSTM(n_in=5, n_out=8, activation="tanh"), "in")
+         .add_vertex("attn", AttentionVertex(n_out=8, n_heads=2), "enc")
+         .add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "attn")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "pool")
+         .set_outputs("out"))
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.data.dataset import DataSet
+    net = ComputationGraph(g.build()).init([(6, 5)])
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 6, 5)).astype(np.float32)
+    y_idx = (x.mean(axis=(1, 2)) > 0).astype(int)
+    y = np.eye(3, dtype=np.float32)[y_idx]
+    ds = DataSet(jnp.asarray(x), jnp.asarray(y))
+    first = float(net.fit(ds))
+    for _ in range(80):
+        last = float(net.fit(ds))
+    assert last < first * 0.6, (first, last)
