@@ -536,14 +536,16 @@ def _gru_cell(x, h, w_ih, w_hh, b):
     return (1 - z) * n + z * h
 
 
-def _rnn_layer(cell_has_c):
+def _rnn_layer(cell_has_c, cell=None):
+    """Scan a cell over (B, T, ...) time-major-internally. ``cell`` defaults
+    to the fused LSTM/GRU cells; any (x_t, h[, c], *args) cell works."""
     def f(x, h0, *args):
         def body(carry, xt):
             if cell_has_c:
                 h, c = carry
-                h2, c2 = _lstm_cell(xt, h, c, *args)
+                h2, c2 = (cell or _lstm_cell)(xt, h, c, *args)
                 return (h2, c2), h2
-            h2 = _gru_cell(xt, carry, *args)
+            h2 = (cell or _gru_cell)(xt, carry, *args)
             return h2, h2
         init = h0 if not cell_has_c else (h0, jnp.zeros_like(h0))
         _, hs = lax.scan(body, init, jnp.swapaxes(x, 0, 1))
@@ -1352,4 +1354,457 @@ NAMESPACES["fft"] = FFT
 MATH_EXT.update({
     "fft": FFT["fft"], "ifft": FFT["ifft"],
     "rfft": FFT["rfft"], "irfft": FFT["irfft"],
+})
+
+
+# -------------------------------------------------------- r4 widening #4 --
+# Upstream name audit vs SDBaseOps/SDMath/SDNN/SDCNN/SDRNN/SDLinalg/SDImage/
+# SDLoss (VERDICT r3 item 4): conditional-replace family, all-pairs reduce3
+# distances, SRU/LSTM-block recurrences, morphological conv, quantization,
+# drawing/NMS-overlaps image ops, the nn.losses catalog exposed as SD loss
+# ops, and the SDMath scalar tail (cube, lerp, rationalTanh, firstIndex...).
+
+def _cond_mask(x, cond, value=0.0):
+    """Upstream `Condition` objects (EqualsCondition, GreaterThan, ...) as a
+    static string + threshold — returns the boolean mask."""
+    c = str(cond).lower()
+    table = {
+        "eq": lambda: x == value, "neq": lambda: x != value,
+        "gt": lambda: x > value, "gte": lambda: x >= value,
+        "lt": lambda: x < value, "lte": lambda: x <= value,
+        "abs_gt": lambda: jnp.abs(x) > value,
+        "abs_lt": lambda: jnp.abs(x) < value,
+        "is_nan": lambda: jnp.isnan(x), "is_inf": lambda: jnp.isinf(x),
+        "not_finite": lambda: ~jnp.isfinite(x),
+    }
+    if c not in table:
+        raise ValueError(f"unknown condition '{cond}' "
+                         f"(known: {sorted(table)})")
+    return table[c]()
+
+
+def _replace_where(x, replacement, cond, value=0.0):
+    """SDBaseOps.replaceWhere: elements satisfying the condition are taken
+    from `replacement` (array or scalar)."""
+    return jnp.where(_cond_mask(x, cond, value),
+                     jnp.broadcast_to(jnp.asarray(replacement, x.dtype),
+                                      x.shape), x)
+
+
+def _compare_and_set(x, compare, set_value, eps=1e-7):
+    """nd4j CompareAndSet: where |x - compare| <= eps, write set_value."""
+    return jnp.where(jnp.abs(x - compare) <= eps,
+                     jnp.asarray(set_value, x.dtype), x)
+
+
+def _first_index(x, cond, value=0.0):
+    """SDMath.firstIndex: first flat index satisfying condition, -1 if none."""
+    m = _cond_mask(jnp.ravel(x), cond, value)
+    idx = jnp.argmax(m)
+    return jnp.where(jnp.any(m), idx, -1).astype(jnp.int32)
+
+
+def _last_index(x, cond, value=0.0):
+    m = _cond_mask(jnp.ravel(x), cond, value)
+    n = m.shape[0]
+    idx = n - 1 - jnp.argmax(m[::-1])
+    return jnp.where(jnp.any(m), idx, -1).astype(jnp.int32)
+
+
+def _merge_max_index(*xs):
+    """nd4j MergeMaxIndex: elementwise argmax across the input list."""
+    return jnp.argmax(jnp.stack(xs), axis=0).astype(jnp.int32)
+
+
+def _rational_tanh(x):
+    """nd4j RationalTanh: 1.7159 * a(2x/3) with the quartic rational
+    approximation a(y) = sgn(y) * (1 - 1/(1 + |y| + y^2 + 1.41645 y^4))."""
+    y = 2.0 * x / 3.0
+    a = 1.0 - 1.0 / (1.0 + jnp.abs(y) + y * y + 1.41645 * y ** 4)
+    return 1.7159 * jnp.sign(y) * a
+
+
+def _check_numerics(x, message="CheckNumerics failed"):
+    """nd4j CheckNumerics: pass-through that fails on NaN/Inf. Concrete
+    arrays raise immediately; under jit the check rides jax's debug
+    machinery (error surfaces on fetch with jax_debug_nans)."""
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+        return x                       # ints/bools are always finite
+    if not isinstance(x, jax.core.Tracer):
+        if not bool(jnp.isfinite(x).all()):
+            raise FloatingPointError(f"{message}: non-finite values present")
+        return x
+    return jax.lax.cond(jnp.isfinite(x).all(), lambda v: v,
+                        lambda v: v * jnp.asarray(jnp.nan, x.dtype),
+                        x)  # poison, not silence
+
+
+def _all_pairs(fn):
+    """reduce3 all-distances (upstream allEuclidean/allManhattan/...):
+    x (N, D), y (M, D) -> (N, M) via vmap over both sides."""
+    return lambda x, y: jax.vmap(
+        lambda xi: jax.vmap(lambda yj: fn(xi, yj))(y))(x)
+
+
+BASE.update({
+    "replace_where": _replace_where,
+    "compare_and_set": _compare_and_set,
+    "standard_deviation": BASE["std"],        # SDBaseOps.standardDeviation
+    "histogram": lambda x, nbins, range=None: jnp.histogram(
+        x, bins=int(nbins), range=range)[0],
+    "check_numerics": _check_numerics,
+})
+
+MATH_EXT.update({
+    "cube": lambda x: x * x * x,
+    "lerp": lambda a, b, w: a + w * (b - a),
+    "rational_tanh": _rational_tanh,
+    "rectified_tanh": lambda x: jnp.maximum(jnp.tanh(x), 0.0),
+    "first_index": _first_index,
+    "last_index": _last_index,
+    "merge_max_index": _merge_max_index,
+    "all_euclidean": _all_pairs(
+        lambda a, b: jnp.sqrt(jnp.sum(jnp.square(a - b)))),
+    "all_manhattan": _all_pairs(lambda a, b: jnp.sum(jnp.abs(a - b))),
+    "all_cosine_similarity": _all_pairs(
+        lambda a, b: jnp.dot(a, b)
+        / jnp.maximum(jnp.linalg.norm(a) * jnp.linalg.norm(b), 1e-12)),
+    "all_cosine_distance": _all_pairs(
+        lambda a, b: 1.0 - jnp.dot(a, b)
+        / jnp.maximum(jnp.linalg.norm(a) * jnp.linalg.norm(b), 1e-12)),
+    "all_dot": _all_pairs(jnp.dot),
+    "all_hamming": _all_pairs(lambda a, b: jnp.sum(a != b)),
+    "all_jaccard": _all_pairs(lambda a, b: 1.0 - jnp.sum(
+        jnp.minimum(a, b)) / jnp.maximum(jnp.sum(jnp.maximum(a, b)), 1e-12)),
+})
+
+
+# ---- quantization (upstream FakeQuantWithMinMax*, tf parity) --------------
+def _fake_quant(x, min=-6.0, max=6.0, num_bits=8, narrow_range=False):
+    qmin = 1 if narrow_range else 0
+    qmax = 2 ** int(num_bits) - 1
+    # nudge range so zero is exactly representable (TF semantics)
+    scale = (max - min) / (qmax - qmin)
+    zero = qmin - min / scale
+    nudged_zero = jnp.clip(jnp.round(zero), qmin, qmax)
+    nudged_min = (qmin - nudged_zero) * scale
+    nudged_max = (qmax - nudged_zero) * scale
+    clipped = jnp.clip(x, nudged_min, nudged_max)
+    q = jnp.round((clipped - nudged_min) / scale)
+    return q * scale + nudged_min
+
+
+def _quantize(x, scale, zero_point, num_bits=8, signed=False):
+    qmin = -(2 ** (num_bits - 1)) if signed else 0
+    qmax = 2 ** (num_bits - 1) - 1 if signed else 2 ** num_bits - 1
+    return jnp.clip(jnp.round(x / scale) + zero_point, qmin, qmax).astype(
+        jnp.int8 if signed and num_bits <= 8 else
+        jnp.uint8 if num_bits <= 8 else jnp.int32)
+
+
+NN_EXT.update({
+    "crelu": lambda x, axis=-1: jnp.concatenate(
+        [jax.nn.relu(x), jax.nn.relu(-x)], axis=axis),
+    "relu_layer": lambda x, w, b: jax.nn.relu(x @ w + b),
+    "fake_quant_with_min_max_args": _fake_quant,
+    "fake_quant_with_min_max_vars": _fake_quant,   # vars = traced min/max
+    "quantize": _quantize,
+    "dequantize": lambda q, scale, zero_point: (
+        q.astype(jnp.float32) - zero_point) * scale,
+})
+
+
+# ---- SRU / LSTM-block recurrences (upstream SDRNN sru/sruCell/lstmblock) --
+def _sru_cell(x, c, w, b):
+    """Simple Recurrent Unit cell (Lei et al. 2017; upstream sruCell):
+    w packs [W, Wf, Wr] as (D, 3D); b packs [bf, br] as (2D,)."""
+    d = c.shape[-1]
+    z = x @ w
+    xt, f_in, r_in = z[..., :d], z[..., d:2 * d], z[..., 2 * d:]
+    f = jax.nn.sigmoid(f_in + b[:d])
+    r = jax.nn.sigmoid(r_in + b[d:])
+    c2 = f * c + (1.0 - f) * xt
+    h = r * jnp.tanh(c2) + (1.0 - r) * x[..., :d]
+    return h, c2
+
+
+def _sru(x, c0, w, b):
+    """SRU over a full (B, T, D) sequence; the elementwise recurrence is
+    the lax.scan body — the matmuls batch over T in one shot first (the
+    property that makes SRU fast: no per-step matmul)."""
+    d = c0.shape[-1]
+    z = x @ w                                  # (B, T, 3D) in one matmul
+    f = jax.nn.sigmoid(z[..., d:2 * d] + b[:d])
+    r = jax.nn.sigmoid(z[..., 2 * d:] + b[d:])
+    xt = z[..., :d]
+
+    def body(c, inp):
+        xt_t, f_t, r_t, x_t = inp
+        c2 = f_t * c + (1.0 - f_t) * xt_t
+        h = r_t * jnp.tanh(c2) + (1.0 - r_t) * x_t[..., :d]
+        return c2, h
+
+    _, hs = lax.scan(body, c0, tuple(
+        jnp.swapaxes(v, 0, 1) for v in (xt, f, r, x)))
+    return jnp.swapaxes(hs, 0, 1)
+
+
+RNN.update({
+    "sru_cell": _sru_cell,
+    "sru": _sru,
+    "simple_rnn_layer": _rnn_layer(cell_has_c=False,
+                                   cell=RNN["simple_rnn_cell"]),
+    "lstm_block_cell": _lstm_cell,   # upstream LSTMBlockCell = fused gates,
+    "lstm_block": _rnn_layer(cell_has_c=True),  # which our cell already is
+})
+
+
+# ---- morphological conv (upstream/tf Dilation2D; erosion as its dual) -----
+def _dilation2d(x, filt, strides=(1, 1), rates=(1, 1), padding="SAME"):
+    """x (B, H, W, C), filt (kh, kw, C): out[b,y,x,c] =
+    max_{dy,dx}(in[b, y*s+dy*r, x*s+dx*r, c] + filt[dy, dx, c])."""
+    kh, kw = filt.shape[0], filt.shape[1]
+    sh, sw = strides
+    rh, rw = rates
+    if padding.upper() == "SAME":
+        # TF SAME formula: pad = max((ceil(in/s) - 1)*s + eff - in, 0) —
+        # with stride > 1 this differs from eff-1 and misaligns otherwise
+        eff_h, eff_w = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        ph = max((-(-x.shape[1] // sh) - 1) * sh + eff_h - x.shape[1], 0)
+        pw = max((-(-x.shape[2] // sw) - 1) * sw + eff_w - x.shape[2], 0)
+        x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                        (pw // 2, pw - pw // 2), (0, 0)),
+                    constant_values=-jnp.inf)
+    h_out = (x.shape[1] - (kh - 1) * rh - 1) // sh + 1
+    w_out = (x.shape[2] - (kw - 1) * rw - 1) // sw + 1
+    taps = []
+    for dy in range(kh):
+        for dx in range(kw):
+            sl = x[:, dy * rh:dy * rh + h_out * sh:sh,
+                   dx * rw:dx * rw + w_out * sw:sw, :]
+            taps.append(sl + filt[dy, dx])
+    return jnp.max(jnp.stack(taps), axis=0)
+
+
+def _erosion2d(x, filt, strides=(1, 1), rates=(1, 1), padding="SAME"):
+    """Morphological dual: erosion(x, f) = -dilation(-x, reverse(f))."""
+    return -_dilation2d(-x, filt[::-1, ::-1], strides, rates, padding)
+
+
+CNN.update({
+    "dilation2d": _dilation2d,
+    "erosion2d": _erosion2d,
+    "pnorm_pool2d": CNN["lp_pool2d"],          # upstream pnormpool2d name
+})
+
+
+# ---- image: NMS-with-overlaps, area resize, box drawing -------------------
+def _nms_overlaps(overlaps, scores, max_out, overlap_threshold=0.5,
+                  score_threshold=-jnp.inf):
+    """tf.image.non_max_suppression_overlaps: greedy NMS where the (N, N)
+    overlap matrix is supplied by the caller instead of IoU from boxes."""
+    n = scores.shape[0]
+
+    def body(state, _):
+        live, count = state
+        masked = jnp.where(live, scores, -jnp.inf)
+        i = jnp.argmax(masked)
+        ok = jnp.logical_and(masked[i] > score_threshold,
+                             jnp.isfinite(masked[i]))
+        suppress = overlaps[i] > overlap_threshold
+        live = jnp.where(ok, jnp.logical_and(live, ~suppress), live)
+        live = live.at[i].set(False)
+        return (live, count + ok.astype(jnp.int32)), \
+            jnp.where(ok, i, -1).astype(jnp.int32)
+
+    (_, count), idx = lax.scan(body, (jnp.ones(n, bool), jnp.int32(0)),
+                               None, length=int(max_out))
+    return idx, count
+
+
+def _resize_area(x, h, w):
+    """Area resize: exact block-mean for integer downscale factors, else
+    bilinear (jax.image has no area kernel; integer-factor block mean IS
+    the area method, which is the common use — avg-pool downscaling)."""
+    b, ih, iw, c = x.shape
+    h, w = int(h), int(w)
+    if ih % h == 0 and iw % w == 0:
+        fh, fw = ih // h, iw // w
+        return x.reshape(b, h, fh, w, fw, c).mean(axis=(2, 4))
+    return jax.image.resize(x, (b, h, w, c), method="linear")
+
+
+def _draw_bounding_boxes(images, boxes, colors=None):
+    """tf.image.draw_bounding_boxes: boxes (B, N, 4) normalized
+    [y1, x1, y2, x2]; draws 1px outlines. Static N (XLA); color cycles
+    through `colors` (K, C) or defaults to max-intensity channel 0."""
+    b, h, w, c = images.shape
+    n = boxes.shape[1]
+    if colors is None:
+        colors = jnp.zeros((1, c), images.dtype).at[0, 0].set(
+            jnp.asarray(1.0, images.dtype))
+    colors = jnp.asarray(colors, images.dtype)
+    ys = jnp.arange(h)[:, None]                  # (H, 1)
+    xs = jnp.arange(w)[None, :]                  # (1, W)
+
+    def draw_one(img, box, color):
+        # TF truncates (int cast), not rounds
+        y1 = (box[0] * (h - 1)).astype(jnp.int32)
+        x1 = (box[1] * (w - 1)).astype(jnp.int32)
+        y2 = (box[2] * (h - 1)).astype(jnp.int32)
+        x2 = (box[3] * (w - 1)).astype(jnp.int32)
+        in_y = (ys >= y1) & (ys <= y2)
+        in_x = (xs >= x1) & (xs <= x2)
+        edge = (in_y & in_x) & (
+            (ys == y1) | (ys == y2) | (xs == x1) | (xs == x2))
+        return jnp.where(edge[..., None], color, img)
+
+    def per_image(img, bxs):
+        def body(im, i):
+            return draw_one(im, bxs[i], colors[i % colors.shape[0]]), None
+        out, _ = lax.scan(body, img, jnp.arange(n))
+        return out
+
+    return jax.vmap(per_image)(images, boxes)
+
+
+IMAGE.update({
+    "non_max_suppression_overlaps": _nms_overlaps,
+    "resize_area": _resize_area,
+    "draw_bounding_boxes": _draw_bounding_boxes,
+})
+
+
+# ---- the nn.losses catalog as SD loss ops (upstream exposes both) ---------
+def _wrap_loss(name):
+    from ..nn import losses as _nnl
+    return _nnl.get(name)
+
+
+LOSS_EXT.update({
+    "mean_pairwise_squared_error": lambda labels, preds: jnp.mean(jax.vmap(
+        lambda d: (jnp.sum(jnp.square(d[:, None] - d[None, :])) / 2.0)
+        / jnp.maximum(d.shape[0] * (d.shape[0] - 1) / 2.0, 1.0))(
+        (preds - labels).reshape(labels.shape[0], -1))),
+    "multi_label_loss": _wrap_loss("multi_label"),
+    "mae_loss": _wrap_loss("mae"),
+    "mape_loss": _wrap_loss("mape"),
+    "msle_loss": _wrap_loss("msle"),
+    "wasserstein_loss": _wrap_loss("wasserstein"),
+    "fmeasure_loss": _wrap_loss("fmeasure"),
+    "mixture_density_loss": _wrap_loss("mixture_density"),
+})
+
+LINALG.update({
+    "adjoint": lambda x: jnp.conjugate(jnp.swapaxes(x, -1, -2)),
+    "matrix_inverse": LINALG["inv"],           # upstream matrixInverse
+    "matrix_determinant": LINALG["det"],       # upstream matrixDeterminant
+})
+
+def _multinomial(key, logits, num_samples):
+    """tf.multinomial semantics: logits (B, K) + int num_samples ->
+    (B, num_samples) draws (vs categorical's shape-tuple argument)."""
+    logits = jnp.asarray(logits)
+    batch = logits.shape[:-1]
+    out = jax.random.categorical(key, logits, axis=-1,
+                                 shape=(int(num_samples),) + batch)
+    return jnp.moveaxis(out, 0, -1)
+
+
+RANDOM.update({
+    "multinomial": _multinomial,
+})
+
+BITWISE.update({
+    "bit_rotl": BITWISE["cyclic_shift_left"],
+    "bit_rotr": BITWISE["cyclic_shift_right"],
+})
+
+
+def _space_to_batch_nd(x, block_shape, paddings):
+    """tf/upstream spaceToBatchNd for NHWC-style inputs (spatial dims are
+    axes 1..len(block_shape))."""
+    bs = [int(b) for b in block_shape]
+    pads = [(0, 0)] + [tuple(int(v) for v in p) for p in paddings] \
+        + [(0, 0)] * (x.ndim - 1 - len(bs))
+    x = jnp.pad(x, pads)
+    b = x.shape[0]
+    spatial = x.shape[1:1 + len(bs)]
+    rest = x.shape[1 + len(bs):]
+    shape = [b]
+    for s, blk in zip(spatial, bs):
+        shape += [s // blk, blk]
+    x = x.reshape(shape + list(rest))
+    # (b, s1/b1, b1, s2/b2, b2, ...) -> (b1, b2, ..., b, s1/b1, s2/b2, ...)
+    perm = [2 * i + 2 for i in range(len(bs))] + [0] \
+        + [2 * i + 1 for i in range(len(bs))] \
+        + list(range(1 + 2 * len(bs), x.ndim))
+    x = x.transpose(perm)
+    return x.reshape([b * _math.prod(bs)] + [s // blk for s, blk in
+                                             zip(spatial, bs)] + list(rest))
+
+
+def _batch_to_space_nd(x, block_shape, crops):
+    bs = [int(b) for b in block_shape]
+    nb = x.shape[0] // _math.prod(bs)
+    spatial = x.shape[1:1 + len(bs)]
+    rest = x.shape[1 + len(bs):]
+    x = x.reshape(bs + [nb] + list(spatial) + list(rest))
+    perm = [len(bs)]
+    for i in range(len(bs)):
+        perm += [len(bs) + 1 + i, i]
+    perm += list(range(1 + 2 * len(bs), x.ndim))
+    x = x.transpose(perm)
+    x = x.reshape([nb] + [s * blk for s, blk in zip(spatial, bs)]
+                  + list(rest))
+    sl = [slice(None)]
+    for (c0, c1), s in zip(crops, x.shape[1:1 + len(bs)]):
+        sl.append(slice(int(c0), s - int(c1)))
+    return x[tuple(sl)]
+
+
+def _image_resize(x, h, w, method="bilinear"):
+    """SDImage.imageResize: one dispatcher over the method enum."""
+    m = str(method).lower()
+    if m in ("area",):
+        return _resize_area(x, h, w)
+    table = {"bilinear": "linear", "linear": "linear",
+             "nearest": "nearest", "neighbor": "nearest",
+             "bicubic": "cubic", "cubic": "cubic",
+             "lanczos3": "lanczos3", "lanczos5": "lanczos5"}
+    if m not in table:
+        raise ValueError(f"unknown resize method '{method}'")
+    b, _, _, c = x.shape
+    return jax.image.resize(x, (b, int(h), int(w), c), method=table[m])
+
+
+BASE.update({
+    "space_to_batch_nd": _space_to_batch_nd,
+    "batch_to_space_nd": _batch_to_space_nd,
+    "tear": BASE["unstack"],                    # nd4j Tear = unstack
+})
+
+MATH_EXT.update({
+    "eps": lambda x, y, eps=1e-5: jnp.abs(x - y) < eps,   # nd4j Eps op
+    "axpy": lambda a, x, y: a * x + y,                    # nd4j Axpy
+    "to_degrees": MATH_EXT["rad2deg"],
+    "to_radians": MATH_EXT["deg2rad"],
+})
+
+NN_EXT.update({
+    "precise_gelu": NN_EXT["gelu_exact"],
+    "thresholded_relu": lambda x, theta=1.0: jnp.where(x > theta, x, 0.0),
+})
+
+RNN.update({
+    "gru": RNN["gru_layer"],                    # upstream GRU (time op)
+})
+
+IMAGE.update({
+    "image_resize": _image_resize,
+    "adjust_contrast_v2": IMAGE["adjust_contrast"],
+})
+
+LOSS_EXT.update({
+    "log_poisson": LOSS_EXT["log_poisson_loss"],
 })

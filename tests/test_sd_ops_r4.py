@@ -1,0 +1,300 @@
+"""Round-4 op-registry widening tests (VERDICT r3 item 4).
+
+Oracle tests for the new conditional-replace family, all-pairs reduce3
+distances, SRU, morphological conv, quantization, image ops, loss wires,
+and the raised registry gate. Reference anchors: upstream nd4j
+``SDBaseOps.replaceWhere``, ``allEuclidean``-family reduce3 ops, ``sruCell``/
+``sru``, tf/nd4j ``Dilation2D``, ``FakeQuantWithMinMaxArgs``,
+``non_max_suppression_overlaps``, ``imageResize``, ``LossMultiLabel`` et al.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import sd_ops
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_registry_gate_r4():
+    from deeplearning4j_tpu.autodiff.samediff import _LOSS, _MATH, _NN
+    total = sd_ops.op_count() + len(_MATH) + len(_NN) + len(_LOSS)
+    assert sd_ops.op_count() >= 550, sd_ops.op_count()
+    assert total >= 620, total
+
+
+# ------------------------------------------------ conditional replace family
+def test_replace_where_and_compare_and_set():
+    x = jnp.asarray([1.0, -2.0, 3.0, -4.0])
+    out = sd_ops.BASE["replace_where"](x, 0.0, "lt", 0.0)
+    np.testing.assert_array_equal(np.asarray(out), [1.0, 0.0, 3.0, 0.0])
+    out = sd_ops.BASE["replace_where"](x, jnp.asarray([9.0, 9.0, 9.0, 9.0]),
+                                       "gt", 2.0)
+    np.testing.assert_array_equal(np.asarray(out), [1.0, -2.0, 9.0, -4.0])
+    out = sd_ops.BASE["compare_and_set"](x, -2.0, 7.0)
+    np.testing.assert_array_equal(np.asarray(out), [1.0, 7.0, 3.0, -4.0])
+    with pytest.raises(ValueError, match="unknown condition"):
+        sd_ops.BASE["replace_where"](x, 0.0, "wat")
+
+
+def test_first_last_index_and_merge_max_index():
+    x = jnp.asarray([0.0, 3.0, 0.0, 5.0, 0.0])
+    assert int(sd_ops.MATH_EXT["first_index"](x, "gt", 0.0)) == 1
+    assert int(sd_ops.MATH_EXT["last_index"](x, "gt", 0.0)) == 3
+    assert int(sd_ops.MATH_EXT["first_index"](x, "gt", 99.0)) == -1
+    a, b, c = jnp.asarray([1.0, 5.0]), jnp.asarray([2.0, 1.0]), \
+        jnp.asarray([0.0, 9.0])
+    np.testing.assert_array_equal(
+        np.asarray(sd_ops.MATH_EXT["merge_max_index"](a, b, c)), [1, 2])
+
+
+def test_check_numerics():
+    good = jnp.asarray([1.0, 2.0])
+    np.testing.assert_array_equal(
+        np.asarray(sd_ops.BASE["check_numerics"](good)), [1.0, 2.0])
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        sd_ops.BASE["check_numerics"](jnp.asarray([1.0, jnp.nan]))
+
+
+# ------------------------------------------------------------- math widening
+def test_rational_and_rectified_tanh():
+    x = jnp.linspace(-3, 3, 31)
+    rt = np.asarray(sd_ops.MATH_EXT["rational_tanh"](x))
+    # LeCun scaled tanh: approximates 1.7159*tanh(2x/3), odd and monotone
+    ref = 1.7159 * np.tanh(2 * np.asarray(x) / 3)
+    assert np.max(np.abs(rt - ref)) < 0.15
+    assert np.all(np.diff(rt) > 0) and np.allclose(rt, -rt[::-1], atol=1e-6)
+    re = np.asarray(sd_ops.MATH_EXT["rectified_tanh"](x))
+    np.testing.assert_allclose(re, np.maximum(np.tanh(np.asarray(x)), 0),
+                               rtol=1e-6)
+
+
+def test_all_pairs_distances():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    y = rng.standard_normal((3, 6)).astype(np.float32)
+    got = np.asarray(sd_ops.MATH_EXT["all_euclidean"](jnp.asarray(x),
+                                                      jnp.asarray(y)))
+    want = np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    gotm = np.asarray(sd_ops.MATH_EXT["all_manhattan"](jnp.asarray(x),
+                                                       jnp.asarray(y)))
+    np.testing.assert_allclose(
+        gotm, np.abs(x[:, None] - y[None]).sum(-1), rtol=1e-5)
+    gotc = np.asarray(sd_ops.MATH_EXT["all_cosine_similarity"](
+        jnp.asarray(x), jnp.asarray(y)))
+    wantc = (x @ y.T) / np.outer(np.linalg.norm(x, axis=1),
+                                 np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(gotc, wantc, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.MATH_EXT["all_dot"](jnp.asarray(x),
+                                              jnp.asarray(y))),
+        x @ y.T, rtol=1e-5)
+
+
+def test_eps_axpy_lerp_cube():
+    x = jnp.asarray([1.0, 2.0])
+    y = jnp.asarray([1.0 + 1e-7, 3.0])
+    np.testing.assert_array_equal(
+        np.asarray(sd_ops.MATH_EXT["eps"](x, y)), [True, False])
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.MATH_EXT["axpy"](2.0, x, y)),
+        np.asarray(2.0 * x + y))
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.MATH_EXT["lerp"](0.0, 10.0, 0.3)), 3.0)
+    np.testing.assert_allclose(
+        np.asarray(sd_ops.MATH_EXT["cube"](jnp.asarray(3.0))), 27.0)
+
+
+# ------------------------------------------------------------- quantization
+def test_fake_quant_tf_semantics():
+    # range [0, 6], 8 bits: scale = 6/255; values snap to the grid
+    x = jnp.asarray([0.0, 0.011, 3.0, 7.0, -1.0])
+    out = np.asarray(sd_ops.NN_EXT["fake_quant_with_min_max_args"](
+        x, min=0.0, max=6.0))
+    scale = 6.0 / 255.0
+    ratio = out / scale
+    assert np.allclose(ratio, np.round(ratio), atol=1e-3)  # on the grid
+    assert out[3] == pytest.approx(6.0, abs=1e-6)   # clipped to max
+    assert out[4] == pytest.approx(0.0, abs=1e-6)   # clipped to min
+    # zero is exactly representable
+    assert out[0] == 0.0
+
+
+def test_quantize_dequantize_roundtrip():
+    x = jnp.asarray([0.0, 0.5, 1.0, -0.25])
+    q = sd_ops.NN_EXT["quantize"](x, scale=1 / 128, zero_point=128)
+    assert q.dtype == jnp.uint8
+    back = np.asarray(sd_ops.NN_EXT["dequantize"](q, 1 / 128, 128))
+    np.testing.assert_allclose(back, [0.0, 0.5, 1.0, -0.25], atol=1 / 128)
+
+
+# ---------------------------------------------------------------------- SRU
+def test_sru_matches_cell_loop():
+    rng = np.random.default_rng(3)
+    b, t, d = 2, 5, 4
+    x = jnp.asarray(rng.standard_normal((b, t, d)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((d, 3 * d)).astype(np.float32))
+    bias = jnp.asarray(rng.standard_normal((2 * d,)).astype(np.float32))
+    c = jnp.zeros((b, d))
+    hs = []
+    for i in range(t):
+        h, c = sd_ops.RNN["sru_cell"](x[:, i], c, w, bias)
+        hs.append(h)
+    want = np.stack([np.asarray(h) for h in hs], axis=1)
+    got = np.asarray(sd_ops.RNN["sru"](x, jnp.zeros((b, d)), w, bias))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_simple_rnn_layer_shapes():
+    x = jnp.ones((2, 3, 4))
+    h0 = jnp.zeros((2, 5))
+    out = sd_ops.RNN["simple_rnn_layer"](x, h0, jnp.ones((4, 5)) * 0.1,
+                                         jnp.ones((5, 5)) * 0.1,
+                                         jnp.zeros(5))
+    assert out.shape == (2, 3, 5)
+    assert np.all(np.diff(np.abs(np.asarray(out)[0, :, 0])) >= -1e-6)
+
+
+# ------------------------------------------------------- morphological conv
+def test_dilation2d_bruteforce():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 6, 6, 2)).astype(np.float32)
+    f = rng.standard_normal((3, 3, 2)).astype(np.float32)
+    got = np.asarray(sd_ops.CNN["dilation2d"](jnp.asarray(x), jnp.asarray(f),
+                                              padding="VALID"))
+    want = np.zeros((1, 4, 4, 2), np.float32)
+    for y in range(4):
+        for xx in range(4):
+            for c in range(2):
+                want[0, y, xx, c] = np.max(
+                    x[0, y:y + 3, xx:xx + 3, c] + f[:, :, c])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # erosion duality: erosion(x, f) = -dilation(-x, flip(f))
+    er = np.asarray(sd_ops.CNN["erosion2d"](jnp.asarray(x), jnp.asarray(f),
+                                            padding="VALID"))
+    want_er = -np.asarray(sd_ops.CNN["dilation2d"](
+        jnp.asarray(-x), jnp.asarray(f[::-1, ::-1]), padding="VALID"))
+    np.testing.assert_allclose(er, want_er, rtol=1e-5)
+
+
+def test_dilation2d_same_padding_shape():
+    x = jnp.ones((1, 5, 7, 1))
+    f = jnp.zeros((3, 3, 1))
+    assert sd_ops.CNN["dilation2d"](x, f, padding="SAME").shape \
+        == (1, 5, 7, 1)
+
+
+def test_dilation2d_same_strided_matches_tf():
+    # TF oracle (verified against tf.nn.dilation2d): stride 2, SAME on a
+    # 4x4 ramp with a zero filter picks the window maxima [[10,11],[14,15]]
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    f = jnp.zeros((3, 3, 1))
+    out = np.asarray(sd_ops.CNN["dilation2d"](x, f, strides=(2, 2),
+                                              padding="SAME"))
+    np.testing.assert_allclose(out[0, :, :, 0], [[10, 11], [14, 15]])
+
+
+def test_check_numerics_int_passthrough_under_jit():
+    out = jax.jit(sd_ops.BASE["check_numerics"])(jnp.asarray([1, 2, 3]))
+    np.testing.assert_array_equal(np.asarray(out), [1, 2, 3])
+
+
+def test_multinomial_tf_signature():
+    key = jax.random.PRNGKey(0)
+    logits = jnp.asarray(np.zeros((2, 5), np.float32))
+    out = sd_ops.RANDOM["multinomial"](key, logits, 7)
+    assert out.shape == (2, 7)
+    assert np.asarray(out).min() >= 0 and np.asarray(out).max() < 5
+
+
+# ------------------------------------------------------------------- image
+def test_nms_overlaps():
+    overlaps = jnp.asarray([[1.0, 0.9, 0.0],
+                            [0.9, 1.0, 0.0],
+                            [0.0, 0.0, 1.0]])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    idx, count = sd_ops.IMAGE["non_max_suppression_overlaps"](
+        overlaps, scores, 3, overlap_threshold=0.5)
+    assert int(count) == 2
+    assert list(np.asarray(idx))[:2] == [0, 2]
+
+
+def test_resize_area_block_mean():
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    out = np.asarray(sd_ops.IMAGE["resize_area"](x, 2, 2))
+    np.testing.assert_allclose(out[0, :, :, 0],
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_image_resize_dispatch():
+    x = jnp.ones((1, 4, 4, 3))
+    for m in ("bilinear", "nearest", "bicubic", "area"):
+        assert sd_ops.IMAGE["image_resize"](x, 8, 8, method=m).shape \
+            == (1, 8, 8, 3)
+    with pytest.raises(ValueError, match="unknown resize method"):
+        sd_ops.IMAGE["image_resize"](x, 8, 8, method="wat")
+
+
+def test_draw_bounding_boxes():
+    img = jnp.zeros((1, 10, 10, 3))
+    boxes = jnp.asarray([[[0.1, 0.1, 0.5, 0.5]]])
+    out = np.asarray(sd_ops.IMAGE["draw_bounding_boxes"](img, boxes))
+    # TF truncates: 0.1*9 = 0.9 -> row/col 0, 0.5*9 = 4.5 -> row/col 4
+    assert out[0, 0, 0, 0] == 1.0 and out[0, 0, 4, 0] == 1.0   # top edge
+    assert out[0, 4, 0, 0] == 1.0                               # bottom edge
+    assert out[0, 2, 2, 0] == 0.0                               # interior
+
+
+# ------------------------------------------------------- losses + transforms
+def test_mean_pairwise_squared_error():
+    labels = jnp.asarray([[0.0, 1.0, 2.0]])
+    preds = jnp.asarray([[1.0, 3.0, 2.0]])
+    d = np.asarray(preds - labels)[0]           # [1, 2, 0]
+    pairs = [(0, 1), (0, 2), (1, 2)]
+    want = np.mean([(d[i] - d[j]) ** 2 for i, j in pairs])
+    got = float(sd_ops.LOSS_EXT["mean_pairwise_squared_error"](labels, preds))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_loss_catalog_wired():
+    labels = jnp.asarray(np.eye(3, dtype=np.float32)[[0, 1, 2]])
+    preds = jnp.abs(jnp.asarray(
+        np.random.default_rng(0).random((3, 3)).astype(np.float32)))
+    for name in ("multi_label_loss", "mae_loss", "mape_loss", "msle_loss",
+                 "wasserstein_loss", "fmeasure_loss"):
+        v = float(sd_ops.LOSS_EXT[name](labels, preds))
+        assert np.isfinite(v), name
+
+
+def test_space_batch_nd_roundtrip():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (2, 4, 6, 3)).astype(np.float32))
+    sb = sd_ops.BASE["space_to_batch_nd"](x, [2, 3], [(0, 0), (0, 0)])
+    assert sb.shape == (12, 2, 2, 3)
+    back = sd_ops.BASE["batch_to_space_nd"](sb, [2, 3], [(0, 0), (0, 0)])
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), rtol=1e-6)
+    # with padding/crops
+    sb = sd_ops.BASE["space_to_batch_nd"](x, [2, 2], [(0, 0), (1, 1)])
+    back = sd_ops.BASE["batch_to_space_nd"](sb, [2, 2], [(0, 0), (1, 1)])
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), rtol=1e-6)
+
+
+def test_crelu_relu_layer_thresholded():
+    x = jnp.asarray([[-1.0, 2.0]])
+    np.testing.assert_array_equal(
+        np.asarray(sd_ops.NN_EXT["crelu"](x)), [[0.0, 2.0, 1.0, 0.0]])
+    w, b = jnp.eye(2), jnp.asarray([0.5, -3.0])
+    np.testing.assert_array_equal(
+        np.asarray(sd_ops.NN_EXT["relu_layer"](x, w, b)), [[0.0, 0.0]])
+    np.testing.assert_array_equal(
+        np.asarray(sd_ops.NN_EXT["thresholded_relu"](
+            jnp.asarray([0.5, 1.5]), 1.0)), [0.0, 1.5])
+
+
+def test_histogram():
+    x = jnp.asarray([0.0, 0.1, 0.9, 1.0, 0.5])
+    h = np.asarray(sd_ops.BASE["histogram"](x, 2, range=(0.0, 1.0)))
+    assert h.sum() == 5 and h[0] == 2 and h[1] == 3  # 0.5 -> upper bin
