@@ -208,7 +208,131 @@ class TFImporter:
             "Conj": lambda i, n: jnp.conj(i[0]),
             "Complex": lambda i, n: lax.complex(i[0], i[1]),
             "Angle": lambda i, n: jnp.angle(i[0]),
+            # --- r4 widening: arbitrary-frozen-graph generality -----------
+            "ClipByValue": lambda i, n: jnp.clip(i[0], i[1], i[2]),
+            "Xlogy": lambda i, n: jax.scipy.special.xlogy(i[0], i[1]),
+            "Xlog1py": lambda i, n: jax.scipy.special.xlog1py(i[0], i[1]),
+            "Xdivy": lambda i, n: jnp.where(
+                i[0] == 0, 0.0, i[0] / jnp.where(i[0] == 0, 1.0, i[1])),
+            "Digamma": lambda i, n: jax.scipy.special.digamma(i[0]),
+            "Lgamma": lambda i, n: jax.scipy.special.gammaln(i[0]),
+            "Igamma": lambda i, n: jax.scipy.special.gammainc(i[0], i[1]),
+            "Igammac": lambda i, n: jax.scipy.special.gammaincc(i[0], i[1]),
+            "Polygamma": lambda i, n: jax.scipy.special.polygamma(
+                jnp.asarray(i[0]).astype(jnp.int32), i[1]),
+            "Zeta": lambda i, n: jax.scipy.special.zeta(i[0], i[1]),
+            "Betainc": lambda i, n: jax.scipy.special.betainc(
+                i[0], i[1], i[2]),
+            "Erfinv": lambda i, n: jax.scipy.special.erfinv(i[0]),
+            "Ndtri": lambda i, n: jax.scipy.special.ndtri(i[0]),
+            "TopKV2": self._topk,
+            "SegmentSum": lambda i, n: self._segment(i, "sum"),
+            "SegmentMean": lambda i, n: self._segment(i, "mean"),
+            "SegmentMax": lambda i, n: self._segment(i, "max"),
+            "SegmentMin": lambda i, n: self._segment(i, "min"),
+            "SegmentProd": lambda i, n: self._segment(i, "prod"),
+            "UnsortedSegmentSum": lambda i, n: self._segment(i, "sum",
+                                                             unsorted=True),
+            "UnsortedSegmentMax": lambda i, n: self._segment(i, "max",
+                                                             unsorted=True),
+            "UnsortedSegmentMin": lambda i, n: self._segment(i, "min",
+                                                             unsorted=True),
+            "UnsortedSegmentProd": lambda i, n: self._segment(i, "prod",
+                                                              unsorted=True),
+            "Bincount": lambda i, n: jnp.bincount(
+                jnp.asarray(i[0]).astype(jnp.int32).ravel(),
+                weights=None if np.asarray(i[2]).size == 0 else i[2].ravel(),
+                length=int(np.asarray(i[1]))),
+            "DynamicPartition": self._dynamic_partition,
+            "DynamicStitch": self._dynamic_stitch,
+            "ParallelDynamicStitch": self._dynamic_stitch,
+            "SpaceToBatchND": self._space_to_batch_nd,
+            "BatchToSpaceND": self._batch_to_space_nd,
+            "Dilation2D": self._dilation2d,
+            "Conv3D": self._conv3d,
+            "MaxPool3D": self._maxpool3d,
+            "AvgPool3D": self._avgpool3d,
+            "FakeQuantWithMinMaxArgs": self._fake_quant_args,
+            "CheckNumerics": self._check_numerics,
+            "Snapshot": self._identity,
+            "PreventGradient": self._identity,
+            "EnsureShape": self._identity,
+            "NonMaxSuppressionV3": self._nms_v3,
+            "NonMaxSuppressionV4": self._nms_v4,
+            "CropAndResize": self._crop_and_resize,
+            "ResizeBicubic": self._resize_bicubic,
+            "DrawBoundingBoxesV2": self._draw_boxes,
+            "DrawBoundingBoxes": self._draw_boxes,
+            "MatrixDeterminant": lambda i, n: jnp.linalg.det(i[0]),
+            "MatrixInverse": lambda i, n: jnp.linalg.inv(i[0]),
+            "Cholesky": lambda i, n: jnp.linalg.cholesky(i[0]),
+            "LogMatrixDeterminant": lambda i, n: list(
+                jnp.linalg.slogdet(i[0])),
+            "SoftmaxCrossEntropyWithLogits": self._softmax_xent,
+            "SparseSoftmaxCrossEntropyWithLogits": self._sparse_softmax_xent,
+            "Roll": lambda i, n: jnp.roll(i[0], _axes(i[1]), _axes(i[2])),
+            "Bucketize": lambda i, n: jnp.searchsorted(
+                jnp.asarray(list(n.attr["boundaries"].list.f)),
+                i[0], side="right").astype(jnp.int32),
+            # TF clamps out-of-range values into the edge bins; jnp.histogram
+            # would drop them, so clip first
+            "HistogramFixedWidth": lambda i, n: jnp.histogram(
+                jnp.clip(i[0], float(np.asarray(i[1])[0]),
+                         float(np.asarray(i[1])[1])),
+                bins=int(np.asarray(i[2])),
+                range=(float(np.asarray(i[1])[0]),
+                       float(np.asarray(i[1])[1])))[0].astype(jnp.int32),
+            "BroadcastArgs": lambda i, n: jnp.asarray(
+                np.broadcast_shapes(tuple(_axes(i[0])), tuple(_axes(i[1]))),
+                jnp.int32),
+            "LeftShift": lambda i, n: jnp.left_shift(i[0], i[1]),
+            "RightShift": lambda i, n: jnp.right_shift(i[0], i[1]),
+            "BitwiseAnd": lambda i, n: jnp.bitwise_and(i[0], i[1]),
+            "BitwiseOr": lambda i, n: jnp.bitwise_or(i[0], i[1]),
+            "BitwiseXor": lambda i, n: jnp.bitwise_xor(i[0], i[1]),
+            "Invert": lambda i, n: jnp.bitwise_not(i[0]),
+            "AccumulateNV2": lambda i, n: sum(i),
+            "RandomUniform": lambda i, n: jax.random.uniform(
+                self._node_key(n), _axes(i[0])),
+            "RandomStandardNormal": lambda i, n: jax.random.normal(
+                self._node_key(n), _axes(i[0])),
+            "TruncatedNormal": lambda i, n: jax.random.truncated_normal(
+                self._node_key(n), -2.0, 2.0, _axes(i[0])),
+            "RandomUniformInt": lambda i, n: jax.random.randint(
+                self._node_key(n), _axes(i[0]), int(np.asarray(i[1])),
+                int(np.asarray(i[2]))),
+            "Multinomial": lambda i, n: self._multinomial(i, n),
+            # --- control flow: V2 functional ops --------------------------
+            "If": self._if, "StatelessIf": self._if,
+            "While": self._while, "StatelessWhile": self._while,
+            "PartitionedCall": self._call, "StatefulPartitionedCall":
+                self._call,
+            # V1 Switch/Merge conditionals are wired in import_graph (they
+            # need graph-level branch tracking); V1 loop frames are not
+            # representable without frame analysis — loud error:
+            "Enter": self._v1_loop_err, "Exit": self._v1_loop_err,
+            "NextIteration": self._v1_loop_err,
+            "LoopCond": self._v1_loop_err,
         }
+        # ops with >1 output: op type -> (node -> output count)
+        self.multi_output = {
+            "Split": lambda n: n.attr["num_split"].i,
+            "SplitV": lambda n: n.attr["num_split"].i,
+            "Unpack": lambda n: n.attr["num"].i,
+            "TopKV2": lambda n: 2,
+            "LogMatrixDeterminant": lambda n: 2,
+            "SoftmaxCrossEntropyWithLogits": lambda n: 2,
+            "SparseSoftmaxCrossEntropyWithLogits": lambda n: 2,
+            "NonMaxSuppressionV4": lambda n: 2,
+            "If": lambda n: len(n.attr["Tout"].list.type),
+            "StatelessIf": lambda n: len(n.attr["Tout"].list.type),
+            "While": lambda n: len(n.attr["T"].list.type),
+            "StatelessWhile": lambda n: len(n.attr["T"].list.type),
+            "PartitionedCall": lambda n: len(n.attr["Tout"].list.type),
+            "StatefulPartitionedCall":
+                lambda n: len(n.attr["Tout"].list.type),
+        }
+        self._functions = {}
 
     # --- handlers needing node attrs ---------------------------------------
     def _identity(self, i, n):
@@ -484,11 +608,335 @@ class TFImporter:
             return total / count
         return total / (k[1] * k[2])
 
+    # --------------------------------------------------- r4 handler methods
+    def _topk(self, i, n):
+        k = int(np.asarray(i[1]))
+        vals, idx = lax.top_k(i[0], k)
+        if not n.attr["sorted"].b:
+            pass  # unsorted=False only loosens the contract; sorted is fine
+        return [vals, idx.astype(jnp.int32)]
+
+    def _segment(self, i, mode, unsorted=False):
+        data = i[0]
+        ids = jnp.asarray(i[1]).astype(jnp.int32)
+        if unsorted:
+            num = int(np.asarray(i[2]))
+        else:
+            # sorted segment ops: num_segments = last id + 1, which must be
+            # static for XLA — requires a const ids tensor (typical in
+            # frozen graphs); a traced ids tensor raises here, loudly
+            num = int(np.asarray(ids)[-1]) + 1
+        if mode == "mean":
+            s = jax.ops.segment_sum(data, ids, num)
+            c = jax.ops.segment_sum(jnp.ones_like(data), ids, num)
+            return s / jnp.maximum(c, 1)
+        return getattr(jax.ops, f"segment_{mode}")(data, ids, num)
+
+    def _dynamic_partition(self, i, n):
+        # XLA needs static shapes: masked same-shape parts (matches our
+        # sd_ops BASE["dynamic_partition"] convention, documented there)
+        num = n.attr["num_partitions"].i
+        parts = jnp.asarray(i[1]).astype(jnp.int32)
+        return [jnp.where(
+            (parts == k).reshape((-1,) + (1,) * (i[0].ndim - 1)), i[0], 0)
+            for k in range(num)]
+
+    def _dynamic_stitch(self, i, n):
+        half = len(i) // 2
+        indices, data = i[:half], i[half:]
+        size = int(max(int(np.asarray(ix).max()) for ix in indices)) + 1
+        suffix = data[0].shape[np.ndim(indices[0]):]
+        out = jnp.zeros((size,) + suffix, data[0].dtype)
+        for ix, d in zip(indices, data):
+            # each pair splits at ITS index rank (mixed ranks are the
+            # canonical DynamicStitch usage)
+            out = out.at[jnp.asarray(ix).astype(jnp.int32).ravel()].set(
+                d.reshape((-1,) + d.shape[np.ndim(ix):]))
+        return out
+
+    def _space_to_batch_nd(self, i, n):
+        from . import sd_ops
+        return sd_ops.BASE["space_to_batch_nd"](
+            i[0], _axes(i[1]), [tuple(r) for r in np.asarray(i[2])])
+
+    def _batch_to_space_nd(self, i, n):
+        from . import sd_ops
+        return sd_ops.BASE["batch_to_space_nd"](
+            i[0], _axes(i[1]), [tuple(r) for r in np.asarray(i[2])])
+
+    def _dilation2d(self, i, n):
+        from . import sd_ops
+        strides = tuple(n.attr["strides"].list.i)[1:3]
+        rates = tuple(n.attr["rates"].list.i)[1:3]
+        return sd_ops.CNN["dilation2d"](i[0], i[1], strides, rates,
+                                        n.attr["padding"].s.decode())
+
+    def _conv3d(self, i, n):
+        strides = tuple(n.attr["strides"].list.i)[1:4]
+        pad = n.attr["padding"].s.decode()
+        return lax.conv_general_dilated(
+            i[0], i[1], strides, pad,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+    def _maxpool3d(self, i, n):
+        k = tuple(n.attr["ksize"].list.i)
+        s = tuple(n.attr["strides"].list.i)
+        return lax.reduce_window(i[0], -jnp.inf, lax.max, k, s,
+                                 n.attr["padding"].s.decode())
+
+    def _avgpool3d(self, i, n):
+        k = tuple(n.attr["ksize"].list.i)
+        s = tuple(n.attr["strides"].list.i)
+        pad = n.attr["padding"].s.decode()
+        total = lax.reduce_window(i[0], 0.0, lax.add, k, s, pad)
+        if pad == "SAME":
+            count = lax.reduce_window(jnp.ones_like(i[0]), 0.0, lax.add,
+                                      k, s, pad)
+            return total / count
+        return total / np.prod(k[1:4])
+
+    def _fake_quant_args(self, i, n):
+        from . import sd_ops
+        return sd_ops.NN_EXT["fake_quant_with_min_max_args"](
+            i[0], min=_attr_f(n, "min", -6.0), max=_attr_f(n, "max", 6.0),
+            num_bits=(n.attr["num_bits"].i or 8),
+            narrow_range=n.attr["narrow_range"].b)
+
+    def _check_numerics(self, i, n):
+        from . import sd_ops
+        return sd_ops.BASE["check_numerics"](
+            i[0], n.attr["message"].s.decode() or "CheckNumerics failed")
+
+    def _nms_v3(self, i, n):
+        from . import sd_ops
+        idx, _ = sd_ops.IMAGE["non_max_suppression"](
+            i[0], i[1], int(np.asarray(i[2])),
+            iou_threshold=float(np.asarray(i[3])),
+            score_threshold=float(np.asarray(i[4])))
+        return idx
+
+    def _nms_v4(self, i, n):
+        from . import sd_ops
+        idx, count = sd_ops.IMAGE["non_max_suppression"](
+            i[0], i[1], int(np.asarray(i[2])),
+            iou_threshold=float(np.asarray(i[3])),
+            score_threshold=float(np.asarray(i[4])))
+        return [idx, count]
+
+    def _crop_and_resize(self, i, n):
+        from . import sd_ops
+        return sd_ops.IMAGE["crop_and_resize"](
+            i[0], i[1], jnp.asarray(i[2]).astype(jnp.int32), _axes(i[3]),
+            extrapolation_value=_attr_f(n, "extrapolation_value", 0.0))
+
+    def _resize_bicubic(self, i, n):
+        x = i[0]
+        oh, ow = (int(v) for v in _axes(i[1]))
+        return jax.image.resize(x, (x.shape[0], oh, ow, x.shape[3]),
+                                method="cubic")
+
+    def _draw_boxes(self, i, n):
+        from . import sd_ops
+        return sd_ops.IMAGE["draw_bounding_boxes"](
+            i[0], i[1], None if len(i) < 3 or np.asarray(i[2]).size == 0
+            else i[2])
+
+    def _softmax_xent(self, i, n):
+        logits, labels = i[0], i[1]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.sum(labels * logp, axis=-1)
+        return [loss, jax.nn.softmax(logits, axis=-1) - labels]
+
+    def _sparse_softmax_xent(self, i, n):
+        logits = i[0]
+        labels = jnp.asarray(i[1]).astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        grad = jax.nn.softmax(logits, axis=-1) \
+            - jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
+        return [loss, grad]
+
+    def _multinomial(self, i, n):
+        from . import sd_ops
+        return sd_ops.RANDOM["multinomial"](
+            self._node_key(n), i[0], int(np.asarray(i[1]))).astype(jnp.int64)
+
+    def _node_key(self, n):
+        """Deterministic PRNG key per random node: frozen-graph inference
+        has no seed input, so derive one from the node name (stable across
+        runs — unlike TF's stateful kernels, deliberately: reproducibility
+        is the TPU-native contract). crc32, not hash(): str hash is
+        process-salted (same reasoning as samediff's name keying)."""
+        import zlib
+        return jax.random.PRNGKey(zlib.crc32(n.name.encode()) & 0x7FFFFFFF)
+
+    def _v1_loop_err(self, i, n):
+        raise NotImplementedError(
+            f"TF v1 control-flow frame op '{n.op}' (node '{n.name}'): v1 "
+            "while-loops need frame analysis and are not supported; "
+            "re-export the model with TF2 functional control flow "
+            "(tf.function produces While/StatelessWhile, which import)")
+
+    # ---------------------------------------------- function-library support
+    def _register_functions(self, graph_def):
+        for fdef in graph_def.library.function:
+            self._functions[fdef.signature.name] = fdef
+
+    @staticmethod
+    def _op_output_args(op_name):
+        """Output arg names for an op type from TF's registry (proto-side
+        only, nothing executes)."""
+        try:
+            from tensorflow.python.framework import op_def_registry
+            od = op_def_registry.get(op_name)
+            return [a.name for a in od.output_arg] if od else None
+        except Exception:  # noqa: BLE001 — registry is best-effort
+            return None
+
+    def _run_function(self, fname, args):
+        """Execute a FunctionDef body eagerly over jax values (used inside
+        lax.cond / lax.while_loop branches). Reuses the same handler table;
+        function-internal tensors live in a local env."""
+        fdef = self._functions[fname]
+        sig = fdef.signature
+        env = {}
+        for arg_def, val in zip(sig.input_arg, args):
+            env[arg_def.name] = val
+
+        def resolve(ref):
+            base, _, rest = ref.partition(":")
+            if base.startswith("^"):
+                return None
+            if base in env and not rest:
+                return env[base]
+            v = env[base]
+            if isinstance(v, dict):       # node with named output args
+                arg, _, idx = rest.partition(":")
+                slot = v[arg]
+                return slot[int(idx)] if isinstance(slot, list) else slot
+            return v
+
+        for node in fdef.node_def:
+            if node.op == "Const":
+                # NUMPY, not jnp.asarray: _run_function executes inside an
+                # active jit trace (lax.cond/while_loop branch), where
+                # jnp.asarray stages a device_put and returns a TRACER —
+                # static-axis handlers (gather, argmax...) then break.
+                # numpy values stay concrete and promote on use.
+                env[node.name] = _tensor_to_np(node.attr["value"].tensor)
+                continue
+            if node.op == "NoOp":
+                continue            # control-dependency anchors, like main
+            handler = self.handlers.get(node.op)
+            if handler is None:
+                raise NotImplementedError(
+                    f"TF op '{node.op}' inside function '{fname}' "
+                    f"(node '{node.name}') not mapped")
+            ins = [resolve(r) for r in node.input if not r.startswith("^")]
+            out = handler(ins, node)
+            if isinstance(out, list):
+                names = self._op_output_args(node.op)
+                if names and len(names) == len(out):
+                    env[node.name] = dict(zip(names, out))
+                elif names and len(names) == 1:
+                    env[node.name] = {names[0]: out}  # one variadic out arg
+                else:
+                    raise NotImplementedError(
+                        f"cannot name the {len(out)} outputs of "
+                        f"'{node.op}' in function '{fname}' (op registry "
+                        "metadata unavailable)")
+            else:
+                env[node.name] = out      # plain value; resolve ignores :a:0
+
+        return [resolve(fdef.ret[o.name]) for o in sig.output_arg]
+
+    def _if(self, i, n):
+        """Concrete operands (tf.function-lifted constant captures — e.g. a
+        gather axis) are CLOSED OVER rather than passed through lax.cond:
+        handlers need them static inside the branch trace."""
+        pred, args = i[0], list(i[1:])
+        then_f = n.attr["then_branch"].func.name
+        else_f = n.attr["else_branch"].func.name
+        dyn = [k for k, v in enumerate(args)
+               if isinstance(v, jax.core.Tracer)]
+
+        def mk(branch):
+            def f(*dyn_vals):
+                full = list(args)
+                for p, k in enumerate(dyn):
+                    full[k] = dyn_vals[p]
+                return tuple(self._run_function(branch, full))
+            return f
+
+        out = lax.cond(jnp.squeeze(jnp.asarray(pred)).astype(bool),
+                       mk(then_f), mk(else_f), *[args[k] for k in dyn])
+        return list(out)   # always a list: import_graph's view nodes index
+
+    def _while(self, i, n):
+        """Loop-INVARIANT args whose incoming value is concrete (lifted
+        constant captures) stay out of the carry — inside the body they must
+        be static (axes, shapes), and a carried tracer would break that.
+        Invariance is read off the body FunctionDef: output k resolves back
+        to input k through Identity chains."""
+        cond_f = n.attr["cond"].func.name
+        body_f = n.attr["body"].func.name
+        fb = self._functions[body_f]
+        args = list(i)
+        id_map = {nd.name: nd.input[0] for nd in fb.node_def
+                  if nd.op == "Identity" and nd.input}
+
+        def base_of(ref):
+            cur, seen = ref.split(":")[0], set()
+            while cur in id_map and cur not in seen:
+                seen.add(cur)
+                cur = id_map[cur].split(":")[0]
+            return cur
+
+        static = []
+        for k, (ia, oa) in enumerate(zip(fb.signature.input_arg,
+                                         fb.signature.output_arg)):
+            invariant = base_of(fb.ret[oa.name]) == ia.name
+            static.append(invariant
+                          and not isinstance(args[k], jax.core.Tracer))
+        carry_idx = [k for k, s in enumerate(static) if not s]
+
+        def full_args(carry):
+            full = list(args)
+            for p, k in enumerate(carry_idx):
+                full[k] = carry[p]
+            return full
+
+        def cond(carry):
+            return jnp.squeeze(jnp.asarray(self._run_function(
+                cond_f, full_args(carry))[0])).astype(bool)
+
+        def body(carry):
+            outs = self._run_function(body_f, full_args(carry))
+            return tuple(outs[k] for k in carry_idx)
+
+        out_carry = lax.while_loop(cond, body,
+                                   tuple(args[k] for k in carry_idx))
+        out = list(args)           # invariant slots pass their input through
+        for p, k in enumerate(carry_idx):
+            out[k] = out_carry[p]
+        return out                 # always a list (see _if)
+
+    def _call(self, i, n):
+        return self._run_function(n.attr["f"].func.name, list(i))
+
     # ------------------------------------------------------------------ main
     def import_graph(self, graph_def, sd: SameDiff | None = None) -> SameDiff:
-        """Map a tf.compat.v1.GraphDef onto a SameDiff graph."""
+        """Map a tf.compat.v1 GraphDef onto a SameDiff graph. Handles the
+        function library (V2 control flow), generalized multi-output ops,
+        and V1 Switch/Merge conditionals (both branches compute, Merge
+        selects on the predicate — the XLA-native formulation of a dataflow
+        cond; V1 loop FRAMES raise, see _v1_loop_err)."""
         sd = sd or SameDiff.create()
+        self._register_functions(graph_def)
         produced: Dict[str, Any] = {}   # tf tensor name → SDVariable | list
+        # V1 conditionals: tensor names descending from a Switch output →
+        # (pred tensor name, branch_is_true); Merge uses it to select.
+        branch_of: Dict[str, Any] = {}
 
         def tensor_ref(name) -> SDVariable:
             base, _, idx = name.partition(":")
@@ -513,27 +961,87 @@ class TFImporter:
                 continue
             if op == "NoOp":
                 continue
+            if op in ("Enter", "Exit", "NextIteration", "LoopCond"):
+                self._v1_loop_err(None, node)   # fail at import, not eval
+            data_inputs = [i for i in node.input if not i.startswith("^")]
+            if op == "Switch":
+                # outputs: 0 = false branch, 1 = true branch; both are
+                # identity views of the data — selection happens at Merge
+                data = tensor_ref(data_inputs[0])
+                pred_name = data_inputs[1]
+                outs = [sd._op(f"{node.name}_b{j}", lambda t: t, [data])
+                        for j in range(2)]
+                branch_of[f"{node.name}:0"] = (pred_name, False)
+                branch_of[f"{node.name}:1"] = (pred_name, True)
+                branch_of[node.name] = (pred_name, False)  # bare = output 0
+                produced[node.name] = outs
+                continue
+            if op == "Merge":
+                # pick the true-branch input via the predicate; both branch
+                # values exist (computed unconditionally — sound for the
+                # side-effect-free graphs XLA compiles anyway)
+                infos = [branch_of.get(i) for i in data_inputs]
+                if not any(infos):
+                    raise NotImplementedError(
+                        f"Merge '{node.name}' without Switch ancestry "
+                        "(v1 loop?) is not supported")
+                pred_name = next(inf[0] for inf in infos if inf)
+                pred = tensor_ref(pred_name)
+                vals = [tensor_ref(i) for i in data_inputs]
+                true_pos = next(
+                    (k for k, inf in enumerate(infos) if inf and inf[1]),
+                    None)
+                if true_pos is None or len(vals) != 2:
+                    raise NotImplementedError(
+                        f"Merge '{node.name}': cannot identify the "
+                        "true-branch input from Switch lineage "
+                        f"({len(vals)} inputs, lineage {infos}) — silently "
+                        "guessing would invert the conditional")
+                t_val = vals[true_pos]
+                f_val = vals[1 - true_pos]
+                v = sd._op(node.name + "_op",
+                           lambda f, t, p: jnp.where(
+                               jnp.asarray(p).astype(bool), t, f),
+                           [f_val, t_val, pred])
+                v.rename(node.name)
+                vi = sd._op(node.name + "_index",
+                            lambda p: jnp.asarray(p, jnp.int32), [pred])
+                produced[node.name] = [v, vi]
+                # nested conds: the whole Merge sits inside the OUTER branch
+                # iff its predicate does — inherit the pred's lineage
+                outer = branch_of.get(pred_name)
+                if outer is not None:
+                    branch_of[node.name] = outer
+                    branch_of[node.name + ":0"] = outer
+                continue
             handler = self.handlers.get(op)
             if handler is None:
                 raise NotImplementedError(
                     f"TF op '{op}' (node '{node.name}') not mapped; "
                     f"supported: {sorted(k for k, v in self.handlers.items() if v)}")
-            ins = [tensor_ref(i) for i in node.input if not i.startswith("^")]
+            ins = [tensor_ref(i) for i in data_inputs]
 
-            def make_fn(h=handler, nd=node, multi=op in ("Split", "SplitV", "Unpack")):
+            def make_fn(h=handler, nd=node):
                 def fn(*vals):
                     return h(list(vals), nd)
                 return fn
 
-            if op in ("Split", "SplitV", "Unpack"):
-                # multi-output: materialize as tuple node + index views
+            # propagate V1 branch lineage through ordinary ops
+            lineage = next((branch_of[i] for i in data_inputs
+                            if i in branch_of), None)
+            if lineage is not None:
+                branch_of[node.name] = lineage
+                branch_of[node.name + ":0"] = lineage
+
+            if op in self.multi_output:
+                count = int(self.multi_output[op](node))
                 tup = sd._op(node.name + "_tuple", make_fn(), ins)
-                count = (node.attr["num_split"].i if op in ("Split", "SplitV")
-                         else node.attr["num"].i)
                 outs = []
                 for j in range(count):
                     outs.append(sd._op(f"{node.name}_{j}",
                                        (lambda jj: lambda t: t[jj])(j), [tup]))
+                    if lineage is not None:
+                        branch_of[f"{node.name}:{j}"] = lineage
                 produced[node.name] = outs
             else:
                 v = sd._op(node.name + "_op", make_fn(), ins)
