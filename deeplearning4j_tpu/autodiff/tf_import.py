@@ -324,6 +324,7 @@ class TFImporter:
             "SoftmaxCrossEntropyWithLogits": lambda n: 2,
             "SparseSoftmaxCrossEntropyWithLogits": lambda n: 2,
             "NonMaxSuppressionV4": lambda n: 2,
+            "DynamicPartition": lambda n: n.attr["num_partitions"].i,
             "If": lambda n: len(n.attr["Tout"].list.type),
             "StatelessIf": lambda n: len(n.attr["Tout"].list.type),
             "While": lambda n: len(n.attr["T"].list.type),
@@ -538,13 +539,18 @@ class TFImporter:
         return x.reshape(b, h, w, bs, bs, c // (bs * bs)).transpose(
             0, 1, 3, 2, 4, 5).reshape(b, h * bs, w * bs, c // (bs * bs))
 
-    def _resize_coords(self, n, in_dim, out_dim):
-        """Source sample coordinates for the three TF resize conventions."""
+    def _resize_coords(self, n, in_dim, out_dim, clamp_half_pixel=True):
+        """Source sample coordinates for the three TF resize conventions.
+        Bilinear/nearest clamp the half-pixel coordinate at 0 (TF's
+        HalfPixelScalerForNN/legacy behavior); bicubic keeps the negative
+        border coordinate and clamps the TAPS instead — pass
+        clamp_half_pixel=False there."""
         if n.attr["align_corners"].b and out_dim > 1:
             return jnp.linspace(0.0, in_dim - 1, out_dim)
         if n.attr["half_pixel_centers"].b:
             scale = in_dim / out_dim
-            return jnp.maximum((jnp.arange(out_dim) + 0.5) * scale - 0.5, 0.0)
+            c = (jnp.arange(out_dim) + 0.5) * scale - 0.5
+            return jnp.maximum(c, 0.0) if clamp_half_pixel else c
         return jnp.arange(out_dim) * (in_dim / out_dim)   # v1 legacy
 
     def _resize_bilinear(self, i, n):
@@ -633,13 +639,19 @@ class TFImporter:
         return getattr(jax.ops, f"segment_{mode}")(data, ids, num)
 
     def _dynamic_partition(self, i, n):
-        # XLA needs static shapes: masked same-shape parts (matches our
-        # sd_ops BASE["dynamic_partition"] convention, documented there)
+        # Frozen graphs virtually always carry CONCRETE partition indices;
+        # true ragged parts then compose correctly with DynamicStitch.
+        # A traced partition vector is inherently dynamic-shape — loud
+        # error, not silently-masked zero rows (which would corrupt a
+        # downstream stitch: every masked slot would write index 0).
         num = n.attr["num_partitions"].i
-        parts = jnp.asarray(i[1]).astype(jnp.int32)
-        return [jnp.where(
-            (parts == k).reshape((-1,) + (1,) * (i[0].ndim - 1)), i[0], 0)
-            for k in range(num)]
+        if isinstance(i[1], jax.core.Tracer):
+            raise NotImplementedError(
+                f"DynamicPartition '{n.name}': data-dependent partition "
+                "indices produce dynamic shapes XLA cannot compile; only "
+                "constant partitions import")
+        parts = np.asarray(i[1]).astype(np.int32)
+        return [i[0][np.nonzero(parts == k)[0]] for k in range(num)]
 
     def _dynamic_stitch(self, i, n):
         half = len(i) // 2
@@ -729,11 +741,46 @@ class TFImporter:
             i[0], i[1], jnp.asarray(i[2]).astype(jnp.int32), _axes(i[3]),
             extrapolation_value=_attr_f(n, "extrapolation_value", 0.0))
 
+    @staticmethod
+    def _cubic_weights(frac, A=-0.75):
+        """Keys cubic kernel weights for taps [-1, 0, 1, 2] at fractional
+        offset ``frac`` (TF uses A=-0.75, unlike jax.image's -0.5)."""
+        d = jnp.stack([frac + 1.0, frac, 1.0 - frac, 2.0 - frac], axis=-1)
+        ad = jnp.abs(d)
+        near = ((A + 2.0) * ad - (A + 3.0)) * ad * ad + 1.0
+        far = ((A * ad - 5.0 * A) * ad + 8.0 * A) * ad - 4.0 * A
+        return jnp.where(ad <= 1.0, near, jnp.where(ad < 2.0, far, 0.0))
+
+    def _axis_cubic(self, n, in_dim, out_dim, dtype):
+        """(indices (out,4), weights (out,4)) for one axis. TF semantics:
+        legacy/align_corners use A=-0.75 with border-CLAMPED taps;
+        half_pixel_centers uses the Keys kernel (A=-0.5) with out-of-range
+        taps ZEROED and the remaining weights renormalized."""
+        half = bool(n.attr["half_pixel_centers"].b)
+        cs = self._resize_coords(n, in_dim, out_dim,
+                                 clamp_half_pixel=False)
+        c0 = jnp.floor(cs)
+        taps = c0.astype(jnp.int32)[:, None] + jnp.arange(-1, 3)[None, :]
+        wts = self._cubic_weights((cs - c0).astype(dtype),
+                                  A=-0.5 if half else -0.75)
+        if half:
+            valid = (taps >= 0) & (taps <= in_dim - 1)
+            wts = wts * valid.astype(dtype)
+            wts = wts / jnp.sum(wts, axis=-1, keepdims=True)
+        return jnp.clip(taps, 0, in_dim - 1), wts
+
     def _resize_bicubic(self, i, n):
+        """Separable bicubic honoring all three TF coordinate conventions
+        (align_corners / half_pixel_centers / legacy) — see _axis_cubic."""
         x = i[0]
         oh, ow = (int(v) for v in _axes(i[1]))
-        return jax.image.resize(x, (x.shape[0], oh, ow, x.shape[3]),
-                                method="cubic")
+        b, h, w, c = x.shape
+        yi, wy = self._axis_cubic(n, h, oh, x.dtype)   # (oh, 4) each
+        xi, wx = self._axis_cubic(n, w, ow, x.dtype)   # (ow, 4) each
+        rows = x[:, yi]                       # (b, oh, 4, w, c)
+        rows = jnp.einsum("bykwc,yk->bywc", rows, wy)
+        cols = rows[:, :, xi]                 # (b, oh, ow, 4, c)
+        return jnp.einsum("bywkc,wk->bywc", cols, wx)
 
     def _draw_boxes(self, i, n):
         from . import sd_ops
@@ -937,6 +984,28 @@ class TFImporter:
         # V1 conditionals: tensor names descending from a Switch output →
         # (pred tensor name, branch_is_true); Merge uses it to select.
         branch_of: Dict[str, Any] = {}
+        # constant folding (upstream TFGraphMapper does this too): nodes
+        # whose transitive inputs are all Const evaluate EAGERLY here, so
+        # shape/axis/index plumbing (Range→DynamicPartition chains, sizes)
+        # reaches downstream handlers as concrete values — at eval time
+        # everything inside the jit is a tracer, which static-arg handlers
+        # cannot accept.
+        concrete: Dict[str, Any] = {}
+        _MISS = object()
+
+        def conc_ref(name):
+            base, _, idx = name.partition(":")
+            v = concrete.get(base.lstrip("^"), _MISS)
+            if v is _MISS:
+                return _MISS
+            if isinstance(v, list):
+                return v[int(idx) if idx else 0]
+            return v
+
+        NOFOLD = {"RandomUniform", "RandomStandardNormal", "TruncatedNormal",
+                  "RandomUniformInt", "Multinomial", "Switch", "Merge",
+                  "If", "StatelessIf", "While", "StatelessWhile",
+                  "PartitionedCall", "StatefulPartitionedCall"}
 
         def tensor_ref(name) -> SDVariable:
             base, _, idx = name.partition(":")
@@ -950,6 +1019,7 @@ class TFImporter:
             op = node.op
             if op == "Const":
                 arr = _tensor_to_np(node.attr["value"].tensor)
+                concrete[node.name] = arr
                 produced[node.name] = sd.constant(node.name, jnp.asarray(arr))
                 continue
             if op in ("Placeholder", "PlaceholderWithDefault"):
@@ -1004,8 +1074,13 @@ class TFImporter:
                                jnp.asarray(p).astype(bool), t, f),
                            [f_val, t_val, pred])
                 v.rename(node.name)
+                # value_index = POSITION of the chosen input (TF contract),
+                # not the predicate value
                 vi = sd._op(node.name + "_index",
-                            lambda p: jnp.asarray(p, jnp.int32), [pred])
+                            (lambda tp: lambda p: jnp.where(
+                                jnp.asarray(p).astype(bool), tp,
+                                1 - tp).astype(jnp.int32))(true_pos),
+                            [pred])
                 produced[node.name] = [v, vi]
                 # nested conds: the whole Merge sits inside the OUTER branch
                 # iff its predicate does — inherit the pred's lineage
@@ -1019,6 +1094,21 @@ class TFImporter:
                 raise NotImplementedError(
                     f"TF op '{op}' (node '{node.name}') not mapped; "
                     f"supported: {sorted(k for k, v in self.handlers.items() if v)}")
+
+            conc_ins = [conc_ref(i) for i in data_inputs]
+            if op not in NOFOLD and handler is not None \
+                    and all(v is not _MISS for v in conc_ins):
+                out = handler(list(conc_ins), node)
+                if isinstance(out, list):
+                    concrete[node.name] = [np.asarray(v) for v in out]
+                    produced[node.name] = [
+                        sd.constant(f"{node.name}_{j}", jnp.asarray(v))
+                        for j, v in enumerate(out)]
+                else:
+                    concrete[node.name] = np.asarray(out)
+                    produced[node.name] = sd.constant(node.name,
+                                                      jnp.asarray(out))
+                continue
             ins = [tensor_ref(i) for i in data_inputs]
 
             def make_fn(h=handler, nd=node):

@@ -10,11 +10,15 @@ from .mesh import (MeshSpec, batch_sharding, bootstrap_distributed,
                    shard_params_fsdp)
 from .pipeline import (make_pipeline_loss, make_pipeline_train_step,
                        place_params_for_pipeline)
-from .pipeline_generic import (make_mln_pipeline_loss,
+from .pipeline_generic import (make_cg_pipeline_train_step,  # noqa: F401
+                               shard_params_pp,
+                               make_mln_pipeline_loss,
                                make_mln_pipeline_train_step, microbatches,
                                partition_layers)
-from .tp import (ColumnParallelDense, ColumnParallelOutputLayer,
-                 RowParallelDense, ShardedSelfAttention,
+from .tp import (ChannelShardedConvolution, ColumnParallelDense,
+                 ColumnParallelOutputLayer, InputChannelShardedConvolution,
+                 RowParallelDense, RowShardedEmbedding,
+                 RowShardedEmbeddingSequence, ShardedSelfAttention,
                  network_param_shardings)
 from .ring_attention import (ring_attention, ring_attention_inner,
                              ring_attention_sharded)
@@ -30,8 +34,11 @@ __all__ = [
     "ring_attention_sharded", "ParallelInference", "ParallelWrapper",
     "ParameterAveragingTrainer",
     "ColumnParallelDense", "ColumnParallelOutputLayer", "RowParallelDense",
+    "RowShardedEmbedding", "RowShardedEmbeddingSequence",
+    "ChannelShardedConvolution", "InputChannelShardedConvolution",
     "ShardedSelfAttention", "network_param_shardings",
     "make_mln_pipeline_loss", "make_mln_pipeline_train_step",
+    "shard_params_pp", "make_cg_pipeline_train_step",
     "microbatches", "partition_layers",
     "DistributedGradientWorker", "GradientExchangeServer",
     "SocketGradientTransport",

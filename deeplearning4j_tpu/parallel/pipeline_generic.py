@@ -13,11 +13,19 @@ static shape — the price of generality XLA demands (the homogeneous
 transformer pipeline in pipeline.py avoids the pad by stacking its
 identical blocks instead).
 
-Scope v1 (documented, enforced): stateless layers only (no BatchNorm
-running stats inside the pipeline), no dropout rng, single input/output.
-Params are replicated across stages (each device executes only its own
-stage; compose with fsdp for memory scaling) — the homogeneous-stack
-variant in pipeline.py is the memory-partitioned path.
+Scope v2: stateful layers (BatchNorm running stats) ARE supported — the
+states pytree rides the fill-drain loop as a carry; each stage updates its
+own layers' stats per microbatch (GPipe semantics: BN batch statistics are
+per-MICROBATCH, like upstream GPipe), and after the drain an
+ownership-masked psum over 'pp' (+ pmean over dp axes) reassembles one
+consistent tree. Still no dropout rng, single input/output.
+
+Memory: ``shard_params_pp`` lays params out 1/pp per device AT REST
+(ZeRO-3 over the 'pp' axis) — params, Adam moments, and every optimizer
+buffer scale with the stage count; the step transiently regathers (XLA
+inserts the all-gather at the shard_map boundary). The homogeneous-stack
+variant in pipeline.py partitions the transient too by stacking identical
+blocks.
 
 ``jax.grad`` differentiates straight through the fill-drain loop
 (ppermute's transpose is the reverse permute), so one program serves
@@ -34,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax, shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nn.layers.base import Ctx
 from ..nn.layers.core import LossLayer, OutputLayer
@@ -84,7 +92,8 @@ def _boundary_shapes(net, stages, batch: int):
                     break
                 if i in net._preprocessors:
                     h = net._preprocessors[i](h)
-                h, _ = layer.apply(params[f"layer_{i}"], {}, h,
+                h, _ = layer.apply(params[f"layer_{i}"],
+                                   net.states[f"layer_{i}"], h,
                                    Ctx(train=True, rng=None))
             return h
         return f
@@ -97,18 +106,45 @@ def _boundary_shapes(net, stages, batch: int):
     return shapes
 
 
+def shard_params_pp(mesh: Mesh, params, min_size: int = 2 ** 12):
+    """ZeRO-3-over-'pp' at-rest layout: shard each large leaf's first
+    divisible axis over 'pp'. Apply to params BEFORE optimizer init so the
+    Adam moments inherit the layout — at-rest model+optimizer memory then
+    scales 1/pp; the pipelined step transiently regathers at the shard_map
+    boundary (XLA inserts the all-gather)."""
+    n = mesh.shape["pp"]
+
+    def sh(leaf):
+        if not hasattr(leaf, "shape") or leaf.size < min_size:
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+        for d, dim in enumerate(leaf.shape):
+            if dim % n == 0:
+                spec = [None] * leaf.ndim
+                spec[d] = "pp"
+                return jax.device_put(leaf, NamedSharding(mesh, P(*spec)))
+        return jax.device_put(leaf, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map(sh, params)
+
+
 def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
     """Pipelined loss for a sequential net over mesh axes ('pp' required,
-    'dp' optional): ``loss = fn(params, x_mb (M, mb, *feat),
-    y_mb (M, mb, *lab))``. Exact same value as the single-device loss
-    averaged over microbatches (proven in tests/test_parallel.py)."""
+    'dp' optional). Stateless nets: ``loss = fn(params, x_mb, y_mb)``.
+    Stateful nets (BatchNorm): ``(loss, new_states) = fn(params, states,
+    x_mb, y_mb)`` — per-microbatch batch stats (GPipe semantics), final
+    states reassembled from each stage's owner. At dp=1 the loss equals the
+    single-device microbatched loop exactly (proven in
+    tests/test_parallel.py); under dp>1 a BN layer normalizes each dp
+    shard's mb/dp samples separately (standard sharded-BN semantics; stats
+    are pmean'd), so BN values differ from single-device by the shard-local
+    normalization, like every dp framework without SyncBN."""
     n_stages = mesh.shape["pp"]
-    for i, s in enumerate(net.states.values()):
-        if s:
-            raise ValueError(
-                f"pipeline v1 supports stateless layers only; layer {i} "
-                "carries state (e.g. BatchNorm running stats)")
+    stateful = any(bool(s) for s in net.states.values())
     stages = partition_layers(net, n_stages)
+    stage_of = {}
+    for s, idx_list in enumerate(stages):
+        for i in idx_list:
+            stage_of[i] = s
     out_layer = unwrap(net.layers[-1])
     if not isinstance(out_layer, (OutputLayer, LossLayer)):
         raise ValueError("last layer must be an OutputLayer/LossLayer")
@@ -121,11 +157,12 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
         idx_list = stages[s]
         is_loss_stage = s == n_stages - 1
 
-        def f(params, flat, tgt):
+        def f(params, states, flat, tgt):
             # leading dim comes from the LOCAL array: under a dp axis,
             # shard_map hands each device its microbatch shard
             h = flat[:, :flat_sizes[s]].reshape(
                 (flat.shape[0],) + shapes[s][1:])
+            new_states = dict(states)
             for i in idx_list:
                 layer = net.layers[i]
                 if i == last_i and isinstance(unwrap(layer),
@@ -133,8 +170,10 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
                     break   # the loss computation below consumes h
                 if i in net._preprocessors:
                     h = net._preprocessors[i](h)
-                h, _ = layer.apply(params[f"layer_{i}"], {}, h,
-                                   Ctx(train=True, rng=None))
+                h, s_new = layer.apply(params[f"layer_{i}"],
+                                       states[f"layer_{i}"], h,
+                                       Ctx(train=True, rng=None))
+                new_states[f"layer_{i}"] = s_new
             out = h.reshape(h.shape[0], -1)
             pad = fmax - out.shape[1]
             if pad:
@@ -143,7 +182,7 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
             # stages never pay the output-head FLOPs (lax.switch executes
             # only the selected branch)
             if not is_loss_stage:
-                return out, jnp.zeros((), jnp.float32)
+                return out, jnp.zeros((), jnp.float32), new_states
             hl = h
             if last_i in net._preprocessors:
                 hl = net._preprocessors[last_i](hl)
@@ -152,14 +191,14 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
                     params[f"layer_{last_i}"], hl, tgt)
             else:
                 mb_loss = out_layer.compute_loss(hl, tgt)
-            return out, mb_loss.astype(jnp.float32)
+            return out, mb_loss.astype(jnp.float32), new_states
         return f
 
     fns = [stage_fn(s) for s in range(n_stages)]
     other_axes = tuple(a for a in mesh.axis_names
                        if a != "pp" and mesh.shape[a] > 1)
 
-    def device_loss(params, x_mb, y_mb):
+    def device_loss(params, states, x_mb, y_mb):
         stage = lax.axis_index("pp")
         n_mb = x_mb.shape[0]
         mb_local = x_mb.shape[1]   # microbatch / dp under a dp axis
@@ -177,7 +216,17 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
             x = jnp.where(is_first & (tick < n_mb), fresh, buf)
             out_idx = tick - (n_stages - 1)
             tgt = y_mb[jnp.clip(out_idx, 0, n_mb - 1)]
-            y, mb_loss = lax.switch(stage, fns, params, x, tgt)
+            y, mb_loss, new_states = lax.switch(stage, fns, params, states,
+                                                x, tgt)
+            # only ticks carrying a real microbatch may advance the stats:
+            # stage s sees live data at ticks [s, s + n_mb); outside that
+            # (fill/drain) it re-ran a clipped mb whose stats must be
+            # discarded
+            if stateful:
+                live = (tick >= stage) & (tick - stage < n_mb)
+                states = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(live, new, old),
+                    new_states, states)
             if out_idx >= 0:
                 use = is_last & (out_idx < n_mb)
                 total = total + jnp.where(use, mb_loss, 0.0)
@@ -185,29 +234,67 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
         total = lax.psum(jnp.where(is_last, total, 0.0), "pp") / n_mb
         for ax in other_axes:
             total = lax.pmean(total, ax)
-        return total
+        if not stateful:
+            return total, states
+        # reassemble: each layer's state is authoritative on its OWNING
+        # stage; masked psum over 'pp' broadcasts it to everyone, pmean
+        # over dp axes averages the per-shard batch stats (all-float)
+        merged = {}
+        for i in range(len(net.layers)):
+            key = f"layer_{i}"
+            own = (stage == stage_of[i]).astype(jnp.float32)
+
+            def pick(leaf, own=own):
+                v = lax.psum(leaf.astype(jnp.float32) * own, "pp")
+                for ax in other_axes:
+                    v = lax.pmean(v, ax)
+                return v.astype(leaf.dtype)
+
+            merged[key] = jax.tree_util.tree_map(pick, states[key])
+        return total, merged
 
     rep = jax.tree_util.tree_map(lambda _: P(), net.params)
+    rep_states = jax.tree_util.tree_map(lambda _: P(), net.states)
     dp = "dp" if "dp" in mesh.axis_names else None
 
     def data_spec(arr_ndim):
         return P(*((None, dp) + (None,) * (arr_ndim - 2)))
 
-    def loss(params, x_mb, y_mb):
+    def loss_with_states(params, states, x_mb, y_mb):
         fn = shard_map(device_loss, mesh=mesh,
-                       in_specs=(rep, data_spec(x_mb.ndim),
+                       in_specs=(rep, rep_states, data_spec(x_mb.ndim),
                                  data_spec(y_mb.ndim)),
-                       out_specs=P(), check_vma=False)
-        return fn(params, x_mb, y_mb)
+                       out_specs=(P(), rep_states), check_vma=False)
+        return fn(params, states, x_mb, y_mb)
+
+    if stateful:
+        return loss_with_states
+
+    def loss(params, x_mb, y_mb):
+        return loss_with_states(params, net.states, x_mb, y_mb)[0]
 
     return loss
 
 
 def make_mln_pipeline_train_step(mesh: Mesh, net, optimizer,
                                  microbatch: int):
-    """Jitted pipelined train step for any sequential net:
-    (params, opt_state, x_mb, y_mb) → (params, opt_state, loss)."""
+    """Jitted pipelined train step for any sequential net. Stateless:
+    (params, opt_state, x_mb, y_mb) → (params, opt_state, loss).
+    Stateful (BatchNorm): (params, states, opt_state, x_mb, y_mb) →
+    (params, states, opt_state, loss)."""
     loss_fn = make_mln_pipeline_loss(mesh, net, microbatch)
+    stateful = any(bool(s) for s in net.states.values())
+
+    if stateful:
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step_s(params, states, opt_state, x_mb, y_mb):
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, states, x_mb, y_mb)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_states, opt_state, loss
+
+        return step_s
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, x_mb, y_mb):
@@ -217,6 +304,52 @@ def make_mln_pipeline_train_step(mesh: Mesh, net, optimizer,
         return params, opt_state, loss
 
     return step
+
+
+class _SequentialView:
+    """MLN-shaped facade over a linear-chain ComputationGraph so the
+    generic pipeline machinery applies unchanged. Params/states are
+    re-keyed node-name → 'layer_i'; ``to_graph``/``from_graph`` convert."""
+
+    def __init__(self, cg):
+        from ..nn.layers.base import Layer as _Layer
+        order = [n for n in cg.conf.topo_order if n not in cg.conf.inputs]
+        for k, name in enumerate(order):
+            node = cg.conf.nodes[name]
+            if not isinstance(node.op, _Layer):
+                raise ValueError(
+                    f"CG pipeline needs a pure layer chain; '{name}' is a "
+                    f"{type(node.op).__name__} vertex")
+            expect = cg.conf.inputs[0] if k == 0 else order[k - 1]
+            if list(node.inputs) != [expect]:
+                raise ValueError(
+                    f"CG pipeline needs a linear chain; '{name}' consumes "
+                    f"{list(node.inputs)} (expected ['{expect}'])")
+        self.names = order
+        self.layers = [cg.conf.nodes[n].op for n in order]
+        self.params = {f"layer_{i}": cg.params[n]
+                       for i, n in enumerate(order)}
+        self.states = {f"layer_{i}": cg.states[n]
+                       for i, n in enumerate(order)}
+        self._preprocessors = {i: cg._preprocessors[n]
+                               for i, n in enumerate(order)
+                               if n in cg._preprocessors}
+        self._init_input_shape = tuple(cg._init_shapes[0])
+
+    def to_graph(self, params):
+        return {n: params[f"layer_{i}"] for i, n in enumerate(self.names)}
+
+    def from_graph(self, params):
+        return {f"layer_{i}": params[n] for i, n in enumerate(self.names)}
+
+
+def make_cg_pipeline_train_step(mesh: Mesh, cg, optimizer, microbatch: int):
+    """Pipeline a linear-chain ComputationGraph: returns (step, view) where
+    ``view.params``/``view.states`` are the 'layer_i'-keyed starting pytree
+    (use ``view.to_graph`` to map results back onto the graph)."""
+    view = _SequentialView(cg)
+    return make_mln_pipeline_train_step(mesh, view, optimizer,
+                                        microbatch), view
 
 
 def microbatches(x, y, microbatch: int):

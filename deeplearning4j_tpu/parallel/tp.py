@@ -28,7 +28,9 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nn.layers.attention import SelfAttentionLayer
-from ..nn.layers.core import DenseLayer, OutputLayer
+from ..nn.layers.conv import ConvolutionLayer
+from ..nn.layers.core import (DenseLayer, EmbeddingLayer,
+                              EmbeddingSequenceLayer, OutputLayer)
 
 
 @dataclass
@@ -57,6 +59,56 @@ class ColumnParallelOutputLayer(OutputLayer):
 
     def param_pspecs(self):
         return {"W": P(None, "tp"), "b": P("tp")}
+
+
+@dataclass
+class RowShardedEmbedding(EmbeddingLayer):
+    """Embedding table sharded over the VOCAB axis: W (vocab/tp, nOut) per
+    device — vocab is the natural tp axis for LM embeddings (the table
+    dominates memory; each id lives on exactly one shard and GSPMD turns
+    the jnp.take into a one-hot-partial + psum, Megatron's
+    VocabParallelEmbedding). Requires vocab % tp == 0 to shard (degrades
+    to replicated otherwise, like every spec here)."""
+
+    def param_pspecs(self):
+        return {"W": P("tp", None), "b": P()}
+
+
+@dataclass
+class RowShardedEmbeddingSequence(EmbeddingSequenceLayer):
+    """Sequence variant of RowShardedEmbedding ((B, T) ids → (B, T, nOut))."""
+
+    def param_pspecs(self):
+        return {"W": P("tp", None), "b": P()}
+
+
+@dataclass
+class ChannelShardedConvolution(ConvolutionLayer):
+    """Conv2D with the kernel sharded over OUTPUT channels: W HWIO
+    (kh, kw, cin, cout/tp), bias (cout/tp) — the column-parallel split for
+    CNNs. Activations come out channel-sharded; stack these and XLA keeps
+    the channel sharding flowing through the whole conv trunk (channel-last
+    NHWC makes the sharded dim the last one, the TPU-friendly layout)."""
+
+    def param_pspecs(self):
+        return {"W": P(None, None, None, "tp"), "b": P("tp")}
+
+
+@dataclass
+class InputChannelShardedConvolution(ConvolutionLayer):
+    """Conv2D sharded over INPUT channels: W (kh, kw, cin/tp, cout) — the
+    row-parallel pairing; consumes channel-sharded activations, XLA psums
+    the partial channel contractions (Megatron 'g' for convs)."""
+
+    def param_pspecs(self):
+        return {"W": P(None, None, "tp", None), "b": P()}
+
+    def validate_tp(self, mesh: Mesh):
+        if self.groups != 1 and mesh.shape.get("tp", 1) > 1:
+            raise ValueError(
+                "InputChannelShardedConvolution: grouped/depthwise convs "
+                "cannot row-shard input channels (each group's channels "
+                "must stay together); use ChannelShardedConvolution")
 
 
 @dataclass

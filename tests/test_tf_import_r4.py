@@ -213,6 +213,75 @@ def test_segment_and_stitch_handlers_vs_tf():
     np.testing.assert_array_equal(_eval(sd, "tki", {"x": xv}), wtki)
 
 
+def test_dynamic_partition_stitch_canonical_vs_tf():
+    """The canonical partition(arange)+partition(data)->stitch inversion
+    pattern must reproduce TF exactly (regression: masked-partition
+    representation silently clobbered row 0)."""
+    tf1 = tf.compat.v1
+    g = tf1.Graph()
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, (6, 2), name="x")
+        parts = tf1.constant(np.asarray([1, 0, 1, 1, 0, 0], np.int32))
+        px = tf1.dynamic_partition(x, parts, 2)
+        pi = tf1.dynamic_partition(tf1.range(6), parts, 2)
+        out = tf1.dynamic_stitch(pi, px)
+        tf1.identity(out, name="out")
+    sd, _ = import_frozen_graph(g.as_graph_def())
+    xv = np.random.default_rng(0).standard_normal((6, 2)).astype(np.float32)
+    with tf1.Session(graph=g) as sess:
+        want = sess.run("out:0", {"x:0": xv})
+    got = _eval(sd, "out", {"x": xv})
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_merge_value_index_position():
+    """Merge's second output is the POSITION of the selected input."""
+    tf1 = tf.compat.v1
+    g = tf1.Graph()
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, (3,), name="x")
+        pred = tf1.placeholder(tf.bool, (), name="pred")
+        sw_f, sw_t = tf.raw_ops.Switch(data=x, pred=pred, name="sw")
+        a = tf1.identity(sw_t * 2.0)
+        b = tf1.identity(sw_f - 1.0)
+        merged, idx = tf.raw_ops.Merge(inputs=[a, b], name="mrg")  # true at 0
+        tf1.identity(merged, name="out")
+        tf1.identity(idx, name="idx")
+    sd, _ = import_frozen_graph(g.as_graph_def())
+    xv = np.asarray([1.0, 2.0, 3.0], np.float32)
+    for p in (True, False):
+        with tf1.Session(graph=g) as sess:
+            want_out, want_idx = sess.run(["out:0", "idx:0"],
+                                          {"x:0": xv, "pred:0": p})
+        got_out = _eval(sd, "out", {"x": xv, "pred": np.asarray(p)})
+        got_idx = _eval(sd, "idx", {"x": xv, "pred": np.asarray(p)})
+        np.testing.assert_allclose(got_out, want_out, atol=1e-6)
+        assert int(got_idx) == int(want_idx), (p, got_idx, want_idx)
+
+
+def test_resize_bicubic_conventions_vs_tf():
+    """ResizeBicubic with TF's A=-0.75 kernel across the coordinate
+    conventions (legacy and half-pixel; align_corners via compat API)."""
+    tf1 = tf.compat.v1
+    rng = np.random.default_rng(1)
+    xv = rng.random((1, 5, 7, 2)).astype(np.float32)
+    for kwargs in ({"align_corners": False, "half_pixel_centers": False},
+                   {"align_corners": True, "half_pixel_centers": False},
+                   {"align_corners": False, "half_pixel_centers": True}):
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, (1, 5, 7, 2), name="x")
+            out = tf.raw_ops.ResizeBicubic(images=x, size=(9, 11), **kwargs)
+            tf1.identity(out, name="out")
+        sd, _ = import_frozen_graph(g.as_graph_def())
+        with tf1.Session(graph=g) as sess:
+            want = sess.run("out:0", {"x:0": xv})
+        got = _eval(sd, "out", {"x": xv})
+        # TF quantizes cubic coefficients through a 1024-entry lookup
+        # table; our exact kernel differs by up to ~1e-3 of the value range
+        np.testing.assert_allclose(got, want, atol=2e-3, err_msg=str(kwargs))
+
+
 def test_seq2seq_greedy_decode_frozen_pb(tmp_path):
     """Seq2seq-style non-BERT family: greedy decoder (While + embedding
     gather + argmax feedback), frozen to a .pb file. Also regression for
