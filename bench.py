@@ -206,12 +206,12 @@ def _mln_chain(net, x, y):
         lambda p, s, o: step.__wrapped__(p, s, o, x, y, rng, None, None)[:3],
         net.params, net.states, net._opt_state)
 
-    def step_once(p, s, o):
-        p, s, o, loss, _ = step(p, s, o, x, y, rng, None, None)
-        return (p, s, o), loss
+    def step_once(p, s, o, k):
+        p, s, o, loss, _, k = step(p, s, o, x, y, k, None, None)
+        return (p, s, o, k), loss
 
     run_chain = chain_runner(step_once, [net.params, net.states,
-                                         net._opt_state])
+                                         net._opt_state, rng])
     return run_chain, flops
 
 

@@ -317,8 +317,12 @@ class ComputationGraph:
             with_stats = getattr(self, "_anomaly_detector", None) is not None
 
             def step(params, states, opt_state, inputs, labels, rng, fmask, lmask):
+                # split inside jit; next key rides the outputs (no separate
+                # host-side split dispatch per batch — see MLN._get_train_step)
+                use_rng, next_rng = jax.random.split(rng)
                 (loss, new_states), grads = jax.value_and_grad(
-                    self._loss, has_aux=True)(params, states, inputs, labels, rng, fmask, lmask)
+                    self._loss, has_aux=True)(params, states, inputs, labels,
+                                              use_rng, fmask, lmask)
                 updates, new_opt_state = optimizer.update(grads, opt_state, params)
                 new_params = self._apply_constraints(
                     optax.apply_updates(params, updates))
@@ -328,7 +332,7 @@ class ComputationGraph:
                     stats, new_params, new_opt_state, new_states = stats_and_gate(
                         grads, params, new_params, opt_state, new_opt_state,
                         states, new_states)
-                return new_params, new_states, new_opt_state, loss, stats
+                return new_params, new_states, new_opt_state, loss, stats, next_rng
 
             self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
         return self._train_step
@@ -400,9 +404,10 @@ class ComputationGraph:
                 labels = {n: jnp.asarray(l) for n, l in zip(self.conf.outputs, labs)}
                 fm = None if fmask is None else jnp.asarray(fmask)
                 lm = None if lmask is None else jnp.asarray(lmask)
-                self._host_key, rng = jax.random.split(self._host_key)
-                self.params, self.states, self._opt_state, loss, gstats = step_fn(
-                    self.params, self.states, self._opt_state, inputs, labels, rng, fm, lm)
+                (self.params, self.states, self._opt_state, loss, gstats,
+                 self._host_key) = step_fn(
+                    self.params, self.states, self._opt_state, inputs, labels,
+                    self._host_key, fm, lm)
                 self._step_count += 1
                 if anomaly_check is not None and gstats is not None:
                     anomaly_check.push(gstats, self._step_count)

@@ -261,8 +261,14 @@ class MultiLayerNetwork:
             with_stats = getattr(self, "_anomaly_detector", None) is not None
 
             def step(params, states, opt_state, x, y, rng, fmask, lmask):
+                # the per-step key split happens INSIDE the jitted step and
+                # the next chain key rides the outputs: the fit loop never
+                # dispatches a separate host-side split per batch (a real
+                # extra device launch per step, costly through the tunnel)
+                use_rng, next_rng = jax.random.split(rng)
                 (loss, new_states), grads = jax.value_and_grad(
-                    self._loss, has_aux=True)(params, states, x, y, rng, fmask, lmask)
+                    self._loss, has_aux=True)(params, states, x, y, use_rng,
+                                              fmask, lmask)
                 updates, new_opt_state = optimizer.update(grads, opt_state, params)
                 new_params = self._apply_constraints(
                     optax.apply_updates(params, updates))
@@ -275,7 +281,7 @@ class MultiLayerNetwork:
                     stats, new_params, new_opt_state, new_states = stats_and_gate(
                         grads, params, new_params, opt_state, new_opt_state,
                         states, new_states)
-                return new_params, new_states, new_opt_state, loss, stats
+                return new_params, new_states, new_opt_state, loss, stats, next_rng
 
             self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
         return self._train_step
@@ -365,9 +371,10 @@ class MultiLayerNetwork:
                     y = jnp.asarray(ds.labels)
                     fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
                     lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
-                    self._host_key, rng = jax.random.split(self._host_key)
-                    self.params, self.states, self._opt_state, loss, gstats = step_fn(
-                        self.params, self.states, self._opt_state, x, y, rng, fmask, lmask)
+                    (self.params, self.states, self._opt_state, loss, gstats,
+                     self._host_key) = step_fn(
+                        self.params, self.states, self._opt_state, x, y,
+                        self._host_key, fmask, lmask)
                     self._step_count += 1
                     if anomaly_check is not None and gstats is not None:
                         anomaly_check.push(gstats, self._step_count)

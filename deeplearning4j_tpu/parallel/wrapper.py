@@ -82,8 +82,12 @@ class ParallelWrapper:
         self._step_with_stats = with_stats
 
         def step(params, states, opt_state, x, y, rng, fmask, lmask):
+            # split inside jit; next key rides the outputs (no separate
+            # host-side split dispatch per batch — see MLN._get_train_step)
+            use_rng, next_rng = jax.random.split(rng)
             (loss, new_states), grads = jax.value_and_grad(
-                net._loss, has_aux=True)(params, states, x, y, rng, fmask, lmask)
+                net._loss, has_aux=True)(params, states, x, y, use_rng,
+                                         fmask, lmask)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             stats = None
@@ -92,7 +96,7 @@ class ParallelWrapper:
                 stats, new_params, new_opt_state, new_states = stats_and_gate(
                     grads, params, new_params, opt_state, new_opt_state,
                     states, new_states)
-            return new_params, new_states, new_opt_state, loss, stats
+            return new_params, new_states, new_opt_state, loss, stats, next_rng
 
         self._step = jax.jit(
             step, donate_argnums=(0, 1, 2),
@@ -138,10 +142,11 @@ class ParallelWrapper:
                             [lmask, np.zeros((pad,) + lmask.shape[1:], lmask.dtype)])
                 fmask = None if fmask is None else jnp.asarray(fmask)
                 lmask = None if lmask is None else jnp.asarray(lmask)
-                net._host_key, rng = jax.random.split(net._host_key)
-                net.params, net.states, net._opt_state, loss, gstats = step_fn(
+                (net.params, net.states, net._opt_state, loss, gstats,
+                 net._host_key) = step_fn(
                     net.params, net.states, net._opt_state,
-                    jnp.asarray(x), jnp.asarray(y), rng, fmask, lmask)
+                    jnp.asarray(x), jnp.asarray(y), net._host_key,
+                    fmask, lmask)
                 net._step_count += 1
                 if anomaly_check is not None and gstats is not None:
                     anomaly_check.push(gstats, net._step_count)

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Serial TPU job queue for r4 perf work: survives tunnel flaps by letting
+# each python job do its own wait_for_backend, and retries a job that
+# reports backend_unavailable. One job at a time — the tunnel serves one
+# client session well.
+cd "$(dirname "$0")/.." || exit 1
+run_retry() {  # run_retry <tag> <cmd...>
+  tag=$1; shift
+  for i in 1 2 3 4 5 6; do
+    echo "=== [$tag] attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue.log
+    "$@" >> /tmp/r4_queue.log 2>&1
+    if ! grep -q backend_unavailable /tmp/r4_queue.log; then return 0; fi
+    # job bailed on backend: clear marker, sleep, retry
+    sed -i 's/backend_unavailable/backend_was_unavailable/g' /tmp/r4_queue.log
+    sleep 120
+  done
+}
+: > /tmp/r4_queue.log
+run_retry diagD python scripts/diag_resnet.py D
+run_retry sweep1 python scripts/sweep_transformer.py 1
+run_retry sweep2 python scripts/sweep_transformer.py 2
+run_retry sweep3 python scripts/sweep_transformer.py 3
+echo "=== queue done $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue.log
