@@ -40,9 +40,10 @@ class BatchNormalization(Layer):
     activation: Any = "identity"
     fused: Any = "auto"
 
-    def _can_fuse(self) -> bool:
-        from ...kernels.fused_ops import supported_activation
-        if self.fused is False or not supported_activation(self.activation):
+    def _fuse_ok(self, supported) -> bool:
+        """Shared fused/auto/backend gating; `supported` is the kernel's
+        activation predicate (inference and training support differ)."""
+        if self.fused is False or not supported(self.activation):
             return False
         if self.fused is True:
             return True
@@ -51,6 +52,14 @@ class BatchNormalization(Layer):
         # every existing BN through the kernel by default
         return self.activation != "identity" \
             and jax.default_backend() == "tpu"
+
+    def _can_fuse(self) -> bool:
+        from ...kernels.fused_ops import supported_activation
+        return self._fuse_ok(supported_activation)
+
+    def _can_fuse_train(self) -> bool:
+        from ...kernels.fused_ops import supported_train_activation
+        return self._fuse_ok(supported_train_activation)
 
     def init(self, key, input_shape):
         c = self.n_out or input_shape[-1]
@@ -65,6 +74,29 @@ class BatchNormalization(Layer):
     def apply(self, params, state, x, ctx: Ctx):
         axes = tuple(range(x.ndim - 1))
         if ctx.train:
+            c = lax.stop_gradient(state["mean"])
+            if self._can_fuse_train():
+                # fused pallas training BN (kernels/fused_ops.py): shifted
+                # one-pass stats sweep + normalize-act sweep, custom-VJP
+                # backward with fused reductions — the cuDNN
+                # BatchNormalizationForwardTraining/Backward regime
+                from ...kernels.fused_ops import fused_bn_act_train
+                ch = x.shape[-1]
+                gamma = (jnp.ones((ch,), jnp.float32)
+                         if self.lock_gamma_beta else params["gamma"])
+                beta = (jnp.zeros((ch,), jnp.float32)
+                        if self.lock_gamma_beta else params["beta"])
+                y, mean, var = fused_bn_act_train(
+                    x.reshape(-1, ch), gamma, beta, c, self.eps,
+                    self.activation,
+                    True if self.fused is True else None)
+                new_state = {
+                    "mean": self.decay * state["mean"]
+                            + (1 - self.decay) * lax.stop_gradient(mean),
+                    "var": self.decay * state["var"]
+                           + (1 - self.decay) * lax.stop_gradient(var),
+                }
+                return y.reshape(x.shape), new_state
             # One-pass stats: jnp.var's two-pass form costs an extra full
             # HBM sweep of the activation per BN; the fused single sweep
             # measured +8.6% whole-model ResNet-50 throughput on v5e.
@@ -76,7 +108,6 @@ class BatchNormalization(Layer):
             # channels. The clamp guards first-batch roundoff while c is
             # still cold.
             xf = x.astype(jnp.float32)
-            c = lax.stop_gradient(state["mean"])
             d = xf - c
             dmean = jnp.mean(d, axis=axes)
             d2mean = jnp.mean(d * d, axis=axes)

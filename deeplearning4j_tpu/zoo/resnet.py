@@ -49,11 +49,13 @@ class ResNet50(ZooModel):
                                          stride=(stride, stride),
                                          convolution_mode="same",
                                          activation="identity", has_bias=False), inp)
-            g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
-            if act is None:
-                return f"{name}_bn"
-            g.add_layer(f"{name}_act", ActivationLayer(activation=act), f"{name}_bn")
-            return f"{name}_act"
+            # the conv→bn→act chain folds the activation INTO the BN node so
+            # the fused pallas BN-act kernels (inference and training) can
+            # engage; `act=None` BNs (pre-residual-add) stay identity
+            g.add_layer(f"{name}_bn",
+                        BatchNormalization(activation=act or "identity"),
+                        f"{name}_conv")
+            return f"{name}_bn"
 
         def bottleneck(name, inp, f1, f2, f3, stride, project):
             x = conv_bn(f"{name}_a", inp, f1, 1, stride)

@@ -69,6 +69,13 @@ class TransformerConfig:
     # where the flash kernel is both faster and the only one that compiles.
     use_flash_attention: Any = "auto"
     flash_min_seq: int = 2048
+    # Opt-in: materialize attention scores in bf16 instead of f32 on the
+    # XLA path (matmuls still accumulate f32 in-register; softmax still
+    # reduces in f32). Halves the dominant (B,H,T,T) HBM traffic at
+    # T<=flash_min_seq for a ~1e-2-relative perturbation of the
+    # probabilities. Ignored when the flash kernel engages (which keeps
+    # scores in VMEM and is exact).
+    attn_scores_bf16: bool = False
     tie_embeddings: bool = False
 
     @property
@@ -175,9 +182,33 @@ def _attention(cfg, q, k, v, mask_bias=None):
         # tp/sp-sharded mesh must keep the XLA fused path (which shards)
         from ..kernels.flash_attention import flash_attention_ntc
         out = flash_attention_ntc(q, k, v, causal=True)
+    elif cfg.attn_scores_bf16 and q.dtype == jnp.bfloat16:
+        out = _xla_attention_bf16_scores(q, k, v)
     else:
         out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
     return out.reshape(b, t, cfg.n_heads * cfg.head_dim)
+
+
+def _xla_attention_bf16_scores(q, k, v):
+    """Causal attention with the (B,H,T,S) score matrix MATERIALIZED bf16:
+    the QK^T matmul accumulates f32 in-register (BF16_BF16_F32) but stores
+    bf16, and the f32 upcast for the softmax fuses into its reduce — so
+    the two T^2 HBM tensors (scores, probs) are half the bytes of the
+    stock XLA path's f32 logits. q/k/v are (B, T, H, D)."""
+    t = q.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)  # pre-scale q (exact
+    # for power-of-two head dims), so no extra pass over the T^2 logits
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k,
+        precision=lax.DotAlgorithmPreset.BF16_BF16_F32,
+        preferred_element_type=jnp.bfloat16)
+    neg = jnp.asarray(jnp.finfo(jnp.bfloat16).min / 2, jnp.bfloat16)
+    causal = jnp.tril(jnp.ones((t, t), jnp.bool_))
+    logits = jnp.where(causal[None, None, :, :], logits, neg)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1
+                           ).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
 def _rmsnorm(x, scale, eps=1e-6):

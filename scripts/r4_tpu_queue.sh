@@ -8,12 +8,17 @@ run_retry() {  # run_retry <tag> <cmd...>
   tag=$1; shift
   for i in 1 2 3 4 5 6; do
     echo "=== [$tag] attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue.log
-    "$@" >> /tmp/r4_queue.log 2>&1
-    if ! grep -q backend_unavailable /tmp/r4_queue.log; then return 0; fi
-    # job bailed on backend: clear marker, sleep, retry
+    if "$@" >> /tmp/r4_queue.log 2>&1 \
+        && ! grep -q backend_unavailable /tmp/r4_queue.log; then
+      return 0
+    fi
+    echo "=== [$tag] attempt $i failed (rc or backend) ===" >> /tmp/r4_queue.log
+    # clear the marker so the next attempt's grep sees only its own report
     sed -i 's/backend_unavailable/backend_was_unavailable/g' /tmp/r4_queue.log
     sleep 120
   done
+  echo "=== [$tag] EXHAUSTED ===" >> /tmp/r4_queue.log
+  return 1
 }
 : > /tmp/r4_queue.log
 run_retry diagD python scripts/diag_resnet.py D

@@ -48,6 +48,10 @@ def phase1():
     run("t1024 b16 fused-loss remat-off", base_cfg(fused_loss=True), 16)
     run("t1024 b16 fused-loss chunk2048",
         base_cfg(fused_loss=True, loss_chunk=2048), 16)
+    run("t1024 b16 fused-loss bf16-scores",
+        base_cfg(fused_loss=True, attn_scores_bf16=True), 16)
+    run("t1024 b16 fused-loss flash-forced",
+        base_cfg(fused_loss=True, use_flash_attention=True), 16)
     run("t1024 b16 fused-loss remat-dots",
         base_cfg(fused_loss=True, remat=True, remat_policy="dots"), 16)
     run("t1024 b16 fused-loss remat-full",

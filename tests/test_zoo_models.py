@@ -135,3 +135,37 @@ def test_transformer_fused_loss_matches_naive():
     pt = tfm.init_params(jax.random.PRNGKey(0), cfg_t)
     assert abs(float(tfm.lm_loss(pt, cfg_t, ids, tgt))
                - float(tfm.lm_loss(pt, cfg_tn, ids, tgt))) < 1e-5
+
+
+def test_transformer_bf16_scores_attention_close_to_xla():
+    """attn_scores_bf16: same math as the stock XLA path up to the bf16
+    score quantization — outputs close, loss finite, grads flow."""
+    from dataclasses import replace
+    import jax
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq=32,
+                                dtype=jnp.bfloat16, remat=False,
+                                fused_loss=False)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0, 64)
+    cfg_b = replace(cfg, attn_scores_bf16=True)
+    lf = float(tfm.lm_loss(params, cfg, ids, tgt))
+    lb = float(tfm.lm_loss(params, cfg_b, ids, tgt))
+    assert abs(lf - lb) / max(abs(lf), 1e-6) < 0.05, (lf, lb)
+    logits_f, _ = tfm.forward(params, cfg, ids)
+    logits_b, _ = tfm.forward(params, cfg_b, ids)
+    np.testing.assert_allclose(np.asarray(logits_f, np.float32),
+                               np.asarray(logits_b, np.float32),
+                               atol=0.15, rtol=0.1)
+    g = jax.grad(lambda p: tfm.lm_loss(p, cfg_b, ids, tgt))(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert bool(jnp.all(jnp.isfinite(leaf)))
+    # causality: future-token perturbation cannot change earlier logits
+    ids2 = ids.at[:, -1].set((ids[:, -1] + 1) % 64)
+    l2, _ = tfm.forward(params, cfg_b, ids2)
+    np.testing.assert_allclose(np.asarray(logits_b, np.float32)[:, :-1],
+                               np.asarray(l2, np.float32)[:, :-1],
+                               atol=1e-4)
