@@ -354,6 +354,27 @@ def bench_transformer(batch, steps):
         batch=batch, seq=cfg.max_seq)
 
 
+def bench_transformer_long(batch, steps):
+    """Long-context config: T=4096 at the same tokens/step as the T=1024
+    config. This is the regime the pallas flash kernel exists for — the
+    (B,H,T,T) score tensor the XLA path materializes would be 1.6 GB f32
+    per layer here (and the tunnel's remote compiler rejects it at
+    T>=2048), while the flash kernel streams it through VMEM. remat=dots
+    keeps the 8-layer residual stream resident."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
+                                n_layers=8, d_ff=2048, max_seq=4096,
+                                dtype=jnp.bfloat16, remat=True,
+                                remat_policy="dots")
+    run_chain, flops = build_transformer(batch, cfg)
+    timing = measure_marginal(run_chain, n1=3, n2=steps)
+    return _record(
+        "Transformer-LM long-context (120M, T=4096, flash attn) tokens/sec/chip",
+        "tokens/sec/chip", batch * cfg.max_seq, timing, flops,
+        batch=batch, seq=cfg.max_seq)
+
+
 def bench_dpoverhead(batch, steps):
     """Per-step wall-clock overhead of the dp-8 path vs single-device at the
     SAME global batch (8-device virtual CPU mesh).
@@ -560,6 +581,7 @@ CONFIGS = {
     "charnn_f32": bench_charnn_f32,
     "bert": bench_bert,
     "transformer": bench_transformer,
+    "transformer_long": bench_transformer_long,
     "dpoverhead": bench_dpoverhead,
 }
 
@@ -574,6 +596,7 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     # transformer: batch 16 + remat off + auto-attention (XLA fused wins at
     # T=1024; pallas flash only from T>=2048) measured +15% tokens/s on-chip
     "transformer": (16, 13),
+    "transformer_long": (4, 9),   # same 16k tokens/step as the T=1024 config
     "dpoverhead": (1024, 20),
 }
 
@@ -654,8 +677,9 @@ def main():
     script = os.path.abspath(__file__)
     repo = os.path.dirname(script)
     for name in ("lenet", "charnn", "bert", "transformer",
-                 "dpoverhead", "resnet50_rawstep", "charnn_f32"):
-        if time.perf_counter() - t_start > 1200:
+                 "transformer_long", "dpoverhead", "resnet50_rawstep",
+                 "charnn_f32"):
+        if time.perf_counter() - t_start > 1500:
             secondary[name] = {"skipped": "time budget"}
         else:
             try:

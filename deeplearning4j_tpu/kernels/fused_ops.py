@@ -32,14 +32,18 @@ from ._common import pltpu
 _VMEM_BUDGET = 8 << 20  # row blocks stay comfortably inside VMEM
 
 
-def plan_blocks(n: int, c: int, itemsize: int):
+def plan_blocks(n: int, c: int, itemsize: int, buffers: int = 2):
     """Row-block size for an (N, C) pass, or None when no clean block fits
-    VMEM (callers fall back to the XLA path). A non-divisible N is only
-    acceptable when the WHOLE array is one small block."""
+    VMEM (callers fall back to the XLA path). `buffers` is how many
+    (block, C) tensors the kernel keeps resident per grid step (in + out =
+    2 for the forward passes; the backward dx pass streams x, g AND dx =
+    3). A non-divisible N is only acceptable when the WHOLE array is one
+    small block."""
     for cand in (1024, 512, 256, 128, 8):
-        if n % cand == 0 and 2 * cand * c * max(itemsize, 4) <= _VMEM_BUDGET:
+        if n % cand == 0 \
+                and buffers * cand * c * max(itemsize, 4) <= _VMEM_BUDGET:
             return cand
-    if 2 * n * c * max(itemsize, 4) <= _VMEM_BUDGET:
+    if buffers * n * c * max(itemsize, 4) <= _VMEM_BUDGET:
         return n
     return None
 
@@ -278,7 +282,9 @@ def _train_bwd(eps, activation, interpret, res, cotangents):
         interpret = _interpret_default()
     scale = gamma.astype(jnp.float32) * inv
     shift = beta.astype(jnp.float32) - mean * scale
-    bn = None if pltpu is None else plan_blocks(n, c, x2d.dtype.itemsize)
+    # 3 resident row blocks in the dx pass (x, g, dx)
+    bn = None if pltpu is None else plan_blocks(n, c, x2d.dtype.itemsize,
+                                                buffers=3)
     if bn is None:
         xf = x2d.astype(jnp.float32)
         z = xf * scale[None, :] + shift[None, :]

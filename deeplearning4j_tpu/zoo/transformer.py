@@ -176,8 +176,12 @@ def _attention(cfg, q, k, v, mask_bias=None):
     if cfg.use_ring_attention:
         from ..parallel.ring_attention import ring_attention_inner
         out = ring_attention_inner(q, k, v, causal=True)
-    elif (want_flash and jax.default_backend() == "tpu"
-          and jax.device_count() == 1):
+    elif (want_flash and jax.device_count() == 1
+          and (cfg.use_flash_attention is True
+               or jax.default_backend() == "tpu")):
+        # explicit True engages the kernel even off-TPU (interpret mode —
+        # slow but correct, and the only way CI covers this branch);
+        # "auto" stays TPU-only
         # single-chip only: pallas_call has no SPMD partitioning rule, so a
         # tp/sp-sharded mesh must keep the XLA fused path (which shards)
         from ..kernels.flash_attention import flash_attention_ntc

@@ -90,3 +90,18 @@ def test_bench_resnet50_fit_path():
     assert flops > 0
     loss = run_fit(2)
     assert loss is not None and np.isfinite(loss)
+
+
+def test_bench_transformer_long_step():
+    """The T=4096-style config (flash+remat-dots) compiles and steps, at
+    toy shapes: flash path interpret-mode on CPU, remat=dots engaged."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=128, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq=32,
+                                dtype=jnp.float32, remat=True,
+                                remat_policy="dots",
+                                use_flash_attention=True)
+    run_chain, flops = bench.build_transformer(batch=2, cfg=cfg)
+    assert flops > 0
+    _run_one(run_chain)
