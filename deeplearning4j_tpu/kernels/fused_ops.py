@@ -115,8 +115,12 @@ def _fwd(x2d, scale, shift, activation, interpret):
 
 def _bwd(activation, interpret, res, g):
     x2d, scale, shift = res
+    # cast like the primal does: without it the recompute emits f32 for
+    # bf16 x (promotion with the f32 scale/shift) and the VJP then
+    # rejects the incoming bf16 cotangent
     _, vjp_fn = jax.vjp(
-        lambda x, sc, sh: bn_act_reference(x, sc, sh, activation),
+        lambda x, sc, sh: bn_act_reference(x, sc, sh, activation
+                                           ).astype(x2d.dtype),
         x2d, scale, shift)
     return vjp_fn(g)
 

@@ -58,6 +58,14 @@ class BatchNormalization(Layer):
         return self._fuse_ok(supported_activation)
 
     def _can_fuse_train(self) -> bool:
+        # OPT-IN ONLY (fused=True), never "auto": on-chip measurement
+        # (scripts/diag_resnet_out.json, r4) showed the pallas training
+        # BN regresses ResNet-50 b128 from MFU 0.35 to 0.22 — the kernel
+        # materializes its input/output at HBM and blocks XLA from fusing
+        # the BN+act chain into the producing convolution's epilogue.
+        # The XLA path with one-pass shifted stats is the fast default.
+        if self.fused is not True:
+            return False
         from ...kernels.fused_ops import supported_train_activation
         return self._fuse_ok(supported_train_activation)
 

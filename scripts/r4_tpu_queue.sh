@@ -13,7 +13,6 @@ run_retry() {  # run_retry <tag> <cmd...>
       return 0
     fi
     echo "=== [$tag] attempt $i failed (rc or backend) ===" >> /tmp/r4_queue.log
-    # clear the marker so the next attempt's grep sees only its own report
     sed -i 's/backend_unavailable/backend_was_unavailable/g' /tmp/r4_queue.log
     sleep 120
   done
@@ -21,8 +20,8 @@ run_retry() {  # run_retry <tag> <cmd...>
   return 1
 }
 : > /tmp/r4_queue.log
-run_retry diagD python scripts/diag_resnet.py D
+run_retry diagABD python scripts/diag_resnet.py A B D
 run_retry sweep1 python scripts/sweep_transformer.py 1
-run_retry sweep2 python scripts/sweep_transformer.py 2
 run_retry sweep3 python scripts/sweep_transformer.py 3
+run_retry sweep2 python scripts/sweep_transformer.py 2
 echo "=== queue done $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue.log

@@ -1,0 +1,21 @@
+#!/bin/bash
+cd "$(dirname "$0")/.." || exit 1
+run_retry() {
+  tag=$1; shift
+  for i in 1 2 3; do
+    echo "=== [$tag] attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue2.log
+    if "$@" >> /tmp/r4_queue2.log 2>&1 \
+        && ! grep -q backend_unavailable /tmp/r4_queue2.log; then
+      return 0
+    fi
+    echo "=== [$tag] attempt $i failed ===" >> /tmp/r4_queue2.log
+    sed -i 's/backend_unavailable/backend_was_unavailable/g' /tmp/r4_queue2.log
+    sleep 90
+  done
+  echo "=== [$tag] EXHAUSTED ===" >> /tmp/r4_queue2.log
+  return 1
+}
+: > /tmp/r4_queue2.log
+run_retry diagBD python scripts/diag_resnet.py B D
+run_retry sweep4 python scripts/sweep_transformer.py 4
+echo "=== queue2 done $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue2.log

@@ -1,0 +1,13 @@
+#!/bin/bash
+cd "$(dirname "$0")/.." || exit 1
+: > /tmp/r4_queue3.log
+for i in 1 2 3; do
+  echo "=== [sweep4] attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue3.log
+  if python scripts/sweep_transformer.py 4 >> /tmp/r4_queue3.log 2>&1 \
+      && ! grep -q backend_unavailable /tmp/r4_queue3.log; then
+    break
+  fi
+  sed -i 's/backend_unavailable/backend_was_unavailable/g' /tmp/r4_queue3.log
+  sleep 90
+done
+echo "=== queue3 done $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue3.log

@@ -78,10 +78,36 @@ def phase3():
                       flush=True)
 
 
+def phase4():
+    """r4: combine the two phase-1/3 winners (remat-full 0.3046, bf16-scores
+    0.2527) and settle the T=4096 long-context config with an XLA-vs-flash
+    comparison under the same remat policy."""
+    best = dict(fused_loss=True, remat=True, remat_policy="full",
+                attn_scores_bf16=True)
+    run("t1024 b16 remat-full+bf16-scores", base_cfg(**best), 16)
+    run("t1024 b16 remat-full+bf16-scores chunk2048",
+        base_cfg(**best, loss_chunk=2048), 16)
+    for b in (32, 64):
+        try:
+            run(f"t1024 b{b} remat-full+bf16-scores", base_cfg(**best), b)
+        except Exception as e:  # noqa: BLE001
+            print(f"b{b}: FAILED {type(e).__name__}: {e}", flush=True)
+    for tag, kw in (("xla", {}),
+                    ("bf16-scores", {"attn_scores_bf16": True}),
+                    ("flash", {"use_flash_attention": True})):
+        try:
+            run(f"t4096 b4 remat-full {tag}",
+                base_cfg(max_seq=4096, fused_loss=True, remat=True,
+                         remat_policy="full", **kw), 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"t4096 {tag}: FAILED {type(e).__name__}: {e}",
+                  flush=True)
+
+
 if __name__ == "__main__":
     phase = sys.argv[1] if len(sys.argv) > 1 else "1"
     ok, detail = bench.wait_for_backend(max_wait_s=120)
     if not ok:
         print(json.dumps({"backend_unavailable": True, "detail": detail}))
         sys.exit(0)
-    {"1": phase1, "2": phase2, "3": phase3}[phase]()
+    {"1": phase1, "2": phase2, "3": phase3, "4": phase4}[phase]()

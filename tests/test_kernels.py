@@ -284,3 +284,37 @@ def test_batchnorm_fused_training_matches_plain():
                                atol=5e-4)
     np.testing.assert_allclose(np.asarray(gf["beta"]), np.asarray(gp["beta"]),
                                atol=5e-4)
+
+
+def test_fused_bn_act_bf16_grad_through_frozen_bn():
+    """r4 regression: bf16 input to the inference fused BN+act must accept
+    the bf16 cotangent (the recompute-based VJP previously emitted f32 and
+    rejected it — scripts/diag_resnet.py phase D failure)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.kernels.fused_ops import fused_bn_act
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((8, 128)),
+                    jnp.bfloat16)
+    scale = jnp.asarray(np.random.default_rng(1).random(128), jnp.float32)
+    shift = jnp.asarray(np.random.default_rng(2).random(128), jnp.float32)
+
+    def f(x):
+        y = fused_bn_act(x, scale, shift, "relu", True)
+        # consume in bf16 like the next conv does
+        return jnp.sum(y * y)
+
+    g = jax.grad(f)(x)
+    assert g.dtype == jnp.bfloat16
+    assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+
+
+def test_bn_auto_training_path_stays_xla():
+    """r4 policy: fused='auto' must NOT engage the pallas kernel on the
+    training path (on-chip regression, see norm.py _can_fuse_train)."""
+    from deeplearning4j_tpu.nn.layers.norm import BatchNormalization
+
+    bn = BatchNormalization(activation="relu")
+    assert bn.fused == "auto" and not bn._can_fuse_train()
+    assert BatchNormalization(activation="relu",
+                              fused=True)._can_fuse_train()

@@ -1,0 +1,410 @@
+"""Round-4 second op-registry widening (VERDICT r3 missing #1).
+
+Oracle tests for the libnd4j updater-op family (upstream nd4j-api
+ops/impl/updaters/*Updater), tf.signal-style STFT/window/mel ops, the
+Assert validation family, image augmentation + affine sampling, and the
+mechanical long tail (AddN, MirrorPad, NthElement, SparseToDense,
+SufficientStatistics, Mode, Bitcast, ...).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import checkify
+
+from deeplearning4j_tpu.autodiff import sd_ops
+
+S = sd_ops.NAMESPACES
+KEY = jax.random.PRNGKey(7)
+
+
+def test_registry_gate_r4b():
+    from deeplearning4j_tpu.autodiff.samediff import _LOSS, _MATH, _NN
+    total = sd_ops.op_count() + len(_MATH) + len(_NN) + len(_LOSS)
+    assert sd_ops.op_count() >= 640, sd_ops.op_count()
+    assert total >= 700, total
+    for ns in ("updater", "signal", "assert"):
+        assert ns in S and len(S[ns]) >= 9
+
+
+# ------------------------------------------------------------- updaters --
+def test_adam_updater_matches_formula_two_steps():
+    g = jnp.asarray([0.1, -0.2, 0.3])
+    m = v = jnp.zeros(3)
+    lr, b1, b2, eps = 0.001, 0.9, 0.999, 1e-8
+    gn = np.asarray(g)
+    mn = vn = np.zeros(3)
+    for t in (1, 2):
+        u, m, v = S["updater"]["adam_updater"](g, m, v, t, lr, b1, b2, eps)
+        mn = b1 * mn + (1 - b1) * gn
+        vn = b2 * vn + (1 - b2) * gn ** 2
+        un = lr * (mn / (1 - b1 ** t)) / (np.sqrt(vn / (1 - b2 ** t)) + eps)
+        np.testing.assert_allclose(np.asarray(u), un, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(m), mn, rtol=1e-6)
+
+
+def test_adam_updater_matches_optax():
+    import optax
+    g = jnp.asarray([0.5, -1.0, 2.0])
+    params = jnp.zeros(3)
+    opt = optax.adam(1e-3)
+    st = opt.init(params)
+    m = v = jnp.zeros(3)
+    p_ours = jnp.zeros(3)
+    for t in range(1, 4):
+        upd, st = opt.update(g, st, params)
+        params = optax.apply_updates(params, upd)
+        u, m, v = S["updater"]["adam_updater"](g, m, v, t)
+        p_ours = p_ours - u
+    np.testing.assert_allclose(np.asarray(p_ours), np.asarray(params),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_simple_updaters_formula():
+    g = jnp.asarray([1.0, -2.0])
+    (u,) = S["updater"]["sgd_updater"](g, 0.5)
+    np.testing.assert_allclose(np.asarray(u), [0.5, -1.0])
+    u, s = S["updater"]["ada_grad_updater"](g, jnp.zeros(2), 0.01, 1e-6)
+    np.testing.assert_allclose(
+        np.asarray(u), 0.01 * np.asarray(g) / (np.abs(np.asarray(g)) + 1e-6),
+        rtol=1e-5)
+    u, s = S["updater"]["rms_prop_updater"](g, jnp.zeros(2), 0.001, 0.95)
+    np.testing.assert_allclose(
+        np.asarray(u),
+        0.001 * np.asarray(g) / np.sqrt(0.05 * np.asarray(g) ** 2 + 1e-8),
+        rtol=1e-5)
+    # momentum: first step v=g
+    u, v2 = S["updater"]["momentum_updater"](g, jnp.zeros(2), 0.1, 0.9)
+    np.testing.assert_allclose(np.asarray(u), 0.1 * np.asarray(g))
+    # nesterov first step: u = lr*(g + mu*g)
+    u, v2 = S["updater"]["nesterovs_updater"](g, jnp.zeros(2), 0.1, 0.9)
+    np.testing.assert_allclose(np.asarray(u), 0.1 * 1.9 * np.asarray(g),
+                               rtol=1e-6)
+
+
+def test_stateful_updaters_shapes_and_finite():
+    g = jnp.asarray(np.random.default_rng(0).standard_normal((4, 3)),
+                    jnp.float32)
+    z = jnp.zeros_like(g)
+    for name, args in [("ada_delta_updater", (g, z, z)),
+                       ("ada_max_updater", (g, z, z, 1)),
+                       ("nadam_updater", (g, z, z, 1)),
+                       ("ams_grad_updater", (g, z, z, z, 1))]:
+        out = S["updater"][name](*args)
+        assert all(o.shape == g.shape for o in out)
+        assert all(bool(jnp.all(jnp.isfinite(o))) for o in out)
+
+
+# --------------------------------------------------------------- signal --
+def test_stft_istft_roundtrip():
+    x = jnp.asarray(np.random.default_rng(1).standard_normal(1024),
+                    jnp.float32)
+    spec = S["signal"]["stft"](x, 256, 128)
+    assert spec.shape == (7, 129) and spec.dtype == jnp.complex64
+    rec = S["signal"]["istft"](spec, 256, 128)
+    np.testing.assert_allclose(np.asarray(rec[256:768]),
+                               np.asarray(x[256:768]), atol=1e-5)
+
+
+def test_stft_first_frame_oracle():
+    x = jnp.asarray(np.random.default_rng(2).standard_normal(512),
+                    jnp.float32)
+    spec = S["signal"]["stft"](x, 128, 64, window="hann")
+    w = np.hanning(129)[:-1]
+    want = np.fft.rfft(np.asarray(x[:128]) * w)
+    np.testing.assert_allclose(np.asarray(spec[0]), want, atol=1e-4)
+
+
+def test_windows_match_numpy():
+    for name, fn in [("hann_window", np.hanning),
+                     ("hamming_window", np.hamming),
+                     ("blackman_window", np.blackman),
+                     ("bartlett_window", np.bartlett)]:
+        np.testing.assert_allclose(
+            np.asarray(S["signal"][name](64, periodic=False)), fn(64),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(S["signal"][name](64, periodic=True)), fn(65)[:-1],
+            atol=1e-6)
+    np.testing.assert_allclose(np.asarray(S["signal"]["kaiser_window"](
+        32, 8.0)), np.kaiser(32, 8.0), atol=1e-6)
+
+
+def test_mel_and_mfcc():
+    m = S["signal"]["linear_to_mel_weight_matrix"](20, 129, 8000)
+    assert m.shape == (129, 20)
+    assert bool(jnp.all(m >= 0)) and float(m.sum()) > 0
+    from scipy.fftpack import dct
+    log_mel = jnp.asarray(np.random.default_rng(3).random((5, 20)),
+                          jnp.float32)
+    got = S["signal"]["mfcc"](log_mel, 13)
+    want = dct(np.asarray(log_mel), type=2, norm="ortho", axis=-1)[:, :13]
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+
+# --------------------------------------------------------------- assert --
+def test_asserts_eager():
+    x = jnp.asarray([1.0, 2.0])
+    np.testing.assert_array_equal(
+        np.asarray(S["assert"]["assert_positive"](x)), [1.0, 2.0])
+    S["assert"]["assert_eq"](x, x)
+    S["assert"]["assert_rank"](x, 1)
+    S["assert"]["assert_shapes_equal"](x, x + 1)
+    with pytest.raises(AssertionError):
+        S["assert"]["assert_positive"](jnp.asarray([1.0, -1.0]))
+    with pytest.raises(AssertionError):
+        S["assert"]["assert_gt"](x, x)
+    with pytest.raises(AssertionError):
+        S["assert"]["assert_finite"](jnp.asarray([jnp.nan]))
+    with pytest.raises(AssertionError):
+        S["assert"]["assert_rank"](x, 2)
+
+
+def test_asserts_traced_checkify():
+    f = checkify.checkify(jax.jit(
+        lambda x: S["assert"]["assert_finite"](x)))
+    err, out = f(jnp.asarray([1.0, 2.0]))
+    assert err.get() is None
+    err, out = f(jnp.asarray([1.0, jnp.inf]))
+    assert err.get() is not None and "assert_finite" in err.get()
+
+
+# ---------------------------------------------------------------- image --
+def test_rotate_matches_rot90():
+    img = jnp.asarray(np.random.default_rng(4).random((8, 8, 3)),
+                      jnp.float32)
+    for k in (1, 2, 3):
+        got = S["image"]["rotate"](img, k * jnp.pi / 2)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.rot90(np.asarray(img), k, (0, 1)),
+                                   atol=1e-5)
+
+
+def test_translate_oracle():
+    img = jnp.asarray(np.arange(25, dtype=np.float32).reshape(5, 5, 1))
+    got = S["image"]["translate"](img, 1.0, 2.0)     # +x right, +y down
+    want = np.zeros((5, 5, 1), np.float32)
+    want[2:, 1:] = np.asarray(img)[:3, :4]
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_random_image_ops():
+    img = jnp.asarray(np.random.default_rng(5).random((4, 8, 8, 3)),
+                      jnp.float32)
+    f1 = S["image"]["random_flip_left_right"](KEY, img)
+    f2 = S["image"]["random_flip_left_right"](KEY, img)
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+    # every image is either original or flipped
+    flipped = np.asarray(jnp.flip(img, axis=-2))
+    orig = np.asarray(img)
+    got = np.asarray(f1)
+    for i in range(4):
+        assert (np.allclose(got[i], orig[i])
+                or np.allclose(got[i], flipped[i]))
+    b = S["image"]["random_brightness"](KEY, img, 0.2)
+    assert float(jnp.max(jnp.abs(b - img))) <= 0.2 + 1e-6
+    c = S["image"]["random_contrast"](KEY, img, 0.5, 1.5)
+    assert c.shape == img.shape
+    s = S["image"]["random_saturation"](KEY, img, 0.5, 1.5)
+    assert s.shape == img.shape
+    h = S["image"]["random_hue"](KEY, img, 0.1)
+    assert h.shape == img.shape
+
+
+def test_affine_identity():
+    img = jnp.asarray(np.random.default_rng(6).random((6, 7, 2)),
+                      jnp.float32)
+    ident = jnp.asarray([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+    np.testing.assert_allclose(
+        np.asarray(S["image"]["affine_transform"](img, ident)),
+        np.asarray(img), atol=1e-6)
+
+
+# ----------------------------------------------------- mechanical tail --
+def test_mechanical_tail_oracles():
+    a = jnp.asarray([1.0, 2.0])
+    np.testing.assert_allclose(
+        np.asarray(S["base"]["add_n"](a, a, a)), [3.0, 6.0])
+    outs = S["base"]["identity_n"](a, 2 * a)
+    assert len(outs) == 2
+    x = jnp.asarray([[1.0, 2.0, 3.0]])
+    np.testing.assert_allclose(
+        np.asarray(S["base"]["mirror_pad"](x, [(0, 0), (2, 2)], "REFLECT")),
+        np.pad(np.asarray(x), [(0, 0), (2, 2)], mode="reflect"))
+    np.testing.assert_allclose(
+        np.asarray(S["base"]["mirror_pad"](x, [(0, 0), (1, 1)],
+                                           "SYMMETRIC")),
+        np.pad(np.asarray(x), [(0, 0), (1, 1)], mode="symmetric"))
+    v = jnp.asarray([5.0, 1.0, 3.0, 2.0])
+    assert float(S["base"]["nth_element"](v, 0)) == 1.0
+    assert float(S["base"]["nth_element"](v, 0, reverse=True)) == 5.0
+    assert float(S["base"]["nth_element"](v, 2)) == 3.0
+
+
+def test_sufficient_statistics_and_mode():
+    x = jnp.asarray(np.random.default_rng(7).random((3, 4)), jnp.float32)
+    count, mean_ss, var_ss, _ = S["base"]["sufficient_statistics"](x, (0,))
+    assert float(count) == 3.0
+    np.testing.assert_allclose(np.asarray(mean_ss),
+                               np.asarray(x).sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(var_ss),
+                               (np.asarray(x) ** 2).sum(0), rtol=1e-5)
+    m = S["base"]["mode"](jnp.asarray([[1.0, 2.0, 2.0, 3.0],
+                                       [4.0, 4.0, 5.0, 6.0]]))
+    np.testing.assert_array_equal(np.asarray(m), [2.0, 4.0])
+
+
+def test_sparse_to_dense_and_index_ops():
+    d = S["base"]["sparse_to_dense"](jnp.asarray([[0, 1], [2, 0]]),
+                                     (3, 2), jnp.asarray([5.0, 6.0]), -1.0)
+    np.testing.assert_array_equal(np.asarray(d),
+                                  [[-1, 5], [-1, -1], [6, -1]])
+    r, c = S["base"]["unravel_index"](jnp.asarray([5, 7]), (3, 4))
+    np.testing.assert_array_equal(np.asarray(r), [1, 1])
+    np.testing.assert_array_equal(np.asarray(c), [1, 3])
+    flat = S["base"]["ravel_multi_index"]((jnp.asarray([1, 1]),
+                                          jnp.asarray([1, 3])), (3, 4))
+    np.testing.assert_array_equal(np.asarray(flat), [5, 7])
+    x = jnp.zeros((2, 3))
+    out = S["base"]["put_along_axis"](x, jnp.asarray([[0], [2]]),
+                                     9.0, 1)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  [[9, 0, 0], [0, 0, 9]])
+
+
+def test_set_ops_static_size():
+    # int inputs: fill value is iinfo.max
+    a = jnp.asarray([1, 2, 3, 4])
+    b = jnp.asarray([3, 4, 5])
+    imax = np.iinfo(np.int32).max
+    inter = np.asarray(S["base"]["intersect1d"](a, b, size=4))
+    assert set(inter[inter != imax]) == {3, 4}
+    uni = np.asarray(S["base"]["union1d"](a, b, size=6))
+    assert set(uni[uni != imax]) == {1, 2, 3, 4, 5}
+    # float inputs: fill value is inf
+    af = jnp.asarray([1.0, 2.0, 3.0])
+    bf = jnp.asarray([3.0, 9.0])
+    interf = np.asarray(S["base"]["intersect1d"](af, bf, size=3))
+    assert set(interf[np.isfinite(interf)]) == {3.0}
+
+
+def test_bitcast_hashcode_arrayequal():
+    x = jnp.asarray([1.0], jnp.float32)
+    bits = S["base"]["bitcast"](x, jnp.int32)
+    assert int(bits[0]) == 0x3F800000
+    h1 = S["base"]["hashcode"](jnp.arange(6.0))
+    h2 = S["base"]["hashcode"](jnp.arange(6.0))
+    h3 = S["base"]["hashcode"](jnp.arange(6.0)[::-1])
+    assert int(h1) == int(h2) and int(h1) != int(h3)
+    assert bool(S["base"]["array_equal"](x, x))
+    assert not bool(S["base"]["array_equal"](x, x + 1))
+
+
+def test_math_tail():
+    from scipy.special import multigammaln
+    x = jnp.asarray([3.0, 4.5])
+    np.testing.assert_allclose(
+        np.asarray(S["math"]["multigammaln"](x, 2)),
+        multigammaln(np.asarray(x), 2), rtol=1e-5)
+    t = jnp.asarray(0.5)
+    np.testing.assert_allclose(float(S["math"]["cot"](t)),
+                               1 / np.tan(0.5), rtol=1e-5)
+    np.testing.assert_allclose(float(S["math"]["sec"](t)),
+                               1 / np.cos(0.5), rtol=1e-5)
+    np.testing.assert_allclose(float(S["math"]["csc"](t)),
+                               1 / np.sin(0.5), rtol=1e-5)
+    # log1mexp stable in both branches
+    for v in (-1e-4, -0.5, -5.0):
+        got = float(S["math"]["log1mexp"](jnp.asarray(v)))
+        np.testing.assert_allclose(got, np.log(-np.expm1(v)), rtol=1e-5)
+
+
+def test_linalg_tail():
+    rng = np.random.default_rng(8)
+    a = jnp.asarray(rng.random((4, 6)), jnp.float32)
+    ns = S["linalg"]["null_space"](a)
+    # columns marked as null space satisfy A @ v ~ 0
+    prod = np.asarray(a @ ns)
+    assert np.abs(prod).max() < 1e-4
+    q = np.asarray(S["linalg"]["orth"](jnp.asarray(rng.random((6, 3)),
+                                                   jnp.float32)))
+    np.testing.assert_allclose(q.T @ q, np.eye(3), atol=1e-4)
+    sign, logdet = S["linalg"]["log_matrix_determinant"](
+        jnp.asarray([[2.0, 0.0], [0.0, 3.0]]))
+    assert float(sign) == 1.0
+    np.testing.assert_allclose(float(logdet), np.log(6.0), rtol=1e-6)
+    a4 = jnp.asarray(rng.random((2, 3, 2, 3)), jnp.float32) \
+        + jnp.eye(6).reshape(2, 3, 2, 3)
+    inv = S["linalg"]["tensorinv"](a4, 2)
+    np.testing.assert_allclose(
+        np.einsum("ijkl,klmn->ijmn", np.asarray(a4), np.asarray(inv)),
+        np.eye(6).reshape(2, 3, 2, 3), atol=1e-3)
+
+
+def test_random_dist_tail():
+    n = 20000
+    w = np.asarray(S["random"]["weibull"](KEY, (n,), 2.0, 1.0))
+    np.testing.assert_allclose(w.mean(), 0.8862, atol=0.02)  # Γ(1.5)
+    t = np.asarray(S["random"]["triangular"](KEY, (n,), 0.0, 0.5, 1.0))
+    np.testing.assert_allclose(t.mean(), 0.5, atol=0.02)
+    assert t.min() >= 0 and t.max() <= 1
+    f = np.asarray(S["random"]["f"](KEY, (n,), 5.0, 20.0))
+    np.testing.assert_allclose(f.mean(), 20.0 / 18.0, atol=0.06)
+    nb = np.asarray(S["random"]["negative_binomial"](KEY, (n,), 10.0, 0.5))
+    np.testing.assert_allclose(nb.mean(), 10.0, atol=0.35)  # n(1-p)/p
+
+
+def test_bidirectional_lstm():
+    rng = np.random.default_rng(9)
+    B, T, I, H = 2, 5, 3, 4
+    x = jnp.asarray(rng.standard_normal((B, T, I)), jnp.float32)
+    h0 = jnp.zeros((B, H))
+    wf = [jnp.asarray(rng.standard_normal(s) * 0.1, jnp.float32)
+          for s in ((I, 4 * H), (H, 4 * H), (4 * H,))]
+    wb = [jnp.asarray(rng.standard_normal(s) * 0.1, jnp.float32)
+          for s in ((I, 4 * H), (H, 4 * H), (4 * H,))]
+    out = S["rnn"]["bidirectional_lstm_layer"](x, h0, h0, *wf, *wb)
+    assert out.shape == (B, T, 2 * H)
+    fwd = S["rnn"]["lstm_layer"](x, h0, *wf)
+    bwd = S["rnn"]["lstm_layer"](jnp.flip(x, 1), h0, *wb)
+    want = jnp.concatenate([fwd, jnp.flip(bwd, 1)], axis=-1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5)
+
+
+def test_cnn_aliases():
+    assert S["cnn"]["conv2d_transpose"] is S["cnn"]["deconv2d"]
+    x = jnp.asarray(np.random.default_rng(10).random((1, 8, 8, 2)),
+                    jnp.float32)
+    w = jnp.asarray(np.random.default_rng(11).random((3, 3, 2, 4)),
+                    jnp.float32)
+    out = S["cnn"]["atrous_conv2d"](x, w, 2)
+    assert out.shape == (1, 8, 8, 4)
+
+
+def test_overlap_and_add_is_sum_not_average():
+    """Review fix r4: tf.signal.overlap_and_add semantics — plain
+    scatter-add, no window normalization."""
+    o = np.asarray(S["signal"]["overlap_and_add"](jnp.ones((4, 8)), 4))
+    np.testing.assert_array_equal(o[:4], 1.0)
+    np.testing.assert_array_equal(o[4:16], 2.0)
+    np.testing.assert_array_equal(o[16:], 1.0)
+
+
+def test_frame_pad_end_tf_parity():
+    """Review fix r4: pad_end=True yields ceil(n/step) frames like
+    tf.signal.frame (frame starts at every step inside the signal)."""
+    f = np.asarray(S["signal"]["frame"](jnp.arange(10.0), 4, 2,
+                                        pad_end=True))
+    assert f.shape == (5, 4)
+    np.testing.assert_array_equal(f[4], [8.0, 9.0, 0.0, 0.0])
+
+
+def test_array_equal_shape_mismatch_is_false():
+    """Review fix r4: shape mismatch returns False (np.array_equal
+    semantics), including broadcastable-but-unequal shapes."""
+    assert not bool(S["base"]["array_equal"](jnp.zeros(3), jnp.zeros(4)))
+    assert not bool(S["base"]["array_equal"](jnp.zeros((3, 1)),
+                                             jnp.zeros((1, 3))))
+    assert bool(S["base"]["array_equal"](jnp.ones(3), jnp.ones(3)))
