@@ -1921,10 +1921,12 @@ def _frame(x, frame_length, frame_step, pad_end=False, pad_value=0.0):
     fl, fs = int(frame_length), int(frame_step)
     n = x.shape[-1]
     if pad_end:
-        # tf.signal.frame: one frame per step start within the signal
+        # tf.signal.frame: one frame per step start within the signal;
+        # no padding needed when frame_length < frame_step leaves the
+        # last frame already in-bounds
         n_frames = -(-n // fs)
         need = (n_frames - 1) * fs + fl
-        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, need - n)],
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, max(0, need - n))],
                     constant_values=pad_value)
     else:
         n_frames = 1 + (n - fl) // fs
@@ -1962,12 +1964,9 @@ def _istft(spec, frame_length=256, frame_step=128, fft_length=None,
          else jnp.ones((fl,)))
     frames = frames * w
     n_frames = frames.shape[-2]
-    out_len = (n_frames - 1) * fs + fl
-    idx = (jnp.arange(n_frames)[:, None] * fs + jnp.arange(fl)[None, :])
-    out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
-    out = out.at[..., idx].add(frames)
-    norm = jnp.zeros((out_len,), frames.dtype).at[idx].add(
-        jnp.square(w)[None, :].repeat(n_frames, 0))
+    out = _overlap_and_add(frames, fs)
+    norm = _overlap_and_add(
+        jnp.broadcast_to(jnp.square(w), (n_frames, fl)), fs)
     return out / jnp.maximum(norm, 1e-12)
 
 
@@ -2348,3 +2347,82 @@ RNN.update({
 NAMESPACES.update({
     "updater": UPDATER, "signal": SIGNAL, "assert": ASSERT,
 })
+
+# ------------------------------------------------------ *_bp op family --
+# libnd4j ships an explicit backprop custom op for every layer op
+# (conv2d_bp, batchnorm_bp, maxpool2d_bp, relu_bp, reduce_sum_bp, ...;
+# nd4j-api ops/impl/layers/convolution/*Bp, ops/impl/transforms/gradient/*).
+# TPU-native equivalent: DERIVE them from the forward registry with
+# jax.vjp — same contract (primals..., dL/dOut, static kwargs) -> input
+# cotangent(s), but guaranteed-consistent with the forward op by
+# construction instead of hand-written CUDA.
+
+
+def _bp_of(fn, n_grads=1):
+    """Wrap forward `fn` as its libnd4j-style _bp op.
+
+    Signature: (*primals, grad, **static_kwargs) — returns the cotangent
+    of the first primal, or a tuple of the first `n_grads` cotangents."""
+    def bp_op(*args, **kwargs):
+        *primals, g = args
+        out, vjp = jax.vjp(lambda *p: fn(*p, **kwargs), *primals)
+        grads = vjp(jnp.asarray(g).astype(out.dtype))
+        return grads[0] if n_grads == 1 else tuple(grads[:n_grads])
+    return bp_op
+
+
+def _reduce_bp(fn):
+    """Reduction _bp: (x, grad, axis=..., keepdims=...) with the grad
+    broadcast back over the reduced axes (upstream reduce_*_bp)."""
+    def bp_op(x, g, **kwargs):
+        out, vjp = jax.vjp(lambda x_: fn(x_, **kwargs), x)
+        return vjp(jnp.asarray(g).astype(out.dtype))[0]
+    return bp_op
+
+
+_ACT_FWD = {
+    "relu": jax.nn.relu, "relu6": jax.nn.relu6, "elu": jax.nn.elu,
+    "selu": jax.nn.selu, "gelu": jax.nn.gelu, "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh, "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign, "swish": jax.nn.swish,
+    "hard_swish": jax.nn.hard_swish, "hard_sigmoid": jax.nn.hard_sigmoid,
+    "leaky_relu": jax.nn.leaky_relu, "mish": jax.nn.mish,
+    "softmax": jax.nn.softmax, "log_softmax": jax.nn.log_softmax,
+    "cube": lambda x: x ** 3,
+    "rational_tanh": MATH_EXT["rational_tanh"],
+    "rectified_tanh": MATH_EXT["rectified_tanh"],
+}
+
+BP = {}
+for _n, _f in _ACT_FWD.items():
+    BP[f"{_n}_bp"] = _bp_of(_f)
+
+for _n in ("conv1d", "conv2d", "conv3d", "deconv1d", "deconv2d", "deconv3d",
+           "depthwise_conv2d", "separable_conv2d"):
+    BP[f"{_n}_bp"] = _bp_of(CNN[_n], n_grads=2)     # (dx, dw)
+
+for _n in ("max_pooling1d", "max_pooling2d", "max_pooling3d",
+           "avg_pooling1d", "avg_pooling2d", "avg_pooling3d",
+           "lp_pool2d", "local_response_normalization", "im2col",
+           "upsampling2d", "pixel_shuffle"):
+    BP[f"{_n}_bp"] = _bp_of(CNN[_n])
+
+BP["batch_norm_bp"] = _bp_of(CNN["batch_norm"], n_grads=5)  # d(all inputs)
+BP["layer_norm_bp"] = _bp_of(NN_EXT["layer_norm_no_bias"], n_grads=1)
+BP["bias_add_bp"] = _bp_of(NN_EXT["bias_add"], n_grads=2)
+BP["l2_normalize_bp"] = _bp_of(NN_EXT["l2_normalize"])
+BP["lstm_layer_bp"] = _bp_of(RNN["lstm_layer"], n_grads=2)  # dx, dh0
+BP["gru_layer_bp"] = _bp_of(RNN["gru_layer"], n_grads=2)
+
+for _n, _fn in (("sum", jnp.sum), ("mean", jnp.mean), ("max", jnp.max),
+                ("min", jnp.min), ("prod", jnp.prod),
+                ("variance", jnp.var), ("std", jnp.std),
+                ("norm2", jnp.linalg.norm),
+                ("logsumexp", jsp.logsumexp)):
+    BP[f"reduce_{_n}_bp"] = _reduce_bp(_fn)
+
+BP["squared_norm_bp"] = _reduce_bp(lambda x, **kw: jnp.sum(x * x, **kw))
+BP["matmul_bp"] = _bp_of(jnp.matmul, n_grads=2)
+BP["mmul_bp"] = BP["matmul_bp"]
+
+NAMESPACES["bp"] = BP

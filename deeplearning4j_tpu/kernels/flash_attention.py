@@ -67,11 +67,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                    # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
+        # operands stay bf16: the v5e MXU multiplies bf16 natively with
+        # f32 accumulation (preferred_element_type); casting to f32 first
+        # runs the MXU at a fraction of peak and doubles VMEM traffic
+        q = q_ref[0]                                        # (bq, d)
+        k = k_ref[0]                                        # (bk, d)
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (bq, bk)
+                                preferred_element_type=jnp.float32
+                                ) * scale                   # (bq, bk) f32
         if causal:
             q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_idx = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -83,7 +87,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
@@ -140,14 +145,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_idx = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -155,7 +160,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
         dq_acc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -182,24 +187,26 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
-        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (bq, bk)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32
+                                ) * scale                    # (bq, bk)
         if causal:
             q_idx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_idx = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_idx > q_idx, NEG_INF, s)
         p = jnp.exp(s - lse[:, None])
         dv_acc_ref[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -322,10 +329,13 @@ def _tuned_blocks(b, h, t, d, dtype, causal, interpret) -> tuple:
         return run
 
     chip = jax.devices()[0].device_kind.replace(" ", "_")
+    # "flash2": bf16-operand kernel revision — older cached choices were
+    # tuned for the f32-operand kernel and don't transfer
     return autotune(
-        f"flash:{chip}:{b}x{h}x{t}x{d}:{jnp.dtype(dtype).name}:{causal}",
+        f"flash2:{chip}:{b}x{h}x{t}x{d}:{jnp.dtype(dtype).name}:{causal}",
         [(128, 128), (256, 128), (128, 256), (256, 256), (512, 128),
-         (128, 512)],
+         (128, 512), (512, 256), (256, 512), (512, 512), (1024, 256),
+         (1024, 512)],
         make_run)
 
 

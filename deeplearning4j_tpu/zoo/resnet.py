@@ -19,7 +19,8 @@ from ..nn.computation_graph import ComputationGraph
 from ..nn.conf import NeuralNetConfiguration
 from ..nn.layers.base import InputType
 from ..nn.layers.conv import (ConvolutionLayer, GlobalPoolingLayer,
-                              SubsamplingLayer, ZeroPaddingLayer)
+                              SpaceToDepthLayer, SubsamplingLayer,
+                              ZeroPaddingLayer)
 from ..nn.layers.core import ActivationLayer, OutputLayer
 from ..nn.layers.norm import BatchNormalization
 from ..nn.vertices import ElementWiseVertex
@@ -31,6 +32,14 @@ from .base import ZooModel
 class ResNet50(ZooModel):
     num_classes: int = 1000
     input_shape: Tuple = (224, 224, 3)
+    # TPU stem optimization (MLPerf-style): rearrange the input
+    # (H, W, 3) -> (H/2, W/2, 12) with space-to-depth(2) and replace the
+    # 7x7/s2 stem conv by the EXACTLY equivalent 4x4/s1 conv on 12
+    # channels (weights folded by `fold_stem_weights_s2d`). C=3 pads
+    # terribly onto the MXU's 128 lanes; C=12 tiles 4x denser and the
+    # conv becomes stride-1. Same function, same init distribution
+    # (init draws a 7x7x3 kernel and folds it).
+    stem_space_to_depth: bool = False
 
     # (n_blocks, filters) per stage; first block of stages 2-4 downsamples
     STAGES = ((3, (64, 64, 256)), (4, (128, 128, 512)),
@@ -69,7 +78,11 @@ class ResNet50(ZooModel):
             g.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
             return f"{name}_out"
 
-        x = conv_bn("stem", "in", 64, 7, 2)
+        if self.stem_space_to_depth:
+            g.add_layer("stem_s2d", SpaceToDepthLayer(block_size=2), "in")
+            x = conv_bn("stem", "stem_s2d", 64, 4, 1)
+        else:
+            x = conv_bn("stem", "in", 64, 7, 2)
         g.add_layer("stem_pool", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
                                                   convolution_mode="same"), x)
         x = "stem_pool"
@@ -86,7 +99,39 @@ class ResNet50(ZooModel):
         return g.build()
 
     def init(self):
-        return ComputationGraph(self.conf()).init()
+        net = ComputationGraph(self.conf()).init()
+        if self.stem_space_to_depth:
+            # keep the baseline stem's function family + init distribution:
+            # draw a 7x7x3 kernel with the stem conv's own initializer and
+            # fold it into the equivalent 4x4x12 layout
+            w4 = net.params["stem_conv"]["W"]
+            proto = ConvolutionLayer(n_out=w4.shape[-1], kernel_size=(7, 7))
+            import jax
+            c_in = self.input_shape[-1]
+            w7 = proto._make_weight(jax.random.PRNGKey(self.seed),
+                                    (7, 7, c_in, w4.shape[-1]))
+            net.params["stem_conv"]["W"] = fold_stem_weights_s2d(
+                w7).astype(w4.dtype)
+        return net
+
+
+def fold_stem_weights_s2d(w7):
+    """Fold a (7, 7, 3, F) stem kernel into the (4, 4, 12, F) kernel that
+    computes the IDENTICAL conv(7x7, stride 2, SAME) on the
+    space-to-depth(2) input.
+
+    Derivation: SAME 7x7/s2 on 224 pads (2, 3), so
+    y[o] = sum_k x[2o + k - 2] W[k]. Writing k - 2 = 2*b + d with
+    d in {0,1} gives block taps b in {-1..2} -> a 4-tap stride-1 conv in
+    block space whose SAME padding for k=4 is exactly (1, 2). The s2d
+    channel layout is (dh, dw, c) (SpaceToDepthLayer's transpose order);
+    the (b=2, d=1) position corresponds to k=7 and is zero."""
+    kh, kw, c, f = w7.shape
+    assert (kh, kw) == (7, 7), w7.shape
+    w8 = jnp.zeros((8, 8, c, f), w7.dtype).at[:7, :7].set(w7)
+    # (8,8,c,f) -> (bh, dh, bw, dw, c, f) -> (bh, bw, dh, dw, c, f)
+    w = w8.reshape(4, 2, 4, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return w.reshape(4, 4, 4 * c, f)
 
 
 # --------------------------------------------------------------------------

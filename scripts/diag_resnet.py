@@ -36,7 +36,7 @@ def emit(tag, **kw):
     OUT.write_text(json.dumps(RESULTS, indent=2))
 
 
-def _mk_step(batch, bn_frozen=False):
+def _mk_step(batch, bn_frozen=False, s2d=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -44,7 +44,8 @@ def _mk_step(batch, bn_frozen=False):
     from deeplearning4j_tpu.utils.tracing import total_flops
     from deeplearning4j_tpu.zoo.resnet import ResNet50
 
-    net = ResNet50(num_classes=1000, compute_dtype=jnp.bfloat16).init()
+    net = ResNet50(num_classes=1000, compute_dtype=jnp.bfloat16,
+                   stem_space_to_depth=s2d).init()
     opt = optax.sgd(0.1, momentum=0.9)
     opt_state = opt.init(net.params)
     train_flag = not bn_frozen
@@ -179,8 +180,22 @@ def phase_e():
          hlo_bytes=len(txt))
 
 
+def phase_f():
+    """r4: space-to-depth stem A/B (exact-equivalent transformation)."""
+    for b in (128, 256):
+        try:
+            run_chain, flops, _ = _mk_step(b, s2d=True)
+            timing = bench.measure_marginal(run_chain, n1=3, n2=13)
+            rec = bench._record(f"F rawstep b{b} s2d-stem",
+                                "samples/sec/chip", b, timing, flops,
+                                batch=b)
+            emit(rec.pop("metric"), **rec)
+        except Exception as e:  # noqa: BLE001
+            emit(f"F rawstep b{b} s2d", error=f"{type(e).__name__}: {e}"[:300])
+
+
 PHASES = {"A": phase_a, "B": phase_b, "C": phase_c, "D": phase_d,
-          "E": phase_e}
+          "E": phase_e, "F": phase_f}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(PHASES)

@@ -1,0 +1,13 @@
+#!/bin/bash
+cd "$(dirname "$0")/.." || exit 1
+: > /tmp/r4_queue4.log
+for i in 1 2 3; do
+  echo "=== [diagF] attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue4.log
+  if python scripts/diag_resnet.py F >> /tmp/r4_queue4.log 2>&1 \
+      && ! grep -q backend_unavailable /tmp/r4_queue4.log; then
+    break
+  fi
+  sed -i 's/backend_unavailable/backend_was_unavailable/g' /tmp/r4_queue4.log
+  sleep 90
+done
+echo "=== queue4 done $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue4.log

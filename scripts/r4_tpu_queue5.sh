@@ -1,0 +1,16 @@
+#!/bin/bash
+# waits for queue4, then re-measures long-context attention with the
+# bf16-operand flash kernel (autotune re-runs under the flash2 key)
+cd "$(dirname "$0")/.." || exit 1
+while pgrep -f "diag_resnet.py F" > /dev/null; do sleep 20; done
+: > /tmp/r4_queue5.log
+for i in 1 2 3; do
+  echo "=== [sweep3b] attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue5.log
+  if python scripts/sweep_transformer.py 3 >> /tmp/r4_queue5.log 2>&1 \
+      && ! grep -q backend_unavailable /tmp/r4_queue5.log; then
+    break
+  fi
+  sed -i 's/backend_unavailable/backend_was_unavailable/g' /tmp/r4_queue5.log
+  sleep 90
+done
+echo "=== queue5 done $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue5.log

@@ -1,0 +1,14 @@
+#!/bin/bash
+cd "$(dirname "$0")/.." || exit 1
+while pgrep -f "sweep_transformer.py 3" > /dev/null; do sleep 20; done
+: > /tmp/r4_queue6.log
+for i in 1 2 3; do
+  echo "=== [charnnAB] attempt $i $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue6.log
+  if python scripts/diag_charnn.py >> /tmp/r4_queue6.log 2>&1 \
+      && ! grep -q backend_unavailable /tmp/r4_queue6.log; then
+    break
+  fi
+  sed -i 's/backend_unavailable/backend_was_unavailable/g' /tmp/r4_queue6.log
+  sleep 90
+done
+echo "=== queue6 done $(date -u +%H:%M:%S) ===" >> /tmp/r4_queue6.log

@@ -408,3 +408,101 @@ def test_array_equal_shape_mismatch_is_false():
     assert not bool(S["base"]["array_equal"](jnp.zeros((3, 1)),
                                              jnp.zeros((1, 3))))
     assert bool(S["base"]["array_equal"](jnp.ones(3), jnp.ones(3)))
+
+
+# ------------------------------------------------------------ _bp family --
+def test_bp_family_registered():
+    assert len(S["bp"]) >= 45
+    for k in ("conv2d_bp", "batch_norm_bp", "relu_bp", "reduce_sum_bp",
+              "max_pooling2d_bp", "lstm_layer_bp", "matmul_bp"):
+        assert k in S["bp"], k
+
+
+def test_activation_bp_matches_grad():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(16),
+                    jnp.float32)
+    g = jnp.asarray(np.random.default_rng(1).standard_normal(16),
+                    jnp.float32)
+    for name, fn in (("relu", jax.nn.relu), ("tanh", jnp.tanh),
+                     ("sigmoid", jax.nn.sigmoid), ("gelu", jax.nn.gelu)):
+        got = S["bp"][f"{name}_bp"](x, g)
+        want = jax.vjp(fn, x)[1](g)[0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
+
+
+def test_conv2d_bp_matches_grad():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 3)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 3, 3, 4)) * 0.1, jnp.float32)
+    out = sd_ops.CNN["conv2d"](x, w)
+    g = jnp.ones_like(out)
+    dx, dw = S["bp"]["conv2d_bp"](x, w, g)
+    want_dx, want_dw = jax.vjp(lambda a, b: sd_ops.CNN["conv2d"](a, b),
+                               x, w)[1](g)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(want_dx),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(want_dw),
+                               rtol=1e-4, atol=1e-5)
+    assert dx.shape == x.shape and dw.shape == w.shape
+
+
+def test_pool_and_reduce_bp():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((1, 4, 4, 2)), jnp.float32)
+    out = sd_ops.CNN["max_pooling2d"](x, (2, 2), (2, 2))
+    g = jnp.ones_like(out)
+    dx = S["bp"]["max_pooling2d_bp"](x, g, k=(2, 2), s=(2, 2))
+    # max pool grad routes each window's grad to the argmax position
+    assert dx.shape == x.shape
+    np.testing.assert_allclose(float(dx.sum()), float(g.sum()), rtol=1e-5)
+
+    x2 = jnp.asarray(rng.standard_normal((3, 5)), jnp.float32)
+    d = S["bp"]["reduce_mean_bp"](x2, jnp.asarray(1.0))
+    np.testing.assert_allclose(np.asarray(d), np.full((3, 5), 1.0 / 15),
+                               rtol=1e-6)
+    d = S["bp"]["reduce_sum_bp"](x2, jnp.ones(5), axis=0)
+    np.testing.assert_allclose(np.asarray(d), np.ones((3, 5)), rtol=1e-6)
+    d = S["bp"]["reduce_max_bp"](x2, jnp.asarray(2.0))
+    assert float(d.sum()) == 2.0  # all grad at the single argmax
+
+
+def test_batch_norm_and_matmul_bp():
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((8, 4)), jnp.float32)
+    gamma = jnp.ones(4)
+    beta = jnp.zeros(4)
+    mean = jnp.zeros(4)
+    var = jnp.ones(4)
+    out = sd_ops.CNN["batch_norm"](x, mean, var, gamma, beta)
+    g = jnp.ones_like(out)
+    grads = S["bp"]["batch_norm_bp"](x, mean, var, gamma, beta, g)
+    assert len(grads) == 5 and grads[0].shape == x.shape
+
+    a = jnp.asarray(rng.standard_normal((3, 4)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((4, 5)), jnp.float32)
+    gm = jnp.ones((3, 5))
+    da, db = S["bp"]["matmul_bp"](a, b, gm)
+    np.testing.assert_allclose(np.asarray(da), np.asarray(gm @ b.T),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(db), np.asarray(a.T @ gm),
+                               rtol=1e-5)
+
+
+def test_frame_pad_end_short_frames():
+    """Review fix r4b: frame_length < frame_step with pad_end must not
+    emit a negative pad (tf.signal.frame supports it)."""
+    f = np.asarray(S["signal"]["frame"](jnp.arange(12.0), 2, 4,
+                                        pad_end=True))
+    assert f.shape == (3, 2)
+    np.testing.assert_array_equal(f, [[0, 1], [4, 5], [8, 9]])
+
+
+def test_resnet50_s2d_stem_non_rgb():
+    """Review fix r4b: s2d stem folds the actual input channel count."""
+    from deeplearning4j_tpu.zoo.resnet import ResNet50
+    net = ResNet50(num_classes=5, input_shape=(32, 32, 1),
+                   stem_space_to_depth=True).init()
+    x = jnp.ones((2, 32, 32, 1))
+    out = net.output(x)
+    assert out.shape == (2, 5)

@@ -169,3 +169,27 @@ def test_transformer_bf16_scores_attention_close_to_xla():
     np.testing.assert_allclose(np.asarray(logits_b, np.float32)[:, :-1],
                                np.asarray(l2, np.float32)[:, :-1],
                                atol=1e-4)
+
+
+def test_resnet50_s2d_stem_exact_equivalence():
+    """r4 TPU stem optimization: space-to-depth(2) input + folded 4x4x12
+    stem kernel computes the bit-identical function of the 7x7/s2 SAME
+    stem (MLPerf-style equivalent transformation)."""
+    import numpy as np
+    from deeplearning4j_tpu.zoo.resnet import (ResNet50,
+                                               fold_stem_weights_s2d)
+
+    std = ResNet50(num_classes=10, input_shape=(64, 64, 3), seed=5).init()
+    s2d = ResNet50(num_classes=10, input_shape=(64, 64, 3), seed=5,
+                   stem_space_to_depth=True).init()
+    for name, p in std.params.items():
+        if name == "stem_conv":
+            s2d.params[name]["W"] = fold_stem_weights_s2d(p["W"])
+        else:
+            for k, v in p.items():
+                s2d.params[name][k] = v
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 64, 64, 3)),
+                    jnp.float32)
+    o1 = np.asarray(std.output(x))
+    o2 = np.asarray(s2d.output(x))
+    assert np.abs(o1 - o2).max() < 2e-5
