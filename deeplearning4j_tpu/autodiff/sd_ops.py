@@ -326,6 +326,8 @@ MATH_EXT = {
     "eq": jnp.equal, "neq": jnp.not_equal, "gt": jnp.greater,
     "gte": jnp.greater_equal, "lt": jnp.less, "lte": jnp.less_equal,
     "is_finite": jnp.isfinite, "is_nan": jnp.isnan, "is_inf": jnp.isinf,
+    "is_numeric_tensor": lambda x: jnp.asarray(
+        jnp.issubdtype(jnp.asarray(x).dtype, jnp.number)),
     "is_close": jnp.isclose,
     "is_max": lambda x: x == jnp.max(x),
     # logical

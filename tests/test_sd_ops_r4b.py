@@ -506,3 +506,29 @@ def test_resnet50_s2d_stem_non_rgb():
     x = jnp.ones((2, 32, 32, 1))
     out = net.output(x)
     assert out.shape == (2, 5)
+
+
+def test_upstream_public_api_audit_is_complete():
+    """scripts/op_audit.py: every curated upstream public namespace
+    method (SDBaseOps/SDMath/SDNN/SDCNN/SDRNN/SDLoss/SDBitwise/SDRandom/
+    SDLinalg/SDImage) resolves to a registry op."""
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "op_audit", pathlib.Path(__file__).parent.parent / "scripts" /
+        "op_audit.py")
+    audit = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(audit)
+    from deeplearning4j_tpu.autodiff.samediff import _LOSS, _MATH, _NN
+    ours = set()
+    for table in sd_ops.NAMESPACES.values():
+        ours.update(table)
+    ours.update(_MATH), ours.update(_NN), ours.update(_LOSS)
+    ours.update({"equal", "not_equal"})
+    missing = []
+    for cls, names in audit.UPSTREAM.items():
+        for n in names.split():
+            s = audit.RENAMES.get(audit.to_snake(n), audit.to_snake(n))
+            if s not in ours:
+                missing.append(f"{cls}.{n}")
+    assert not missing, missing
