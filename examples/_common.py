@@ -1,0 +1,26 @@
+"""Shared example boilerplate.
+
+Forces the CPU backend by default so examples run anywhere (set
+EXAMPLES_ON_TPU=1 to use the real chip), and provides the --smoke flag
+every example supports (tiny sizes, a few seconds on CPU — the mode CI
+runs)."""
+
+import argparse
+import os
+import pathlib
+import sys
+
+# the repo root (works without pip-installing the package)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def setup(description: str):
+    if not os.environ.get("EXAMPLES_ON_TPU"):
+        os.environ.setdefault("XLA_FLAGS",
+                              "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes for a fast functional check")
+    return ap.parse_args()

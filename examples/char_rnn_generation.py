@@ -1,0 +1,55 @@
+"""Character-level text generation with GravesLSTM.
+
+Mirrors the reference's LSTMCharModellingExample: train a 2-layer LSTM
+char model on a text corpus, then sample new text one streamed
+rnn_time_step at a time. Run: python examples/char_rnn_generation.py
+[--smoke]
+"""
+
+import numpy as np
+
+from _common import setup
+
+args = setup(__doc__)
+
+from deeplearning4j_tpu.zoo import TextGenerationLSTM
+
+CORPUS = (
+    "deep learning on tpus is a matter of feeding the matrix units. "
+    "keep the tensors large and the dtypes small. "
+    "the systolic array eats batches of matmuls for breakfast. "
+) * (4 if args.smoke else 64)
+
+chars = sorted(set(CORPUS))
+vocab = len(chars)
+idx = {c: i for i, c in enumerate(chars)}
+seq = 32
+units = 64 if args.smoke else 256
+
+model = TextGenerationLSTM(num_classes=vocab, input_shape=(seq, vocab),
+                           units=units)
+net = model.init()
+
+# build (B, T, vocab) one-hot windows
+data = np.asarray([idx[c] for c in CORPUS], np.int32)
+starts = np.arange(0, len(data) - seq - 1, seq)
+x = np.eye(vocab, dtype=np.float32)[
+    np.stack([data[s:s + seq] for s in starts])]
+y = np.eye(vocab, dtype=np.float32)[
+    np.stack([data[s + 1:s + seq + 1] for s in starts])]
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+epochs = 3 if args.smoke else 20
+for e in range(epochs):
+    loss = net.fit(DataSet(x, y))
+print(f"final loss {loss:.3f}")
+
+prime = "deep learning "
+pr = np.eye(vocab, dtype=np.float32)[
+    np.asarray([idx[c] for c in prime], np.int32)][None]
+ids = model.generate(net, pr, n_steps=80, temperature=0.7)
+text = "".join(chars[i] for i in np.asarray(ids)[0])
+print("generated:", prime + text)
+assert len(text) == 80
+print("OK")

@@ -1,0 +1,54 @@
+"""Import a Keras .h5 model and verify identical outputs.
+
+Mirrors the reference's Keras import examples (KerasModelImport): save a
+compiled Keras Sequential model, import it, compare predictions, then
+fine-tune the imported network natively. Requires tensorflow (CPU) for
+the save step only. Run: python examples/keras_import.py [--smoke]
+"""
+
+import tempfile
+
+import numpy as np
+
+from _common import setup
+
+args = setup(__doc__)
+
+try:
+    import tensorflow as tf
+except ImportError:
+    print("SKIP: tensorflow not installed (needed only to produce the .h5)")
+    raise SystemExit(0)
+
+keras = tf.keras
+m = keras.Sequential([
+    keras.layers.Input((20,)),
+    keras.layers.Dense(32, activation="relu"),
+    keras.layers.Dropout(0.2),
+    keras.layers.Dense(5, activation="softmax"),
+])
+# compiling records the loss in the .h5 — the importer then converts the
+# softmax head into a trainable OutputLayer (uncompiled models import for
+# inference only)
+m.compile(loss="categorical_crossentropy", optimizer="adam")
+x = np.random.default_rng(0).random((8, 20)).astype(np.float32)
+want = m.predict(x, verbose=0)
+
+with tempfile.NamedTemporaryFile(suffix=".h5") as f:
+    m.save(f.name)
+    from deeplearning4j_tpu.import_.keras import import_keras_sequential
+    net = import_keras_sequential(f.name)
+
+got = np.asarray(net.output(x))
+np.testing.assert_allclose(got, want, atol=1e-5)
+print("imported model matches keras predictions (atol 1e-5)")
+
+# the imported network is a first-class MultiLayerNetwork: fine-tune it
+from deeplearning4j_tpu.data import ListDataSetIterator
+from deeplearning4j_tpu.data.dataset import DataSet
+
+y = np.eye(5, dtype=np.float32)[np.random.default_rng(1).integers(0, 5, 64)]
+xf = np.random.default_rng(2).random((64, 20)).astype(np.float32)
+net.fit(ListDataSetIterator([DataSet(xf[i:i + 16], y[i:i + 16])
+                             for i in range(0, 64, 16)]))
+print("OK — imported net fine-tunes natively")

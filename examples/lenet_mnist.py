@@ -1,0 +1,59 @@
+"""LeNet on MNIST — conv/pool/dense with batch normalization.
+
+Mirrors the reference's LenetMnistExample (conv→pool→conv→pool→dense→out)
+with a BatchNormalization layer on the stem and a save/load round trip via
+ModelSerializer. Run: python examples/lenet_mnist.py [--smoke]
+"""
+
+import tempfile
+
+import numpy as np
+
+from _common import setup
+
+args = setup(__doc__)
+
+from deeplearning4j_tpu.data import MnistDataSetIterator
+from deeplearning4j_tpu.nn import (BatchNormalization, ConvolutionLayer,
+                                   DenseLayer, InputType,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer,
+                                   SubsamplingLayer)
+from deeplearning4j_tpu.serde import ModelSerializer
+from deeplearning4j_tpu.train import Adam
+
+n = 2048 if args.smoke else 8192
+epochs = 3 if args.smoke else 3
+
+conf = (NeuralNetConfiguration.builder()
+        .seed(123)
+        .updater(Adam(1e-3))
+        .list()
+        .layer(ConvolutionLayer(n_out=8, kernel_size=(5, 5),
+                                activation="identity"))
+        .layer(BatchNormalization(activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=16, kernel_size=(5, 5),
+                                activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=64, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax"))
+        .set_input_type(InputType.convolutional(28, 28, 1))
+        .build())
+
+net = MultiLayerNetwork(conf)
+net.init()
+net.fit(MnistDataSetIterator(batch_size=128, train=True, num_examples=n,
+                             seed=123), epochs=epochs)
+ev = net.evaluate(MnistDataSetIterator(batch_size=128, train=False,
+                                       num_examples=max(n // 4, 512),
+                                       seed=123))
+print(ev.stats())
+
+with tempfile.NamedTemporaryFile(suffix=".zip") as f:
+    ModelSerializer.write_model(net, f.name)
+    net2 = ModelSerializer.restore_multi_layer_network(f.name)
+x = np.random.default_rng(0).random((4, 28, 28, 1)).astype(np.float32)
+assert (np.asarray(net.output(x)) == np.asarray(net2.output(x))).all()
+assert ev.accuracy() > (0.80 if args.smoke else 0.95), ev.accuracy()
+print(f"OK accuracy={ev.accuracy():.4f}, save/load bit-identical")

@@ -1,0 +1,42 @@
+"""MNIST single-layer MLP — the "hello world".
+
+Mirrors the reference's MLPMnistSingleLayerExample: one dense hidden
+layer + softmax output, trained with fit(DataSetIterator), evaluated with
+Evaluation. Run: python examples/mnist_mlp.py [--smoke]
+"""
+
+from _common import setup
+
+args = setup(__doc__)
+
+from deeplearning4j_tpu.data import MnistDataSetIterator
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.train import Adam
+
+n = 2048 if args.smoke else 8192
+epochs = 5 if args.smoke else 5
+
+conf = (NeuralNetConfiguration.builder()
+        .seed(123)
+        .updater(Adam(1e-3))
+        .list()
+        .layer(DenseLayer(n_in=784, n_out=256, activation="relu"))
+        .layer(OutputLayer(n_in=256, n_out=10, activation="softmax",
+                           loss="mcxent"))
+        .build())
+
+net = MultiLayerNetwork(conf)
+net.init((784,))
+
+train = MnistDataSetIterator(batch_size=128, flatten=True, train=True, num_examples=n,
+                             seed=123)
+test = MnistDataSetIterator(batch_size=128, flatten=True, train=False,
+                            num_examples=max(n // 4, 512), seed=123)
+
+net.fit(train, epochs=epochs)
+ev = net.evaluate(test)
+print(ev.stats())
+assert ev.accuracy() > (0.80 if args.smoke else 0.95), ev.accuracy()
+print(f"OK accuracy={ev.accuracy():.4f}")

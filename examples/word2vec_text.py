@@ -1,0 +1,36 @@
+"""Word2Vec on a toy corpus: train embeddings, query nearest words.
+
+Mirrors the reference's Word2VecRawTextExample (skip-gram with negative
+sampling; CBOW and hierarchical softmax are flags away). Run:
+python examples/word2vec_text.py [--smoke]
+"""
+
+from _common import setup
+
+args = setup(__doc__)
+
+from deeplearning4j_tpu.nlp import Word2Vec
+
+SENTENCES = [
+    "the tpu runs matrix multiplications on the systolic array",
+    "the gpu runs kernels on streaming multiprocessors",
+    "a tpu chip has fast hbm memory and a matrix unit",
+    "a gpu card has fast hbm memory and tensor cores",
+    "training needs data parallel sharding across chips",
+    "inference needs low latency on a single chip",
+    "the compiler fuses elementwise work into the matmul",
+    "the scheduler overlaps transfers with compute",
+] * (8 if args.smoke else 64)
+
+w2v = Word2Vec(min_word_frequency=2,
+               layer_size=32 if args.smoke else 128,
+               window_size=3, seed=11,
+               epochs=2 if args.smoke else 10)
+w2v.fit(SENTENCES)
+
+for q in ("tpu", "memory"):
+    print(q, "->", w2v.words_nearest(q, 4))
+sim = w2v.similarity("tpu", "gpu")
+print(f"similarity(tpu, gpu) = {sim:.3f}")
+assert -1.0 <= sim <= 1.0
+print("OK")
