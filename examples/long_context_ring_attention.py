@@ -1,0 +1,38 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh.
+
+Long-context training shards the SEQUENCE across devices (`sp` axis); the
+(T, T) score matrix never exists on any one chip — key/value blocks rotate
+around the ring via ppermute while each device holds only its local
+T/n_devices slice. This example runs on 8 virtual CPU devices; the same
+code runs unchanged on a TPU pod slice. Run:
+python examples/long_context_ring_attention.py [--smoke]
+"""
+
+import numpy as np
+
+from _common import setup
+
+args = setup(__doc__)
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.parallel import make_mesh, ring_attention
+
+B, T, H, D = (2, 64, 2, 8) if args.smoke else (4, 4096, 8, 64)
+mesh = make_mesh(dp=2, sp=4)
+
+rng = np.random.default_rng(0)
+q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+           for _ in range(3))
+
+out = ring_attention(mesh, q, k, v, causal=True)
+print("ring attention out:", out.shape, "on mesh", dict(
+    zip(mesh.axis_names, mesh.devices.shape)))
+
+# exactness vs the single-device reference
+ref = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+err = float(jnp.abs(out - ref).max())
+print(f"max |ring - reference| = {err:.2e}")
+assert err < 2e-5
+print("OK — exact attention, sequence sharded 4-way")
