@@ -1,0 +1,46 @@
+"""Training listeners + periodic checkpoints with retention.
+
+Mirrors the reference's listener examples (ScoreIterationListener,
+CheckpointListener with keepLast): scores print as training runs,
+checkpoints rotate on disk, and training resumes from the newest one.
+Run: python examples/checkpoint_and_listeners.py [--smoke]
+"""
+
+import pathlib
+import tempfile
+
+from _common import setup
+
+args = setup(__doc__)
+
+from deeplearning4j_tpu.data import MnistDataSetIterator
+from deeplearning4j_tpu.nn import (CheckpointListener, DenseLayer,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer,
+                                   ScoreIterationListener)
+from deeplearning4j_tpu.serde import ModelSerializer
+from deeplearning4j_tpu.train import Adam
+
+conf = (NeuralNetConfiguration.builder()
+        .seed(5).updater(Adam(1e-3)).list()
+        .layer(DenseLayer(n_in=784, n_out=64, activation="relu"))
+        .layer(OutputLayer(n_in=64, n_out=10, activation="softmax"))
+        .build())
+net = MultiLayerNetwork(conf)
+net.init((784,))
+
+n = 2048 if args.smoke else 8192
+with tempfile.TemporaryDirectory() as td:
+    net.set_listeners(
+        ScoreIterationListener(print_iterations=8),
+        CheckpointListener(td, save_every_n_iterations=8, keep_last=2))
+    net.fit(MnistDataSetIterator(batch_size=128, flatten=True, train=True,
+                                 num_examples=n, seed=5), epochs=2)
+    ckpts = sorted(pathlib.Path(td).glob("checkpoint_*.zip"))
+    print("checkpoints on disk:", [c.name for c in ckpts])
+    assert len(ckpts) == 2, "retention should keep exactly 2"
+
+    resumed = ModelSerializer.restore_multi_layer_network(str(ckpts[-1]))
+    resumed.fit(MnistDataSetIterator(batch_size=128, flatten=True,
+                                     train=True, num_examples=512, seed=6))
+print("OK — resumed training from the newest checkpoint")

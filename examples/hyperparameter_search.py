@@ -1,0 +1,58 @@
+"""Hyperparameter search with Arbiter: random + genetic candidates.
+
+Mirrors the reference's Arbiter examples: declare parameter spaces, give
+the runner a train-and-score closure, let it hunt. Run:
+python examples/hyperparameter_search.py [--smoke]
+"""
+
+from _common import setup
+
+args = setup(__doc__)
+
+from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                        GeneticSearchCandidateGenerator,
+                                        IntegerParameterSpace,
+                                        OptimizationRunner)
+from deeplearning4j_tpu.data import MnistDataSetIterator
+from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.train import Adam
+
+space = {
+    "lr": ContinuousParameterSpace(1e-4, 1e-2, log_scale=True),
+    "hidden": IntegerParameterSpace(16, 128),
+}
+
+n = 1024 if args.smoke else 4096
+train = MnistDataSetIterator(batch_size=128, flatten=True, train=True,
+                             num_examples=n, seed=1)
+test = MnistDataSetIterator(batch_size=128, flatten=True, train=False,
+                            num_examples=512, seed=1)
+
+
+def score_fn(candidate):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(candidate["lr"])).list()
+            .layer(DenseLayer(n_in=784, n_out=candidate["hidden"],
+                              activation="relu"))
+            .layer(OutputLayer(n_in=candidate["hidden"], n_out=10,
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init((784,))
+    net.fit(train, epochs=1)
+    train.reset()
+    acc = net.evaluate(test).accuracy()
+    test.reset()
+    return acc
+
+
+gen = GeneticSearchCandidateGenerator(
+    space, population_size=4,
+    max_candidates=6 if args.smoke else 24, seed=9)
+runner = OptimizationRunner(gen, score_fn, minimize=False)
+best = runner.execute()
+print(f"tried {len(runner.results)} candidates; "
+      f"best acc={best.score:.4f} with {best.candidate}")
+assert best.score > 0.5
+print("OK")
