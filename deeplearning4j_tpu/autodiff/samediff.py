@@ -308,6 +308,12 @@ class SameDiff:
         self.rnn = _Namespace(self, sd_ops.RNN, "rnn")
         self.image = _Namespace(self, sd_ops.IMAGE, "image")
         self.fft = _Namespace(self, sd_ops.FFT, "fft")
+        self.signal = _Namespace(self, sd_ops.SIGNAL, "signal")
+        # `updater` is the training-config field; `assert` is a keyword —
+        # the r4 namespaces surface under non-clashing names
+        self.updaters = _Namespace(self, sd_ops.UPDATER, "updater")
+        self.assertions = _Namespace(self, sd_ops.ASSERT, "assert")
+        self.bp = _Namespace(self, sd_ops.BP, "bp")
         self._training_config: Optional[TrainingConfig] = None
         self._loss_vars: List[str] = []
         self._opt_state = None

@@ -1,0 +1,27 @@
+#!/bin/bash
+# When the tunnel is healthy again but the old sweep process (blocked on
+# the DEAD connection) hasn't produced output in >10 min, kill it so the
+# queued charnn A/B + final bench can proceed. Only ever acts on a healthy
+# tunnel: killing a client of the dead relay can't wedge the new one.
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  sleep 120
+  pid=$(pgrep -f "sweep_transformer.py 3" | head -1)
+  [ -z "$pid" ] && { echo "$(date -u +%H:%M) sweep gone; watchdog done" >> /tmp/r4_watchdog.log; exit 0; }
+  ok=$(timeout 90 python - <<'PY' 2>/dev/null
+import subprocess, sys
+r = subprocess.run([sys.executable, "-c",
+    "import jax; print(jax.devices()[0].platform)"],
+    capture_output=True, text=True, timeout=75)
+print("healthy" if "tpu" in r.stdout else "down")
+PY
+)
+  if [ "$ok" = "healthy" ]; then
+    age=$(( $(date +%s) - $(stat -c %Y /tmp/r4_queue5.log) ))
+    if [ "$age" -gt 600 ]; then
+      echo "$(date -u +%H:%M) tunnel healthy, sweep silent ${age}s -> kill $pid" >> /tmp/r4_watchdog.log
+      kill "$pid"
+      exit 0
+    fi
+  fi
+done

@@ -532,3 +532,26 @@ def test_upstream_public_api_audit_is_complete():
             if s not in ours:
                 missing.append(f"{cls}.{n}")
     assert not missing, missing
+
+
+def test_new_namespaces_on_samediff_graph():
+    """r4b namespaces are callable from the SameDiff graph API."""
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    sd = SameDiff.create()
+    x = sd.placeholder("x")
+    spec = sd.signal.stft(x, 64, 32)
+    rec = sd.signal.istft(spec, 64, 32)
+    wave = np.random.default_rng(0).standard_normal(256).astype(np.float32)
+    out = np.asarray(sd.eval(rec, {"x": wave}))
+    np.testing.assert_allclose(out[64:192], wave[64:192], atol=1e-4)
+
+    g = sd.placeholder("g")
+    upd = sd.updaters.sgd_updater(g, 0.5)
+    got = sd.eval(upd, {"g": np.asarray([2.0], np.float32)})
+    np.testing.assert_allclose(np.asarray(got[0]), [1.0])
+
+    y = sd.placeholder("y")
+    relu_bp = sd.bp.relu_bp(y, y)
+    out = np.asarray(sd.eval(relu_bp,
+                             {"y": np.asarray([-1.0, 2.0], np.float32)}))
+    np.testing.assert_allclose(out, [0.0, 2.0])
