@@ -233,6 +233,48 @@ def build_lenet(batch, compute_dtype="bf16"):
     return _mln_chain(net, x, y)
 
 
+def build_lenet_scan(batch, compute_dtype="bf16"):
+    """(run_chain, flops) for the SCANNED LeNet fit: fit_scanned runs the
+    epoch as one lax.scan dispatch, so the marginal per-step time is pure
+    device compute — the dispatch overhead that dominates a ~1 ms model
+    through the tunnel is paid once per chain call. Same step math as
+    fit() (bit-identical trajectory, tests/test_fit_scanned.py)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.utils.tracing import total_flops
+    from deeplearning4j_tpu.zoo import LeNet
+
+    cd = jnp.bfloat16 if compute_dtype == "bf16" else None
+    net = LeNet(num_classes=10, compute_dtype=cd).init()
+    rng = np.random.default_rng(0)
+    # a few distinct device-resident batches, reused cyclically
+    dss = [DataSet(jnp.asarray(rng.random((batch, 28, 28, 1), np.float32)),
+                   jnp.asarray(np.eye(10, dtype=np.float32)[
+                       rng.integers(0, 10, batch)]))
+           for _ in range(4)]
+    net._build_optimizer(1)
+    step = net._get_train_step()
+    flops = total_flops(
+        lambda p, s, o: step.__wrapped__(
+            p, s, o, dss[0].features, dss[0].labels,
+            __import__("jax").random.PRNGKey(0), None, None)[:3],
+        net.params, net.states, net._opt_state)
+
+    def run_chain(n):
+        return net.fit_scanned([dss[i % len(dss)] for i in range(n)])
+
+    return run_chain, flops
+
+
+def bench_lenet_scan(batch, steps):
+    run_chain, flops = build_lenet_scan(batch, compute_dtype="bf16")
+    timing = measure_marginal(run_chain, n1=5, n2=steps)
+    return _record(
+        "LeNet MNIST fit_scanned samples/sec/chip (bf16, scan-dispatch)",
+        "samples/sec/chip", batch, timing, flops, dtype="bf16", batch=batch)
+
+
 def bench_lenet(batch, steps):
     run_chain, flops = build_lenet(batch, compute_dtype="bf16")
     timing = measure_marginal(run_chain, n1=5, n2=steps)
@@ -585,6 +627,7 @@ CONFIGS = {
     "resnet50": bench_resnet50_fit,   # headline: the REAL fit() entry point
     "resnet50_rawstep": bench_resnet50,
     "lenet": bench_lenet,
+    "lenet_scan": bench_lenet_scan,
     "charnn": bench_charnn,
     "charnn_f32": bench_charnn_f32,
     "bert": bench_bert,
@@ -598,6 +641,7 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     "resnet50": (128, 13),
     "resnet50_rawstep": (128, 13),
     "lenet": (512, 25),
+    "lenet_scan": (512, 25),
     "charnn": (256, 25),
     "charnn_f32": (256, 25),
     "bert": (32, 13),
@@ -684,7 +728,7 @@ def main():
     secondary = {}
     script = os.path.abspath(__file__)
     repo = os.path.dirname(script)
-    for name in ("lenet", "charnn", "bert", "transformer",
+    for name in ("lenet", "lenet_scan", "charnn", "bert", "transformer",
                  "transformer_long", "dpoverhead", "resnet50_rawstep",
                  "charnn_f32"):
         if time.perf_counter() - t_start > 1500:

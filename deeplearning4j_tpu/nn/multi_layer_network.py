@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 import optax
 
@@ -65,6 +66,7 @@ class MultiLayerNetwork:
         self.listeners: List[Any] = []
         self.initialized = False
         self._train_step = None
+        self._scan_epoch = None
         self._host_key = jax.random.PRNGKey(self._g.seed)
 
     # ------------------------------------------------------------------ init
@@ -297,6 +299,7 @@ class MultiLayerNetwork:
         else:
             self._anomaly_detector = detector or GradientAnomalyDetector()
         self._train_step = None  # rebuild with/without stats
+        self._scan_epoch = None
         return self
 
     # ------------------------------------------------------------------ fit
@@ -420,6 +423,90 @@ class MultiLayerNetwork:
         if anomaly_check is not None:
             anomaly_check.flush()
         return None if last is None else float(last)
+
+    def fit_scanned(self, data, *, epochs: int = 1):
+        """TPU-idiomatic epoch loop: ONE jit dispatch per epoch.
+
+        Stacks the epoch's minibatches to (K, B, ...) and runs the train
+        step as a ``lax.scan`` over them, so per-step dispatch overhead
+        (pytree flatten + launch latency — milliseconds through a relay,
+        and comparable to the whole step for small models) is paid once
+        per EPOCH instead of once per batch. Semantics vs :meth:`fit`:
+        identical parameter trajectory (same step math, same rng chain);
+        listeners fire per-iteration AFTER the epoch's dispatch from the
+        scanned loss history (one device fetch for all K losses), so
+        listeners that inspect model state mid-epoch (checkpointing,
+        evaluative) see the post-epoch model and are rejected loudly.
+
+        Requires equally-shaped, mask-free minibatches (the stacked scan
+        is a single compiled program). The reference has no analogue —
+        this is what an XLA-native training loop looks like.
+        """
+        from ..data.dataset import DataSet
+        if isinstance(data, DataSet):
+            batches = [data]
+        else:
+            batches = list(data)
+        if not batches:
+            return None
+        if any(b.features_mask is not None or b.labels_mask is not None
+               for b in batches):
+            raise ValueError("fit_scanned does not support masked batches; "
+                             "use fit()")
+        shapes = {(np.asarray(b.features).shape, np.asarray(b.labels).shape)
+                  for b in batches}
+        if len(shapes) > 1:
+            raise ValueError(f"fit_scanned needs equally-shaped batches, "
+                             f"got {sorted(shapes)}; use fit()")
+        for ls in self.listeners:
+            if not getattr(ls, "deferred_score_ok", False):
+                raise ValueError(
+                    f"listener {type(ls).__name__} needs exact per-"
+                    "iteration model state; use fit()")
+        if getattr(self, "_anomaly_detector", None) is not None:
+            raise ValueError("gradient anomaly detection gates per step; "
+                             "use fit()")
+        if not self.initialized:
+            self.init(tuple(np.asarray(batches[0].features).shape[1:]))
+        if self._optimizer is None:
+            self._iters_per_epoch = len(batches)
+            self._build_optimizer(self._iters_per_epoch)
+        xs = jnp.stack([jnp.asarray(b.features) for b in batches])
+        ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
+        step_fn = self._get_train_step()
+
+        if self._scan_epoch is None:
+            def scan_epoch(params, states, opt_state, rng, xs, ys):
+                def body(carry, xy):
+                    p, s, o, k = carry
+                    x, y = xy
+                    p, s, o, loss, _, k = step_fn.__wrapped__(
+                        p, s, o, x, y, k, None, None)
+                    return (p, s, o, k), loss
+                (params, states, opt_state, rng), losses = lax.scan(
+                    body, (params, states, opt_state, rng), (xs, ys))
+                return params, states, opt_state, rng, losses
+            self._scan_epoch = jax.jit(scan_epoch, donate_argnums=(0, 1, 2))
+        losses = None
+        for _ in range(epochs):
+            (self.params, self.states, self._opt_state, self._host_key,
+             losses) = self._scan_epoch(self.params, self.states,
+                                        self._opt_state, self._host_key,
+                                        xs, ys)
+            self._step_count += len(batches)
+            self.epoch_count += 1
+            if self.listeners:
+                host_losses = np.asarray(losses)   # ONE fetch for K losses
+                base = self._step_count - len(batches)
+                for i, lv in enumerate(host_losses):
+                    for listener in self.listeners:
+                        listener.iteration_done(self, base + i + 1,
+                                                self.epoch_count - 1,
+                                                float(lv))
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+        return float(np.asarray(losses)[-1])
 
     # ---------------------------------------------------------------- score
     def score(self, dataset=None):
@@ -599,6 +686,7 @@ class MultiLayerNetwork:
     def _invalidate(self):
         self._infer_fn = None
         self._train_step = None
+        self._scan_epoch = None
         self._rnn_stream_fn = None
 
     def clone(self):

@@ -105,3 +105,10 @@ def test_bench_transformer_long_step():
     run_chain, flops = bench.build_transformer(batch=2, cfg=cfg)
     assert flops > 0
     _run_one(run_chain)
+
+
+def test_bench_lenet_scan_step():
+    run_chain, flops = bench.build_lenet_scan(batch=8)
+    assert flops > 0
+    loss = run_chain(3)
+    assert loss is not None and float(loss) == float(loss)
