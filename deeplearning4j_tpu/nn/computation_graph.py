@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 import optax
 
@@ -39,6 +40,7 @@ class ComputationGraph:
         self.listeners: List[Any] = []
         self.initialized = False
         self._train_step = None
+        self._scan_epoch = None
         self._infer_fn = None
         self.epoch_count = 0
         self._step_count = 0
@@ -345,6 +347,7 @@ class ComputationGraph:
         else:
             self._anomaly_detector = detector or GradientAnomalyDetector()
         self._train_step = None
+        self._scan_epoch = None
         return self
 
     # ------------------------------------------------------------------ fit
@@ -386,6 +389,90 @@ class ComputationGraph:
         if anomaly_check is not None:
             anomaly_check.flush()
         return None if last is None else float(last)
+
+    def fit_scanned(self, data, *, epochs: int = 1):
+        """One jit dispatch per epoch: ``lax.scan`` of the train step over
+        the stacked minibatches — same contract as
+        ``MultiLayerNetwork.fit_scanned`` (bit-identical trajectory to
+        ``fit``, equally-shaped mask-free batches, listeners replayed from
+        the scanned loss history)."""
+        from ..data.dataset import DataSet, MultiDataSet
+        if isinstance(data, (DataSet, MultiDataSet)):
+            batches = [data]
+        else:
+            batches = list(data)
+        if not batches:
+            return None
+
+        def unpack(ds):
+            if isinstance(ds, MultiDataSet):
+                if ds.features_masks is not None or ds.labels_masks is not None:
+                    raise ValueError("fit_scanned does not support masked "
+                                     "batches; use fit()")
+                return ds.features, ds.labels
+            if ds.features_mask is not None or ds.labels_mask is not None:
+                raise ValueError("fit_scanned does not support masked "
+                                 "batches; use fit()")
+            return [ds.features], [ds.labels]
+
+        pairs = [unpack(ds) for ds in batches]
+        shapes = {tuple(np.asarray(f).shape for f in fs)
+                  + tuple(np.asarray(l).shape for l in ls)
+                  for fs, ls in pairs}
+        if len(shapes) > 1:
+            raise ValueError("fit_scanned needs equally-shaped batches; "
+                             "use fit()")
+        for ls in self.listeners:
+            if not getattr(ls, "deferred_score_ok", False):
+                raise ValueError(
+                    f"listener {type(ls).__name__} needs exact per-"
+                    "iteration model state; use fit()")
+        if getattr(self, "_anomaly_detector", None) is not None:
+            raise ValueError("gradient anomaly detection gates per step; "
+                             "use fit()")
+        if not self.initialized:
+            self.init([tuple(np.asarray(f).shape[1:])
+                       for f in pairs[0][0]])
+        if self._optimizer is None:
+            self._build_optimizer(max(len(batches), 1))
+        xs = {n: jnp.stack([jnp.asarray(fs[i]) for fs, _ in pairs])
+              for i, n in enumerate(self.conf.inputs)}
+        ys = {n: jnp.stack([jnp.asarray(ls[i]) for _, ls in pairs])
+              for i, n in enumerate(self.conf.outputs)}
+        step_fn = self._get_train_step()
+
+        if self._scan_epoch is None:
+            def scan_epoch(params, states, opt_state, rng, xs, ys):
+                def body(carry, xy):
+                    p, s, o, k = carry
+                    x, y = xy
+                    p, s, o, loss, _, k = step_fn.__wrapped__(
+                        p, s, o, x, y, k, None, None)
+                    return (p, s, o, k), loss
+                (params, states, opt_state, rng), losses = lax.scan(
+                    body, (params, states, opt_state, rng), (xs, ys))
+                return params, states, opt_state, rng, losses
+            self._scan_epoch = jax.jit(scan_epoch, donate_argnums=(0, 1, 2))
+        losses = None
+        for _ in range(epochs):
+            (self.params, self.states, self._opt_state, self._host_key,
+             losses) = self._scan_epoch(self.params, self.states,
+                                        self._opt_state, self._host_key,
+                                        xs, ys)
+            self._step_count += len(batches)
+            self.epoch_count += 1
+            if self.listeners:
+                host_losses = np.asarray(losses)
+                base = self._step_count - len(batches)
+                for i, lv in enumerate(host_losses):
+                    for listener in self.listeners:
+                        listener.iteration_done(self, base + i + 1,
+                                                self.epoch_count - 1,
+                                                float(lv))
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+        return float(np.asarray(losses)[-1])
 
     def _fit_epochs(self, run_iter, source_iter, wrapped, epochs, step_fn,
                     anomaly_check):

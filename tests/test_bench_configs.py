@@ -112,3 +112,13 @@ def test_bench_lenet_scan_step():
     assert flops > 0
     loss = run_chain(3)
     assert loss is not None and float(loss) == float(loss)
+
+
+def test_bench_resnet50_fitscan_parts():
+    """build_resnet50_fit(return_parts=True) feeds the fitscan config; the
+    scanned entry point runs on the tiny-config CI path."""
+    run_fit, flops, net, dss = bench.build_resnet50_fit(
+        batch=2, num_classes=10, n_distinct=2, return_parts=True)
+    assert flops > 0 and hasattr(net, "fit_scanned")
+    loss = net.fit_scanned([dss[0], dss[1]])
+    assert float(loss) == float(loss)

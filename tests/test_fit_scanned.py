@@ -74,3 +74,26 @@ def test_fit_scanned_rejects_unsupported():
                      labels_mask=np.ones((8, 1), np.float32))
     with pytest.raises(ValueError, match="masked"):
         net3.fit_scanned([masked])
+
+
+def test_cg_fit_scanned_matches_fit_bitwise():
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+
+    def mk():
+        b = (NeuralNetConfiguration.builder().seed(9).updater(Adam(1e-3))
+             .graph_builder().add_inputs("in"))
+        b.add_layer("d", DenseLayer(n_in=20, n_out=16, activation="relu"),
+                    "in")
+        b.add_layer("out", OutputLayer(n_in=16, n_out=4,
+                                       activation="softmax"), "d")
+        b.set_outputs("out")
+        return ComputationGraph(b.build()).init([(20,)])
+
+    batches = _batches()
+    a, b = mk(), mk()
+    la = a.fit(batches, epochs=2)
+    lb = b.fit_scanned(batches, epochs=2)
+    for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                    jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert abs(la - lb) < 1e-6
