@@ -1,0 +1,62 @@
+"""Import an ONNX model (exported from PyTorch) and verify identical outputs.
+
+Mirrors the reference's ONNX import path: export a torch model to ONNX
+bytes, import into a SameDiff graph, compare predictions. Requires torch
+(CPU) for the export step only. Run: python examples/onnx_import.py
+[--smoke]
+"""
+
+import io
+
+import numpy as np
+
+from _common import setup
+
+args = setup(__doc__)
+
+try:
+    import torch
+except ImportError:
+    print("SKIP: torch not installed (needed only to produce the .onnx)")
+    raise SystemExit(0)
+
+# torch's exporter imports `onnx` only to splice in custom-function protos;
+# with none present it returns the bytes unchanged, so an empty stub
+# satisfies it on images without the onnx package (our importer parses the
+# wire format itself).
+import sys as _sys
+import types as _types
+
+if "onnx" not in _sys.modules:
+    _stub = _types.ModuleType("onnx")
+
+    class _StubGraph:
+        node = ()
+
+    class _StubModel:
+        graph = _StubGraph()
+
+    _stub.load_model_from_string = lambda b: _StubModel()
+    _sys.modules["onnx"] = _stub
+
+from deeplearning4j_tpu.autodiff.onnx_import import import_onnx
+
+model = torch.nn.Sequential(
+    torch.nn.Conv2d(1, 8, 3, padding=1), torch.nn.ReLU(),
+    torch.nn.MaxPool2d(2),
+    torch.nn.Flatten(),
+    torch.nn.Linear(8 * 14 * 14, 10), torch.nn.Softmax(dim=-1))
+model.eval()
+x = torch.randn(4, 1, 28, 28)
+
+buf = io.BytesIO()
+torch.onnx.export(model, (x,), buf, opset_version=13, dynamo=False,
+                  input_names=["input"], output_names=["out"])
+
+sd, outs = import_onnx(buf.getvalue())
+got = np.asarray(outs[0].eval({"input": x.numpy()}))
+want = model(x).detach().numpy()
+np.testing.assert_allclose(got, want, atol=1e-4)
+print(f"imported conv net matches torch (max |diff| = "
+      f"{np.abs(got - want).max():.2e})")
+print("OK")

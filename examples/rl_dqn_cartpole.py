@@ -1,0 +1,31 @@
+"""Deep Q-learning on CartPole.
+
+Mirrors the reference's RL4J QLearningDiscrete example: replay buffer,
+target network, epsilon-greedy policy — the TD step is one jitted
+program. Run: python examples/rl_dqn_cartpole.py [--smoke]
+"""
+
+import numpy as np
+
+from _common import setup
+
+args = setup(__doc__)
+
+from deeplearning4j_tpu.rl import CartPoleEnv, DQN, QLearningConfiguration
+
+episodes = 12 if args.smoke else 80
+env = CartPoleEnv(seed=1, max_steps=200)
+cfg = QLearningConfiguration(
+    seed=1, warmup_steps=100 if args.smoke else 200,
+    eps_decay_steps=800 if args.smoke else 2000,
+    batch_size=64, target_update_freq=200, learning_rate=1e-3,
+    max_episode_steps=200)
+agent = DQN(env, cfg)
+rewards = agent.train(episodes=episodes)
+print(f"episode rewards: first={rewards[0]:.0f} "
+      f"mean(last 5)={np.mean(rewards[-5:]):.0f}")
+score = agent.play(max_steps=200)
+print(f"greedy play: {score:.0f} steps balanced")
+if not args.smoke:
+    assert np.mean(rewards[-10:]) > np.mean(rewards[:10])
+print("OK")
