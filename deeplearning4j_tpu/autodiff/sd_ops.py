@@ -2428,3 +2428,84 @@ BP["matmul_bp"] = _bp_of(jnp.matmul, n_grads=2)
 BP["mmul_bp"] = BP["matmul_bp"]
 
 NAMESPACES["bp"] = BP
+
+# --------------------------------------------------- r4 widening tail --
+# tf-interop aliases (the TF importer maps these names directly), signal
+# conveniences, and a few genuinely-absent ops.
+
+
+def _sample_distorted_bounding_box(key, image_size, min_object_covered=0.1,
+                                   area_range=(0.05, 1.0),
+                                   aspect_ratio_range=(0.75, 1.33)):
+    """tf.image.sample_distorted_bounding_box (random-crop training
+    regime): returns (begin(y,x), size(h,w)) for a random crop window with
+    area/aspect constraints. Static image_size; rejection-free sampling
+    (area and aspect drawn, then clamped into the image)."""
+    h, w = int(image_size[0]), int(image_size[1])
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    area = jax.random.uniform(k1, (), minval=area_range[0],
+                              maxval=area_range[1]) * (h * w)
+    ar = jnp.exp(jax.random.uniform(
+        k2, (), minval=jnp.log(jnp.asarray(aspect_ratio_range[0])),
+        maxval=jnp.log(jnp.asarray(aspect_ratio_range[1]))))
+    ch = jnp.clip(jnp.sqrt(area / ar), 1, h).astype(jnp.int32)
+    cw = jnp.clip(jnp.sqrt(area * ar), 1, w).astype(jnp.int32)
+    y0 = jax.random.randint(k3, (), 0, jnp.maximum(h - ch, 1))
+    x0 = jax.random.randint(k4, (), 0, jnp.maximum(w - cw, 1))
+    return jnp.stack([y0, x0]), jnp.stack([ch, cw])
+
+
+def _nms_with_scores(boxes, scores, max_output_size, iou_threshold=0.5,
+                     score_threshold=-jnp.inf):
+    idx, valid = IMAGE["non_max_suppression"](boxes, scores,
+                                              max_output_size,
+                                              iou_threshold,
+                                              score_threshold)
+    return idx, jnp.take(scores, jnp.maximum(idx, 0)) * (idx >= 0)
+
+
+IMAGE.update({
+    "sample_distorted_bounding_box": _sample_distorted_bounding_box,
+    "non_max_suppression_with_scores": _nms_with_scores,
+})
+
+SIGNAL.update({
+    "spectrogram": lambda x, frame_length=256, frame_step=128, **kw:
+        jnp.square(jnp.abs(_stft(x, frame_length, frame_step, **kw))),
+    "log_mel_spectrogram": lambda x, frame_length=256, frame_step=128,
+        num_mel_bins=40, sample_rate=16000, **kw: jnp.log(
+            jnp.square(jnp.abs(_stft(x, frame_length, frame_step, **kw)))
+            @ _mel_matrix(num_mel_bins,
+                          (int(kw.get("fft_length") or frame_length))
+                          // 2 + 1, sample_rate) + 1e-6),
+})
+
+# tf reduce_* spellings — same callables, importer-friendly names
+BASE.update({
+    "reduce_sum": BASE["sum"], "reduce_mean": BASE["mean"],
+    "reduce_max": BASE["max"], "reduce_min": BASE["min"],
+    "reduce_prod": BASE["prod"], "reduce_any": BASE["any"],
+    "reduce_all": BASE["all"], "reduce_logsumexp": BASE["logsumexp"],
+})
+
+# key-first random ops already implement the stateless contract
+RANDOM.update({
+    "stateless_uniform": RANDOM["uniform"],
+    "stateless_normal": RANDOM["normal"],
+    "stateless_truncated_normal": RANDOM["truncated_normal"],
+    "stateless_bernoulli": RANDOM["bernoulli"],
+})
+
+LINALG.update({
+    "cholesky_solve": LINALG["cho_solve"],
+    "matrix_triangular_solve": LINALG["triangular_solve"],
+})
+
+RNN.update({
+    "static_rnn": RNN["simple_rnn_layer"],
+    "bidirectional_dynamic_rnn": RNN["bidirectional_lstm_layer"],
+})
+
+NN_EXT.update({
+    "scaled_dot_product_attention": NN_EXT["dot_product_attention"],
+})

@@ -555,3 +555,31 @@ def test_new_namespaces_on_samediff_graph():
     out = np.asarray(sd.eval(relu_bp,
                              {"y": np.asarray([-1.0, 2.0], np.float32)}))
     np.testing.assert_allclose(out, [0.0, 2.0])
+
+
+def test_registry_tail_batch():
+    """r4 tail: tf-interop aliases + sampling/spectrogram conveniences."""
+    assert S["base"]["reduce_sum"] is S["base"]["sum"]
+    assert S["random"]["stateless_uniform"] is S["random"]["uniform"]
+    assert S["linalg"]["cholesky_solve"] is S["linalg"]["cho_solve"]
+    begin, size = S["image"]["sample_distorted_bounding_box"](
+        KEY, (64, 48), area_range=(0.1, 0.5))
+    y0, x0 = int(begin[0]), int(begin[1])
+    h, w = int(size[0]), int(size[1])
+    assert 0 <= y0 and y0 + h <= 64 and 0 <= x0 and x0 + w <= 48
+    assert h >= 1 and w >= 1
+
+    boxes = jnp.asarray([[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                         [20, 20, 30, 30]], jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    idx, sc = S["image"]["non_max_suppression_with_scores"](
+        boxes, scores, 3, iou_threshold=0.5)
+    kept = [int(i) for i in np.asarray(idx) if i >= 0]
+    assert kept == [0, 2]
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(1024),
+                    jnp.float32)
+    spec = S["signal"]["spectrogram"](x, 256, 128)
+    assert spec.shape == (7, 129) and bool(jnp.all(spec >= 0))
+    mel = S["signal"]["log_mel_spectrogram"](x, 256, 128, num_mel_bins=40)
+    assert mel.shape == (7, 40) and bool(jnp.all(jnp.isfinite(mel)))
