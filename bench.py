@@ -413,10 +413,13 @@ def bench_transformer_long(batch, steps):
     keeps the 8-layer residual stream resident."""
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo import transformer as tfm
+    # remat-full measured ahead of remat-dots for flash at T=4096
+    # (sweep phase 4: 0.0575 vs 0.0508 with the f32-operand kernel; the
+    # bf16-operand kernel revision should widen the gap)
     cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
                                 n_layers=8, d_ff=2048, max_seq=4096,
                                 dtype=jnp.bfloat16, remat=True,
-                                remat_policy="dots")
+                                remat_policy="full")
     run_chain, flops = build_transformer(batch, cfg)
     timing = measure_marginal(run_chain, n1=3, n2=steps)
     return _record(
