@@ -97,3 +97,30 @@ def test_cg_fit_scanned_matches_fit_bitwise():
                     jax.tree_util.tree_leaves(b.params)):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
     assert abs(la - lb) < 1e-6
+
+
+def test_fit_scanned_threads_bn_state():
+    """Stateful layers: BN running stats must advance through the scan
+    carry exactly as through the per-batch loop."""
+    from deeplearning4j_tpu.nn import BatchNormalization
+
+    def mk():
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(1e-3)).list()
+                .layer(DenseLayer(n_in=20, n_out=16,
+                                  activation="identity"))
+                .layer(BatchNormalization(activation="relu"))
+                .layer(OutputLayer(n_in=16, n_out=4, activation="softmax"))
+                .build())
+        return MultiLayerNetwork(conf).init((20,))
+
+    batches = _batches()
+    a, b = mk(), mk()
+    a.fit(batches, epochs=2)
+    b.fit_scanned(batches, epochs=2)
+    for x, y in zip(jax.tree_util.tree_leaves(a.states),
+                    jax.tree_util.tree_leaves(b.states)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                    jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
