@@ -1,0 +1,53 @@
+"""Import a TensorFlow frozen graph and verify identical outputs.
+
+Mirrors the reference's TFGraphMapper path: build a small conv graph with
+TF1-compat ops (conv + fused batch norm + pool + softmax — the frozen-
+inference idiom), import the GraphDef into SameDiff, compare against a
+TF session run. Requires tensorflow (CPU) for the graph build only. Run:
+python examples/tf_frozen_graph_import.py [--smoke]
+"""
+
+import numpy as np
+
+from _common import setup
+
+args = setup(__doc__)
+
+try:
+    import tensorflow as tf
+except ImportError:
+    print("SKIP: tensorflow not installed (needed only to build the graph)")
+    raise SystemExit(0)
+
+tf1 = tf.compat.v1
+rng = np.random.default_rng(0)
+
+g = tf1.Graph()
+with g.as_default():
+    x = tf1.placeholder(tf.float32, (None, 8, 8, 3), name="x")
+    k = tf1.constant(rng.standard_normal((3, 3, 3, 4)).astype(np.float32)
+                     * 0.3)
+    conv = tf1.nn.conv2d(x, k, strides=[1, 1, 1, 1], padding="SAME")
+    gamma = tf1.constant(rng.uniform(0.5, 1.5, 4).astype(np.float32))
+    beta = tf1.constant(rng.standard_normal(4).astype(np.float32))
+    mean = tf1.constant(rng.standard_normal(4).astype(np.float32))
+    var = tf1.constant(rng.uniform(0.5, 2.0, 4).astype(np.float32))
+    bn, _, _ = tf1.nn.fused_batch_norm(conv, gamma, beta, mean, var,
+                                       is_training=False)
+    act = tf.nn.relu(bn)
+    pool = tf1.nn.max_pool2d(act, ksize=2, strides=2, padding="VALID")
+    flat = tf1.reshape(pool, (-1, 4 * 4 * 4))
+    w = tf1.constant(rng.standard_normal((64, 5)).astype(np.float32) * 0.2)
+    tf.nn.softmax(tf1.matmul(flat, w), name="out")
+
+from deeplearning4j_tpu.autodiff.tf_import import import_frozen_graph
+
+sd, _ = import_frozen_graph(g.as_graph_def())
+feats = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+got = np.asarray(sd.eval(sd.get_variable("out"), {"x": feats}))
+with tf1.Session(graph=g) as sess:
+    want = sess.run("out:0", {"x:0": feats})
+np.testing.assert_allclose(got, want, atol=1e-5)
+print(f"imported frozen graph matches TF session "
+      f"(max |diff| = {np.abs(got - want).max():.2e})")
+print("OK")
