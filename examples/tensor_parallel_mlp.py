@@ -1,0 +1,59 @@
+"""Tensor parallelism on a user-built network (Megatron-style splits).
+
+Column/RowParallelDense declare their weight shardings as PartitionSpecs;
+GSPMD inserts the collectives when ParallelWrapper runs the net on a
+dp×tp mesh. The parameter trajectory matches the single-device run
+exactly — tensor parallelism here is a LAYOUT declaration, not different
+math. Runs on 8 virtual CPU devices; unchanged on a TPU slice. Run:
+python examples/tensor_parallel_mlp.py [--smoke]
+"""
+
+import numpy as np
+
+from _common import setup
+
+args = setup(__doc__)
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.parallel import (ColumnParallelDense,
+                                         ParallelWrapper, RowParallelDense,
+                                         make_mesh)
+from deeplearning4j_tpu.train import Sgd
+
+
+def mlp(hidden_cls1, hidden_cls2):
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.05))
+            .list()
+            .layer(hidden_cls1(n_in=32, n_out=64, activation="relu"))
+            .layer(hidden_cls2(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init((32,))
+
+
+rng = np.random.default_rng(0)
+X = rng.random((64, 32), np.float32)
+Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+ds = DataSet(jnp.asarray(X), jnp.asarray(Y))
+steps = 5 if args.smoke else 30
+
+single = mlp(DenseLayer, DenseLayer)
+ref_losses = [single.fit(ds) for _ in range(steps)]
+
+tp_net = mlp(ColumnParallelDense, RowParallelDense)
+mesh = make_mesh(jax.devices()[:4], dp=2, tp=2)
+pw = ParallelWrapper(tp_net, mesh=mesh)
+tp_losses = [pw.fit([ds]) for _ in range(steps)]
+
+np.testing.assert_allclose(ref_losses, tp_losses, atol=1e-5)
+spec = tp_net.params["layer_0"]["W"].sharding.spec
+print(f"layer_0 W sharded as {tuple(spec)} over mesh "
+      f"{dict(zip(mesh.axis_names, mesh.devices.shape))}")
+print(f"losses match single-device to 1e-5 over {steps} steps")
+print("OK")
