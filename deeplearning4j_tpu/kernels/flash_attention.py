@@ -102,19 +102,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             (m_ref[:, 0] + jnp.log(l_safe))[:, None], lse_ref.shape[1:])
 
 
+def _causal_kv_map(bq, bk, causal):
+    """KV-block index map. For causal grids, dead steps (key block entirely
+    above the diagonal) CLAMP to the last live key block: Pallas skips the
+    HBM->VMEM fetch when successive steps reference the same block, so the
+    ~half of the rectangular grid that pl.when skips stops costing
+    bandwidth too. (Compute for dead steps is already skipped; without the
+    clamp their DMAs still ran — measured ~2x wasted attention traffic at
+    long T.)"""
+    if not causal:
+        return lambda bh, i, j: (bh, j, 0)
+    return lambda bh, i, j: (bh, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+
+
 def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     b, h, t, d = q.shape
     bq, bk = _block_sizes(t, d, block_q, block_k)
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
+    kv_map = _causal_kv_map(bq, bk, causal)
     grid = (b * h, t // bq, t // bk)      # kv block = fastest dim (streamed)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal),
         grid=grid,
         in_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-                  pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
-                  pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))],
+                  pl.BlockSpec((1, bk, d), kv_map),
+                  pl.BlockSpec((1, bk, d), kv_map)],
         out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
                    pl.BlockSpec((1, bq, 8), lambda bh, i, j: (bh, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
@@ -265,12 +279,21 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     lsef = jnp.broadcast_to(lse.reshape(b * h, t)[:, :, None], (b * h, t, 8))
     deltaf = jnp.broadcast_to(delta.reshape(b * h, t)[:, :, None], (b * h, t, 8))
 
+    kv_map = _causal_kv_map(bq, bk, causal)
+    if causal:
+        # dkv grid streams q blocks; dead steps (q block entirely above the
+        # diagonal) clamp to the FIRST live q block — same no-refetch trick
+        # as _causal_kv_map, mirrored
+        q_map = lambda bh, j, i: (bh, jnp.maximum(i, (j * bk) // bq), 0)
+    else:
+        q_map = lambda bh, j, i: (bh, i, 0)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
         grid=(b * h, t // bq, t // bk),   # kv block streamed (fastest dim)
         in_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-                  pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
-                  pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+                  pl.BlockSpec((1, bk, d), kv_map),
+                  pl.BlockSpec((1, bk, d), kv_map),
                   pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
                   pl.BlockSpec((1, bq, 8), lambda bh, i, j: (bh, i, 0)),
                   pl.BlockSpec((1, bq, 8), lambda bh, i, j: (bh, i, 0))],
@@ -283,12 +306,14 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
         grid=(b * h, t // bk, t // bq),   # q block streamed (fastest dim)
-        in_specs=[pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+        in_specs=[pl.BlockSpec((1, bq, d), q_map),
                   pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
                   pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
-                  pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
-                  pl.BlockSpec((1, bq, 8), lambda bh, j, i: (bh, i, 0)),
-                  pl.BlockSpec((1, bq, 8), lambda bh, j, i: (bh, i, 0))],
+                  pl.BlockSpec((1, bq, d), q_map),
+                  # lse/delta stream with the q block — clamp them too, or
+                  # dead causal steps keep fetching these (1, bq, 8) blocks
+                  pl.BlockSpec((1, bq, 8), q_map),
+                  pl.BlockSpec((1, bq, 8), q_map)],
         out_specs=[pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
                    pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
@@ -329,10 +354,10 @@ def _tuned_blocks(b, h, t, d, dtype, causal, interpret) -> tuple:
         return run
 
     chip = jax.devices()[0].device_kind.replace(" ", "_")
-    # "flash2": bf16-operand kernel revision — older cached choices were
-    # tuned for the f32-operand kernel and don't transfer
+    # "flash3": causal DMA-clamp revision (dead blocks no longer fetched) —
+    # block choices tuned for earlier kernels' traffic don't transfer
     return autotune(
-        f"flash2:{chip}:{b}x{h}x{t}x{d}:{jnp.dtype(dtype).name}:{causal}",
+        f"flash3:{chip}:{b}x{h}x{t}x{d}:{jnp.dtype(dtype).name}:{causal}",
         [(128, 128), (256, 128), (128, 256), (256, 256), (512, 128),
          (128, 512), (512, 256), (256, 512), (512, 512), (1024, 256),
          (1024, 512)],

@@ -46,7 +46,12 @@ from .listeners import (CheckpointListener, CollectScoresListener,
                         PerformanceListener, ScoreIterationListener,
                         StatsListener, TimeIterationListener)
 from .losses import Loss
+from .computation_graph import ComputationGraph
 from .multi_layer_network import MultiLayerNetwork
+from .vertices import (ElementWiseVertex, L2NormalizeVertex, L2Vertex,
+                       MergeVertex, PreprocessorVertex, ReshapeVertex,
+                       ScaleVertex, ShiftVertex, StackVertex, SubsetVertex,
+                       UnstackVertex)
 from .transfer import (FineTuneConfiguration, TransferLearning,
                        TransferLearningHelper)
 from .weightnoise import (BernoulliDistribution, DropConnect,

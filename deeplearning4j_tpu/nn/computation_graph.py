@@ -46,6 +46,9 @@ class ComputationGraph:
         self._step_count = 0
         self._host_key = jax.random.PRNGKey(self._g.seed)
         self.output_loss_weights = {name: 1.0 for name in conf.outputs}
+        # int n -> train-time forward runs as n jax.checkpoint segments
+        # (activation rematerialization; see _forward_remat)
+        self.remat_segments: Optional[int] = None
 
     # ------------------------------------------------------------------ init
     def init(self, input_shapes=None):
@@ -88,57 +91,192 @@ class ComputationGraph:
         return self
 
     # -------------------------------------------------------------- forward
-    def _forward(self, params, states, inputs: dict, *, train, rng,
-                 fmask=None, lmask=None, stop_at_output_preact=False):
-        acts = dict(inputs)
-        new_states = {}
-        pre_acts = {}
-        for idx, name in enumerate(self.conf.topo_order):
-            node = self.conf.nodes[name]
-            xs = [acts[i] for i in node.inputs]
-            if isinstance(node.op, Layer):
-                if getattr(node.op, "multi_input", False):
-                    lrng = None if rng is None else jax.random.fold_in(rng, idx)
-                    ctx = Ctx(train=train, rng=lrng, mask=fmask, label_mask=lmask)
-                    if train and node.op.dropout > 0.0 and lrng is not None:
-                        keep = 1.0 - node.op.dropout
-                        dropped = []
-                        for j, h in enumerate(xs):
-                            m = jax.random.bernoulli(
-                                jax.random.fold_in(lrng, 997 + j), keep, h.shape)
-                            dropped.append(
-                                jnp.where(m, h / keep, 0.0).astype(h.dtype))
-                        xs = dropped
-                    p_n = maybe_apply_weight_noise(node.op, params[name],
-                                                   lrng, train)
-                    h, s_new = node.op.apply(p_n, states[name], xs, ctx)
-                    new_states[name] = s_new
-                    acts[name] = h
-                    continue
-                h = xs[0]
-                if name in self._preprocessors:
-                    h = self._preprocessors[name](h)
+    def _apply_node(self, idx, name, params, states, acts, pre_acts,
+                    new_states, *, train, rng, fmask, lmask,
+                    stop_at_output_preact):
+        """Apply one topo-order node, writing into acts/pre_acts/new_states.
+
+        ``idx`` is the GLOBAL topo position (the per-node rng is
+        ``fold_in(rng, idx)``), so segmented execution reproduces the exact
+        dropout/weight-noise draws of the monolithic walk."""
+        node = self.conf.nodes[name]
+        xs = [acts[i] for i in node.inputs]
+        if isinstance(node.op, Layer):
+            if getattr(node.op, "multi_input", False):
                 lrng = None if rng is None else jax.random.fold_in(rng, idx)
                 ctx = Ctx(train=train, rng=lrng, mask=fmask, label_mask=lmask)
                 if train and node.op.dropout > 0.0 and lrng is not None:
                     keep = 1.0 - node.op.dropout
-                    m = jax.random.bernoulli(jax.random.fold_in(lrng, 997), keep, h.shape)
-                    h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
-                if stop_at_output_preact and name in self.conf.outputs and \
-                        isinstance(unwrap(node.op),
-                                   (OutputLayer, LossLayer, SameDiffOutputLayer)):
-                    pre_acts[name] = h
-                    new_states[name] = states[name]
-                    acts[name] = h
-                    continue
+                    dropped = []
+                    for j, h in enumerate(xs):
+                        m = jax.random.bernoulli(
+                            jax.random.fold_in(lrng, 997 + j), keep, h.shape)
+                        dropped.append(
+                            jnp.where(m, h / keep, 0.0).astype(h.dtype))
+                    xs = dropped
                 p_n = maybe_apply_weight_noise(node.op, params[name],
                                                lrng, train)
-                h, s_new = node.op.apply(p_n, states[name], h, ctx)
+                h, s_new = node.op.apply(p_n, states[name], xs, ctx)
                 new_states[name] = s_new
                 acts[name] = h
-            else:
-                acts[name] = node.op.apply(xs)
+                return
+            h = xs[0]
+            if name in self._preprocessors:
+                h = self._preprocessors[name](h)
+            lrng = None if rng is None else jax.random.fold_in(rng, idx)
+            ctx = Ctx(train=train, rng=lrng, mask=fmask, label_mask=lmask)
+            if train and node.op.dropout > 0.0 and lrng is not None:
+                keep = 1.0 - node.op.dropout
+                m = jax.random.bernoulli(jax.random.fold_in(lrng, 997), keep, h.shape)
+                h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
+            if stop_at_output_preact and name in self.conf.outputs and \
+                    isinstance(unwrap(node.op),
+                               (OutputLayer, LossLayer, SameDiffOutputLayer)):
+                pre_acts[name] = h
                 new_states[name] = states[name]
+                acts[name] = h
+                return
+            p_n = maybe_apply_weight_noise(node.op, params[name],
+                                           lrng, train)
+            h, s_new = node.op.apply(p_n, states[name], h, ctx)
+            new_states[name] = s_new
+            acts[name] = h
+        else:
+            acts[name] = node.op.apply(xs)
+            new_states[name] = states[name]
+
+    def _as_input_dict(self, inputs):
+        """Accept {name: arr}, [arr, ...] (zipped with conf.inputs), or a
+        bare array (single-input graphs) — the MLN-compatible calling
+        convention ParallelWrapper/ParallelInference use."""
+        if isinstance(inputs, dict):
+            return inputs
+        if isinstance(inputs, (list, tuple)):
+            return {n: v for n, v in zip(self.conf.inputs, inputs)}
+        return {self.conf.inputs[0]: inputs}
+
+    def _as_label_dict(self, labels):
+        if isinstance(labels, dict):
+            return labels
+        if isinstance(labels, (list, tuple)):
+            return {n: v for n, v in zip(self.conf.outputs, labels)}
+        return {self.conf.outputs[0]: labels}
+
+    def _forward(self, params, states, inputs, *, train, rng,
+                 fmask=None, lmask=None, stop_at_output_preact=False):
+        inputs = self._as_input_dict(inputs)
+        if train and getattr(self, "remat_segments", None):
+            return self._forward_remat(
+                params, states, inputs, train=train, rng=rng, fmask=fmask,
+                lmask=lmask, stop_at_output_preact=stop_at_output_preact)
+        acts = dict(inputs)
+        new_states = {}
+        pre_acts = {}
+        for idx, name in enumerate(self.conf.topo_order):
+            self._apply_node(idx, name, params, states, acts, pre_acts,
+                             new_states, train=train, rng=rng, fmask=fmask,
+                             lmask=lmask,
+                             stop_at_output_preact=stop_at_output_preact)
+        return acts, pre_acts, new_states
+
+    # ------------------------------------------------------- segmented remat
+    def _segment_plan(self, n_segments, input_names):
+        """Partition topo_order into ``n_segments`` contiguous segments,
+        cutting where the cross-boundary live set is smallest.
+
+        Liveness: an activation is live after position i if its producer is
+        at <= i and some consumer is at > i (graph outputs live to the end).
+        Each cut carries exactly the live set, so ANY cut position is
+        semantically valid — the live-set size only decides how much the
+        checkpoint saves. For chain-of-blocks topologies (ResNet bottleneck
+        stacks) the minimal-live cuts land on block boundaries where exactly
+        one tensor crosses."""
+        order = self.conf.topo_order
+        n = len(order)
+        last_use = {}
+        for idx, name in enumerate(order):
+            for i in self.conf.nodes[name].inputs:
+                last_use[i] = idx
+        for o in self.conf.outputs:
+            last_use[o] = n
+        producers = list(input_names) + order
+        pos = {a: -1 for a in input_names}
+        pos.update({name: idx for idx, name in enumerate(order)})
+
+        def live_after(idx):
+            return [a for a in producers
+                    if pos[a] <= idx and last_use.get(a, -1) > idx]
+
+        cuts = []
+        span = n / n_segments
+        for k in range(1, n_segments):
+            ideal = int(round(k * span)) - 1
+            lo = max((cuts[-1] + 1) if cuts else 0, int(ideal - span // 2))
+            hi = min(n - 2, int(ideal + span // 2))
+            if lo > hi:
+                continue
+            best = min(range(lo, hi + 1),
+                       key=lambda i: (len(live_after(i)), abs(i - ideal)))
+            cuts.append(best)
+        if len(cuts) + 1 < n_segments:
+            import warnings
+            warnings.warn(
+                f"remat_segments={n_segments} exceeds what this "
+                f"{n}-node graph supports; using {len(cuts) + 1} "
+                "checkpoint segments (activation footprint will be larger "
+                "than configured)", stacklevel=3)
+        bounds = [-1] + cuts + [n - 1]
+        segments = []
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            nodes = [(i, order[i]) for i in range(a + 1, b + 1)]
+            carry_in = sorted(live_after(a)) if a >= 0 else sorted(input_names)
+            carry_out = sorted(live_after(b)) if b < n - 1 else \
+                sorted(set(self.conf.outputs))
+            segments.append({"nodes": nodes, "carry_in": carry_in,
+                             "carry_out": carry_out})
+        return segments
+
+    def _forward_remat(self, params, states, inputs, *, train, rng,
+                      fmask=None, lmask=None, stop_at_output_preact=False):
+        """_forward with each segment under ``jax.checkpoint``: only the
+        cross-segment live activations are saved for the backward pass;
+        everything inside a segment is recomputed. Trades (otherwise idle,
+        on an HBM-bound step) MXU cycles for activation traffic — the same
+        lever as the transformer's remat-full policy."""
+        key = (int(self.remat_segments), tuple(sorted(inputs)))
+        cache = getattr(self, "_remat_plan_cache", None)
+        if cache is None:
+            cache = self._remat_plan_cache = {}
+        plan = cache.get(key)
+        if plan is None:
+            plan = cache[key] = self._segment_plan(self.remat_segments,
+                                                   sorted(inputs))
+        acts = dict(inputs)
+        pre_acts = {}
+        new_states = {}
+        for seg in plan:
+            seg_names = [nm for _, nm in seg["nodes"]]
+            seg_params = {nm: params[nm] for nm in seg_names}
+            seg_states = {nm: states[nm] for nm in seg_names}
+
+            def seg_fn(p, s, carry, rng_, fmask_, lmask_, _seg=seg):
+                a = dict(carry)
+                pre = {}
+                ns = {}
+                for idx, nm in _seg["nodes"]:
+                    self._apply_node(
+                        idx, nm, p, s, a, pre, ns, train=train, rng=rng_,
+                        fmask=fmask_, lmask=lmask_,
+                        stop_at_output_preact=stop_at_output_preact)
+                return ({k: a[k] for k in _seg["carry_out"] if k in a},
+                        ns, pre)
+
+            carry_in = {k: acts[k] for k in seg["carry_in"]}
+            out, ns, pre = jax.checkpoint(seg_fn)(
+                seg_params, seg_states, carry_in, rng, fmask, lmask)
+            acts.update(out)
+            new_states.update(ns)
+            pre_acts.update(pre)
         return acts, pre_acts, new_states
 
     def output(self, *inputs):
@@ -242,6 +380,7 @@ class ComputationGraph:
 
     # ----------------------------------------------------------------- loss
     def _loss(self, params, states, inputs, labels, rng, fmask, lmask):
+        labels = self._as_label_dict(labels)
         acts, pre_acts, new_states = self._forward(
             params, states, inputs, train=True, rng=rng, fmask=fmask, lmask=lmask,
             stop_at_output_preact=True)

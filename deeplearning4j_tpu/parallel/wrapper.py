@@ -24,6 +24,42 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import data_parallel_mesh, shard_params_fsdp
 
 
+def _unpack_batch(ds):
+    """DataSet or MultiDataSet -> (x, y, fmask, lmask). Multi-arm features/
+    labels become tuples (CG._as_input_dict zips them with conf.inputs /
+    conf.outputs); MultiDataSet masks (plural attrs) collapse to the single
+    mask the network applies, or raise if there are several."""
+    feats = ds.features
+    labs = ds.labels
+    if isinstance(feats, (list, tuple)) or isinstance(labs, (list, tuple)):
+        def one(ms, what):
+            if ms is None:
+                return None
+            ms = [m for m in ms if m is not None]
+            if len(ms) > 1:
+                raise NotImplementedError(
+                    f"ParallelWrapper supports at most one {what} mask per "
+                    "MultiDataSet (the network applies a single mask)")
+            return ms[0] if ms else None
+        return (tuple(feats) if isinstance(feats, (list, tuple)) else feats,
+                tuple(labs) if isinstance(labs, (list, tuple)) else labs,
+                one(getattr(ds, "features_masks", None), "features"),
+                one(getattr(ds, "labels_masks", None), "labels"))
+    return feats, labs, getattr(ds, "features_mask", None), \
+        getattr(ds, "labels_mask", None)
+
+
+def _padder(pad, zero=False):
+    """Pad `pad` rows onto axis 0: repeat the last row (batch arrays) or
+    zeros (masks, so padded rows drop out of the loss)."""
+    def f(a):
+        a = np.asarray(a)
+        tail = (np.zeros((pad,) + a.shape[1:], a.dtype) if zero
+                else np.repeat(a[-1:], pad, 0))
+        return np.concatenate([a, tail])
+    return f
+
+
 class ParallelWrapper:
     """Data-parallel trainer over a mesh's 'dp' (and optional 'fsdp') axis."""
 
@@ -122,31 +158,29 @@ class ParallelWrapper:
             anomaly_check = DelayedAnomalyCheck(net._anomaly_detector)
         for _ in range(epochs):
             for ds in iterator:
-                x, y = ds.features, ds.labels
-                fmask, lmask = ds.features_mask, ds.labels_mask
-                if x.shape[0] % n:   # pad final partial batch to divide mesh
+                x, y, fmask, lmask = _unpack_batch(ds)
+                multi = isinstance(x, tuple)
+                rows = (x[0] if multi else x).shape[0]
+                if rows % n:     # pad final partial batch to divide mesh
                     # padding is host work — device-resident arrays fetch
                     # once here (partial final batch only); full batches
                     # pass straight through without a host bounce
-                    x, y = np.asarray(x), np.asarray(y)
-                    fmask = None if fmask is None else np.asarray(fmask)
-                    lmask = None if lmask is None else np.asarray(lmask)
-                    pad = n - x.shape[0] % n
-                    x = np.concatenate([x, np.repeat(x[-1:], pad, 0)])
-                    y = np.concatenate([y, np.repeat(y[-1:], pad, 0)])
+                    pad = n - rows % n
+                    x = jax.tree_util.tree_map(_padder(pad), x)
+                    y = jax.tree_util.tree_map(_padder(pad), y)
                     if fmask is not None:  # padded rows masked out entirely
-                        fmask = np.concatenate(
-                            [fmask, np.zeros((pad,) + fmask.shape[1:], fmask.dtype)])
+                        fmask = jax.tree_util.tree_map(_padder(pad, zero=True),
+                                                       fmask)
                     if lmask is not None:
-                        lmask = np.concatenate(
-                            [lmask, np.zeros((pad,) + lmask.shape[1:], lmask.dtype)])
-                fmask = None if fmask is None else jnp.asarray(fmask)
-                lmask = None if lmask is None else jnp.asarray(lmask)
+                        lmask = jax.tree_util.tree_map(_padder(pad, zero=True),
+                                                       lmask)
+                as_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
                 (net.params, net.states, net._opt_state, loss, gstats,
                  net._host_key) = step_fn(
                     net.params, net.states, net._opt_state,
-                    jnp.asarray(x), jnp.asarray(y), net._host_key,
-                    fmask, lmask)
+                    as_dev(x), as_dev(y), net._host_key,
+                    None if fmask is None else as_dev(fmask),
+                    None if lmask is None else as_dev(lmask))
                 net._step_count += 1
                 if anomaly_check is not None and gstats is not None:
                     anomaly_check.push(gstats, net._step_count)
@@ -205,10 +239,18 @@ class ParallelInference:
 
     def _build(self):
         net = self.net
+        from ..nn.computation_graph import ComputationGraph
 
-        def infer(params, states, x):
-            y, _ = net._forward(params, states, x, train=False, rng=None)
-            return y
+        if isinstance(net, ComputationGraph):
+            def infer(params, states, x):
+                acts, _, _ = net._forward(params, states, x, train=False,
+                                          rng=None)
+                outs = [acts[o] for o in net.conf.outputs]
+                return outs[0] if len(outs) == 1 else outs
+        else:
+            def infer(params, states, x):
+                y, _ = net._forward(params, states, x, train=False, rng=None)
+                return y
 
         self._infer = jax.jit(infer, in_shardings=(
             self._param_sh,
@@ -218,12 +260,17 @@ class ParallelInference:
 
     def output(self, x):
         fn = self._infer or self._build()
-        x = np.asarray(x)
+        multi = isinstance(x, (list, tuple))   # multi-input ComputationGraph
+        xs = [np.asarray(a) for a in x] if multi else [np.asarray(x)]
         n = self._batch_div
-        orig = x.shape[0]
+        orig = xs[0].shape[0]
         if orig % n:
-            x = np.concatenate([x, np.repeat(x[-1:], n - orig % n, 0)])
-        out = fn(self._params, self._states, jnp.asarray(x))
+            pad = n - orig % n
+            xs = [np.concatenate([a, np.repeat(a[-1:], pad, 0)]) for a in xs]
+        arg = tuple(jnp.asarray(a) for a in xs) if multi else jnp.asarray(xs[0])
+        out = fn(self._params, self._states, arg)
+        if isinstance(out, (list, tuple)):   # multi-output ComputationGraph
+            return [np.asarray(o)[:orig] for o in out]
         return np.asarray(out)[:orig]
 
     def submit(self, x):

@@ -40,6 +40,11 @@ class ResNet50(ZooModel):
     # conv becomes stride-1. Same function, same init distribution
     # (init draws a 7x7x3 kernel and folds it).
     stem_space_to_depth: bool = False
+    # int n -> run the train-time forward as n jax.checkpoint segments
+    # (cuts at minimal-live-set block boundaries; see
+    # ComputationGraph._forward_remat). Trades recompute for HBM
+    # activation traffic on the bandwidth-bound b128 step.
+    remat_segments: "int | None" = None
 
     # (n_blocks, filters) per stage; first block of stages 2-4 downsamples
     STAGES = ((3, (64, 64, 256)), (4, (128, 128, 512)),
@@ -100,6 +105,7 @@ class ResNet50(ZooModel):
 
     def init(self):
         net = ComputationGraph(self.conf()).init()
+        net.remat_segments = self.remat_segments
         if self.stem_space_to_depth:
             # keep the baseline stem's function family + init distribution:
             # draw a 7x7x3 kernel with the stem conv's own initializer and

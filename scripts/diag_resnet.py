@@ -36,7 +36,7 @@ def emit(tag, **kw):
     OUT.write_text(json.dumps(RESULTS, indent=2))
 
 
-def _mk_step(batch, bn_frozen=False, s2d=False):
+def _mk_step(batch, bn_frozen=False, s2d=False, remat=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -45,7 +45,7 @@ def _mk_step(batch, bn_frozen=False, s2d=False):
     from deeplearning4j_tpu.zoo.resnet import ResNet50
 
     net = ResNet50(num_classes=1000, compute_dtype=jnp.bfloat16,
-                   stem_space_to_depth=s2d).init()
+                   stem_space_to_depth=s2d, remat_segments=remat).init()
     opt = optax.sgd(0.1, momentum=0.9)
     opt_state = opt.init(net.params)
     train_flag = not bn_frozen
@@ -194,8 +194,24 @@ def phase_f():
             emit(f"F rawstep b{b} s2d", error=f"{type(e).__name__}: {e}"[:300])
 
 
+def phase_g():
+    """r4: segmented activation remat (jax.checkpoint over live-set-minimal
+    cuts). The step is HBM-bound with idle MXU headroom (A: 14.6ms MXU floor
+    vs 47.5ms measured) — recompute is free if it cuts activation traffic."""
+    for nseg in (4, 8, 16):
+        try:
+            run_chain, flops, _ = _mk_step(128, remat=nseg)
+            timing = bench.measure_marginal(run_chain, n1=3, n2=13)
+            rec = bench._record(f"G rawstep b128 remat{nseg}",
+                                "samples/sec/chip", 128, timing, flops,
+                                batch=128)
+            emit(rec.pop("metric"), **rec)
+        except Exception as e:  # noqa: BLE001
+            emit(f"G remat{nseg}", error=f"{type(e).__name__}: {e}"[:300])
+
+
 PHASES = {"A": phase_a, "B": phase_b, "C": phase_c, "D": phase_d,
-          "E": phase_e, "F": phase_f}
+          "E": phase_e, "F": phase_f, "G": phase_g}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(PHASES)

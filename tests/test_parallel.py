@@ -728,3 +728,143 @@ def test_sharded_attention_rejects_uneven_heads(devices8):
     with pytest.raises(ValueError, match="divisible by tp"):
         layer_param_shardings(make_mesh(jax.devices()[:2], tp=2),
                               layer, params)
+
+
+def _small_cg(seed=7, remat=None):
+    """Residual conv CG used by the ParallelWrapper/Inference CG tests."""
+    from deeplearning4j_tpu.nn import (ActivationLayer, BatchNormalization,
+                                       ComputationGraph, ConvolutionLayer,
+                                       ElementWiseVertex, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Sgd
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+    g = b.graph_builder().add_inputs("in")
+    g.add_layer("c1", ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                       convolution_mode="same",
+                                       activation="identity"), "in")
+    g.add_layer("bn1", BatchNormalization(activation="relu"), "c1")
+    g.add_layer("c2", ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                       convolution_mode="same",
+                                       activation="identity"), "bn1")
+    g.add_layer("bn2", BatchNormalization(activation="identity"), "c2")
+    g.add_vertex("add", ElementWiseVertex(op="add"), "bn2", "bn1")
+    g.add_layer("act", ActivationLayer(activation="relu"), "add")
+    g.add_layer("out", OutputLayer(n_out=5, activation="softmax",
+                                   loss="mcxent"), "act")
+    g.set_outputs("out")
+    g.set_input_types(InputType.convolutional(8, 8, 3))
+    net = ComputationGraph(g.build()).init()
+    net.remat_segments = remat
+    return net
+
+
+def test_parallel_wrapper_computation_graph(devices8):
+    """ParallelWrapper is a drop-in for ComputationGraph.fit too (its array
+    x/y calling convention must reach CG._loss — regression: dict(inputs)
+    blew up on the raw batch array). dp-8 trajectory == single-device."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+
+    rng = np.random.default_rng(3)
+    X = jnp.asarray(rng.standard_normal((64, 8, 8, 3)).astype(np.float32))
+    Y = jnp.asarray(np.eye(5, dtype=np.float32)[rng.integers(0, 5, 64)])
+    ds = DataSet(X, Y)
+    single = _small_cg()
+    for _ in range(4):
+        single.fit([ds])
+    par = _small_cg()
+    pw = ParallelWrapper(par, mesh=make_mesh(dp=8))
+    for _ in range(4):
+        pw.fit([ds])
+    for k in single.params:
+        for pk, a in single.params[k].items():
+            np.testing.assert_allclose(np.asarray(a),
+                                       np.asarray(par.params[k][pk]),
+                                       rtol=2e-4, atol=1e-5)
+
+
+def test_parallel_wrapper_computation_graph_remat(devices8):
+    """remat_segments composes with ParallelWrapper (checkpointed segments
+    inside the dp-sharded jitted step)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+
+    rng = np.random.default_rng(4)
+    X = jnp.asarray(rng.standard_normal((32, 8, 8, 3)).astype(np.float32))
+    Y = jnp.asarray(np.eye(5, dtype=np.float32)[rng.integers(0, 5, 32)])
+    ds = DataSet(X, Y)
+    plain = _small_cg()
+    pw1 = ParallelWrapper(plain, mesh=make_mesh(dp=8))
+    l1 = pw1.fit([ds])
+    remat = _small_cg(remat=3)
+    pw2 = ParallelWrapper(remat, mesh=make_mesh(dp=8))
+    l2 = pw2.fit([ds])
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+def test_parallel_inference_computation_graph(devices8):
+    """ParallelInference serves a ComputationGraph (3-tuple _forward)."""
+    from deeplearning4j_tpu.parallel import ParallelInference, make_mesh
+
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((24, 8, 8, 3)).astype(np.float32)
+    net = _small_cg()
+    want = np.asarray(net.output(jnp.asarray(X)))
+    pi = ParallelInference(net, mesh=make_mesh(dp=8))
+    got = pi.output(X)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_parallel_wrapper_multidataset_cg(devices8):
+    """Multi-input/multi-output CG trains through ParallelWrapper with
+    MultiDataSet batches (tuple features/labels reach CG._as_input_dict),
+    and ParallelInference returns per-output arrays."""
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.nn import (ComputationGraph, DenseLayer,
+                                       MergeVertex, NeuralNetConfiguration,
+                                       OutputLayer)
+    from deeplearning4j_tpu.parallel import (ParallelInference,
+                                             ParallelWrapper, make_mesh)
+    from deeplearning4j_tpu.train import Sgd
+
+    def build():
+        b = NeuralNetConfiguration.builder().seed(11).updater(Sgd(0.1))
+        g = b.graph_builder().add_inputs("a", "b")
+        g.add_layer("da", DenseLayer(n_in=6, n_out=8, activation="tanh"), "a")
+        g.add_layer("db", DenseLayer(n_in=4, n_out=8, activation="tanh"), "b")
+        g.add_vertex("m", MergeVertex(), "da", "db")
+        g.add_layer("o1", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                      loss="mcxent"), "m")
+        g.add_layer("o2", OutputLayer(n_in=16, n_out=2, activation="softmax",
+                                      loss="mcxent"), "m")
+        g.set_outputs("o1", "o2")
+        return ComputationGraph(g.build()).init([(6,), (4,)])
+
+    rng = np.random.default_rng(0)
+    xa = rng.standard_normal((32, 6)).astype(np.float32)
+    xb = rng.standard_normal((32, 4)).astype(np.float32)
+    y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    y2 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+    mds = MultiDataSet([xa, xb], [y1, y2])
+
+    single = build()
+    for _ in range(3):
+        single.fit([mds])
+    par = build()
+    pw = ParallelWrapper(par, mesh=make_mesh(dp=8))
+    for _ in range(3):
+        pw.fit([mds])
+    for k in single.params:
+        for pk, a in single.params[k].items():
+            np.testing.assert_allclose(np.asarray(a),
+                                       np.asarray(par.params[k][pk]),
+                                       rtol=2e-4, atol=1e-5)
+    # multi-input serving + multi-output unpadding (24 rows pads to 32 on
+    # dp=8): per-output arrays must match the net's own output()
+    pi = ParallelInference(single, mesh=make_mesh(dp=8))
+    got = pi.output([xa[:24], xb[:24]])
+    want = single.output(jnp.asarray(xa[:24]), jnp.asarray(xb[:24]))
+    assert isinstance(got, list) and len(got) == 2
+    for g_arr, w_arr in zip(got, want):
+        np.testing.assert_allclose(g_arr, np.asarray(w_arr), rtol=1e-5,
+                                   atol=1e-6)

@@ -1,0 +1,138 @@
+"""Segmented-remat ComputationGraph forward (``remat_segments``).
+
+The remat path must be a pure execution-strategy change: identical loss,
+gradients, and BN state updates to the monolithic topo walk — including
+identical dropout draws (per-node rng is keyed by GLOBAL topo index, so
+segmentation must not renumber it). Mirrors the reference's invariant that
+workspace/cache config never changes numerics
+(org.deeplearning4j.nn.conf.WorkspaceMode).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers.base import InputType
+from deeplearning4j_tpu.nn.layers.conv import ConvolutionLayer, SubsamplingLayer, GlobalPoolingLayer
+from deeplearning4j_tpu.nn.layers.core import ActivationLayer, DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.norm import BatchNormalization
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+
+
+def _residual_cnn(seed=7, dropout=0.0):
+    """Small ResNet-shaped CG: stem conv + two residual blocks + head."""
+    b = NeuralNetConfiguration.builder().seed(seed)
+    g = b.graph_builder().add_inputs("in")
+    g.add_layer("stem", ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                         convolution_mode="same",
+                                         activation="identity"), "in")
+    g.add_layer("stem_bn", BatchNormalization(activation="relu"), "stem")
+    x = "stem_bn"
+    for i in range(2):
+        g.add_layer(f"b{i}_conv", ConvolutionLayer(
+            n_out=8, kernel_size=(3, 3), convolution_mode="same",
+            activation="identity", dropout=dropout), x)
+        g.add_layer(f"b{i}_bn", BatchNormalization(activation="identity"),
+                    f"b{i}_conv")
+        g.add_vertex(f"b{i}_add", ElementWiseVertex(op="add"), f"b{i}_bn", x)
+        g.add_layer(f"b{i}_out", ActivationLayer(activation="relu"),
+                    f"b{i}_add")
+        x = f"b{i}_out"
+    g.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), x)
+    g.add_layer("out", OutputLayer(n_in=8, n_out=5, activation="softmax",
+                                   loss="mcxent"), "gap")
+    g.set_outputs("out")
+    g.set_input_types(InputType.convolutional(8, 8, 3))
+    return ComputationGraph(g.build()).init()
+
+
+def _loss_and_grads(net, x, y, rng):
+    def f(params, states):
+        loss, new_states = net._loss(params, states, {"in": x}, {"out": y},
+                                     rng, None, None)
+        return loss, new_states
+    (loss, new_states), grads = jax.value_and_grad(f, has_aux=True)(
+        net.params, net.states)
+    return loss, grads, new_states
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 8, 8, 3)), jnp.float32)
+    y = jnp.asarray(np.eye(5, dtype=np.float32)[rng.integers(0, 5, 4)])
+    return x, y
+
+
+@pytest.mark.parametrize("n_segments", [2, 3, 5])
+def test_remat_loss_grads_states_identical(data, n_segments):
+    x, y = data
+    net = _residual_cnn()
+    l0, g0, s0 = _loss_and_grads(net, x, y, None)
+    net.remat_segments = n_segments
+    l1, g1, s1 = _loss_and_grads(net, x, y, None)
+    assert jnp.allclose(l0, l1, rtol=0, atol=0), (l0, l1)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)), g0, g1)
+    # BN running stats threaded identically through segments
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-6), s0, s1)
+
+
+def test_remat_dropout_rng_matches_monolithic(data):
+    """Per-node rng is keyed by global topo index: dropout masks must be
+    bit-identical across execution strategies."""
+    x, y = data
+    rng = jax.random.PRNGKey(42)
+    net = _residual_cnn(dropout=0.3)
+    l0, g0, _ = _loss_and_grads(net, x, y, rng)
+    net.remat_segments = 3
+    l1, g1, _ = _loss_and_grads(net, x, y, rng)
+    assert float(l0) == pytest.approx(float(l1), abs=0)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)), g0, g1)
+
+
+def test_remat_fit_trajectory_matches(data):
+    """Two nets, same seed, one remat'd: fit() must produce identical params."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    x, y = data
+    a = _residual_cnn()
+    b = _residual_cnn()
+    b.remat_segments = 3
+    ds = DataSet(x, y)
+    for _ in range(3):
+        a.fit([ds])
+        b.fit([ds])
+    jax.tree_util.tree_map(
+        lambda p, q: np.testing.assert_allclose(
+            np.asarray(p), np.asarray(q), rtol=1e-6), a.params, b.params)
+
+
+def test_segment_plan_cuts_at_block_boundaries():
+    """Minimal-live cuts on a residual chain land where ONE tensor crosses."""
+    net = _residual_cnn()
+    plan = net._segment_plan(3, ["in"])
+    assert len(plan) == 3
+    assert [len(s["carry_in"]) for s in plan] == [1, 1, 1]
+    # every node appears exactly once, in topo order
+    flat = [nm for seg in plan for _, nm in seg["nodes"]]
+    assert flat == list(net.conf.topo_order)
+
+
+def test_inference_ignores_remat():
+    """train=False path stays monolithic (no checkpoint overhead at serve)."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 3)), jnp.float32)
+    net = _residual_cnn()
+    out0 = np.asarray(net.output(x))
+    net.remat_segments = 4
+    net._infer_fn = None
+    out1 = np.asarray(net.output(x))
+    np.testing.assert_array_equal(out0, out1)
