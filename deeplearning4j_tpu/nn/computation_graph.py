@@ -48,7 +48,23 @@ class ComputationGraph:
         self.output_loss_weights = {name: 1.0 for name in conf.outputs}
         # int n -> train-time forward runs as n jax.checkpoint segments
         # (activation rematerialization; see _forward_remat)
-        self.remat_segments: Optional[int] = None
+        self.remat_segments = None
+
+    @property
+    def remat_segments(self):
+        return self._remat_segments
+
+    @remat_segments.setter
+    def remat_segments(self, n):
+        """Changing the remat policy invalidates every compiled step that
+        traced the old forward (same staleness rule as
+        enable_gradient_anomaly_detection)."""
+        if getattr(self, "_remat_segments", None) != n:
+            self._train_step = None
+            self._scan_epoch = None
+            self._infer_fn = None
+            self._remat_plan_cache = {}
+        self._remat_segments = n
 
     # ------------------------------------------------------------------ init
     def init(self, input_shapes=None):

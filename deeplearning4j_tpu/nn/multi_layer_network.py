@@ -68,6 +68,24 @@ class MultiLayerNetwork:
         self._train_step = None
         self._scan_epoch = None
         self._host_key = jax.random.PRNGKey(self._g.seed)
+        # int n -> train-time forward runs as n jax.checkpoint segments
+        # (activation remat; sequential analogue of
+        # ComputationGraph.remat_segments)
+        self.remat_segments = None
+
+    @property
+    def remat_segments(self):
+        return self._remat_segments
+
+    @remat_segments.setter
+    def remat_segments(self, n):
+        """Changing the remat policy invalidates every compiled step that
+        traced the old forward."""
+        if getattr(self, "_remat_segments", None) != n:
+            self._train_step = None
+            self._scan_epoch = None
+            self._infer_fn = None
+        self._remat_segments = n
 
     # ------------------------------------------------------------------ init
     def init(self, input_shape=None):
@@ -102,36 +120,85 @@ class MultiLayerNetwork:
         return self
 
     # -------------------------------------------------------------- forward
+    def _apply_one(self, i, params, states, h, new_states, *, train, rng,
+                   fmask, lmask, stop_before_output):
+        """Apply layer ``i`` to ``h``; returns (h, stopped). ``i`` keys the
+        per-layer rng (fold_in), so segmented execution reproduces the
+        monolithic walk's dropout/weight-noise draws exactly."""
+        layer = self.layers[i]
+        if stop_before_output and i == len(self.layers) - 1 and isinstance(
+                unwrap(layer),
+                (OutputLayer, LossLayer, SameDiffOutputLayer,
+                 OCNNOutputLayer)):
+            new_states[f"layer_{i}"] = states[f"layer_{i}"]
+            return h, True
+        if i in self._preprocessors:
+            h = self._preprocessors[i](h)
+        lrng = jax.random.fold_in(rng, i) if rng is not None else None
+        ctx = Ctx(train=train, rng=lrng, mask=fmask, label_mask=lmask)
+        if train and layer.dropout > 0.0 and lrng is not None:
+            keep = 1.0 - layer.dropout
+            dk = jax.random.fold_in(lrng, 997)
+            m = jax.random.bernoulli(dk, keep, h.shape)
+            h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
+        p_i = maybe_apply_weight_noise(layer, params[f"layer_{i}"],
+                                       lrng, train)
+        h, s_new = layer.apply(p_i, states[f"layer_{i}"], h, ctx)
+        new_states[f"layer_{i}"] = s_new
+        return h, False
+
     def _forward(self, params, states, x, *, train, rng, fmask=None, lmask=None,
                  stop_before_output=False):
         """Pure forward. Returns (activation, new_states)."""
+        if train and getattr(self, "remat_segments", None):
+            return self._forward_remat(
+                params, states, x, train=train, rng=rng, fmask=fmask,
+                lmask=lmask, stop_before_output=stop_before_output)
         new_states = {}
         h = x
-        n = len(self.layers)
-        for i, layer in enumerate(self.layers):
-            is_last = i == n - 1
-            if stop_before_output and is_last and isinstance(
-                    unwrap(layer),
-                    (OutputLayer, LossLayer, SameDiffOutputLayer,
-                     OCNNOutputLayer)):
-                new_states[f"layer_{i}"] = states[f"layer_{i}"]
+        for i in range(len(self.layers)):
+            h, stopped = self._apply_one(
+                i, params, states, h, new_states, train=train, rng=rng,
+                fmask=fmask, lmask=lmask,
+                stop_before_output=stop_before_output)
+            if stopped:
                 break
-            if i in self._preprocessors:
-                h = self._preprocessors[i](h)
-            if rng is not None:
-                lrng = jax.random.fold_in(rng, i)
-            else:
-                lrng = None
-            ctx = Ctx(train=train, rng=lrng, mask=fmask, label_mask=lmask)
-            if train and layer.dropout > 0.0 and lrng is not None:
-                keep = 1.0 - layer.dropout
-                dk = jax.random.fold_in(lrng, 997)
-                m = jax.random.bernoulli(dk, keep, h.shape)
-                h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
-            p_i = maybe_apply_weight_noise(layer, params[f"layer_{i}"],
-                                           lrng, train)
-            h, s_new = layer.apply(p_i, states[f"layer_{i}"], h, ctx)
-            new_states[f"layer_{i}"] = s_new
+        return h, new_states
+
+    def _forward_remat(self, params, states, x, *, train, rng, fmask=None,
+                      lmask=None, stop_before_output=False):
+        """_forward with contiguous layer chunks under ``jax.checkpoint``:
+        only chunk-boundary activations are saved for backward; in-chunk
+        activations recompute. The sequential counterpart of
+        ComputationGraph._forward_remat (single carried tensor, so the
+        segment plan is just an even index split)."""
+        n = len(self.layers)
+        nseg = max(1, min(int(self.remat_segments), n))
+        bounds = [round(k * n / nseg) for k in range(nseg + 1)]
+        h = x
+        new_states = {}
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            if a == b:
+                continue
+
+            def seg_fn(p, s, hh, rng_, fmask_, lmask_, _a=a, _b=b):
+                ns = {}
+                for i in range(_a, _b):
+                    hh, stopped = self._apply_one(
+                        i, p, s, hh, ns, train=train, rng=rng_,
+                        fmask=fmask_, lmask=lmask_,
+                        stop_before_output=stop_before_output)
+                    if stopped:
+                        break
+                return hh, ns
+
+            seg_params = {f"layer_{i}": params[f"layer_{i}"]
+                          for i in range(a, b)}
+            seg_states = {f"layer_{i}": states[f"layer_{i}"]
+                          for i in range(a, b)}
+            h, ns = jax.checkpoint(seg_fn)(seg_params, seg_states, h, rng,
+                                           fmask, lmask)
+            new_states.update(ns)
         return h, new_states
 
     def output(self, x, train: bool = False):

@@ -265,8 +265,8 @@ class ParallelInference:
         n = self._batch_div
         orig = xs[0].shape[0]
         if orig % n:
-            pad = n - orig % n
-            xs = [np.concatenate([a, np.repeat(a[-1:], pad, 0)]) for a in xs]
+            pad_fn = _padder(n - orig % n)
+            xs = [pad_fn(a) for a in xs]
         arg = tuple(jnp.asarray(a) for a in xs) if multi else jnp.asarray(xs[0])
         out = fn(self._params, self._states, arg)
         if isinstance(out, (list, tuple)):   # multi-output ComputationGraph

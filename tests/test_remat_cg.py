@@ -132,7 +132,88 @@ def test_inference_ignores_remat():
     x = jnp.asarray(rng.standard_normal((2, 8, 8, 3)), jnp.float32)
     net = _residual_cnn()
     out0 = np.asarray(net.output(x))
-    net.remat_segments = 4
-    net._infer_fn = None
+    net.remat_segments = 4   # setter invalidates the cached inference fn
     out1 = np.asarray(net.output(x))
     np.testing.assert_array_equal(out0, out1)
+
+
+def test_remat_toggle_after_fit_takes_effect(data):
+    """Setting remat_segments after a compiled fit() invalidates the cached
+    train step (staleness regression: the old trace would silently keep the
+    monolithic forward)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    x, y = data
+    net = _residual_cnn()
+    ds = DataSet(x, y)
+    net.fit([ds])
+    assert net._train_step is not None
+    net.remat_segments = 3
+    assert net._train_step is None   # must retrace with the remat forward
+    net.fit([ds])                    # and the retraced step still trains
+    mln = _mln()
+    mln.fit([ds])
+    assert mln._train_step is not None
+    mln.remat_segments = 2
+    assert mln._train_step is None
+    mln.fit([ds])
+
+
+# ---------------------------------------------------------------------- MLN
+
+def _mln(seed=9, dropout=0.0):
+    from deeplearning4j_tpu.nn import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .list()
+            .layer(ConvolutionLayer(n_out=6, kernel_size=(3, 3),
+                                    convolution_mode="same",
+                                    activation="relu", dropout=dropout))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="tanh", dropout=dropout))
+            .layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 3)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.mark.parametrize("n_segments", [2, 3, 5])
+def test_mln_remat_loss_grads_identical(data, n_segments):
+    x, y = data
+    rng = jax.random.PRNGKey(17)
+
+    def lg(net):
+        def f(p):
+            return net._loss(p, net.states, x, y, rng, None, None)[0]
+        return jax.value_and_grad(f)(net.params)
+
+    plain = _mln(dropout=0.2)
+    l0, g0 = lg(plain)
+    remat = _mln(dropout=0.2)
+    remat.remat_segments = n_segments
+    l1, g1 = lg(remat)
+    assert float(l0) == pytest.approx(float(l1), abs=0)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)), g0, g1)
+
+
+def test_mln_remat_fit_and_inference(data):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    x, y = data
+    a = _mln()
+    b = _mln()
+    b.remat_segments = 3
+    ds = DataSet(x, y)
+    for _ in range(3):
+        a.fit([ds])
+        b.fit([ds])
+    jax.tree_util.tree_map(
+        lambda p, q: np.testing.assert_allclose(
+            np.asarray(p), np.asarray(q), rtol=1e-6), a.params, b.params)
+    np.testing.assert_allclose(np.asarray(a.output(x)),
+                               np.asarray(b.output(x)), rtol=1e-6)
+
+
+def test_remat_segments_clamped_with_warning():
+    net = _residual_cnn()
+    with pytest.warns(UserWarning, match="exceeds what this"):
+        net._segment_plan(50, ["in"])
