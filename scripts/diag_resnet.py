@@ -198,7 +198,7 @@ def phase_g():
     """r4: segmented activation remat (jax.checkpoint over live-set-minimal
     cuts). The step is HBM-bound with idle MXU headroom (A: 14.6ms MXU floor
     vs 47.5ms measured) — recompute is free if it cuts activation traffic."""
-    for nseg in (4, 8, 16):
+    for nseg in (16, 8, 4):   # block-boundary-ish first: likeliest winner
         try:
             run_chain, flops, _ = _mk_step(128, remat=nseg)
             timing = bench.measure_marginal(run_chain, n1=3, n2=13)
@@ -210,8 +210,25 @@ def phase_g():
             emit(f"G remat{nseg}", error=f"{type(e).__name__}: {e}"[:300])
 
 
+def phase_h():
+    """remat + space-to-depth stem composed: s2d measured FLAT while the
+    step was bandwidth-bound (idle MXU absorbed the stem's padded-lane
+    waste); if remat shifts the bottleneck toward compute, the stem's MXU
+    saving should start to pay."""
+    for nseg in (16, 8):
+        try:
+            run_chain, flops, _ = _mk_step(128, s2d=True, remat=nseg)
+            timing = bench.measure_marginal(run_chain, n1=3, n2=13)
+            rec = bench._record(f"H rawstep b128 remat{nseg}+s2d",
+                                "samples/sec/chip", 128, timing, flops,
+                                batch=128)
+            emit(rec.pop("metric"), **rec)
+        except Exception as e:  # noqa: BLE001
+            emit(f"H remat{nseg}+s2d", error=f"{type(e).__name__}: {e}"[:300])
+
+
 PHASES = {"A": phase_a, "B": phase_b, "C": phase_c, "D": phase_d,
-          "E": phase_e, "F": phase_f, "G": phase_g}
+          "E": phase_e, "F": phase_f, "G": phase_g, "H": phase_h}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(PHASES)

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-4 session-2 TPU queue: remat sweep -> flash crossover -> charnn A/B
+# -> full bench refresh. NO timeout wrappers (killing a TPU-attached
+# process wedges the relay — learned the hard way twice). Each python
+# entry starts with bench.wait_for_backend and exits cleanly if the
+# tunnel is down; the loop retries with long sleeps.
+cd "$(dirname "$0")/.." || exit 1
+LOG=/tmp/r4_queue7.log
+: > "$LOG"
+note() { echo "=== $1 $(date -u +%H:%M:%S) ===" >> "$LOG"; }
+
+run_step() {  # run_step <name> <cmd...>
+  name=$1; shift
+  for i in 1 2 3; do
+    note "[$name] attempt $i"
+    "$@" >> "$LOG" 2>&1
+    if ! tail -5 "$LOG" | grep -q backend_unavailable; then
+      note "[$name] done"
+      return 0
+    fi
+    sleep 180
+  done
+  note "[$name] gave up (backend unavailable)"
+  return 1
+}
+
+run_step remat   python scripts/diag_resnet.py G H
+run_step flash   python scripts/diag_flash.py bwd
+run_step charnn  python scripts/diag_charnn.py
+note "[bench] full capture"
+python bench.py > /tmp/r4_bench_stdout.json 2>> "$LOG"
+cat /tmp/r4_bench_stdout.json >> "$LOG"
+note "queue7 done"
