@@ -267,13 +267,23 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return _flash_bwd_impl(scale, causal, block_q, block_k, interpret,
+                           q, k, v, g, lse, delta)
+
+
+def _flash_bwd_impl(scale, causal, block_q, block_k, interpret,
+                    q, k, v, g, lse, delta):
+    """Shared backward. ``delta`` is rowsum(dO·O) for the plain kernel; the
+    lse-returning variant passes rowsum(dO·O) − dLSE instead — the ONLY
+    difference an lse cotangent makes (ds = p·(dp − delta + dlse), so it
+    folds into delta; dv is dlse-independent)."""
     if interpret is None:
         interpret = _interpret_default()
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, h, t, d = q.shape
     bq, bk = _block_sizes(t, d, block_q, block_k)
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     flat = lambda x: x.reshape(b * h, t, -1)
     qf, kf, vf, dof = flat(q), flat(k), flat(v), flat(g)
     lsef = jnp.broadcast_to(lse.reshape(b * h, t)[:, :, None], (b * h, t, 8))
@@ -328,6 +338,66 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
 
 
 _flash_attention_pallas.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------------- lse-returning api --
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_lse_pallas(q, k, v, scale: Optional[float] = None,
+                                causal: bool = False, block_q: int = 128,
+                                block_k: int = 128,
+                                interpret: Optional[bool] = None):
+    (out, lse), _ = _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k,
+                                   interpret)
+    return out, lse
+
+
+def flash_attention_lse(q, k, v, scale: Optional[float] = None,
+                        causal: bool = False, block_q: int = 128,
+                        block_k: int = 128,
+                        interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp, ``lse`` (B, H, T) f32 — the quantity ring attention needs
+    to merge partial attention results across sequence shards. The custom
+    VJP propagates BOTH cotangents (dLSE folds into the delta term; see
+    `_flash_bwd_impl`). Falls back to a plain-XLA computation on jaxlib
+    builds without Pallas-TPU support (same policy as flash_attention)."""
+    if pltpu is None:
+        if scale is None:
+            scale = 1.0 / math.sqrt(q.shape[-1])
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        if causal:
+            t = q.shape[2]
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            s = jnp.where(mask, s, NEG_INF)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        p = jnp.exp(s - lse[..., None])
+        out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                         v.astype(jnp.float32)).astype(q.dtype)
+        return out, lse
+    return _flash_attention_lse_pallas(q, k, v, scale, causal, block_q,
+                                       block_k, interpret)
+
+
+def _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, res = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return (out, res[4]), res
+
+
+def _flash_bwd_lse(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    delta = jnp.sum(g_out.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    if g_lse is not None and jnp.issubdtype(
+            getattr(g_lse, "dtype", jnp.float32), jnp.floating):
+        delta = delta - g_lse.astype(jnp.float32)
+    return _flash_bwd_impl(scale, causal, block_q, block_k, interpret,
+                           q, k, v, g_out, lse, delta)
+
+
+_flash_attention_lse_pallas.defvjp(_flash_fwd_lse, _flash_bwd_lse)
 
 
 def _tuned_blocks(b, h, t, d, dtype, causal, interpret) -> tuple:

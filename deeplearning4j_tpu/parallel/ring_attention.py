@@ -6,13 +6,21 @@ capability the goal spec demands (sequence parallel over the 'sp' mesh axis).
 Design (Liu et al. ring attention, blockwise online softmax): queries stay
 resident per device; key/value blocks rotate around the 'sp' ring via
 ``lax.ppermute`` (ICI neighbor exchange), each hop overlapping the local
-blockwise attention. Accumulation uses the numerically-stable online-softmax
-(running max + running denominator), so the result is EXACT — identical to
-full attention, with O(T/n) memory per device.
+blockwise attention. Partials are merged in (out, lse) form — numerically
+stable log-sum-exp weighting — so the result is EXACT: identical to full
+attention, with O(T/n) memory per device.
 
-`ring_attention_inner` is mesh-aware: inside shard_map/jit over a mesh with
-'sp', it runs the ring; with no 'sp' axis in scope it falls back to plain
-fused attention (so the same model code runs on 1 chip).
+r4 rework:
+- No bias tensors: the only hop that needs masking is the diagonal one
+  (own k/v), and there the q/k blocks are ALIGNED, so plain causal
+  attention applies. Earlier hops are unmasked; later hops are fully
+  masked and are SKIPPED via ``lax.cond`` (an all-zero partial), halving
+  the causal ring's compute instead of exp(-1e30)-ing it away.
+- The local block attention can run the pallas flash kernel
+  (``use_flash="auto"``): ``flash_attention_lse`` streams the block
+  through VMEM and returns the lse the merge needs, custom-VJP included,
+  so the per-shard score matrix never hits HBM — the composition the
+  long-context regime exists for.
 """
 
 from __future__ import annotations
@@ -27,67 +35,92 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 
-def _blockwise_attn(q, k, v, causal_bias):
-    """Single block attention returning (num, denom, rowmax) for online merge.
-
-    q (B,Tq,H,D), k/v (B,Tk,H,D); bias (Tq,Tk) additive (0/-inf) or None.
-    """
+def _xla_attn_lse(q, k, v, causal):
+    """(B,T,H,D) attention returning (out f32, lse (B,H,T) f32)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal_bias is not None:
-        s = s + causal_bias[None, None, :, :]
-    m = jnp.max(s, axis=-1, keepdims=True)                     # (B,H,Tq,1)
-    m = jnp.maximum(m, -1e30)
-    p = jnp.exp(s - m)
-    denom = jnp.sum(p, axis=-1, keepdims=True)                  # (B,H,Tq,1)
-    num = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)   # (B,Tq,H,D)
-    return num.astype(jnp.float32), denom, m
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    m = jnp.maximum(jnp.max(s, axis=-1), -1e30)                 # (B,H,Tq)
+    p = jnp.exp(s - m[..., None])
+    den = jnp.sum(p, axis=-1)                                   # (B,H,Tq)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    den_safe = jnp.maximum(den, 1e-30)
+    out = out.astype(jnp.float32) / den_safe.transpose(0, 2, 1)[..., None]
+    return out, m + jnp.log(den_safe)
+
+
+def _flash_attn_lse(q, k, v, causal, interpret):
+    """Flash-kernel local attention in ring layout (B,T,H,D)."""
+    from ..kernels.flash_attention import _tuned_blocks, flash_attention_lse
+    b, t, h, d = q.shape
+    bq, bk = _tuned_blocks(b, h, t, d, q.dtype, causal, interpret)
+    out, lse = flash_attention_lse(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), None, causal, bq, bk, interpret)
+    return out.transpose(0, 2, 1, 3).astype(jnp.float32), lse
 
 
 def _merge(acc, new):
-    """Merge two online-softmax partials."""
-    num_a, den_a, m_a = acc
-    num_n, den_n, m_n = new
-    m = jnp.maximum(m_a, m_n)
-    ca = jnp.exp(m_a - m)
-    cn = jnp.exp(m_n - m)
-    num = num_a * ca.squeeze(-1).transpose(0, 2, 1)[..., None] \
-        + num_n * cn.squeeze(-1).transpose(0, 2, 1)[..., None]
-    den = den_a * ca + den_n * cn
-    return num, den, m
+    """Merge two (out, lse) online-softmax partials."""
+    out_a, lse_a = acc
+    out_n, lse_n = new
+    lse = jnp.logaddexp(lse_a, lse_n)                            # (B,H,Tq)
+    ca = jnp.exp(lse_a - lse).transpose(0, 2, 1)[..., None]      # (B,Tq,H,1)
+    cn = jnp.exp(lse_n - lse).transpose(0, 2, 1)[..., None]
+    return out_a * ca + out_n * cn, lse
 
 
-def ring_attention_sharded(q, k, v, axis_name: str = "sp", causal: bool = True):
+def _use_flash(use_flash, t_local):
+    from ..kernels._common import pltpu
+    if pltpu is None:     # CPU-only pallas wheel: no kernel to run
+        return False
+    if use_flash == "auto":
+        return jax.default_backend() == "tpu" and t_local >= 1024
+    return bool(use_flash)
+
+
+def ring_attention_sharded(q, k, v, axis_name: str = "sp",
+                           causal: bool = True, use_flash="auto",
+                           interpret=None):
     """Runs INSIDE shard_map: q/k/v are the local sequence shard
     (B, T_local, H, D). Exact causal attention across the full sequence."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     t_local = q.shape[1]
+    flash = _use_flash(use_flash, t_local)
 
-    def local_bias(q_block_idx, k_block_idx):
-        # causal mask between local q block (global rows) and rotating k block
-        if not causal:
-            return None
-        q_pos = q_block_idx * t_local + jnp.arange(t_local)
-        k_pos = k_block_idx * t_local + jnp.arange(t_local)
-        mask = q_pos[:, None] >= k_pos[None, :]
-        return jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    def attn(q_, k_, v_, causal_):
+        if flash:
+            return _flash_attn_lse(q_, k_, v_, causal_, interpret)
+        return _xla_attn_lse(q_, k_, v_, causal_)
 
-    # initial block: own k/v
-    acc = _blockwise_attn(q, k, v, local_bias(idx, idx))
+    # hop 0: own k/v — the diagonal block is ALIGNED, plain causal applies
+    acc = attn(q, k, v, causal)
     kv = (k, v)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    zero = (jnp.zeros_like(acc[0]),
+            jnp.full_like(acc[1], -jnp.inf))
     for hop in range(1, n):
         kv = jax.tree_util.tree_map(lambda x: lax.ppermute(x, axis_name, perm), kv)
         src = (idx - hop) % n   # whose k/v we now hold
-        new = _blockwise_attn(q, kv[0], kv[1], local_bias(idx, src))
+        if causal:
+            # src < idx: full (unmasked) block; src > idx: entirely above
+            # the diagonal — skip the matmuls, contribute a zero partial
+            new = lax.cond(src < idx,
+                           lambda ops: attn(q, ops[0], ops[1], False),
+                           lambda ops: zero, kv)
+        else:
+            new = attn(q, kv[0], kv[1], False)
         acc = _merge(acc, new)
-    num, den, _ = acc
-    den_t = den.squeeze(-1).transpose(0, 2, 1)[..., None]       # (B,Tq,H,1)
-    return (num / jnp.maximum(den_t, 1e-30)).astype(q.dtype)
+    out, _ = acc
+    return out.astype(q.dtype)
 
 
-def ring_attention_inner(q, k, v, causal: bool = True, axis_name: str = "sp"):
+def ring_attention_inner(q, k, v, causal: bool = True, axis_name: str = "sp",
+                         use_flash="auto"):
     """Mesh-aware dispatch: ring when 'sp' is an in-scope mapped axis."""
     try:
         lax.axis_index(axis_name)  # raises NameError outside shard_map('sp')
@@ -95,17 +128,22 @@ def ring_attention_inner(q, k, v, causal: bool = True, axis_name: str = "sp"):
     except NameError:
         in_ring = False
     if in_ring:
-        return ring_attention_sharded(q, k, v, axis_name, causal)
+        return ring_attention_sharded(q, k, v, axis_name, causal, use_flash)
     return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
 
 
-def ring_attention(mesh: Mesh, q, k, v, causal: bool = True):
+def ring_attention(mesh: Mesh, q, k, v, causal: bool = True,
+                   use_flash="auto", interpret=None):
     """Host-callable wrapper: shard q/k/v over ('dp', 'sp') and run the ring.
 
     q/k/v: (B, T, H, D) global arrays. Returns global (B, T, H, D).
+    ``use_flash``: True / False / "auto" — run the pallas flash kernel for
+    the per-shard local attention (auto: on TPU when the local shard is
+    long enough to engage it).
     """
     spec = P("dp" if "dp" in mesh.axis_names else None, "sp", None, None)
     fn = shard_map(
-        partial(ring_attention_sharded, axis_name="sp", causal=causal),
+        partial(ring_attention_sharded, axis_name="sp", causal=causal,
+                use_flash=use_flash, interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)
