@@ -44,6 +44,11 @@ import time
 
 BASELINE_SAMPLES_PER_SEC = 360.0  # DL4J ResNet-50 V100 cuDNN (BASELINE.md)
 
+# Activation-remat policy for the ResNet configs (None = off; int = number
+# of jax.checkpoint segments). Set from the diag_resnet G/H sweep when the
+# measured winner beats the monolithic forward on-chip.
+RESNET_REMAT = None
+
 
 def _git_sha():
     import subprocess
@@ -537,7 +542,8 @@ def build_resnet50_fit(batch, num_classes=1000, n_distinct=8,
     from deeplearning4j_tpu.zoo.resnet import ResNet50
 
     net = ResNet50(num_classes=num_classes, compute_dtype=jnp.bfloat16,
-                   updater=Momentum(0.1, 0.9)).init()
+                   updater=Momentum(0.1, 0.9),
+                   remat_segments=RESNET_REMAT).init()
     rng = np.random.default_rng(0)
     dss = []
     for i in range(n_distinct):
@@ -605,7 +611,8 @@ def build_resnet50(batch, num_classes=1000):
     from deeplearning4j_tpu.utils.tracing import total_flops
     from deeplearning4j_tpu.zoo.resnet import ResNet50
 
-    net = ResNet50(num_classes=num_classes, compute_dtype=jnp.bfloat16).init()
+    net = ResNet50(num_classes=num_classes, compute_dtype=jnp.bfloat16,
+                   remat_segments=RESNET_REMAT).init()
     opt = optax.sgd(0.1, momentum=0.9)
     opt_state = opt.init(net.params)
 
