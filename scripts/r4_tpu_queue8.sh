@@ -1,0 +1,47 @@
+#!/bin/bash
+# Persistent round-4 TPU queue: block until the tunnel is healthy (up to
+# ~4h, one gentle probe per 5 min), then run remat sweep -> flash
+# crossover -> charnn A/B -> full bench. No timeout wrappers around the
+# TPU jobs themselves (killing a TPU-attached process wedges the relay).
+cd "$(dirname "$0")/.." || exit 1
+LOG=/tmp/r4_queue8.log
+: > "$LOG"
+note() { echo "=== $1 $(date -u +%H:%M:%S) ===" >> "$LOG"; }
+
+note "waiting for tunnel"
+healthy=0
+for i in $(seq 1 48); do
+  if python - >> "$LOG" 2>&1 <<'PY'
+import sys
+sys.path.insert(0, ".")
+import bench
+ok, detail = bench.wait_for_backend(max_wait_s=100)
+sys.exit(0 if ok else 1)
+PY
+  then healthy=1; break; fi
+  sleep 300
+done
+if [ "$healthy" != 1 ]; then note "gave up waiting"; exit 1; fi
+note "tunnel healthy"
+
+run_step() {
+  name=$1; shift
+  for i in 1 2 3; do
+    note "[$name] attempt $i"
+    "$@" >> "$LOG" 2>&1
+    if ! tail -5 "$LOG" | grep -q backend_unavailable; then
+      note "[$name] done"; return 0
+    fi
+    sleep 240
+  done
+  note "[$name] gave up"
+  return 1
+}
+
+run_step remat   python scripts/diag_resnet.py G H
+run_step flash   python scripts/diag_flash.py bwd
+run_step charnn  python scripts/diag_charnn.py
+note "[bench] full capture"
+python bench.py > /tmp/r4_bench_stdout.json 2>> "$LOG"
+cat /tmp/r4_bench_stdout.json >> "$LOG"
+note "queue8 done"
