@@ -1,0 +1,87 @@
+"""Activation rematerialization — train a deep residual net in a fraction
+of the activation memory, with identical numerics.
+
+``net.remat_segments = n`` runs the training forward as n
+``jax.checkpoint`` segments, cut where the fewest activations cross a
+boundary (a liveness pass over the DAG). Only segment-boundary
+activations are stored for the backward pass; everything inside a segment
+is recomputed. On an HBM-bandwidth-bound step the recompute rides
+otherwise-idle MXU cycles. The reference has no analogue (cuDNN-era
+workspaces trade memory differently); in this framework it is one
+attribute on any MultiLayerNetwork / ComputationGraph, and
+``ResNet50(remat_segments=n)`` in the zoo.
+
+This example trains the same residual CNN twice — monolithic and with 4
+checkpoint segments — and verifies the parameter trajectories agree.
+Run: python examples/activation_remat.py [--smoke]
+"""
+
+import numpy as np
+
+from _common import setup
+
+args = setup(__doc__)
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn import (ActivationLayer, BatchNormalization,
+                                   ComputationGraph, ConvolutionLayer,
+                                   ElementWiseVertex, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.train import Sgd
+
+
+def build(n_blocks):
+    b = NeuralNetConfiguration.builder().seed(42).updater(Sgd(0.05))
+    g = b.graph_builder().add_inputs("in")
+    g.add_layer("stem", ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                         convolution_mode="same",
+                                         activation="identity"), "in")
+    g.add_layer("stem_bn", BatchNormalization(activation="relu"), "stem")
+    x = "stem_bn"
+    for i in range(n_blocks):
+        g.add_layer(f"b{i}_conv", ConvolutionLayer(
+            n_out=16, kernel_size=(3, 3), convolution_mode="same",
+            activation="identity"), x)
+        g.add_layer(f"b{i}_bn", BatchNormalization(activation="identity"),
+                    f"b{i}_conv")
+        g.add_vertex(f"b{i}_add", ElementWiseVertex(op="add"),
+                     f"b{i}_bn", x)
+        g.add_layer(f"b{i}_relu", ActivationLayer(activation="relu"),
+                    f"b{i}_add")
+        x = f"b{i}_relu"
+    g.add_layer("out", OutputLayer(n_out=10, activation="softmax",
+                                   loss="mcxent"), x)
+    g.set_outputs("out")
+    g.set_input_types(InputType.convolutional(16, 16, 3))
+    return ComputationGraph(g.build()).init()
+
+
+n_blocks = 3 if args.smoke else 8
+steps = 4 if args.smoke else 30
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.standard_normal((32, 16, 16, 3)), jnp.float32)
+y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, 32)])
+ds = DataSet(x, y)
+
+plain = build(n_blocks)
+remat = build(n_blocks)
+remat.remat_segments = 4     # 4 checkpoint segments; boundaries auto-chosen
+
+for _ in range(steps):
+    l0 = plain.fit([ds])
+    l1 = remat.fit([ds])
+
+drift = max(float(jnp.max(jnp.abs(a - b)))
+            for a, b in zip(jax.tree_util.tree_leaves(plain.params),
+                            jax.tree_util.tree_leaves(remat.params)))
+print(f"final loss  plain={l0:.4f}  remat={l1:.4f}")
+print(f"max param drift after {steps} steps: {drift:.2e}")
+assert drift < 1e-4, "remat must be an execution-strategy change only"
+
+plan = remat._segment_plan(4, ["in"])
+print("checkpoint boundaries (1 tensor crosses each):",
+      [seg["carry_in"] for seg in plan])
+print("OK")

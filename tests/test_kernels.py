@@ -318,3 +318,32 @@ def test_bn_auto_training_path_stays_xla():
     assert bn.fused == "auto" and not bn._can_fuse_train()
     assert BatchNormalization(activation="relu",
                               fused=True)._can_fuse_train()
+
+
+def test_causal_clamp_index_maps_match_liveness():
+    """The causal DMA-clamp index maps must agree exactly with the kernels'
+    pl.when liveness: a (q-block i, k-block j) step is live iff
+    j*bk <= i*bq + bq - 1; dead steps must re-reference the LAST live block
+    (fwd/dq kv map) or the FIRST live block (dkv q map) so Pallas skips the
+    fetch."""
+    from deeplearning4j_tpu.kernels.flash_attention import _causal_kv_map
+
+    for bq, bk in ((128, 128), (256, 128), (128, 256), (64, 512)):
+        t = 1024
+        nq, nk = t // bq, t // bk
+        kv_map = _causal_kv_map(bq, bk, True)
+        for i in range(nq):
+            last_live = (i * bq + bq - 1) // bk
+            for j in range(nk):
+                live = j * bk <= i * bq + bq - 1
+                _, jj, _ = kv_map(0, i, j)
+                jj = int(jj)
+                if live:
+                    assert jj == j, (bq, bk, i, j)
+                else:
+                    assert jj == last_live, (bq, bk, i, j, jj)
+                # dead steps always clamp to a LIVE block index
+                assert jj * bk <= i * bq + bq - 1
+    # non-causal: identity
+    ident = _causal_kv_map(128, 128, False)
+    assert tuple(int(x) for x in ident(3, 2, 5)) == (3, 5, 0)
