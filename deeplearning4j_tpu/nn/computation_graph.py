@@ -704,6 +704,44 @@ class ComputationGraph:
     def num_params(self):
         return sum(int(p.size) for p in jax.tree_util.tree_leaves(self.params))
 
+    def params_flat(self):
+        """Single flat vector (reference ComputationGraph.params() order:
+        topological node order via the params dict)."""
+        leaves = jax.tree_util.tree_leaves(self.params)
+        return jnp.concatenate([l.ravel() for l in leaves]) if leaves \
+            else jnp.zeros((0,))
+
+    def set_params_flat(self, flat):
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        out, off = [], 0
+        for l in leaves:
+            n = int(l.size)
+            out.append(jnp.asarray(flat[off:off + n]).reshape(l.shape)
+                       .astype(l.dtype))
+            off += n
+        self.params = jax.tree_util.tree_unflatten(treedef, out)
+        self._train_step = None
+        self._scan_epoch = None
+        self._infer_fn = None
+        self._rnn_stream_fn = None
+
+    def clone(self):
+        """Reference ComputationGraph.clone(): config deep-copied, params/
+        states shared-by-value (jax arrays are immutable)."""
+        import copy
+        net = ComputationGraph(copy.deepcopy(self.conf))
+        if self.initialized:
+            # REAL copies: fit() donates param buffers, so sharing arrays
+            # would let the clone's training invalidate the source's
+            net.params = jax.tree_util.tree_map(jnp.copy, self.params)
+            net.states = jax.tree_util.tree_map(jnp.copy, self.states)
+            net._preprocessors = dict(self._preprocessors)
+            net.output_shapes = dict(self.output_shapes)
+            net._init_shapes = list(getattr(self, "_init_shapes", []))
+            net.remat_segments = self.remat_segments
+            net.initialized = True
+        return net
+
     def summary(self):
         lines = ["=" * 72, f"{'Node':<26}{'Type':<26}{'Params':<12}", "=" * 72]
         total = 0

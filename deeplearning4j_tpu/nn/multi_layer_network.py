@@ -766,8 +766,10 @@ class MultiLayerNetwork:
         import copy
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
         if self.initialized:
-            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            net.states = jax.tree_util.tree_map(lambda a: a, self.states)
+            # REAL copies: fit() donates param buffers, so sharing arrays
+            # would let the clone's training invalidate the source's
+            net.params = jax.tree_util.tree_map(jnp.copy, self.params)
+            net.states = jax.tree_util.tree_map(jnp.copy, self.states)
             net._preprocessors = dict(self._preprocessors)
             net.output_shape = self.output_shape
             net.initialized = True
