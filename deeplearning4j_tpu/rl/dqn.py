@@ -85,7 +85,10 @@ class DQN:
             updates, opt_state = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
-        self._update = update
+        # jitted TD step (no donation: params and target_params alias the
+        # same buffers right after a target sync, and XLA rejects donating
+        # a buffer that is also a live input)
+        self._update = jax.jit(update)
         self._q_jit = jax.jit(lambda p, x: q_fn(p, x))
 
     # ------------------------------------------------------------------ api
@@ -130,7 +133,7 @@ class DQN:
                         self.params, self.target_params, self._opt_state, batch)
                 if self._steps % cfg.target_update_freq == 0:
                     self.target_params = jax.tree_util.tree_map(
-                        lambda a: a, self.params)
+                        jnp.copy, self.params)
             self.episode_rewards.append(ep_reward)
             if callback:
                 callback(self, ep_reward)
