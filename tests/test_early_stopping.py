@@ -109,3 +109,26 @@ def test_early_stopping_parallel_trainer():
     assert result.best_model_score < result.score_vs_epoch[0]
     with pytest.raises(TypeError):
         EarlyStoppingParallelTrainer(cfg, object(), _iter())
+
+
+def test_early_stopping_parallel_trainer_computation_graph():
+    """EarlyStoppingParallelTrainer over ParallelWrapper(ComputationGraph):
+    the CG array-convention fix makes the full early-stopping loop (fit +
+    score calculator on the wrapped CG) work end-to-end."""
+    from deeplearning4j_tpu.nn import ComputationGraph
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+
+    b = NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-3))
+    g = b.graph_builder().add_inputs("in")
+    g.add_layer("d1", DenseLayer(n_in=5, n_out=16, activation="tanh"), "in")
+    g.add_layer("out", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                   loss="mcxent"), "d1")
+    g.set_outputs("out")
+    cg = ComputationGraph(g.build()).init([(5,)])
+    pw = ParallelWrapper(cg, mesh=make_mesh(dp=8))
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+        score_calculator=DataSetLossCalculator(_iter()))
+    result = EarlyStoppingParallelTrainer(cfg, pw, _iter()).fit()
+    assert result.total_epochs == 5
+    assert np.isfinite(result.best_model_score)
