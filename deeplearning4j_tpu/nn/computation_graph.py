@@ -60,11 +60,17 @@ class ComputationGraph:
         traced the old forward (same staleness rule as
         enable_gradient_anomaly_detection)."""
         if getattr(self, "_remat_segments", None) != n:
-            self._train_step = None
-            self._scan_epoch = None
-            self._infer_fn = None
+            self._invalidate()
             self._remat_plan_cache = {}
         self._remat_segments = n
+
+    def _invalidate(self):
+        """Drop every compiled function that closed over params/topology
+        (mirrors MultiLayerNetwork._invalidate)."""
+        self._train_step = None
+        self._scan_epoch = None
+        self._infer_fn = None
+        self._rnn_stream_fn = None
 
     # ------------------------------------------------------------------ init
     def init(self, input_shapes=None):
@@ -720,10 +726,7 @@ class ComputationGraph:
                        .astype(l.dtype))
             off += n
         self.params = jax.tree_util.tree_unflatten(treedef, out)
-        self._train_step = None
-        self._scan_epoch = None
-        self._infer_fn = None
-        self._rnn_stream_fn = None
+        self._invalidate()
 
     def clone(self):
         """Reference ComputationGraph.clone(): config deep-copied, params/

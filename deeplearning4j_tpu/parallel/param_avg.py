@@ -139,6 +139,15 @@ class ParameterAveragingTrainer:
         params (exact, no staleness; epoch_count advances once per epoch
         either way)."""
         net = self.net
+        # peek without consuming (lists/tuples only; generator iterators hit
+        # the same loud guard in _run_round on the first full round)
+        probe = iterator[0] if isinstance(iterator, (list, tuple)) \
+            and len(iterator) else None
+        if probe is not None and isinstance(probe.features, (list, tuple)):
+            raise NotImplementedError(
+                "ParameterAveragingTrainer stacks single-arm DataSet "
+                "batches; for MultiDataSet (multi-input/multi-output) "
+                "training use ParallelWrapper instead")
         round_fn = self._round or self._build()
         if net._optimizer is None:
             net._build_optimizer(1)
@@ -199,6 +208,11 @@ class ParameterAveragingTrainer:
 
     def _run_round(self, round_fn, sp, so, ss, buf):
         net = self.net
+        if isinstance(buf[0].features, (list, tuple)):
+            raise NotImplementedError(
+                "ParameterAveragingTrainer stacks single-arm DataSet "
+                "batches; for MultiDataSet (multi-input/multi-output) "
+                "training use ParallelWrapper instead")
         buf_x = [np.asarray(ds.features) for ds in buf]
         buf_y = [np.asarray(ds.labels) for ds in buf]
         b = buf_x[0].shape[0]

@@ -920,3 +920,24 @@ def test_ring_attention_xla_path_grads(devices8):
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ring, g_ref):
         assert float(jnp.abs(a - b).max()) < 5e-5
+
+
+def test_param_averaging_computation_graph(devices8):
+    """ParameterAveragingTrainer drives a ComputationGraph (array x/y reach
+    CG._loss via the normalization shim); MultiDataSet rejects loudly."""
+    from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+    from deeplearning4j_tpu.parallel import (ParameterAveragingTrainer,
+                                             make_mesh)
+
+    rng = np.random.default_rng(12)
+    X = rng.standard_normal((64, 8, 8, 3)).astype(np.float32)
+    Y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 64)]
+    net = _small_cg(seed=21)
+    tr = ParameterAveragingTrainer(net, mesh=make_mesh(dp=8),
+                                   averaging_frequency=1)
+    loss = tr.fit([DataSet(X, Y)] * 4)
+    assert loss is not None and np.isfinite(loss)
+
+    mds = MultiDataSet([X, X], [Y])
+    with pytest.raises(NotImplementedError, match="MultiDataSet"):
+        tr.fit([mds] * 2)
