@@ -160,6 +160,14 @@ class ParameterAveragingTrainer:
             buf = []
             tail_handled = False
             for ds in iterator:
+                if isinstance(ds.features, (list, tuple)):
+                    # generators bypass the list peek above; guard every
+                    # batch so the tail path never feeds MultiDataSets
+                    # into DataSet.merge
+                    raise NotImplementedError(
+                        "ParameterAveragingTrainer stacks single-arm "
+                        "DataSet batches; for MultiDataSet use "
+                        "ParallelWrapper instead")
                 buf.append(ds)
                 if len(buf) == need:
                     sp, so, ss, last = self._run_round(round_fn, sp, so, ss,

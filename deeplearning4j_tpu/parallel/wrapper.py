@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import data_parallel_mesh, shard_params_fsdp
@@ -116,6 +117,9 @@ class ParallelWrapper:
         net = self.net
         with_stats = getattr(net, "_anomaly_detector", None) is not None
         self._step_with_stats = with_stats
+        # the compiled step traced net._loss, which routes on the net's
+        # remat policy — record it so a later toggle forces a rebuild
+        self._built_remat = getattr(net, "remat_segments", None)
 
         def step(params, states, opt_state, x, y, rng, fmask, lmask):
             # split inside jit; next key rides the outputs (no separate
@@ -134,6 +138,7 @@ class ParallelWrapper:
                     states, new_states)
             return new_params, new_states, new_opt_state, loss, stats, next_rng
 
+        self._step_raw = step    # unjitted: fit_scanned scans over it
         self._step = jax.jit(
             step, donate_argnums=(0, 1, 2),
             in_shardings=(self._param_sh,
@@ -149,6 +154,10 @@ class ParallelWrapper:
         want_stats = getattr(net, "_anomaly_detector", None) is not None
         if self._step is not None and getattr(self, "_step_with_stats", None) != want_stats:
             self._step = None  # detector toggled since compile — rebuild
+        if self._step is not None and getattr(self, "_built_remat", None) != \
+                getattr(net, "remat_segments", None):
+            self._step = None            # remat policy toggled — retrace
+            self._scan_epoch = None
         step_fn = self._step or self._build_step()
         last = None
         n = self._batch_div
@@ -195,6 +204,103 @@ class ParallelWrapper:
         if anomaly_check is not None:
             anomaly_check.flush()
         return None if last is None else float(last)
+
+    def fit_scanned(self, data, *, epochs: int = 1):
+        """One jit dispatch per EPOCH across the dp mesh: the epoch's
+        equally-shaped minibatches stack to (K, B, ...) sharded over the
+        batch axes, and the dp train step runs as a ``lax.scan`` over K.
+        Composes the two throughput levers — data-parallel sharding and
+        the scanned epoch loop (net.fit_scanned) — so per-step dispatch
+        overhead (the quantity `bench.py dpoverhead` measures) is paid
+        once per epoch. Same restrictions as net.fit_scanned: no masks,
+        no anomaly gating, deferred-score listeners only; single-arm
+        DataSet batches (MultiDataSet: use fit())."""
+        net = self.net
+        batches = [data] if not isinstance(data, (list, tuple)) else list(data)
+        if not batches:
+            return None
+        if any(isinstance(b.features, (list, tuple)) for b in batches):
+            raise ValueError("fit_scanned supports single-arm DataSet "
+                             "batches; use fit() for MultiDataSet")
+        if any(getattr(b, "features_mask", None) is not None
+               or getattr(b, "labels_mask", None) is not None
+               for b in batches):
+            raise ValueError("fit_scanned does not support masked batches; "
+                             "use fit()")
+        shapes = {(np.shape(b.features), np.shape(b.labels))
+                  for b in batches}
+        if len(shapes) > 1:
+            raise ValueError(f"fit_scanned needs equally-shaped batches, "
+                             f"got {sorted(shapes)}; use fit()")
+        if batches[0].features.shape[0] % self._batch_div:
+            raise ValueError(
+                f"batch size {batches[0].features.shape[0]} must divide the "
+                f"mesh batch axes ({self._batch_div}) — fit_scanned does "
+                "not pad")
+        for ls in net.listeners:
+            if not getattr(ls, "deferred_score_ok", False):
+                raise ValueError(
+                    f"listener {type(ls).__name__} needs exact per-"
+                    "iteration model state; use fit()")
+        if getattr(net, "_anomaly_detector", None) is not None:
+            raise ValueError("gradient anomaly detection gates per step; "
+                             "use fit()")
+        if epochs <= 0:
+            return None
+        if self._step is not None and (
+                getattr(self, "_built_remat", None) !=
+                getattr(net, "remat_segments", None)
+                or getattr(self, "_step_with_stats", None)):
+            # remat policy toggled, or the cached step was compiled with
+            # anomaly-stats gating (detector since disabled) — retrace
+            self._step = None
+            self._scan_epoch = None
+        if self._step is None:
+            self._build_step()
+        step_raw = self._step_raw
+        xs = jnp.stack([jnp.asarray(b.features) for b in batches])
+        ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
+        if getattr(self, "_scan_epoch", None) is None:
+            def scan_epoch(params, states, opt_state, rng, xs, ys):
+                def body(carry, xy):
+                    p, s, o, k = carry
+                    x, y = xy
+                    p, s, o, loss, _, k = step_raw(p, s, o, x, y, k,
+                                                   None, None)
+                    return (p, s, o, k), loss
+                (params, states, opt_state, rng), losses = lax.scan(
+                    body, (params, states, opt_state, rng), (xs, ys))
+                return params, states, opt_state, rng, losses
+
+            # stacked batches: leading K axis replicated, batch axes sharded
+            stacked_sh = NamedSharding(self.mesh,
+                                       P(None, *self._batch_sh.spec))
+            self._scan_epoch = jax.jit(
+                scan_epoch, donate_argnums=(0, 1, 2),
+                in_shardings=(self._param_sh,
+                              jax.tree_util.tree_map(lambda _: self._rep,
+                                                     net.states),
+                              None, self._rep, stacked_sh, stacked_sh))
+        losses = None
+        for _ in range(epochs):
+            (net.params, net.states, net._opt_state, net._host_key,
+             losses) = self._scan_epoch(net.params, net.states,
+                                        net._opt_state, net._host_key,
+                                        xs, ys)
+            net._step_count += len(batches)
+            net.epoch_count += 1
+            if net.listeners:
+                host_losses = np.asarray(losses)   # ONE fetch for K losses
+                base = net._step_count - len(batches)
+                for i, lv in enumerate(host_losses):
+                    for listener in net.listeners:
+                        listener.iteration_done(net, base + i + 1,
+                                                net.epoch_count - 1,
+                                                float(lv))
+                for listener in net.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(net)
+        return float(np.asarray(losses)[-1])
 
 
 class ParallelInference:

@@ -941,3 +941,51 @@ def test_param_averaging_computation_graph(devices8):
     mds = MultiDataSet([X, X], [Y])
     with pytest.raises(NotImplementedError, match="MultiDataSet"):
         tr.fit([mds] * 2)
+
+
+def test_parallel_wrapper_fit_scanned_matches_fit(devices8):
+    """ParallelWrapper.fit_scanned == ParallelWrapper.fit: same parameter
+    trajectory (same step math, same rng chain), one dispatch per epoch."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+    from deeplearning4j_tpu.train import Sgd
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(13).updater(Sgd(0.2))
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=12, activation="tanh"))
+                .layer(OutputLayer(n_in=12, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init((6,))
+
+    rng = np.random.default_rng(2)
+    dss = [DataSet(jnp.asarray(rng.standard_normal((16, 6)).astype(np.float32)),
+                   jnp.asarray(np.eye(3, dtype=np.float32)[
+                       rng.integers(0, 3, 16)]))
+           for _ in range(4)]
+    a = build()
+    pw_a = ParallelWrapper(a, mesh=make_mesh(dp=8))
+    for _ in range(3):
+        pw_a.fit(dss)
+    b = build()
+    pw_b = ParallelWrapper(b, mesh=make_mesh(dp=8))
+    last = pw_b.fit_scanned(dss, epochs=3)
+    assert np.isfinite(last)
+    for k in a.params:
+        for pk, v in a.params[k].items():
+            np.testing.assert_allclose(np.asarray(v),
+                                       np.asarray(b.params[k][pk]),
+                                       rtol=2e-5, atol=1e-6)
+
+    # rejection: ragged shapes
+    ragged = dss + [DataSet(jnp.zeros((8, 6)), jnp.zeros((8, 3)))]
+    with pytest.raises(ValueError, match="equally-shaped"):
+        pw_b.fit_scanned(ragged)
+    # rejection: batch must divide the dp extent
+    with pytest.raises(ValueError, match="divide"):
+        pw_b.fit_scanned([DataSet(jnp.zeros((6, 6)), jnp.zeros((6, 3)))])
+    # epochs=0 is a graceful no-op, like fit()
+    assert pw_b.fit_scanned(dss, epochs=0) is None
