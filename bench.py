@@ -66,15 +66,21 @@ def _stamp(rec):
     """Provenance: every record carries capture time + repo SHA + backend, so
     a stale artifact can never masquerade as current (the r3 failure mode)."""
     import datetime
-    import jax
     rec.setdefault("captured_at",
                    datetime.datetime.now(datetime.timezone.utc).isoformat(
                        timespec="seconds"))
     rec.setdefault("git_sha", _git_sha())
-    try:
-        rec.setdefault("backend", jax.default_backend())
-    except Exception:  # noqa: BLE001
-        rec.setdefault("backend", "unavailable")
+    if "backend" not in rec:
+        # only touch jax when the caller did NOT pre-set the backend:
+        # setdefault would evaluate jax.default_backend() eagerly, and on
+        # an unavailable-backend record that call INITIALIZES the wedged
+        # backend in-process and hangs the very record reporting it
+        # (observed: rc=124 instead of the clean unavailable line)
+        try:
+            import jax
+            rec["backend"] = jax.default_backend()
+        except Exception:  # noqa: BLE001
+            rec["backend"] = "unavailable"
     return rec
 
 
