@@ -18,7 +18,10 @@ states pytree rides the fill-drain loop as a carry; each stage updates its
 own layers' stats per microbatch (GPipe semantics: BN batch statistics are
 per-MICROBATCH, like upstream GPipe), and after the drain an
 ownership-masked psum over 'pp' (+ pmean over dp axes) reassembles one
-consistent tree. Still no dropout rng, single input/output.
+consistent tree. Dropout/weight-noise: pass ``rng`` to the loss/step —
+masks are drawn per MICROBATCH (fold_in(microbatch, layer); GPipe
+semantics, like the per-microbatch BN stats — NOT bit-equal to a
+single-device full-batch mask). Single input/output still.
 
 Memory: ``shard_params_pp`` lays params out 1/pp per device AT REST
 (ZeRO-3 over the 'pp' axis) — params, Adam moments, and every optimizer
@@ -153,11 +156,16 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
     flat_sizes = [math.prod(s[1:]) for s in shapes]
     fmax = max(flat_sizes)
 
+    from ..nn.weightnoise import maybe_apply_weight_noise
+    needs_rng = any(getattr(l, "dropout", 0.0) > 0.0
+                    or getattr(l, "weight_noise", None) is not None
+                    for l in net.layers)
+
     def stage_fn(s):
         idx_list = stages[s]
         is_loss_stage = s == n_stages - 1
 
-        def f(params, states, flat, tgt):
+        def f(params, states, flat, tgt, mb_rng):
             # leading dim comes from the LOCAL array: under a dp axis,
             # shard_map hands each device its microbatch shard
             h = flat[:, :flat_sizes[s]].reshape(
@@ -170,9 +178,20 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
                     break   # the loss computation below consumes h
                 if i in net._preprocessors:
                     h = net._preprocessors[i](h)
-                h, s_new = layer.apply(params[f"layer_{i}"],
+                lrng = None if mb_rng is None else \
+                    jax.random.fold_in(mb_rng, i)
+                if getattr(layer, "dropout", 0.0) > 0.0 and lrng is not None:
+                    # per-MICROBATCH masks (GPipe semantics, like the
+                    # per-microbatch BN stats above)
+                    keep = 1.0 - layer.dropout
+                    m = jax.random.bernoulli(
+                        jax.random.fold_in(lrng, 997), keep, h.shape)
+                    h = jnp.where(m, h / keep, 0.0).astype(h.dtype)
+                p_i = maybe_apply_weight_noise(
+                    layer, params[f"layer_{i}"], lrng, True)
+                h, s_new = layer.apply(p_i,
                                        states[f"layer_{i}"], h,
-                                       Ctx(train=True, rng=None))
+                                       Ctx(train=True, rng=lrng))
                 new_states[f"layer_{i}"] = s_new
             out = h.reshape(h.shape[0], -1)
             pad = fmax - out.shape[1]
@@ -198,7 +217,7 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
     other_axes = tuple(a for a in mesh.axis_names
                        if a != "pp" and mesh.shape[a] > 1)
 
-    def device_loss(params, states, x_mb, y_mb):
+    def device_loss(params, states, x_mb, y_mb, rng=None):
         stage = lax.axis_index("pp")
         n_mb = x_mb.shape[0]
         mb_local = x_mb.shape[1]   # microbatch / dp under a dp axis
@@ -208,6 +227,18 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
         is_first = stage == 0
         is_last = stage == n_stages - 1
         for tick in range(n_mb + n_stages - 1):
+            # the microbatch THIS stage works on at this tick (stage s gets
+            # live microbatch tick - s) — keys its dropout/weight-noise rng
+            my_mb = jnp.clip(tick - stage, 0, n_mb - 1)
+            if rng is None:
+                mb_rng = None
+            else:
+                mb_rng = jax.random.fold_in(rng, my_mb)
+                # de-correlate masks across data-parallel shards: without
+                # this every dp device would draw the SAME per-position
+                # mask for its shard of the microbatch
+                for ax in other_axes:
+                    mb_rng = jax.random.fold_in(mb_rng, lax.axis_index(ax))
             mb_idx = jnp.clip(tick, 0, n_mb - 1)
             fresh = x_mb[mb_idx].reshape(mb_local, -1)
             if fresh.shape[1] < fmax:
@@ -217,7 +248,7 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
             out_idx = tick - (n_stages - 1)
             tgt = y_mb[jnp.clip(out_idx, 0, n_mb - 1)]
             y, mb_loss, new_states = lax.switch(stage, fns, params, states,
-                                                x, tgt)
+                                                x, tgt, mb_rng)
             # only ticks carrying a real microbatch may advance the stats:
             # stage s sees live data at ticks [s, s + n_mb); outside that
             # (fill/drain) it re-ran a clipped mb whose stats must be
@@ -260,18 +291,28 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
     def data_spec(arr_ndim):
         return P(*((None, dp) + (None,) * (arr_ndim - 2)))
 
-    def loss_with_states(params, states, x_mb, y_mb):
+    def loss_with_states(params, states, x_mb, y_mb, rng=None):
+        if not needs_rng:
+            rng = None   # dropout-free net: skip the whole rng machinery
+        if rng is None:
+            fn = shard_map(
+                lambda p, s, x, y: device_loss(p, s, x, y, None),
+                mesh=mesh,
+                in_specs=(rep, rep_states, data_spec(x_mb.ndim),
+                          data_spec(y_mb.ndim)),
+                out_specs=(P(), rep_states), check_vma=False)
+            return fn(params, states, x_mb, y_mb)
         fn = shard_map(device_loss, mesh=mesh,
                        in_specs=(rep, rep_states, data_spec(x_mb.ndim),
-                                 data_spec(y_mb.ndim)),
+                                 data_spec(y_mb.ndim), P()),
                        out_specs=(P(), rep_states), check_vma=False)
-        return fn(params, states, x_mb, y_mb)
+        return fn(params, states, x_mb, y_mb, rng)
 
     if stateful:
         return loss_with_states
 
-    def loss(params, x_mb, y_mb):
-        return loss_with_states(params, net.states, x_mb, y_mb)[0]
+    def loss(params, x_mb, y_mb, rng=None):
+        return loss_with_states(params, net.states, x_mb, y_mb, rng)[0]
 
     return loss
 
@@ -287,9 +328,9 @@ def make_mln_pipeline_train_step(mesh: Mesh, net, optimizer,
 
     if stateful:
         @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def step_s(params, states, opt_state, x_mb, y_mb):
+        def step_s(params, states, opt_state, x_mb, y_mb, rng=None):
             (loss, new_states), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, states, x_mb, y_mb)
+                loss_fn, has_aux=True)(params, states, x_mb, y_mb, rng)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, new_states, opt_state, loss
@@ -297,8 +338,8 @@ def make_mln_pipeline_train_step(mesh: Mesh, net, optimizer,
         return step_s
 
     @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, x_mb, y_mb):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x_mb, y_mb)
+    def step(params, opt_state, x_mb, y_mb, rng=None):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x_mb, y_mb, rng)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -989,3 +989,50 @@ def test_parallel_wrapper_fit_scanned_matches_fit(devices8):
         pw_b.fit_scanned([DataSet(jnp.zeros((6, 6)), jnp.zeros((6, 3)))])
     # epochs=0 is a graceful no-op, like fit()
     assert pw_b.fit_scanned(dss, epochs=0) is None
+
+
+def test_generic_pipeline_dropout_rng(devices8):
+    """Dropout in the generic pipeline: rng engages per-microbatch masks
+    (loss changes vs rng=None and varies across keys); rng=None keeps the
+    old deterministic behavior; dropout=0 nets ignore the key entirely."""
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.parallel import make_mln_pipeline_loss, make_mesh
+
+    def build(dropout):
+        conf = (NeuralNetConfiguration.builder().seed(9)
+                .list()
+                .layer(DenseLayer(n_in=12, n_out=24, activation="relu"))
+                .layer(DenseLayer(n_out=24, activation="relu",
+                                  dropout=dropout))
+                .layer(DenseLayer(n_out=12, activation="relu"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init((12,))
+
+    mesh = make_mesh(jax.devices()[:2], pp=2)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 8, 12)), jnp.float32)
+    y = jnp.asarray(np.eye(4, dtype=np.float32)[
+        rng.integers(0, 4, (4, 8))])
+
+    net = build(dropout=0.5)
+    loss_fn = make_mln_pipeline_loss(mesh, net, microbatch=8)
+    base = float(loss_fn(net.params, x, y))
+    la = float(loss_fn(net.params, x, y, jax.random.PRNGKey(1)))
+    lb = float(loss_fn(net.params, x, y, jax.random.PRNGKey(2)))
+    assert la != base and lb != base and la != lb
+
+    # gradient flows through the dropout path
+    g = jax.grad(lambda p: loss_fn(p, x, y, jax.random.PRNGKey(1)))(
+        net.params)
+    assert any(float(jnp.abs(l).max()) > 0
+               for l in jax.tree_util.tree_leaves(g))
+
+    # a dropout-free net gives the same loss with and without a key
+    net0 = build(dropout=0.0)
+    fn0 = make_mln_pipeline_loss(mesh, net0, microbatch=8)
+    np.testing.assert_allclose(
+        float(fn0(net0.params, x, y)),
+        float(fn0(net0.params, x, y, jax.random.PRNGKey(3))), rtol=1e-6)
