@@ -319,6 +319,12 @@ class SameDiff:
         self._opt_state = None
         self._optimizer = None
         self._compiled = {}
+        # True -> fit()'s loss runs under jax.checkpoint (whole-graph
+        # activation remat: backward recomputes the forward instead of
+        # storing intermediates — the SameDiff counterpart of the layer
+        # API's remat_segments, unsegmented because the graph executes as
+        # one recursive trace)
+        self.remat = False
 
     @staticmethod
     def create() -> "SameDiff":
@@ -516,10 +522,13 @@ class SameDiff:
             if self._opt_state is None:     # may be restored by load()
                 self._opt_state = self._optimizer.init(self._values_snapshot())
         ph_names = cfg.feature_mapping + cfg.label_mapping
-        step_key = ("__fit_step__", tuple(ph_names), self._loss_vars[0])
+        step_key = ("__fit_step__", tuple(ph_names), self._loss_vars[0],
+                    bool(self.remat))
         if step_key not in self._compiled:
             loss_var = self._vars[self._loss_vars[0]]
             fn = self.make_function(loss_var, ph_names)
+            if self.remat:
+                fn = jax.checkpoint(fn)
             optimizer = self._optimizer
 
             @jax.jit

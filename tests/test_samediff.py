@@ -138,3 +138,43 @@ def test_samediff_stats_listener_writes_records(tmp_path):
     assert all("score" in r for r in data)
     assert any("update_ratios" in r and "variables" in r["update_ratios"]
                for r in data[1:])
+
+
+def test_sd_fit_remat_identical_trajectory():
+    """sd.remat = True (whole-graph jax.checkpoint in fit) is a pure
+    execution-strategy change: identical loss curve and final variables."""
+    import numpy as np
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff, TrainingConfig
+    from deeplearning4j_tpu.train import Sgd
+
+    def build():
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (8, 4))
+        y = sd.placeholder("y", (8, 3))
+        w1 = sd.var("w1", value=np.random.default_rng(0).standard_normal(
+            (4, 16)).astype(np.float32) * 0.1)
+        w2 = sd.var("w2", value=np.random.default_rng(1).standard_normal(
+            (16, 3)).astype(np.float32) * 0.1)
+        h = sd.nn.tanh(x.mmul(w1))
+        logits = h.mmul(w2)
+        loss = sd.loss.softmax_cross_entropy(y, logits).rename("loss")
+        sd.set_loss_variables(loss)
+        sd.set_training_config(TrainingConfig(
+            updater=Sgd(0.1), data_set_feature_mapping=["x"],
+            data_set_label_mapping=["y"]))
+        return sd
+
+    rng = np.random.default_rng(2)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    ds = DataSet(rng.standard_normal((8, 4)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+
+    a = build()
+    ha = a.fit(iterator=[ds] * 3, epochs=2)
+    b = build()
+    b.remat = True
+    hb = b.fit(iterator=[ds] * 3, epochs=2)
+    np.testing.assert_allclose(ha.loss_curve, hb.loss_curve, rtol=1e-6)
+    for n in ("w1", "w2"):
+        np.testing.assert_allclose(np.asarray(a._values[n]),
+                                   np.asarray(b._values[n]), rtol=1e-6)
