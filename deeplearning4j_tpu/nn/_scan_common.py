@@ -1,0 +1,37 @@
+"""Shared pieces of the fit_scanned contract (MLN / CG / ParallelWrapper):
+the listener/anomaly gate and the post-epoch listener replay. One copy —
+a change to scanned-loop listener semantics must not be applied three
+times."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_scan_listeners(net):
+    """Scanned epochs fetch losses after the dispatch: only listeners that
+    opted into deferred scores may run, and per-step anomaly gating cannot."""
+    for ls in net.listeners:
+        if not getattr(ls, "deferred_score_ok", False):
+            raise ValueError(
+                f"listener {type(ls).__name__} needs exact per-"
+                "iteration model state; use fit()")
+    if getattr(net, "_anomaly_detector", None) is not None:
+        raise ValueError("gradient anomaly detection gates per step; "
+                         "use fit()")
+
+
+def replay_scan_listeners(net, losses, n_batches):
+    """Fire per-iteration listeners from the scanned loss history (ONE
+    device fetch for all K losses), then epoch-end hooks."""
+    if not net.listeners:
+        return
+    host_losses = np.asarray(losses)
+    base = net._step_count - n_batches
+    for i, lv in enumerate(host_losses):
+        for listener in net.listeners:
+            listener.iteration_done(net, base + i + 1,
+                                    net.epoch_count - 1, float(lv))
+    for listener in net.listeners:
+        if hasattr(listener, "on_epoch_end"):
+            listener.on_epoch_end(net)

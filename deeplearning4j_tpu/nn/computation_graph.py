@@ -174,6 +174,10 @@ class ComputationGraph:
         if isinstance(inputs, dict):
             return inputs
         if isinstance(inputs, (list, tuple)):
+            if len(inputs) != len(self.conf.inputs):
+                raise ValueError(
+                    f"got {len(inputs)} feature arrays for a graph with "
+                    f"{len(self.conf.inputs)} inputs {self.conf.inputs}")
             return {n: v for n, v in zip(self.conf.inputs, inputs)}
         return {self.conf.inputs[0]: inputs}
 
@@ -181,6 +185,10 @@ class ComputationGraph:
         if isinstance(labels, dict):
             return labels
         if isinstance(labels, (list, tuple)):
+            if len(labels) != len(self.conf.outputs):
+                raise ValueError(
+                    f"got {len(labels)} label arrays for a graph with "
+                    f"{len(self.conf.outputs)} outputs {self.conf.outputs}")
             return {n: v for n, v in zip(self.conf.outputs, labels)}
         return {self.conf.outputs[0]: labels}
 
@@ -583,14 +591,8 @@ class ComputationGraph:
         if len(shapes) > 1:
             raise ValueError("fit_scanned needs equally-shaped batches; "
                              "use fit()")
-        for ls in self.listeners:
-            if not getattr(ls, "deferred_score_ok", False):
-                raise ValueError(
-                    f"listener {type(ls).__name__} needs exact per-"
-                    "iteration model state; use fit()")
-        if getattr(self, "_anomaly_detector", None) is not None:
-            raise ValueError("gradient anomaly detection gates per step; "
-                             "use fit()")
+        from ._scan_common import check_scan_listeners
+        check_scan_listeners(self)
         if not self.initialized:
             self.init([tuple(np.asarray(f).shape[1:])
                        for f in pairs[0][0]])
@@ -622,17 +624,8 @@ class ComputationGraph:
                                         xs, ys)
             self._step_count += len(batches)
             self.epoch_count += 1
-            if self.listeners:
-                host_losses = np.asarray(losses)
-                base = self._step_count - len(batches)
-                for i, lv in enumerate(host_losses):
-                    for listener in self.listeners:
-                        listener.iteration_done(self, base + i + 1,
-                                                self.epoch_count - 1,
-                                                float(lv))
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_end"):
-                        listener.on_epoch_end(self)
+            from ._scan_common import replay_scan_listeners
+            replay_scan_listeners(self, losses, len(batches))
         return float(np.asarray(losses)[-1])
 
     def _fit_epochs(self, run_iter, source_iter, wrapped, epochs, step_fn,
@@ -742,6 +735,7 @@ class ComputationGraph:
             net.output_shapes = dict(self.output_shapes)
             net._init_shapes = list(getattr(self, "_init_shapes", []))
             net.remat_segments = self.remat_segments
+            net.output_loss_weights = dict(self.output_loss_weights)
             net.initialized = True
         return net
 

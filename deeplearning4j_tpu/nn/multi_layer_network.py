@@ -531,14 +531,8 @@ class MultiLayerNetwork:
         if len(shapes) > 1:
             raise ValueError(f"fit_scanned needs equally-shaped batches, "
                              f"got {sorted(shapes)}; use fit()")
-        for ls in self.listeners:
-            if not getattr(ls, "deferred_score_ok", False):
-                raise ValueError(
-                    f"listener {type(ls).__name__} needs exact per-"
-                    "iteration model state; use fit()")
-        if getattr(self, "_anomaly_detector", None) is not None:
-            raise ValueError("gradient anomaly detection gates per step; "
-                             "use fit()")
+        from ._scan_common import check_scan_listeners
+        check_scan_listeners(self)
         if not self.initialized:
             self.init(tuple(np.asarray(batches[0].features).shape[1:]))
         if self._optimizer is None:
@@ -568,17 +562,8 @@ class MultiLayerNetwork:
                                         xs, ys)
             self._step_count += len(batches)
             self.epoch_count += 1
-            if self.listeners:
-                host_losses = np.asarray(losses)   # ONE fetch for K losses
-                base = self._step_count - len(batches)
-                for i, lv in enumerate(host_losses):
-                    for listener in self.listeners:
-                        listener.iteration_done(self, base + i + 1,
-                                                self.epoch_count - 1,
-                                                float(lv))
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_end"):
-                        listener.on_epoch_end(self)
+            from ._scan_common import replay_scan_listeners
+            replay_scan_listeners(self, losses, len(batches))
         return float(np.asarray(losses)[-1])
 
     # ---------------------------------------------------------------- score
@@ -765,6 +750,7 @@ class MultiLayerNetwork:
     def clone(self):
         import copy
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        net.remat_segments = self.remat_segments
         if self.initialized:
             # REAL copies: fit() donates param buffers, so sharing arrays
             # would let the clone's training invalidate the source's

@@ -234,11 +234,15 @@ def make_mln_pipeline_loss(mesh: Mesh, net, microbatch: int):
                 mb_rng = None
             else:
                 mb_rng = jax.random.fold_in(rng, my_mb)
-                # de-correlate masks across data-parallel shards: without
-                # this every dp device would draw the SAME per-position
-                # mask for its shard of the microbatch
-                for ax in other_axes:
-                    mb_rng = jax.random.fold_in(mb_rng, lax.axis_index(ax))
+                # de-correlate masks across DATA-sharding axes only ('dp'
+                # is the sole axis data_spec shards over): without this
+                # every dp device would draw the SAME per-position mask
+                # for its shard. Non-data axes (tp/fsdp) hold replicated
+                # activations and MUST keep identical masks or their
+                # "replicated" values silently diverge.
+                if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+                    mb_rng = jax.random.fold_in(mb_rng,
+                                                lax.axis_index("dp"))
             mb_idx = jnp.clip(tick, 0, n_mb - 1)
             fresh = x_mb[mb_idx].reshape(mb_local, -1)
             if fresh.shape[1] < fmax:

@@ -120,7 +120,7 @@ def ring_attention_sharded(q, k, v, axis_name: str = "sp",
 
 
 def ring_attention_inner(q, k, v, causal: bool = True, axis_name: str = "sp",
-                         use_flash="auto"):
+                         use_flash="auto", interpret=None):
     """Mesh-aware dispatch: ring when 'sp' is an in-scope mapped axis."""
     try:
         lax.axis_index(axis_name)  # raises NameError outside shard_map('sp')
@@ -128,7 +128,8 @@ def ring_attention_inner(q, k, v, causal: bool = True, axis_name: str = "sp",
     except NameError:
         in_ring = False
     if in_ring:
-        return ring_attention_sharded(q, k, v, axis_name, causal, use_flash)
+        return ring_attention_sharded(q, k, v, axis_name, causal, use_flash,
+                                      interpret)
     return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
 
 

@@ -237,14 +237,8 @@ class ParallelWrapper:
                 f"batch size {batches[0].features.shape[0]} must divide the "
                 f"mesh batch axes ({self._batch_div}) — fit_scanned does "
                 "not pad")
-        for ls in net.listeners:
-            if not getattr(ls, "deferred_score_ok", False):
-                raise ValueError(
-                    f"listener {type(ls).__name__} needs exact per-"
-                    "iteration model state; use fit()")
-        if getattr(net, "_anomaly_detector", None) is not None:
-            raise ValueError("gradient anomaly detection gates per step; "
-                             "use fit()")
+        from ..nn._scan_common import check_scan_listeners
+        check_scan_listeners(net)
         if epochs <= 0:
             return None
         if self._step is not None and (
@@ -289,17 +283,8 @@ class ParallelWrapper:
                                         xs, ys)
             net._step_count += len(batches)
             net.epoch_count += 1
-            if net.listeners:
-                host_losses = np.asarray(losses)   # ONE fetch for K losses
-                base = net._step_count - len(batches)
-                for i, lv in enumerate(host_losses):
-                    for listener in net.listeners:
-                        listener.iteration_done(net, base + i + 1,
-                                                net.epoch_count - 1,
-                                                float(lv))
-                for listener in net.listeners:
-                    if hasattr(listener, "on_epoch_end"):
-                        listener.on_epoch_end(net)
+            from ..nn._scan_common import replay_scan_listeners
+            replay_scan_listeners(net, losses, len(batches))
         return float(np.asarray(losses)[-1])
 
 

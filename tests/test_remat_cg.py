@@ -255,3 +255,29 @@ def test_mln_clone_trains_independently(data):
     twin.fit([DataSet(x, y)])
     np.testing.assert_array_equal(np.asarray(net.params_flat()), flat)
     assert not np.array_equal(np.asarray(twin.params_flat()), flat)
+
+
+def test_clone_preserves_loss_weights_and_remat(data):
+    """clone() carries output_loss_weights (CG) and remat_segments (both) —
+    review findings: early stopping clones the best model, which must keep
+    the configured loss weighting and memory policy."""
+    x, y = data
+    net = _residual_cnn()
+    net.output_loss_weights = {"out": 0.25}
+    net.remat_segments = 3
+    twin = net.clone()
+    assert twin.output_loss_weights == {"out": 0.25}
+    assert twin.remat_segments == 3
+    mln = _mln()
+    mln.remat_segments = 2
+    assert mln.clone().remat_segments == 2
+
+
+def test_as_input_dict_rejects_arm_mismatch(data):
+    """Too many/few feature or label arms fail loudly instead of silently
+    truncating (zip)."""
+    net = _residual_cnn()
+    with pytest.raises(ValueError, match="1 inputs"):
+        net._as_input_dict([jnp.zeros((2, 8, 8, 3)), jnp.zeros((2, 4))])
+    with pytest.raises(ValueError, match="1 outputs"):
+        net._as_label_dict([jnp.zeros((2, 5)), jnp.zeros((2, 5))])
