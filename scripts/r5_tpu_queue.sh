@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 TPU queue (VERDICT r4 items 1/2/3/5): block until the tunnel is
+# healthy (up to ~10h, one gentle probe per 5 min — the r4 outage lasted
+# 8h), then run, in order:
+#   1. ResNet remat sweep         (scripts/diag_resnet.py G H)
+#   2. flash crossover post-fix   (scripts/diag_flash.py bwd)
+#   3. charnn pallas-vs-scan A/B  (scripts/diag_charnn.py)
+#   4. T=4096 cliff decomposition (scripts/diag_t4096.py)
+#   5. BERT composition sweep     (scripts/diag_bert.py)
+#   6. full bench capture         (python bench.py)
+# No timeout wrappers around TPU jobs (killing a TPU-attached process
+# wedges the relay — see memory note axon-tunnel-fragility).
+cd "$(dirname "$0")/.." || exit 1
+LOG=/tmp/r5_queue.log
+: > "$LOG"
+note() { echo "=== $1 $(date -u +%H:%M:%S) ===" >> "$LOG"; }
+
+note "waiting for tunnel"
+healthy=0
+for i in $(seq 1 120); do
+  if python - >> "$LOG" 2>&1 <<'PY'
+import sys
+sys.path.insert(0, ".")
+import bench
+ok, detail = bench.wait_for_backend(max_wait_s=100)
+sys.exit(0 if ok else 1)
+PY
+  then healthy=1; break; fi
+  sleep 300
+done
+if [ "$healthy" != 1 ]; then note "gave up waiting"; exit 1; fi
+note "tunnel healthy"
+
+run_step() {
+  name=$1; shift
+  for i in 1 2 3; do
+    note "[$name] attempt $i"
+    "$@" >> "$LOG" 2>&1
+    if ! tail -5 "$LOG" | grep -q backend_unavailable; then
+      note "[$name] done"; return 0
+    fi
+    sleep 240
+  done
+  note "[$name] gave up"
+  return 1
+}
+
+run_step remat   python scripts/diag_resnet.py G H
+run_step flash   python scripts/diag_flash.py bwd
+run_step charnn  python scripts/diag_charnn.py
+run_step t4096   python scripts/diag_t4096.py
+run_step bert    python scripts/diag_bert.py
+note "[bench] full capture"
+python bench.py > /tmp/r5_bench_stdout.json 2>> "$LOG"
+cat /tmp/r5_bench_stdout.json >> "$LOG"
+note "queue done"
