@@ -704,8 +704,11 @@ class ComputationGraph:
         return sum(int(p.size) for p in jax.tree_util.tree_leaves(self.params))
 
     def params_flat(self):
-        """Single flat vector (reference ComputationGraph.params() order:
-        topological node order via the params dict)."""
+        """Single flat vector. NOTE: order is jax tree-flatten order
+        (sorted node name, then sorted param name within a node), NOT the
+        reference's topological node order — self-consistent with
+        set_params_flat, but do not zip against a reference-ordered flat
+        checkpoint without reindexing."""
         leaves = jax.tree_util.tree_leaves(self.params)
         return jnp.concatenate([l.ravel() for l in leaves]) if leaves \
             else jnp.zeros((0,))
@@ -734,9 +737,12 @@ class ComputationGraph:
             net._preprocessors = dict(self._preprocessors)
             net.output_shapes = dict(self.output_shapes)
             net._init_shapes = list(getattr(self, "_init_shapes", []))
-            net.remat_segments = self.remat_segments
-            net.output_loss_weights = dict(self.output_loss_weights)
             net.initialized = True
+        # execution policy / loss weighting are config-level, not
+        # init-dependent — copy them even for an uninitialized graph
+        # (matches MultiLayerNetwork.clone())
+        net.remat_segments = self.remat_segments
+        net.output_loss_weights = dict(self.output_loss_weights)
         return net
 
     def summary(self):

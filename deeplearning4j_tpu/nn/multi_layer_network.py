@@ -173,6 +173,13 @@ class MultiLayerNetwork:
         ComputationGraph._forward_remat (single carried tensor, so the
         segment plan is just an even index split)."""
         n = len(self.layers)
+        if int(self.remat_segments) > n:
+            import warnings
+            warnings.warn(
+                f"remat_segments={int(self.remat_segments)} exceeds what "
+                f"this {n}-layer net supports; using {n} checkpoint "
+                "segments (activation footprint will be larger than "
+                "configured)", stacklevel=3)
         nseg = max(1, min(int(self.remat_segments), n))
         bounds = [round(k * n / nseg) for k in range(nseg + 1)]
         h = x
@@ -309,6 +316,13 @@ class MultiLayerNetwork:
             iters_per_epoch=iters_per_epoch,
             param_labels=labels, per_label_updaters=per_label)
         self._opt_state = self._optimizer.init(self.params)
+        upstream = getattr(self, "_upstream_adam_state", None)
+        if upstream is not None:  # resume from an upstream DL4J zip — graft
+            # here so EVERY optimizer consumer (fit/fit_scanned/
+            # ParallelWrapper) picks the restored m/v/count up
+            from ..serde.upstream_dl4j import graft_adam_state
+            self._opt_state = graft_adam_state(self._opt_state, upstream)
+            self._upstream_adam_state = None
 
     def _apply_constraints(self, params):
         from ..train.constraints import apply_constraints

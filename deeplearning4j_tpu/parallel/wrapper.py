@@ -154,6 +154,7 @@ class ParallelWrapper:
         want_stats = getattr(net, "_anomaly_detector", None) is not None
         if self._step is not None and getattr(self, "_step_with_stats", None) != want_stats:
             self._step = None  # detector toggled since compile — rebuild
+            self._scan_epoch = None  # scans over _step_raw — same staleness
         if self._step is not None and getattr(self, "_built_remat", None) != \
                 getattr(net, "remat_segments", None):
             self._step = None            # remat policy toggled — retrace

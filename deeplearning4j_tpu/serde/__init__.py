@@ -7,13 +7,22 @@ Reference parity: ``org.deeplearning4j.util.ModelSerializer``.
 
 from .model_serializer import load_model, restore_normalizer, save_model
 from .orbax_ckpt import OrbaxCheckpointer, PreemptionWatchdog
+from .upstream_dl4j import (is_upstream_format,
+                            restore_upstream_multi_layer_network,
+                            write_model_upstream_format)
 
 
 class ModelSerializer:
-    """DL4J-style static facade (``writeModel`` / ``restoreMultiLayerNetwork``)."""
+    """DL4J-style static facade (``writeModel`` / ``restoreMultiLayerNetwork``).
+
+    ``restore_multi_layer_network`` auto-detects upstream DL4J zips
+    (configuration.json + coefficients.bin — the format existing DL4J
+    users hold) alongside our native format;
+    ``write_model_upstream_format`` exports back to it."""
 
     write_model = staticmethod(save_model)
     writeModel = staticmethod(save_model)
+    write_model_upstream_format = staticmethod(write_model_upstream_format)
     restore_multi_layer_network = staticmethod(load_model)
     restoreMultiLayerNetwork = staticmethod(load_model)
     restore_computation_graph = staticmethod(load_model)
@@ -24,5 +33,6 @@ class ModelSerializer:
 
 __all__ = [
     "ModelSerializer", "save_model", "load_model", "restore_normalizer",
-    "OrbaxCheckpointer", "PreemptionWatchdog",
+    "OrbaxCheckpointer", "PreemptionWatchdog", "is_upstream_format",
+    "restore_upstream_multi_layer_network", "write_model_upstream_format",
 ]

