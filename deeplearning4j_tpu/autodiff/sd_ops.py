@@ -2509,3 +2509,182 @@ RNN.update({
 NN_EXT.update({
     "scaled_dot_product_attention": NN_EXT["dot_product_attention"],
 })
+
+
+# ------------------------------------------------- r5 straggler closers --
+# The r5 exclusion audit (docs/OP_AUDIT.md) surfaced the last
+# TPU-representable gaps in the upstream custom-op catalog. Reference:
+# libnd4j/include/ops/declarable/generic/{list,parity_ops,blas}, nd4j-api
+# TensorArray ops. The upstream list family is a mutable TensorArray; the
+# TPU-native form is a FIXED-CAPACITY stacked tensor + element count
+# carried functionally (XLA needs static shapes), which is exactly how
+# lax.scan carries state.
+
+
+def _list_create(capacity, element_shape, dtype=jnp.float32):
+    """TensorArray analogue: (stack, count). Static capacity + shape."""
+    return (jnp.zeros((int(capacity),) + tuple(element_shape), dtype),
+            jnp.zeros((), jnp.int32))
+
+
+def _list_write(tarr, index, value):
+    """Out-of-capacity writes are dropped (count pins at capacity) — the
+    traced setting cannot raise on a dynamic index, and silent clamping
+    would corrupt the LAST slot instead."""
+    stack, count = tarr
+    cap = stack.shape[0]
+    idx = jnp.asarray(index, jnp.int32)
+    ok = idx < cap
+    new = lax.dynamic_update_index_in_dim(
+        stack, jnp.asarray(value, stack.dtype), jnp.minimum(idx, cap - 1), 0)
+    stack = jnp.where(ok, new, stack)
+    return stack, jnp.minimum(jnp.maximum(count, idx + 1), cap)
+
+
+def _list_read(tarr, index):
+    stack, _ = tarr
+    return lax.dynamic_index_in_dim(stack, jnp.asarray(index, jnp.int32),
+                                    0, keepdims=False)
+
+
+def _list_push(tarr, value):
+    """Push past capacity is a DROPPED no-op with count pinned at capacity
+    (not a clamped overwrite of the last slot)."""
+    stack, count = tarr
+    cap = stack.shape[0]
+    ok = count < cap
+    new = lax.dynamic_update_index_in_dim(
+        stack, jnp.asarray(value, stack.dtype), jnp.minimum(count, cap - 1), 0)
+    return jnp.where(ok, new, stack), jnp.minimum(count + 1, cap)
+
+
+def _list_stack(tarr):
+    """Materialize the written prefix MASKED to zeros past count (static
+    shape: the full capacity — slice with count would be dynamic)."""
+    stack, count = tarr
+    mask = (jnp.arange(stack.shape[0]) < count)
+    return jnp.where(mask.reshape((-1,) + (1,) * (stack.ndim - 1)), stack, 0)
+
+
+def _list_unstack(tarr, values):
+    stack, _ = tarr
+    v = jnp.asarray(values, stack.dtype)
+    n = min(v.shape[0], stack.shape[0])
+    stack = lax.dynamic_update_slice_in_dim(stack, v[:n], 0, 0)
+    return stack, jnp.asarray(n, jnp.int32)
+
+
+def _list_gather(tarr, indices):
+    stack, _ = tarr
+    return jnp.take(stack, jnp.asarray(indices, jnp.int32), axis=0)
+
+
+def _list_scatter(tarr, indices, values):
+    stack, count = tarr
+    idx = jnp.asarray(indices, jnp.int32)
+    stack = stack.at[idx].set(jnp.asarray(values, stack.dtype))
+    # initial=-1 keeps an EMPTY scatter a no-op instead of a zero-size max
+    hi = jnp.max(idx, initial=-1) + 1
+    return stack, jnp.minimum(jnp.maximum(count, hi), stack.shape[0])
+
+
+def _list_split(tarr, values, sizes):
+    """Upstream split_list: rows of `values` split into count-`sizes`
+    chunks written sequentially. Static sizes (XLA); each chunk is padded
+    to the widest so the stacked element shape stays static."""
+    sizes = [int(s) for s in sizes]
+    stack, _ = tarr
+    width = stack.shape[1] if stack.ndim > 1 else max(sizes)
+    v = jnp.asarray(values, stack.dtype)
+    off = 0
+    for i, s in enumerate(sizes):
+        chunk = v[off:off + s]
+        pad = [(0, width - s)] + [(0, 0)] * (chunk.ndim - 1)
+        stack = stack.at[i].set(jnp.pad(chunk, pad))
+        off += s
+    return stack, jnp.asarray(len(sizes), jnp.int32)
+
+
+def _list_size(tarr):
+    return tarr[1]
+
+
+LIST = {
+    "create_list": _list_create,
+    "write_list": _list_write,
+    "read_list": _list_read,
+    "push_list": _list_push,
+    "stack_list": _list_stack,
+    "unstack_list": _list_unstack,
+    "gather_list": _list_gather,
+    "scatter_list": _list_scatter,
+    "split_list": _list_split,
+    "size_list": _list_size,
+}
+NAMESPACES["list"] = LIST
+
+
+def _embedding_lookup(params, ids, max_norm=None):
+    """tf/upstream embedding_lookup: gather rows; optional L2 clip."""
+    out = jnp.take(jnp.asarray(params), jnp.asarray(ids, jnp.int32), axis=0)
+    if max_norm is not None:
+        norms = jnp.linalg.norm(out, axis=-1, keepdims=True)
+        out = out * jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-12))
+    return out
+
+
+def _xw_plus_b(x, w, b):
+    return jnp.asarray(x) @ jnp.asarray(w) + jnp.asarray(b)
+
+
+def _compare_and_bitpack(x, threshold):
+    """Pack (x > threshold) along the last axis (len divisible by 8) into
+    uint8 — upstream compare_and_bitpack. MXU-free: one dot with the bit
+    weights per byte."""
+    x = jnp.asarray(x)
+    bits = (x > threshold).astype(jnp.uint8)
+    b8 = bits.reshape(x.shape[:-1] + (x.shape[-1] // 8, 8))
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    return jnp.sum(b8 * weights, axis=-1).astype(jnp.uint8)
+
+
+def _batched_gemm(a, b, transpose_a=False, transpose_b=False,
+                  alpha=1.0, beta=0.0, c=None):
+    """libnd4j batched_gemm: C = alpha * op(A) @ op(B) + beta * C over
+    leading batch dims."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    out = alpha * jnp.matmul(a, b)
+    if c is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(c)
+    return out
+
+
+def _choose(x, mode, scalar):
+    """Legacy nd4j choose op: elements of x satisfying the comparison
+    `mode` vs scalar (0:<, 1:<=, 2:==, 3:!=, 4:>, 5:>=), zeros elsewhere,
+    plus the match count (static-shape form of the ragged upstream
+    return)."""
+    x = jnp.asarray(x)
+    cmp = [lambda a: a < scalar, lambda a: a <= scalar,
+           lambda a: a == scalar, lambda a: a != scalar,
+           lambda a: a > scalar, lambda a: a >= scalar][int(mode)]
+    m = cmp(x)
+    return jnp.where(m, x, 0), jnp.sum(m.astype(jnp.int32))
+
+
+NN_EXT.update({
+    "embedding_lookup": _embedding_lookup,
+    "xw_plus_b": _xw_plus_b,
+})
+BASE.update({
+    "compare_and_bitpack": _compare_and_bitpack,
+    "choose": _choose,
+})
+LINALG.update({
+    "batched_gemm": _batched_gemm,
+})

@@ -244,6 +244,18 @@ class StatsListener(TrainingListener):
     def iteration_done(self, model, iteration, epoch, score):
         if iteration % self.frequency:
             return
+        if not getattr(self, "_static_written", False):
+            # run-level static info (upstream StatsStorage staticInfo):
+            # written once, lets the UI label historical sessions
+            self._static_written = True
+            try:
+                info = {"model": type(model).__name__}
+                if hasattr(model, "num_params"):
+                    info["num_params"] = int(model.num_params())
+                self._jsonl.write(json.dumps({"static": info}) + "\n")
+                self._jsonl.flush()
+            except Exception:  # noqa: BLE001 — decoration only
+                pass
         rec = {"iter": iteration, "epoch": epoch, "score": score,
                "ts": time.time()}
         lr = self._current_lr(model, iteration)

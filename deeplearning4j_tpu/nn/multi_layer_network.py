@@ -771,7 +771,10 @@ class MultiLayerNetwork:
             net.params = jax.tree_util.tree_map(jnp.copy, self.params)
             net.states = jax.tree_util.tree_map(jnp.copy, self.states)
             net._preprocessors = dict(self._preprocessors)
-            net.output_shape = self.output_shape
+            # a net restored without input_type has params but never ran
+            # shape resolution — clone what exists
+            if hasattr(self, "output_shape"):
+                net.output_shape = self.output_shape
             net.initialized = True
         return net
 

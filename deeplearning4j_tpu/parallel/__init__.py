@@ -23,6 +23,9 @@ from .tp import (ChannelShardedConvolution, ColumnParallelDense,
 from .ring_attention import (ring_attention, ring_attention_inner,
                              ring_attention_sharded)
 from .param_avg import ParameterAveragingTrainer
+from .scaleout import (ParamAveragingHub, ParameterAveragingTrainingMaster,
+                       SparkComputationGraph, SparkDl4jMultiLayer,
+                       TrainingMaster, WorkerClient, worker_main)
 from .wrapper import ParallelInference, ParallelWrapper
 
 __all__ = [
@@ -42,4 +45,7 @@ __all__ = [
     "microbatches", "partition_layers",
     "DistributedGradientWorker", "GradientExchangeServer",
     "SocketGradientTransport",
+    "TrainingMaster", "ParameterAveragingTrainingMaster",
+    "SparkDl4jMultiLayer", "SparkComputationGraph", "ParamAveragingHub",
+    "WorkerClient", "worker_main",
 ]

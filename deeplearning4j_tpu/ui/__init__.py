@@ -5,5 +5,8 @@ VertxUIServer; or `python -m deeplearning4j_tpu.ui --serve`)."""
 
 from .dashboard import load_stats, render, sparkline, watch
 from .server import UIServer
+from .stats_storage import (FileStatsStorage, InMemoryStatsStorage,
+                            StatsStorage)
 
-__all__ = ["UIServer", "load_stats", "render", "sparkline", "watch"]
+__all__ = ["UIServer", "load_stats", "render", "sparkline", "watch",
+           "StatsStorage", "FileStatsStorage", "InMemoryStatsStorage"]

@@ -55,6 +55,8 @@ def load_stats(log_dir) -> List[Dict]:
                 continue  # torn write at the tail of a live file
             if "run_start" in rec:
                 records = []  # later run supersedes everything before it
+            elif "static" in rec:
+                continue      # run-level metadata (FileStatsStorage reads it)
             else:
                 records.append(rec)
     return records

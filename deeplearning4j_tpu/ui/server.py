@@ -12,18 +12,23 @@ iterations, learning rate, and the per-layer update:param ratio
 training-health chart.
 
 Endpoints:
-  GET /             the dashboard page
-  GET /train/stats  last-run records as JSON (FileStatsStorage read)
+  GET /                 the dashboard page
+  GET /train/stats      latest-session records as JSON
+  GET /train/stats?sid= any session's records (FileStatsStorage read —
+                        reattach to a finished run's history)
+  GET /train/sessions   all session ids + static info in the storage
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .dashboard import load_stats
+from .stats_storage import FileStatsStorage
 
 _PAGE = """<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>deeplearning4j_tpu training UI</title>
@@ -37,6 +42,8 @@ _PAGE = """<!DOCTYPE html>
  .warn { color: #b00; }
 </style></head><body>
 <h1>deeplearning4j_tpu — training</h1>
+<div class="meta">session: <select id="session"></select>
+ <span id="static"></span></div>
 <div class="meta" id="meta">waiting for stats…</div>
 <h2>score</h2><canvas id="score" width="860" height="220"></canvas>
 <h2>learning rate</h2><canvas id="lr" width="860" height="120"></canvas>
@@ -77,9 +84,34 @@ function drawSeries(id, series, logY) {
     ctx.stroke();
   });
 }
+let selectedSid = null;   // null = follow the latest session live
+async function refreshSessions() {
+  try {
+    const r = await fetch('/train/sessions'); const data = await r.json();
+    const sel = document.getElementById('session');
+    const ids = data.sessions.map(s => s.id);
+    if (sel.options.length !== ids.length + 1) {
+      const cur = sel.value;
+      sel.innerHTML = '<option value="">latest (live)</option>' +
+        data.sessions.map(s =>
+          `<option value="${s.id}">${s.id} (${s.n} records)</option>`
+        ).join('');
+      sel.value = cur || '';
+    }
+    const last = data.sessions[data.sessions.length - 1];
+    if (last && last.static && Object.keys(last.static).length)
+      document.getElementById('static').textContent =
+        Object.entries(last.static).map(([k, v]) => `${k}: ${v}`).join(' · ');
+  } catch (e) { /* keep polling */ }
+}
+document.getElementById('session').addEventListener('change',
+  e => { selectedSid = e.target.value || null; refresh(); });
 async function refresh() {
   try {
-    const r = await fetch('/train/stats'); const data = await r.json();
+    const url = selectedSid
+      ? '/train/stats?sid=' + encodeURIComponent(selectedSid)
+      : '/train/stats';
+    const r = await fetch(url); const data = await r.json();
     const recs = data.records;
     if (!recs.length) return;
     const last = recs[recs.length - 1];
@@ -102,7 +134,8 @@ async function refresh() {
     ).join(' &nbsp; ');
   } catch (e) { /* server restarting; keep polling */ }
 }
-refresh(); setInterval(refresh, 2000);
+refreshSessions(); refresh();
+setInterval(refresh, 2000); setInterval(refreshSessions, 5000);
 </script></body></html>
 """
 
@@ -114,9 +147,26 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/" or self.path == "/train" or self.path == "/index.html":
             body = _PAGE.encode()
             ctype = "text/html; charset=utf-8"
+        elif self.path.startswith("/train/sessions"):
+            sessions = [{"id": s["id"], "static": s["static"],
+                         "n": len(s["updates"])}
+                        for s in FileStatsStorage(
+                            self.server.ui_log_dir).sessions()]
+            body = json.dumps({"sessions": sessions}).encode()
+            ctype = "application/json"
         elif self.path.startswith("/train/stats"):
-            body = json.dumps(
-                {"records": load_stats(self.server.ui_log_dir)}).encode()
+            q = urllib.parse.urlparse(self.path).query
+            sid = urllib.parse.parse_qs(q).get("sid", [None])[0]
+            if sid:
+                match = [s for s in FileStatsStorage(
+                    self.server.ui_log_dir).sessions() if s["id"] == sid]
+                if not match:
+                    self.send_error(404, f"no session {sid}")
+                    return
+                records = match[0]["updates"]
+            else:
+                records = load_stats(self.server.ui_log_dir)
+            body = json.dumps({"records": records}).encode()
             ctype = "application/json"
         else:
             self.send_error(404)

@@ -1,0 +1,147 @@
+"""r5 straggler ops: the TensorArray/list family + the last
+TPU-representable gaps the exclusion audit surfaced (docs/OP_AUDIT.md).
+Reference: libnd4j/include/ops/declarable/generic/{list,parity_ops,blas}.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import sd_ops
+
+S = sd_ops.NAMESPACES
+L = S["list"]
+
+
+def test_registry_gate_r5():
+    from deeplearning4j_tpu.autodiff.samediff import _LOSS, _MATH, _NN
+    total = sd_ops.op_count() + len(_MATH) + len(_NN) + len(_LOSS)
+    assert sd_ops.op_count() >= 735, sd_ops.op_count()
+    assert total >= 805, total
+    assert "list" in S and len(S["list"]) >= 10
+
+
+def test_list_write_read_stack_size():
+    ta = L["create_list"](4, (3,))
+    ta = L["write_list"](ta, 0, jnp.asarray([1.0, 2.0, 3.0]))
+    ta = L["write_list"](ta, 2, jnp.asarray([7.0, 8.0, 9.0]))
+    assert int(L["size_list"](ta)) == 3        # count = max index + 1
+    np.testing.assert_array_equal(L["read_list"](ta, 2),
+                                  np.asarray([7.0, 8.0, 9.0], np.float32))
+    stacked = L["stack_list"](ta)
+    assert stacked.shape == (4, 3)
+    np.testing.assert_array_equal(stacked[1], np.zeros(3, np.float32))
+    np.testing.assert_array_equal(stacked[3], np.zeros(3, np.float32))
+
+
+def test_list_push_gather_scatter_unstack():
+    ta = L["create_list"](5, (2,))
+    ta = L["push_list"](ta, jnp.asarray([1.0, 1.0]))
+    ta = L["push_list"](ta, jnp.asarray([2.0, 2.0]))
+    assert int(L["size_list"](ta)) == 2
+    got = L["gather_list"](ta, jnp.asarray([1, 0]))
+    np.testing.assert_array_equal(got, np.asarray([[2, 2], [1, 1]], np.float32))
+
+    ta = L["scatter_list"](ta, jnp.asarray([4]), jnp.asarray([[9.0, 9.0]]))
+    assert int(L["size_list"](ta)) == 5
+    np.testing.assert_array_equal(L["read_list"](ta, 4),
+                                  np.asarray([9, 9], np.float32))
+
+    ta2 = L["unstack_list"](L["create_list"](3, (2,)),
+                            jnp.ones((3, 2)) * 5.0)
+    assert int(L["size_list"](ta2)) == 3
+    np.testing.assert_array_equal(L["read_list"](ta2, 1),
+                                  np.asarray([5, 5], np.float32))
+
+
+def test_list_split():
+    ta = L["create_list"](2, (3, 2))
+    vals = jnp.arange(10, dtype=jnp.float32).reshape(5, 2)
+    ta = L["split_list"](ta, vals, [3, 2])
+    assert int(L["size_list"](ta)) == 2
+    np.testing.assert_array_equal(L["read_list"](ta, 0), np.asarray(vals[:3]))
+    got = L["read_list"](ta, 1)
+    np.testing.assert_array_equal(got[:2], np.asarray(vals[3:]))
+    np.testing.assert_array_equal(got[2], np.zeros(2, np.float32))
+
+
+def test_list_ops_trace_under_scan():
+    """The fixed-capacity design exists so TensorArray patterns compile:
+    accumulate per-step outputs inside lax.scan."""
+    def body(ta, x):
+        return L["push_list"](ta, x * 2.0), None
+
+    xs = jnp.arange(6, dtype=jnp.float32).reshape(3, 2)
+    ta, _ = jax.lax.scan(body, L["create_list"](3, (2,)), xs)
+    np.testing.assert_array_equal(L["stack_list"](ta), np.asarray(xs) * 2.0)
+
+
+def test_embedding_lookup_and_xw_plus_b():
+    table = jnp.asarray(np.random.default_rng(0).normal(size=(10, 4)),
+                        jnp.float32)
+    ids = jnp.asarray([3, 7, 3])
+    out = S["nn"]["embedding_lookup"](table, ids)
+    np.testing.assert_array_equal(out, np.asarray(table)[[3, 7, 3]])
+    clipped = S["nn"]["embedding_lookup"](table * 100.0, ids, max_norm=1.0)
+    assert float(jnp.linalg.norm(clipped, axis=-1).max()) <= 1.0 + 1e-5
+
+    x = jnp.ones((2, 3))
+    w = jnp.full((3, 4), 2.0)
+    b = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(S["nn"]["xw_plus_b"](x, w, b)),
+                               6.0 + np.asarray([1, 2, 3, 4], np.float32)
+                               * np.ones((2, 4), np.float32) ** 0)
+
+
+def test_compare_and_bitpack():
+    x = jnp.asarray([1.0, -1.0, 1.0, -1.0, -1.0, -1.0, -1.0, 1.0])
+    out = S["base"]["compare_and_bitpack"](x, 0.0)
+    # bits 10100001 = 0xA1 = 161; (8,) packs to (1,)
+    assert out.dtype == jnp.uint8 and out.shape == (1,) and int(out[0]) == 161
+    x2 = jnp.stack([x, -x])
+    out2 = S["base"]["compare_and_bitpack"](x2, 0.0)
+    assert out2.shape == (2, 1) and int(out2[1, 0]) == 0x5E
+
+
+def test_batched_gemm_and_choose():
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(4, 3, 5)).astype(np.float32)
+    b = rng.normal(size=(4, 5, 2)).astype(np.float32)
+    c = rng.normal(size=(4, 3, 2)).astype(np.float32)
+    got = S["linalg"]["batched_gemm"](a, b, alpha=2.0, beta=0.5, c=c)
+    np.testing.assert_allclose(np.asarray(got), 2.0 * a @ b + 0.5 * c,
+                               rtol=1e-5)
+    gt = S["linalg"]["batched_gemm"](a.transpose(0, 2, 1), b,
+                                     transpose_a=True)
+    np.testing.assert_allclose(np.asarray(gt), a @ b, rtol=1e-5)
+
+    x = jnp.asarray([1.0, 5.0, -2.0, 7.0])
+    vals, n = S["base"]["choose"](x, 4, 3.0)   # mode 4: >
+    assert int(n) == 2
+    np.testing.assert_array_equal(np.asarray(vals),
+                                  np.asarray([0, 5, 0, 7], np.float32))
+
+
+def test_list_push_past_capacity_is_dropped_not_clamped():
+    """r5 review finding: overflowing pushes must not corrupt the last
+    slot; they drop, and count pins at capacity."""
+    ta = L["create_list"](2, (2,))
+    for v in ([1.0, 1.0], [2.0, 2.0], [3.0, 3.0]):
+        ta = L["push_list"](ta, jnp.asarray(v))
+    assert int(L["size_list"](ta)) == 2
+    np.testing.assert_array_equal(
+        np.asarray(ta[0]), np.asarray([[1, 1], [2, 2]], np.float32))
+    # write past capacity: dropped too
+    ta = L["write_list"](ta, 5, jnp.asarray([9.0, 9.0]))
+    assert int(L["size_list"](ta)) == 2
+    np.testing.assert_array_equal(
+        np.asarray(ta[0]), np.asarray([[1, 1], [2, 2]], np.float32))
+
+
+def test_list_scatter_empty_indices_is_noop():
+    ta = L["create_list"](3, (2,))
+    ta = L["push_list"](ta, jnp.asarray([1.0, 1.0]))
+    ta2 = L["scatter_list"](ta, jnp.asarray([], jnp.int32),
+                            jnp.zeros((0, 2)))
+    assert int(L["size_list"](ta2)) == 1
+    np.testing.assert_array_equal(np.asarray(ta2[0]), np.asarray(ta[0]))

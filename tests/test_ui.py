@@ -194,3 +194,123 @@ def test_run_delimiter_survives_torn_tail(tmp_path):
     sl.close()
     recs = load_stats(tmp_path)
     assert [r["iter"] for r in recs] == [1]       # only the NEW run
+
+
+def test_file_stats_storage_sessions_and_reattach(tmp_path):
+    """r5 StatsStorage (upstream FileStatsStorage parity): multi-session
+    history persists; a storage opened on a FINISHED run's file serves
+    every session — the reattach workflow the live-poll UI lacked."""
+    from deeplearning4j_tpu.ui import FileStatsStorage
+
+    p = tmp_path / "stats.jsonl"
+    lines = [
+        {"run_start": 100.0},
+        {"static": {"model": "MultiLayerNetwork", "num_params": 42}},
+        {"iter": 1, "epoch": 0, "score": 0.9, "ts": 0.0},
+        {"iter": 2, "epoch": 0, "score": 0.7, "ts": 1.0},
+        {"run_start": 200.0},
+        {"iter": 1, "epoch": 0, "score": 0.5, "ts": 2.0},
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in lines) + "\n")
+
+    storage = FileStatsStorage(tmp_path)          # dir or file both work
+    sids = storage.list_session_ids()
+    assert sids == ["run-0-100", "run-1-200"]
+    assert storage.latest_session_id() == "run-1-200"
+    assert [r["iter"] for r in storage.get_updates("run-0-100")] == [1, 2]
+    assert storage.get_static_info("run-0-100")["num_params"] == 42
+    assert [r["score"] for r in storage.get_updates("run-1-200")] == [0.5]
+    with pytest.raises(KeyError):
+        storage.get_updates("run-9-999")
+
+    # write API: appending a new session is visible to a fresh reader
+    sid = storage.new_session()
+    storage.put_static_info({"model": "ComputationGraph"})
+    storage.put_update({"iter": 1, "epoch": 0, "score": 0.3, "ts": 3.0})
+    storage.close()
+    again = FileStatsStorage(p)
+    assert sid in again.list_session_ids()
+    assert again.get_static_info(sid)["model"] == "ComputationGraph"
+
+
+def test_in_memory_stats_storage():
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage
+
+    s = InMemoryStatsStorage()
+    s.put_update({"iter": 1, "score": 1.0})
+    s.put_static_info({"model": "X"})
+    sid = s.latest_session_id()
+    assert s.get_updates(sid)[0]["score"] == 1.0
+    assert s.get_static_info(sid)["model"] == "X"
+    sid2 = s.new_session()
+    s.put_update({"iter": 1, "score": 0.5})
+    assert len(s.list_session_ids()) == 2
+    assert s.get_updates(sid2)[0]["score"] == 0.5
+
+
+def test_ui_server_session_endpoints(tmp_path):
+    """/train/sessions lists history; /train/stats?sid= serves a finished
+    session while a newer one is live."""
+    import urllib.request
+
+    from deeplearning4j_tpu.ui import UIServer
+
+    p = tmp_path / "stats.jsonl"
+    lines = [
+        {"run_start": 100.0},
+        {"static": {"model": "MultiLayerNetwork"}},
+        {"iter": 1, "epoch": 0, "score": 0.9, "ts": 0.0},
+        {"run_start": 200.0},
+        {"iter": 1, "epoch": 0, "score": 0.5, "ts": 2.0},
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in lines) + "\n")
+    srv = UIServer(log_dir=str(tmp_path), port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        sess = json.loads(urllib.request.urlopen(
+            f"{base}/train/sessions", timeout=5).read())["sessions"]
+        assert [s["id"] for s in sess] == ["run-0-100", "run-1-200"]
+        assert sess[0]["static"]["model"] == "MultiLayerNetwork"
+        assert sess[0]["n"] == 1
+
+        hist = json.loads(urllib.request.urlopen(
+            f"{base}/train/stats?sid=run-0-100", timeout=5).read())
+        assert [r["score"] for r in hist["records"]] == [0.9]
+        live = json.loads(urllib.request.urlopen(
+            f"{base}/train/stats", timeout=5).read())
+        assert [r["score"] for r in live["records"]] == [0.5]
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/train/stats?sid=run-7-7",
+                                   timeout=5)
+        page = urllib.request.urlopen(f"{base}/", timeout=5).read().decode()
+        assert "train/sessions" in page and "session" in page
+    finally:
+        srv.stop()
+
+
+def test_stats_listener_writes_static_info(tmp_path):
+    """StatsListener emits one static-info record per run (model class +
+    param count) that FileStatsStorage surfaces, and load_stats skips."""
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.listeners import StatsListener
+    from deeplearning4j_tpu.ui import FileStatsStorage
+
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    lst = StatsListener(log_dir=str(tmp_path), frequency=1,
+                        tensorboard=False)
+    lst.iteration_done(net, 0, 0, 1.23)
+    lst.iteration_done(net, 1, 0, 1.11)
+
+    storage = FileStatsStorage(tmp_path)
+    sid = storage.latest_session_id()
+    info = storage.get_static_info(sid)
+    assert info["model"] == "MultiLayerNetwork"
+    assert info["num_params"] == net.num_params()
+    assert [r["iter"] for r in storage.get_updates(sid)] == [0, 1]
+    assert all("static" not in r for r in load_stats(tmp_path))
