@@ -466,6 +466,11 @@ class ComputationGraph:
             param_labels=labels if has_override else None,
             per_label_updaters=per_label if has_override else None)
         self._opt_state = self._optimizer.init(self.params)
+        upstream = getattr(self, "_upstream_adam_state", None)
+        if upstream is not None:  # resume from an upstream DL4J zip
+            from ..serde.upstream_dl4j import graft_adam_state
+            self._opt_state = graft_adam_state(self._opt_state, upstream)
+            self._upstream_adam_state = None
 
     def _apply_constraints(self, params):
         from ..train.constraints import apply_constraints

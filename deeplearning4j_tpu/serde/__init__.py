@@ -8,7 +8,9 @@ Reference parity: ``org.deeplearning4j.util.ModelSerializer``.
 from .model_serializer import load_model, restore_normalizer, save_model
 from .orbax_ckpt import OrbaxCheckpointer, PreemptionWatchdog
 from .upstream_dl4j import (is_upstream_format,
+                            restore_upstream_computation_graph,
                             restore_upstream_multi_layer_network,
+                            write_computation_graph_upstream_format,
                             write_model_upstream_format)
 
 
@@ -23,6 +25,8 @@ class ModelSerializer:
     write_model = staticmethod(save_model)
     writeModel = staticmethod(save_model)
     write_model_upstream_format = staticmethod(write_model_upstream_format)
+    write_computation_graph_upstream_format = staticmethod(
+        write_computation_graph_upstream_format)
     restore_multi_layer_network = staticmethod(load_model)
     restoreMultiLayerNetwork = staticmethod(load_model)
     restore_computation_graph = staticmethod(load_model)
@@ -35,4 +39,6 @@ __all__ = [
     "ModelSerializer", "save_model", "load_model", "restore_normalizer",
     "OrbaxCheckpointer", "PreemptionWatchdog", "is_upstream_format",
     "restore_upstream_multi_layer_network", "write_model_upstream_format",
+    "restore_upstream_computation_graph",
+    "write_computation_graph_upstream_format",
 ]

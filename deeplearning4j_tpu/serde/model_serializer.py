@@ -110,7 +110,13 @@ def load_model(path):
             from ..autodiff.samediff import SameDiff
             return SameDiff.load(path)
         if "configuration.json" in zf.namelist():   # upstream DL4J zip
-            from .upstream_dl4j import restore_upstream_multi_layer_network
+            import json as _json
+            from .upstream_dl4j import (
+                restore_upstream_computation_graph,
+                restore_upstream_multi_layer_network)
+            conf = _json.loads(zf.read("configuration.json"))
+            if "vertices" in conf:
+                return restore_upstream_computation_graph(path)
             return restore_upstream_multi_layer_network(path)
         meta = pickle.loads(zf.read("conf.pkl"))
         cls = {"MultiLayerNetwork": MultiLayerNetwork,

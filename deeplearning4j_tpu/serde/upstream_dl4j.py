@@ -414,8 +414,25 @@ def _upstream_param_entries(layer, params, state):
     return out
 
 
+def _iter_param_nodes(net):
+    """(key, layer, params, states) per param-bearing node, packing order:
+    MLN = layer index order; CG = topological node order."""
+    if hasattr(net, "layers"):                         # MultiLayerNetwork
+        for i, layer in enumerate(net.layers):
+            yield (f"layer_{i}", layer, net.params[f"layer_{i}"],
+                   net.states[f"layer_{i}"])
+    else:                                              # ComputationGraph
+        from ..nn.layers.base import Layer
+        for name in net.conf.topo_order:
+            op = net.conf.nodes[name].op
+            if isinstance(op, Layer):
+                yield (name, op, net.params.get(name, {}),
+                       net.states.get(name, {}))
+
+
 def _assign_upstream_params(net, flat: np.ndarray):
-    """Split the upstream flat row vector back into net.params/states."""
+    """Split the upstream flat row vector back into net.params/states
+    (MLN and CG — _iter_param_nodes fixes the packing order)."""
     from ..nn.layers import conv as C
     from ..nn.layers import norm as N
     from ..nn.layers.wrappers import unwrap
@@ -434,10 +451,8 @@ def _assign_upstream_params(net, flat: np.ndarray):
         off += n
         return chunk
 
-    for i, layer in enumerate(net.layers):
+    for _key, layer, p, s in _iter_param_nodes(net):
         lyr = unwrap(layer)
-        p = net.params[f"layer_{i}"]
-        s = net.states[f"layer_{i}"]
         if isinstance(lyr, N.BatchNormalization):
             c = s["mean"].shape[0]
             gamma = take((c,))
@@ -471,9 +486,8 @@ def _assign_upstream_params(net, flat: np.ndarray):
 def _param_order_arrays(net):
     """All upstream param entries of the whole net, packing order."""
     out = []
-    for i, layer in enumerate(net.layers):
-        out.extend(a for _, a in _upstream_param_entries(
-            layer, net.params[f"layer_{i}"], net.states[f"layer_{i}"]))
+    for _key, layer, p, s in _iter_param_nodes(net):
+        out.extend(a for _, a in _upstream_param_entries(layer, p, s))
     return out
 
 
@@ -568,11 +582,10 @@ def _extract_adam_mv(net):
     if mu is None:
         return None, None
     ms, vs = [], []
-    for i, layer in enumerate(net.layers):
-        entries = _upstream_param_entries(
-            layer, net.params[f"layer_{i}"], net.states[f"layer_{i}"])
-        mu_i = mu.get(f"layer_{i}", {})
-        nu_i = nu.get(f"layer_{i}", {})
+    for nkey, layer, p, s in _iter_param_nodes(net):
+        entries = _upstream_param_entries(layer, p, s)
+        mu_i = mu.get(nkey, {})
+        nu_i = nu.get(nkey, {})
         for key, arr in entries:
             if key in ("mean", "var", "gamma", "beta"):
                 src_m = mu_i.get(key) if key in ("gamma", "beta") else None
@@ -603,13 +616,15 @@ def _adopt_updater_state(net, flat: np.ndarray, iteration_count: int = 0):
     from ..nn.layers.wrappers import unwrap
 
     flat = np.asarray(flat).reshape(-1)
-    mu = {}
-    nu = {}
+    # the mu/nu trees must MATCH net.params' structure (graft tree_maps
+    # them), so start every node key — param-less vertex nodes included —
+    # with an empty dict
+    mu = {k: {} for k in net.params}
+    nu = {k: {} for k in net.params}
     off = 0
-    for i, layer in enumerate(net.layers):
+    for nkey, layer, p, s in _iter_param_nodes(net):
         lyr = unwrap(layer)
-        entries = _upstream_param_entries(
-            layer, net.params[f"layer_{i}"], net.states[f"layer_{i}"])
+        entries = _upstream_param_entries(layer, p, s)
         mu_i, nu_i = {}, {}
         for key, arr in entries:
             if key in ("mean", "var"):
@@ -621,15 +636,15 @@ def _adopt_updater_state(net, flat: np.ndarray, iteration_count: int = 0):
             m = flat[off:off + n].reshape(arr.shape, order="f")
             v = flat[off + n:off + 2 * n].reshape(arr.shape, order="f")
             off += 2 * n
-            if key not in net.params[f"layer_{i}"]:
+            if key not in p:
                 continue               # e.g. locked BN gamma/beta
             if isinstance(lyr, C.ConvolutionLayer) and key == "W":
                 m = m.transpose(2, 3, 1, 0)
                 v = v.transpose(2, 3, 1, 0)
             mu_i[key] = jnp.asarray(m, jnp.float32)
             nu_i[key] = jnp.asarray(v, jnp.float32)
-        mu[f"layer_{i}"] = mu_i
-        nu[f"layer_{i}"] = nu_i
+        mu[nkey] = mu_i
+        nu[nkey] = nu_i
     if off != flat.size:
         raise ValueError(f"updaterState.bin has {flat.size} floats; the "
                          f"configuration consumes {off}")
@@ -673,11 +688,10 @@ def restore_upstream_multi_layer_network(path, load_updater: bool = True):
         conf_json = json.loads(zf.read("configuration.json"))
         if "confs" not in conf_json:
             if "vertices" in conf_json or "networkInputs" in conf_json:
-                raise NotImplementedError(
-                    "this is an upstream ComputationGraph zip — only "
-                    "upstream MultiLayerNetwork zips (configuration.json "
-                    "with 'confs') are supported; rebuild the graph with "
-                    "our ComputationGraph API and load params manually")
+                raise ValueError(
+                    "this is an upstream ComputationGraph zip — use "
+                    "restore_upstream_computation_graph (or the "
+                    "ModelSerializer facade, which auto-routes)")
             raise ValueError("configuration.json has no 'confs' — not an "
                              "upstream MultiLayerConfiguration")
         if "coefficients.bin" not in names:
@@ -723,3 +737,193 @@ def is_upstream_format(path) -> bool:
         return "configuration.json" in names and "coefficients.bin" in names
     except (zipfile.BadZipFile, OSError):
         return False
+
+
+# -------------------------------------------------- ComputationGraph zips --
+# Upstream ComputationGraphConfiguration JSON: networkInputs/networkOutputs,
+# "vertices" (@class-tagged GraphVertex configs; LayerVertex wraps a
+# NeuralNetConfiguration holding the layer), "vertexInputs". Param packing
+# follows the graph's topological order (reference ComputationGraph.params()
+# flattens vertex param tables in topo order); our writer emits "vertices"
+# in that same order so the round trip is stable, and for foreign JSON the
+# packing order is OUR (deterministic) Kahn sort — documented assumption,
+# same provenance caveat as the module header.
+
+_GV = "org.deeplearning4j.nn.conf.graph."
+_EW_FROM_JAVA = {"Add": "add", "Subtract": "sub", "Product": "mul",
+                 "Average": "avg", "Max": "max"}
+_EW_TO_JAVA = {v: k for k, v in _EW_FROM_JAVA.items()}
+
+
+def _vertex_from_json(d):
+    from ..nn import vertices as V
+    cls = d.get("@class", "").rsplit(".", 1)[-1]
+    if cls == "MergeVertex":
+        return V.MergeVertex()
+    if cls == "ElementWiseVertex":
+        op = d.get("op", "Add")
+        if op not in _EW_FROM_JAVA:
+            raise ValueError(f"unsupported ElementWiseVertex op {op!r}")
+        return V.ElementWiseVertex(op=_EW_FROM_JAVA[op])
+    if cls == "ScaleVertex":
+        return V.ScaleVertex(scale=float(d.get("scaleFactor", 1.0)))
+    if cls == "ShiftVertex":
+        return V.ShiftVertex(shift=float(d.get("shiftFactor", 0.0)))
+    if cls == "L2NormalizeVertex":
+        return V.L2NormalizeVertex()
+    if cls == "StackVertex":
+        return V.StackVertex()
+    if cls == "SubsetVertex":
+        return V.SubsetVertex(lo=int(d["from"]), hi=int(d["to"]))
+    raise ValueError(
+        f"unsupported upstream graph vertex {cls!r} — supported: "
+        "LayerVertex, Merge, ElementWise, Scale, Shift, L2Normalize, "
+        "Stack, Subset")
+
+
+def _vertex_to_json(v):
+    from ..nn import vertices as V
+    if type(v) is V.MergeVertex:
+        return {"@class": _GV + "MergeVertex"}
+    if type(v) is V.ElementWiseVertex:
+        if v.op not in _EW_TO_JAVA:
+            raise ValueError(f"ElementWiseVertex op {v.op!r} has no "
+                             "upstream analogue")
+        return {"@class": _GV + "ElementWiseVertex", "op": _EW_TO_JAVA[v.op]}
+    if type(v) is V.ScaleVertex:
+        return {"@class": _GV + "ScaleVertex", "scaleFactor": float(v.scale)}
+    if type(v) is V.ShiftVertex:
+        return {"@class": _GV + "ShiftVertex", "shiftFactor": float(v.shift)}
+    if type(v) is V.L2NormalizeVertex:
+        return {"@class": _GV + "L2NormalizeVertex"}
+    if type(v) is V.StackVertex:
+        return {"@class": _GV + "StackVertex"}
+    if type(v) is V.SubsetVertex:
+        return {"@class": _GV + "SubsetVertex", "from": int(v.lo),
+                "to": int(v.hi)}
+    raise ValueError(f"vertex {type(v).__name__} has no upstream-format "
+                     "writer")
+
+
+def write_computation_graph_upstream_format(cg, path,
+                                            save_updater: bool = False):
+    """Write a ComputationGraph in the upstream DL4J zip layout."""
+    from ..nn.layers.base import Layer
+    vertices = {}
+    vertex_inputs = {}
+    for name in cg.conf.topo_order:
+        node = cg.conf.nodes[name]
+        if isinstance(node.op, Layer):
+            vertices[name] = {
+                "@class": _GV + "LayerVertex",
+                "layerConf": {"layer": _layer_to_json(node.op),
+                              "seed": int(cg.conf.globals_.seed)}}
+        else:
+            vertices[name] = _vertex_to_json(node.op)
+        vertex_inputs[name] = list(node.inputs)
+    top = {
+        "networkInputs": list(cg.conf.inputs),
+        "networkOutputs": list(cg.conf.outputs),
+        "vertices": vertices,
+        "vertexInputs": vertex_inputs,
+        "iterationCount": int(getattr(cg, "_step_count", 0)),
+        "iUpdater": _updater_to_json(cg.conf.globals_.updater),
+    }
+    shapes = getattr(cg, "_init_shapes", None)
+    if shapes:
+        its = []
+        for s in shapes:
+            fake = type("N", (), {"_init_input_shape": tuple(s)})()
+            its.append(_input_type_json(fake))
+        top["inputTypes"] = its
+    arrays = _param_order_arrays(cg)
+    flat = np.concatenate([a.ravel(order="f").astype(np.float32)
+                           for a in arrays]) if arrays else np.zeros(0, "f4")
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", json.dumps(top, indent=2))
+        zf.writestr("coefficients.bin",
+                    write_nd4j_array(flat.reshape(1, -1), order="f"))
+        if save_updater and getattr(cg, "_opt_state", None) is not None:
+            m, v = _extract_adam_mv(cg)
+            if m is not None:
+                state = np.concatenate([
+                    np.concatenate([mm.ravel(order="f"), vv.ravel(order="f")])
+                    for mm, vv in zip(m, v)]) if m else np.zeros(0, "f4")
+                zf.writestr("updaterState.bin",
+                            write_nd4j_array(
+                                state.astype(np.float32).reshape(1, -1),
+                                order="f"))
+
+
+def restore_upstream_computation_graph(path, input_shapes=None,
+                                       load_updater: bool = True):
+    """Restore an upstream-format ComputationGraph zip."""
+    from ..nn.conf import NeuralNetConfiguration
+    from ..nn.computation_graph import ComputationGraph
+
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+        conf_json = json.loads(zf.read("configuration.json"))
+        if "vertices" not in conf_json:
+            raise ValueError("configuration.json has no 'vertices' — use "
+                             "restore_upstream_multi_layer_network for "
+                             "MultiLayerNetwork zips")
+        builder = NeuralNetConfiguration.builder()
+        upd_json = conf_json.get("iUpdater")
+        if upd_json is None:
+            # genuine upstream zips carry the updater INSIDE each
+            # LayerVertex's NeuralNetConfiguration, not at the top level
+            for vd in conf_json["vertices"].values():
+                lc = vd.get("layerConf")
+                if lc and lc.get("iUpdater"):
+                    upd_json = lc["iUpdater"]
+                    break
+        upd = _updater_from_json(upd_json)
+        if upd is not None:
+            builder = builder.updater(upd)
+        gb = builder.graph_builder()
+        gb.add_inputs(*conf_json["networkInputs"])
+        vertex_inputs = conf_json.get("vertexInputs", {})
+        for name, vd in conf_json["vertices"].items():
+            cls = vd.get("@class", "").rsplit(".", 1)[-1]
+            ins = vertex_inputs.get(name, [])
+            if cls == "LayerVertex":
+                layer = _layer_from_json(vd["layerConf"]["layer"])
+                gb.add_layer(name, layer, *ins)
+            else:
+                gb.add_vertex(name, _vertex_from_json(vd), *ins)
+        gb.set_outputs(*conf_json["networkOutputs"])
+        cg = ComputationGraph(gb.build())
+        if input_shapes is None:
+            its = conf_json.get("inputTypes")
+            if its:
+                input_shapes = []
+                for it in its:
+                    fake = {"inputType": it}
+                    input_shapes.append(
+                        _input_shape_from_json(fake, [None]))
+            else:
+                raise ValueError(
+                    "configuration.json has no inputTypes — pass "
+                    "input_shapes=[...] to restore_upstream_computation_graph")
+        cg.init(list(input_shapes))
+
+        flat = read_nd4j_array(zf.read("coefficients.bin"))
+        _assign_upstream_params(cg, flat)   # shared MLN/CG unpacker
+        cg._step_count = int(conf_json.get("iterationCount", 0))
+        if load_updater and "updaterState.bin" in names:
+            from ..train import updaters as U
+            if isinstance(upd, (U.Adam, U.AdamW)):
+                _adopt_updater_state(
+                    cg, read_nd4j_array(zf.read("updaterState.bin")),
+                    conf_json.get("iterationCount", 0))
+            else:
+                import warnings
+                warnings.warn(
+                    f"updaterState.bin present but the updater is "
+                    f"{type(upd).__name__} — only Adam/AdamW state layouts "
+                    "are mapped; training resumes with fresh optimizer "
+                    "state", stacklevel=2)
+    return cg
