@@ -24,6 +24,7 @@ CnnToFeedForward preprocessor vertex.
 from __future__ import annotations
 
 import json
+from contextlib import contextmanager as _contextmanager
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
@@ -571,6 +572,87 @@ _KERAS_LOSSES = {
 }
 
 
+def _keras_to_snake(name: str) -> str:
+    """keras.src to_snake_case: the rule behind v3 auto variable paths
+    ('Conv2D' → 'conv2d', 'BatchNormalization' → 'batch_normalization')."""
+    import re
+    name = re.sub(r"\W+", "", name)
+    name = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub(r"([a-z])([A-Z])", r"\1_\2", name).lower()
+
+
+def _v3_auto_paths(layer_cfgs) -> Dict[str, str]:
+    """Config layer name → the auto path keras-v3 keys its weights h5 by.
+
+    model.weights.h5 groups are 'layers/<snake(class)>[_<k>]' in CREATION
+    order per base name — the config's explicit layer names never appear
+    (verified empirically, keras 3.13). Regenerating the counter sequence
+    over the config's layer list (skipping InputLayer, which saves no
+    group) reproduces the mapping."""
+    counts: Dict[str, int] = {}
+    out: Dict[str, str] = {}
+    for kc in layer_cfgs:
+        if kc["class_name"] == "InputLayer":
+            continue
+        base = _keras_to_snake(kc["class_name"])
+        k = counts.get(base, 0)
+        counts[base] = k + 1
+        out[kc["config"]["name"]] = base if k == 0 else f"{base}_{k}"
+    return out
+
+
+class _V3Weights:
+    """Presents a keras-v3 weights h5 with the legacy name-keyed interface
+    the assignment code uses (config layer name → h5 group with vars/)."""
+
+    def __init__(self, h5file, name_map: Dict[str, str]):
+        self._layers = h5file.get("layers")
+        self._map = name_map
+
+    def keys(self):
+        if self._layers is None:
+            return []
+        return [cfg_name for cfg_name, auto in self._map.items()
+                if auto in self._layers]
+
+    def __contains__(self, k):
+        return self._layers is not None and self._map.get(k) in self._layers
+
+    def __getitem__(self, k):
+        return self._layers[self._map[k]]
+
+
+@_contextmanager
+def _model_source(path):
+    """Context manager: (f-like with .attrs, weights-group-like) for BOTH
+    the legacy .h5 layout and the keras-v3 .keras zip archive
+    (config.json + model.weights.h5 + metadata.json)."""
+    import io
+    import types
+    import zipfile as _zip
+
+    import h5py
+
+    if _zip.is_zipfile(path):
+        with _zip.ZipFile(path) as zf:
+            if "config.json" not in set(zf.namelist()):
+                raise ValueError(f"{path} is a zip but not a .keras "
+                                 "archive (no config.json)")
+            cfg = json.loads(zf.read("config.json"))
+            attrs = {"model_config": json.dumps(cfg)}
+            if cfg.get("compile_config"):
+                attrs["training_config"] = json.dumps(cfg["compile_config"])
+            inner = cfg["config"]
+            layer_cfgs = inner["layers"] if isinstance(inner, dict) else inner
+            with h5py.File(io.BytesIO(zf.read("model.weights.h5")),
+                           "r") as hf:
+                yield (types.SimpleNamespace(attrs=attrs),
+                       _V3Weights(hf, _v3_auto_paths(layer_cfgs)))
+    else:
+        with h5py.File(path, "r") as f:
+            yield f, (f["model_weights"] if "model_weights" in f else f)
+
+
 def _h5_training_loss(f) -> Optional[str]:
     """The compiled loss from the h5 training_config attr, mapped to our
     loss name (reference enforceTrainingConfig path)."""
@@ -604,9 +686,8 @@ def import_keras_sequential(path, input_shape=None, loss=None):
     enforceTrainingConfig behavior). Without either, the import is
     inference-only like an uncompiled keras save.
     """
-    import h5py
     from ..nn.layers.core import OutputLayer
-    with h5py.File(path, "r") as f:
+    with _model_source(path) as (f, wg):
         raw = f.attrs["model_config"]
         cfg = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
         if cfg["class_name"] != "Sequential":
@@ -658,7 +739,6 @@ def import_keras_sequential(path, input_shape=None, loss=None):
             b.set_input_type(itype)
         net = MultiLayerNetwork(b.build())
         net.init(tuple(itype[1]) if itype else tuple(input_shape))
-        wg = f["model_weights"] if "model_weights" in f else f
         present = set(wg.keys())
         _assign_weights(net, wg, [n if n in present else None for n in names])
     return net
@@ -703,11 +783,9 @@ def _io_names(spec) -> List[str]:
 def import_keras_model(path):
     """KerasModelImport.importKerasModelAndWeights analogue: Functional
     keras model → ComputationGraph."""
-    import h5py
-
     from ..nn.computation_graph import ComputationGraph
 
-    with h5py.File(path, "r") as f:
+    with _model_source(path) as (f, wg):
         raw = f.attrs["model_config"]
         cfg = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
         if cfg["class_name"] == "Sequential":
@@ -749,7 +827,6 @@ def import_keras_model(path):
         b.set_outputs(*outputs)
         net = ComputationGraph(b.build())
         net.init([input_shapes[i] for i in inputs])
-        wg = f["model_weights"] if "model_weights" in f else f
         present = set(wg.keys())
         for name, layer in layer_names.items():
             if name not in present:

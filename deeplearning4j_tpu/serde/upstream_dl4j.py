@@ -759,7 +759,7 @@ def _vertex_from_json(d):
     from ..nn import vertices as V
     cls = d.get("@class", "").rsplit(".", 1)[-1]
     if cls == "MergeVertex":
-        return V.MergeVertex()
+        return V.MergeVertex(axis=int(d.get("mergeAxis", -1)))
     if cls == "ElementWiseVertex":
         op = d.get("op", "Add")
         if op not in _EW_FROM_JAVA:
@@ -770,7 +770,10 @@ def _vertex_from_json(d):
     if cls == "ShiftVertex":
         return V.ShiftVertex(shift=float(d.get("shiftFactor", 0.0)))
     if cls == "L2NormalizeVertex":
-        return V.L2NormalizeVertex()
+        kw = {}
+        if "eps" in d:
+            kw["eps"] = float(d["eps"])
+        return V.L2NormalizeVertex(**kw)
     if cls == "StackVertex":
         return V.StackVertex()
     if cls == "SubsetVertex":
@@ -784,7 +787,7 @@ def _vertex_from_json(d):
 def _vertex_to_json(v):
     from ..nn import vertices as V
     if type(v) is V.MergeVertex:
-        return {"@class": _GV + "MergeVertex"}
+        return {"@class": _GV + "MergeVertex", "mergeAxis": int(v.axis)}
     if type(v) is V.ElementWiseVertex:
         if v.op not in _EW_TO_JAVA:
             raise ValueError(f"ElementWiseVertex op {v.op!r} has no "
@@ -795,7 +798,7 @@ def _vertex_to_json(v):
     if type(v) is V.ShiftVertex:
         return {"@class": _GV + "ShiftVertex", "shiftFactor": float(v.shift)}
     if type(v) is V.L2NormalizeVertex:
-        return {"@class": _GV + "L2NormalizeVertex"}
+        return {"@class": _GV + "L2NormalizeVertex", "eps": float(v.eps)}
     if type(v) is V.StackVertex:
         return {"@class": _GV + "StackVertex"}
     if type(v) is V.SubsetVertex:
@@ -816,8 +819,13 @@ def write_computation_graph_upstream_format(cg, path,
         if isinstance(node.op, Layer):
             vertices[name] = {
                 "@class": _GV + "LayerVertex",
+                # the genuine upstream format carries the updater inside
+                # each LayerVertex's NeuralNetConfiguration — emit it there
+                # (the top-level copy below is a convenience duplicate)
                 "layerConf": {"layer": _layer_to_json(node.op),
-                              "seed": int(cg.conf.globals_.seed)}}
+                              "seed": int(cg.conf.globals_.seed),
+                              "iUpdater": _updater_to_json(
+                                  cg.conf.globals_.updater)}}
         else:
             vertices[name] = _vertex_to_json(node.op)
         vertex_inputs[name] = list(node.inputs)
@@ -870,6 +878,10 @@ def restore_upstream_computation_graph(path, input_shapes=None,
             raise ValueError("configuration.json has no 'vertices' — use "
                              "restore_upstream_multi_layer_network for "
                              "MultiLayerNetwork zips")
+        if "coefficients.bin" not in names:
+            raise ValueError(f"{path} has configuration.json but no "
+                             "coefficients.bin — not a complete upstream "
+                             "DL4J model zip")
         builder = NeuralNetConfiguration.builder()
         upd_json = conf_json.get("iUpdater")
         if upd_json is None:

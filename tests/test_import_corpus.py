@@ -276,6 +276,42 @@ def fam_keras_functional(tmp_path):
     np.testing.assert_allclose(got, m.predict(x, verbose=0), atol=1e-5)
 
 
+def fam_keras_v3_sequential(tmp_path):
+    """keras-v3 .keras zip archive (config.json + model.weights.h5):
+    weight groups are keyed by AUTO paths (snake(class)_k), not config
+    names — the importer regenerates the counter sequence."""
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((8, 8, 2)),
+        keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+        keras.layers.BatchNormalization(),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Conv2D(8, 3),
+        keras.layers.Flatten(),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    x = RNG.random((2, 8, 8, 2)).astype(np.float32)
+    p = tmp_path / "seq_v3.keras"
+    m.save(p)
+    got = np.asarray(import_keras_sequential(str(p)).output(x))
+    np.testing.assert_allclose(got, m.predict(x, verbose=0), atol=1e-4)
+
+
+def fam_keras_v3_functional(tmp_path):
+    keras = tf.keras
+    inp = keras.layers.Input((8,))
+    a = keras.layers.Dense(8, activation="relu")(inp)
+    b = keras.layers.Dense(8, activation="tanh")(inp)
+    merged = keras.layers.Concatenate()([a, b])
+    out = keras.layers.Dense(3, activation="softmax")(merged)
+    m = keras.Model(inp, out)
+    x = RNG.random((3, 8)).astype(np.float32)
+    p = tmp_path / "func_v3.keras"
+    m.save(p)
+    got = np.asarray(import_keras_model(str(p)).output(x))
+    np.testing.assert_allclose(got, m.predict(x, verbose=0), atol=1e-5)
+
+
 CORPUS = {
     "tf_mlp": fam_tf_mlp,
     "tf_cnn": fam_tf_cnn,
@@ -291,6 +327,8 @@ CORPUS = {
     "keras_conv": fam_keras_conv,
     "keras_lstm": fam_keras_lstm,
     "keras_functional": fam_keras_functional,
+    "keras_v3_sequential": fam_keras_v3_sequential,
+    "keras_v3_functional": fam_keras_v3_functional,
 }
 
 
