@@ -164,6 +164,9 @@ def restore_normalizer(path):
     with zipfile.ZipFile(path) as zf:
         if "normalizer.pkl" in zf.namelist():
             return pickle.loads(zf.read("normalizer.pkl"))
+        if "normalizer.bin" in zf.namelist():   # upstream DL4J layout
+            from .upstream_dl4j import read_normalizer_upstream_format
+            return read_normalizer_upstream_format(zf.read("normalizer.bin"))
     return None
 
 

@@ -69,9 +69,11 @@ def _write_utf(buf: io.BytesIO, s: str):
     buf.write(raw)
 
 
-def read_nd4j_array(data: bytes) -> np.ndarray:
-    """Parse one Nd4j.write()-format array from ``data``."""
-    buf = io.BytesIO(data)
+def read_nd4j_array(data) -> np.ndarray:
+    """Parse one Nd4j.write()-format array from ``data`` (bytes, or a
+    BytesIO stream — the stream is left positioned just past the frame,
+    so back-to-back frames parse by repeated calls)."""
+    buf = io.BytesIO(data) if isinstance(data, (bytes, bytearray)) else data
     shape_dtype = _read_utf(buf)
     if shape_dtype not in ("LONG", "INT"):
         raise ValueError(f"unexpected shape-buffer dtype {shape_dtype!r}")
@@ -536,9 +538,10 @@ def _input_shape_from_json(d, layers):
                      "layer has no nIn — cannot infer input shape")
 
 
-def write_model_upstream_format(net, path, save_updater: bool = False):
+def write_model_upstream_format(net, path, save_updater: bool = False,
+                                normalizer=None):
     """Write ``net`` in the upstream DL4J zip layout (configuration.json +
-    coefficients.bin [+ updaterState.bin])."""
+    coefficients.bin [+ updaterState.bin] [+ normalizer.bin])."""
     confs = []
     for layer in net.layers:
         confs.append({"layer": _layer_to_json(layer),
@@ -568,6 +571,10 @@ def write_model_upstream_format(net, path, save_updater: bool = False):
                             write_nd4j_array(
                                 state.astype(np.float32).reshape(1, -1),
                                 order="f"))
+        norm = normalizer or getattr(net, "normalizer", None)
+        if norm is not None:
+            zf.writestr("normalizer.bin",
+                        write_normalizer_upstream_format(norm))
 
 
 def _extract_adam_mv(net):
@@ -727,6 +734,9 @@ def restore_upstream_multi_layer_network(path, load_updater: bool = True):
                     f"{type(upd).__name__} — only Adam/AdamW state layouts "
                     "(2 floats per param) are mapped; training resumes "
                     "with fresh optimizer state", stacklevel=2)
+        if "normalizer.bin" in names:
+            net.normalizer = read_normalizer_upstream_format(
+                zf.read("normalizer.bin"))
     return net
 
 
@@ -809,7 +819,8 @@ def _vertex_to_json(v):
 
 
 def write_computation_graph_upstream_format(cg, path,
-                                            save_updater: bool = False):
+                                            save_updater: bool = False,
+                                            normalizer=None):
     """Write a ComputationGraph in the upstream DL4J zip layout."""
     from ..nn.layers.base import Layer
     vertices = {}
@@ -863,6 +874,10 @@ def write_computation_graph_upstream_format(cg, path,
                             write_nd4j_array(
                                 state.astype(np.float32).reshape(1, -1),
                                 order="f"))
+        norm = normalizer or getattr(cg, "normalizer", None)
+        if norm is not None:
+            zf.writestr("normalizer.bin",
+                        write_normalizer_upstream_format(norm))
 
 
 def restore_upstream_computation_graph(path, input_shapes=None,
@@ -938,4 +953,105 @@ def restore_upstream_computation_graph(path, input_shapes=None,
                     f"{type(upd).__name__} — only Adam/AdamW state layouts "
                     "are mapped; training resumes with fresh optimizer "
                     "state", stacklevel=2)
+        if "normalizer.bin" in names:
+            cg.normalizer = read_normalizer_upstream_format(
+                zf.read("normalizer.bin"))
     return cg
+
+
+# ----------------------------------------------------------- normalizer.bin
+# Reference: ``NormalizerSerializer`` — ModelSerializer.addNormalizerToModel
+# stores the fitted normalizer as a "normalizer.bin" zip entry. Wire spec
+# (same provenance caveat as the module header; strategies beyond
+# standardize/min-max are rejected loudly):
+#   writeUTF(strategy)        "STANDARDIZE" | "MIN_MAX"
+#   writeBoolean(fitLabels)   1 byte
+#   MIN_MAX only: float64 targetMin, float64 targetMax (big-endian)
+#   Nd4j arrays: feature stats pair [, label stats pair when fitLabels]
+#     STANDARDIZE: mean, std      MIN_MAX: min, max
+
+
+def _stats_from_mean_std(mean, std):
+    from ..data.normalizers import _Stats
+    st = _Stats()
+    mean = np.asarray(mean, np.float64).reshape(-1)
+    std = np.asarray(std, np.float64).reshape(-1)
+    st.n = 1
+    st.sum = mean.copy()
+    st.sum_sq = std * std + mean * mean   # var = sum_sq/n − mean²
+    st.min = mean - std
+    st.max = mean + std
+    return st
+
+
+def _stats_from_min_max(mn, mx):
+    from ..data.normalizers import _Stats
+    st = _Stats()
+    mn = np.asarray(mn, np.float64).reshape(-1)
+    mx = np.asarray(mx, np.float64).reshape(-1)
+    st.n = 1
+    st.sum = (mn + mx) / 2
+    st.sum_sq = st.sum * st.sum
+    st.min = mn
+    st.max = mx
+    return st
+
+
+def write_normalizer_upstream_format(norm) -> bytes:
+    from ..data.normalizers import (NormalizerMinMaxScaler,
+                                    NormalizerStandardize)
+    buf = io.BytesIO()
+    if isinstance(norm, NormalizerStandardize):
+        _write_utf(buf, "STANDARDIZE")
+        buf.write(struct.pack(">?", bool(norm.fit_labels)))
+        arrays = [norm._f.mean, norm._f.std]
+        if norm.fit_labels:
+            arrays += [norm._l.mean, norm._l.std]
+    elif isinstance(norm, NormalizerMinMaxScaler):
+        _write_utf(buf, "MIN_MAX")
+        buf.write(struct.pack(">?", bool(norm.fit_labels)))
+        buf.write(struct.pack(">dd", float(norm.min_range),
+                              float(norm.max_range)))
+        arrays = [norm._f.min, norm._f.max]
+        if norm.fit_labels:
+            arrays += [norm._l.min, norm._l.max]
+    else:
+        raise ValueError(
+            f"{type(norm).__name__} has no upstream normalizer.bin writer "
+            "(supported: NormalizerStandardize, NormalizerMinMaxScaler)")
+    for a in arrays:
+        # stats accumulate in f64 — keep that precision on the wire
+        # (large-magnitude means lose up to ~1.0 at f32)
+        buf.write(write_nd4j_array(
+            np.asarray(a, np.float64).reshape(1, -1), order="f"))
+    return buf.getvalue()
+
+
+def read_normalizer_upstream_format(data: bytes):
+    from ..data.normalizers import (NormalizerMinMaxScaler,
+                                    NormalizerStandardize)
+    buf = io.BytesIO(data)
+    strategy = _read_utf(buf)
+    (fit_labels,) = struct.unpack(">?", buf.read(1))
+
+    def next_array():
+        # read_nd4j_array consumes exactly one frame from the stream
+        return np.asarray(read_nd4j_array(buf), np.float64).reshape(-1)
+
+    if strategy == "STANDARDIZE":
+        norm = NormalizerStandardize()
+        norm.fit_labels = bool(fit_labels)
+        norm._f = _stats_from_mean_std(next_array(), next_array())
+        if fit_labels:
+            norm._l = _stats_from_mean_std(next_array(), next_array())
+        return norm
+    if strategy == "MIN_MAX":
+        lo, hi = struct.unpack(">dd", buf.read(16))
+        norm = NormalizerMinMaxScaler(min_range=lo, max_range=hi)
+        norm.fit_labels = bool(fit_labels)
+        norm._f = _stats_from_min_max(next_array(), next_array())
+        if fit_labels:
+            norm._l = _stats_from_min_max(next_array(), next_array())
+        return norm
+    raise ValueError(f"unsupported upstream normalizer strategy "
+                     f"{strategy!r} (supported: STANDARDIZE, MIN_MAX)")

@@ -492,3 +492,55 @@ def test_upstream_cg_updater_state_training_resume(tmp_path):
     np.testing.assert_allclose(np.asarray(restored.output(x)),
                                np.asarray(cg.output(x)),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_upstream_normalizer_bin_roundtrip(tmp_path):
+    """normalizer.bin (NormalizerSerializer analogue): standardize and
+    min-max stats survive the wire, and restore attaches the normalizer."""
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.data.normalizers import (NormalizerMinMaxScaler,
+                                                     NormalizerStandardize)
+    from deeplearning4j_tpu.serde import ModelSerializer
+    from deeplearning4j_tpu.serde.upstream_dl4j import (
+        read_normalizer_upstream_format, write_normalizer_upstream_format)
+
+    rng = np.random.default_rng(17)
+    x = (rng.normal(size=(64, 6)) * 3.0 + 1.5).astype(np.float32)
+    y = rng.normal(size=(64, 3)).astype(np.float32)
+    ds = DataSet(x, y)
+
+    std = NormalizerStandardize()
+    std.fit_label(True)
+    std.fit([ds])
+    back = read_normalizer_upstream_format(
+        write_normalizer_upstream_format(std))
+    np.testing.assert_allclose(np.asarray(back.transform(ds).features),
+                               np.asarray(std.transform(ds).features),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(back.transform(ds).labels),
+                               np.asarray(std.transform(ds).labels),
+                               rtol=1e-5, atol=1e-5)
+    assert back.fit_labels
+
+    mm = NormalizerMinMaxScaler(min_range=-1.0, max_range=1.0)
+    mm.fit([ds])
+    back2 = read_normalizer_upstream_format(
+        write_normalizer_upstream_format(mm))
+    np.testing.assert_allclose(np.asarray(back2.transform(ds).features),
+                               np.asarray(mm.transform(ds).features),
+                               rtol=1e-5, atol=1e-5)
+    # revert (inverse) uses the restored min/max too
+    np.testing.assert_allclose(
+        np.asarray(back2.revert_features(
+            back2.transform(ds).features)), x, rtol=1e-4, atol=1e-4)
+
+    # end-to-end: normalizer rides the model zip and restore attaches it
+    net, xx, yy, dss = _small_trained_net()
+    path = tmp_path / "with_norm.zip"
+    write_model_upstream_format(net, path, normalizer=std)
+    restored = restore_upstream_multi_layer_network(path)
+    assert restored.normalizer is not None
+    np.testing.assert_allclose(
+        np.asarray(restored.normalizer.transform(ds).features),
+        np.asarray(std.transform(ds).features), rtol=1e-5, atol=1e-5)
+    assert ModelSerializer.restore_normalizer(str(path)) is not None
