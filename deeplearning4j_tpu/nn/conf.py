@@ -242,3 +242,18 @@ class MultiLayerConfiguration:
                 return str(o)
         return json.dumps({"globals": enc(self.globals_), "input_type": self.input_type,
                            "layers": [enc(l) for l in self.layers]}, indent=2, default=str)
+
+    def to_upstream_json(self) -> str:
+        """Upstream ``MultiLayerConfiguration.toJson()``-format JSON —
+        loadable by DL4J tooling (serde/upstream_dl4j.py, supported-layer
+        subset)."""
+        from ..serde.upstream_dl4j import mln_conf_to_upstream_json
+        return mln_conf_to_upstream_json(self)
+
+    @staticmethod
+    def from_upstream_json(data: str) -> "MultiLayerConfiguration":
+        """Upstream ``MultiLayerConfiguration.fromJson()`` analogue."""
+        from ..serde.upstream_dl4j import mln_conf_from_upstream_json
+        return mln_conf_from_upstream_json(data)
+
+    fromJson = from_upstream_json      # reference naming

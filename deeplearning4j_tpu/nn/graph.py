@@ -34,6 +34,20 @@ class ComputationGraphConfiguration:
     topo_order: List[str]
     input_types: Optional[List] = None
 
+    def to_upstream_json(self) -> str:
+        """Upstream ``ComputationGraphConfiguration.toJson()``-format JSON
+        (serde/upstream_dl4j.py, supported layer/vertex subset)."""
+        from ..serde.upstream_dl4j import cg_conf_to_upstream_json
+        return cg_conf_to_upstream_json(self)
+
+    @staticmethod
+    def from_upstream_json(data: str) -> "ComputationGraphConfiguration":
+        """Upstream ``ComputationGraphConfiguration.fromJson()`` analogue."""
+        from ..serde.upstream_dl4j import cg_conf_from_upstream_json
+        return cg_conf_from_upstream_json(data)
+
+    fromJson = from_upstream_json      # reference naming
+
 
 class GraphBuilder:
     def __init__(self, g: GlobalConf):

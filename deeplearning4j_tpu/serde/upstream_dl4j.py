@@ -495,38 +495,57 @@ def _param_order_arrays(net):
 
 # ------------------------------------------------------------------ zip io
 
-def _input_type_json(net):
-    shape = getattr(net, "_init_input_shape", None)
-    if shape is None:
-        return None
+_IT = "org.deeplearning4j.nn.conf.inputs.InputType$"
+
+
+def _shape_to_input_type_json(shape):
+    """A concrete input shape → the upstream InputType JSON (rank decides:
+    4=cnn3d DHWC, 3=cnn HWC, 2=recurrent (T, C), 1=feed-forward)."""
+    shape = tuple(shape)
+    if len(shape) == 4:
+        dd, h, w, c = shape
+        return {"@class": _IT + "InputTypeConvolutional3D",
+                "depth": int(dd), "height": int(h), "width": int(w),
+                "channels": int(c)}
     if len(shape) == 3:
         h, w, c = shape
-        return {"@class": "org.deeplearning4j.nn.conf.inputs."
-                          "InputType$InputTypeConvolutional",
+        return {"@class": _IT + "InputTypeConvolutional",
                 "height": int(h), "width": int(w), "channels": int(c)}
     if len(shape) == 2:
         t, c = shape
-        d = {"@class": "org.deeplearning4j.nn.conf.inputs."
-                       "InputType$InputTypeRecurrent", "size": int(c)}
+        d = {"@class": _IT + "InputTypeRecurrent", "size": int(c)}
         if t is not None:
             d["timeSeriesLength"] = int(t)
         return d
-    return {"@class": "org.deeplearning4j.nn.conf.inputs."
-                      "InputType$InputTypeFeedForward",
-            "size": int(shape[-1])}
+    return {"@class": _IT + "InputTypeFeedForward", "size": int(shape[-1])}
+
+
+def _input_type_json(net):
+    shape = getattr(net, "_init_input_shape", None)
+    return None if shape is None else _shape_to_input_type_json(shape)
+
+
+def _input_type_from_json(it):
+    """Upstream InputType JSON → our (kind, shape) input-type tuple."""
+    cls = it.get("@class", "").rsplit("$", 1)[-1]
+    if cls == "InputTypeConvolutional3D":
+        return ("cnn3d", (int(it["depth"]), int(it["height"]),
+                          int(it["width"]), int(it["channels"])))
+    if cls == "InputTypeConvolutional":
+        return ("cnn", (int(it["height"]), int(it["width"]),
+                        int(it["channels"])))
+    if cls == "InputTypeRecurrent":
+        t = it.get("timeSeriesLength")
+        return ("rnn", (int(t) if t else None, int(it["size"])))
+    if cls == "InputTypeFeedForward":
+        return ("ff", (int(it["size"]),))
+    raise ValueError(f"unsupported upstream InputType {cls!r}")
 
 
 def _input_shape_from_json(d, layers):
     it = d.get("inputType")
     if it:
-        cls = it.get("@class", "").rsplit("$", 1)[-1]
-        if cls == "InputTypeConvolutional":
-            return (int(it["height"]), int(it["width"]), int(it["channels"]))
-        if cls == "InputTypeRecurrent":
-            t = it.get("timeSeriesLength")
-            return (int(t) if t else None, int(it["size"]))
-        if cls == "InputTypeFeedForward":
-            return (int(it["size"]),)
+        return _input_type_from_json(it)[1]
     n_in = getattr(layers[0], "n_in", None)
     if n_in:
         # recurrent first layer needs (T, C); feed-forward needs (C,)
@@ -542,15 +561,10 @@ def write_model_upstream_format(net, path, save_updater: bool = False,
                                 normalizer=None):
     """Write ``net`` in the upstream DL4J zip layout (configuration.json +
     coefficients.bin [+ updaterState.bin] [+ normalizer.bin])."""
-    confs = []
-    for layer in net.layers:
-        confs.append({"layer": _layer_to_json(layer),
-                      "seed": int(net._g.seed), "miniBatch": True,
-                      "iUpdater": _updater_to_json(net._g.updater)})
-    top = {"backpropType": "Standard", "confs": confs,
-           "iterationCount": int(getattr(net, "_step_count", 0))}
-    it = _input_type_json(net)
-    if it:
+    top = json.loads(mln_conf_to_upstream_json(net.conf))
+    top["iterationCount"] = int(getattr(net, "_step_count", 0))
+    it = _input_type_json(net)   # net's resolved init shape beats the
+    if it:                       # config-level declaration when present
         top["inputType"] = it
     arrays = _param_order_arrays(net)
     flat = np.concatenate([a.ravel(order="f").astype(np.float32)
@@ -705,19 +719,10 @@ def restore_upstream_multi_layer_network(path, load_updater: bool = True):
             raise ValueError(f"{path} has configuration.json but no "
                              "coefficients.bin — not a complete upstream "
                              "DL4J model zip")
-        layers = [_layer_from_json(c["layer"]) for c in conf_json["confs"]]
-        builder = NeuralNetConfiguration.builder()
-        upd = None
-        if conf_json["confs"]:
-            upd = _updater_from_json(conf_json["confs"][0].get("iUpdater"))
-            builder = builder.seed(conf_json["confs"][0].get("seed", 12345))
-        if upd is not None:
-            builder = builder.updater(upd)
-        lb = builder.list()
-        for lyr in layers:
-            lb = lb.layer(lyr)
-        net = MultiLayerNetwork(lb.build())
-        net.init(_input_shape_from_json(conf_json, layers))
+        conf = mln_conf_from_upstream_json(conf_json)
+        upd = conf.globals_.updater
+        net = MultiLayerNetwork(conf)
+        net.init(_input_shape_from_json(conf_json, conf.layers))
         flat = read_nd4j_array(zf.read("coefficients.bin"))
         _assign_upstream_params(net, flat)
         net._step_count = int(conf_json.get("iterationCount", 0))
@@ -822,39 +827,13 @@ def write_computation_graph_upstream_format(cg, path,
                                             save_updater: bool = False,
                                             normalizer=None):
     """Write a ComputationGraph in the upstream DL4J zip layout."""
-    from ..nn.layers.base import Layer
-    vertices = {}
-    vertex_inputs = {}
-    for name in cg.conf.topo_order:
-        node = cg.conf.nodes[name]
-        if isinstance(node.op, Layer):
-            vertices[name] = {
-                "@class": _GV + "LayerVertex",
-                # the genuine upstream format carries the updater inside
-                # each LayerVertex's NeuralNetConfiguration — emit it there
-                # (the top-level copy below is a convenience duplicate)
-                "layerConf": {"layer": _layer_to_json(node.op),
-                              "seed": int(cg.conf.globals_.seed),
-                              "iUpdater": _updater_to_json(
-                                  cg.conf.globals_.updater)}}
-        else:
-            vertices[name] = _vertex_to_json(node.op)
-        vertex_inputs[name] = list(node.inputs)
-    top = {
-        "networkInputs": list(cg.conf.inputs),
-        "networkOutputs": list(cg.conf.outputs),
-        "vertices": vertices,
-        "vertexInputs": vertex_inputs,
-        "iterationCount": int(getattr(cg, "_step_count", 0)),
-        "iUpdater": _updater_to_json(cg.conf.globals_.updater),
-    }
+    top = json.loads(cg_conf_to_upstream_json(cg.conf))
+    top["iterationCount"] = int(getattr(cg, "_step_count", 0))
+    # convenience duplicate of the per-LayerVertex iUpdater
+    top["iUpdater"] = _updater_to_json(cg.conf.globals_.updater)
     shapes = getattr(cg, "_init_shapes", None)
-    if shapes:
-        its = []
-        for s in shapes:
-            fake = type("N", (), {"_init_input_shape": tuple(s)})()
-            its.append(_input_type_json(fake))
-        top["inputTypes"] = its
+    if shapes:   # the net's resolved init shapes beat any config-level
+        top["inputTypes"] = [_shape_to_input_type_json(s) for s in shapes]
     arrays = _param_order_arrays(cg)
     flat = np.concatenate([a.ravel(order="f").astype(np.float32)
                            for a in arrays]) if arrays else np.zeros(0, "f4")
@@ -897,40 +876,12 @@ def restore_upstream_computation_graph(path, input_shapes=None,
             raise ValueError(f"{path} has configuration.json but no "
                              "coefficients.bin — not a complete upstream "
                              "DL4J model zip")
-        builder = NeuralNetConfiguration.builder()
-        upd_json = conf_json.get("iUpdater")
-        if upd_json is None:
-            # genuine upstream zips carry the updater INSIDE each
-            # LayerVertex's NeuralNetConfiguration, not at the top level
-            for vd in conf_json["vertices"].values():
-                lc = vd.get("layerConf")
-                if lc and lc.get("iUpdater"):
-                    upd_json = lc["iUpdater"]
-                    break
-        upd = _updater_from_json(upd_json)
-        if upd is not None:
-            builder = builder.updater(upd)
-        gb = builder.graph_builder()
-        gb.add_inputs(*conf_json["networkInputs"])
-        vertex_inputs = conf_json.get("vertexInputs", {})
-        for name, vd in conf_json["vertices"].items():
-            cls = vd.get("@class", "").rsplit(".", 1)[-1]
-            ins = vertex_inputs.get(name, [])
-            if cls == "LayerVertex":
-                layer = _layer_from_json(vd["layerConf"]["layer"])
-                gb.add_layer(name, layer, *ins)
-            else:
-                gb.add_vertex(name, _vertex_from_json(vd), *ins)
-        gb.set_outputs(*conf_json["networkOutputs"])
-        cg = ComputationGraph(gb.build())
+        gconf = cg_conf_from_upstream_json(conf_json)
+        upd = gconf.globals_.updater
+        cg = ComputationGraph(gconf)
         if input_shapes is None:
-            its = conf_json.get("inputTypes")
-            if its:
-                input_shapes = []
-                for it in its:
-                    fake = {"inputType": it}
-                    input_shapes.append(
-                        _input_shape_from_json(fake, [None]))
+            if gconf.input_types:
+                input_shapes = [tuple(t[1]) for t in gconf.input_types]
             else:
                 raise ValueError(
                     "configuration.json has no inputTypes — pass "
@@ -1055,3 +1006,132 @@ def read_normalizer_upstream_format(data: bytes):
         return norm
     raise ValueError(f"unsupported upstream normalizer strategy "
                      f"{strategy!r} (supported: STANDARDIZE, MIN_MAX)")
+
+
+# ------------------------------------------------- config-level JSON API --
+# Reference: ``MultiLayerConfiguration.toJson()/fromJson()`` and
+# ``ComputationGraphConfiguration.toJson()/fromJson()`` — the config-only
+# half of the interop (no weights). These power the `to_upstream_json` /
+# `from_upstream_json` methods on our configuration classes.
+
+
+_KIND_TO_RANK = {"ff": 1, "rnn": 2, "cnn": 3, "cnn3d": 4}
+
+
+def _our_input_type_to_json(it):
+    """Our (kind, shape) input-type tuple → upstream InputType JSON,
+    dispatching on the KIND tag (not shape-length guessing)."""
+    kind, shape = it[0], tuple(it[1])
+    if kind not in _KIND_TO_RANK:
+        raise ValueError(f"input type kind {kind!r} has no upstream "
+                         "InputType analogue")
+    if len(shape) != _KIND_TO_RANK[kind]:
+        raise ValueError(f"input type {it!r}: kind {kind!r} expects a "
+                         f"rank-{_KIND_TO_RANK[kind]} shape")
+    return _shape_to_input_type_json(shape)
+
+
+def mln_conf_to_upstream_json(conf) -> str:
+    """Our MultiLayerConfiguration → upstream-format JSON string."""
+    confs = []
+    for layer in conf.layers:
+        confs.append({"layer": _layer_to_json(layer),
+                      "seed": int(conf.globals_.seed), "miniBatch": True,
+                      "iUpdater": _updater_to_json(conf.globals_.updater)})
+    top = {"backpropType": "Standard", "confs": confs}
+    if conf.input_type is not None:
+        top["inputType"] = _our_input_type_to_json(conf.input_type)
+    return json.dumps(top, indent=2)
+
+
+def mln_conf_from_upstream_json(data):
+    """Upstream MultiLayerConfiguration JSON (str or parsed dict) → our
+    configuration."""
+    from ..nn.conf import NeuralNetConfiguration
+    d = json.loads(data) if isinstance(data, (str, bytes)) else data
+    if "confs" not in d:
+        raise ValueError("not an upstream MultiLayerConfiguration (no "
+                         "'confs')")
+    layers = [_layer_from_json(c["layer"]) for c in d["confs"]]
+    builder = NeuralNetConfiguration.builder()
+    if d["confs"]:
+        builder = builder.seed(d["confs"][0].get("seed", 12345))
+        upd = _updater_from_json(d["confs"][0].get("iUpdater"))
+        if upd is not None:
+            builder = builder.updater(upd)
+    lb = builder.list()
+    for lyr in layers:
+        lb = lb.layer(lyr)
+    it = d.get("inputType")
+    if it:
+        lb = lb.set_input_type(_input_type_from_json(it))
+    return lb.build()
+
+
+def cg_conf_to_upstream_json(conf) -> str:
+    """Our ComputationGraphConfiguration → upstream-format JSON string."""
+    from ..nn.layers.base import Layer
+    vertices = {}
+    vertex_inputs = {}
+    for name in conf.topo_order:
+        node = conf.nodes[name]
+        if isinstance(node.op, Layer):
+            vertices[name] = {
+                "@class": _GV + "LayerVertex",
+                "layerConf": {"layer": _layer_to_json(node.op),
+                              "seed": int(conf.globals_.seed),
+                              "iUpdater": _updater_to_json(
+                                  conf.globals_.updater)}}
+        else:
+            vertices[name] = _vertex_to_json(node.op)
+        vertex_inputs[name] = list(node.inputs)
+    top = {"networkInputs": list(conf.inputs),
+           "networkOutputs": list(conf.outputs),
+           "vertices": vertices,
+           "vertexInputs": vertex_inputs}
+    if conf.input_types:
+        top["inputTypes"] = [_our_input_type_to_json(it)
+                             for it in conf.input_types]
+    return json.dumps(top, indent=2)
+
+
+def cg_conf_from_upstream_json(data):
+    """Upstream ComputationGraphConfiguration JSON (str or parsed dict) →
+    our configuration."""
+    from ..nn.conf import NeuralNetConfiguration
+    d = json.loads(data) if isinstance(data, (str, bytes)) else data
+    if "vertices" not in d:
+        raise ValueError("not an upstream ComputationGraphConfiguration "
+                         "(no 'vertices')")
+    builder = NeuralNetConfiguration.builder()
+    upd_json = d.get("iUpdater")
+    seed = None
+    for vd in d["vertices"].values():
+        lc = vd.get("layerConf")
+        if lc:
+            if upd_json is None and lc.get("iUpdater"):
+                upd_json = lc["iUpdater"]   # genuine upstream zips carry
+                # the updater inside each LayerVertex's NeuralNetConfiguration
+            if seed is None and lc.get("seed") is not None:
+                seed = int(lc["seed"])
+    if seed is not None:
+        builder = builder.seed(seed)
+    upd = _updater_from_json(upd_json)
+    if upd is not None:
+        builder = builder.updater(upd)
+    gb = builder.graph_builder()
+    gb.add_inputs(*d["networkInputs"])
+    vertex_inputs = d.get("vertexInputs", {})
+    for name, vd in d["vertices"].items():
+        cls = vd.get("@class", "").rsplit(".", 1)[-1]
+        ins = vertex_inputs.get(name, [])
+        if cls == "LayerVertex":
+            gb.add_layer(name, _layer_from_json(vd["layerConf"]["layer"]),
+                         *ins)
+        else:
+            gb.add_vertex(name, _vertex_from_json(vd), *ins)
+    gb.set_outputs(*d["networkOutputs"])
+    its = d.get("inputTypes")
+    if its:
+        gb.set_input_types(*[_input_type_from_json(it) for it in its])
+    return gb.build()

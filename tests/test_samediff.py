@@ -133,9 +133,12 @@ def test_samediff_stats_listener_writes_records(tmp_path):
     files = glob.glob(log_dir + "/*.jsonl")
     assert files
     recs = [_json.loads(l) for l in open(files[0]) if l.strip()]
-    data = [r for r in recs if "run_start" not in r]
+    # run_start delimits runs; static carries run-level metadata (r5
+    # StatsStorage) — neither is a per-iteration record
+    data = [r for r in recs if "run_start" not in r and "static" not in r]
     assert len(data) >= 6
     assert all("score" in r for r in data)
+    assert any("static" in r for r in recs)   # the metadata record exists
     assert any("update_ratios" in r and "variables" in r["update_ratios"]
                for r in data[1:])
 

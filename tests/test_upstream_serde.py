@@ -544,3 +544,103 @@ def test_upstream_normalizer_bin_roundtrip(tmp_path):
         np.asarray(restored.normalizer.transform(ds).features),
         np.asarray(std.transform(ds).features), rtol=1e-5, atol=1e-5)
     assert ModelSerializer.restore_normalizer(str(path)) is not None
+
+
+def test_config_level_upstream_json_roundtrip():
+    """MultiLayerConfiguration / ComputationGraphConfiguration
+    to_upstream_json()/from_upstream_json() — the fromJson half of the
+    reference config API, weights-free."""
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       ComputationGraph, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.vertices import MergeVertex
+    from deeplearning4j_tpu.train import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(21).updater(Adam(2e-3))
+            .list()
+            .layer(DenseLayer(n_in=5, n_out=7, activation="relu"))
+            .layer(OutputLayer(n_in=7, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    j = conf.to_upstream_json()
+    assert "org.deeplearning4j.nn.conf.layers.DenseLayer" in j
+    conf2 = MultiLayerConfiguration.from_upstream_json(j)
+    net = MultiLayerNetwork(conf2).init()
+    assert net.layers[0].n_in == 5 and net.layers[1].n_out == 2
+    assert type(conf2.globals_.updater).__name__ == "Adam"
+    assert abs(conf2.globals_.updater.learning_rate - 2e-3) < 1e-9
+
+    gb = (NeuralNetConfiguration.builder().updater(Adam(1e-3))
+          .graph_builder()
+          .add_inputs("in")
+          .add_layer("a", DenseLayer(n_in=4, n_out=6, activation="tanh"),
+                     "in")
+          .add_layer("b", DenseLayer(n_in=4, n_out=6, activation="relu"),
+                     "in")
+          .add_vertex("m", MergeVertex(), "a", "b")
+          .add_layer("out", OutputLayer(n_in=12, n_out=3,
+                                        activation="softmax", loss="mcxent"),
+                     "m")
+          .set_outputs("out"))
+    gconf = gb.build()
+    gj = gconf.to_upstream_json()
+    gconf2 = ComputationGraphConfiguration.from_upstream_json(gj)
+    cg = ComputationGraph(gconf2).init([(4,)])
+    x = np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32)
+    assert np.asarray(cg.output(x)).shape == (2, 3)
+    assert gconf2.topo_order == gconf.topo_order
+
+
+def test_config_json_input_types_and_seed_roundtrip():
+    """Review findings r5: recurrent + cnn3d input types survive the
+    config JSON round trip; CG seed and input_types restore too."""
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.layers.core import RnnOutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+
+    rnn_conf = (NeuralNetConfiguration.builder().seed(33).list()
+                .layer(LSTM(n_in=3, n_out=5, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=5, n_out=2,
+                                      activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(3, timesteps=7))
+                .build())
+    back = MultiLayerConfiguration.from_upstream_json(
+        rnn_conf.to_upstream_json())
+    assert back.input_type == ("rnn", (7, 3))
+    assert back.globals_.seed == 33
+
+    c3d = (NeuralNetConfiguration.builder().list()
+           .layer(DenseLayer(n_in=8, n_out=4, activation="relu"))
+           .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                              loss="mcxent"))
+           .set_input_type(InputType.convolutional_3d(2, 3, 3, 1))
+           .build())
+    j = c3d.to_upstream_json()
+    assert "InputTypeConvolutional3D" in j
+    assert MultiLayerConfiguration.from_upstream_json(j).input_type == \
+        ("cnn3d", (2, 3, 3, 1))
+
+    gb = (NeuralNetConfiguration.builder().seed(99).graph_builder()
+          .add_inputs("in")
+          .add_layer("d", DenseLayer(n_in=4, n_out=6, activation="relu"),
+                     "in")
+          .add_layer("out", OutputLayer(n_in=6, n_out=2,
+                                        activation="softmax", loss="mcxent"),
+                     "d")
+          .set_outputs("out")
+          .set_input_types(InputType.feed_forward(4)))
+    gconf = gb.build()
+    back_g = ComputationGraphConfiguration.from_upstream_json(
+        gconf.to_upstream_json())
+    assert back_g.globals_.seed == 99
+    assert back_g.input_types == [("ff", (4,))]
+    # a self-describing CG config initializes without explicit shapes
+    from deeplearning4j_tpu.nn import ComputationGraph
+    cg = ComputationGraph(back_g).init()
+    x = np.random.default_rng(1).normal(size=(2, 4)).astype(np.float32)
+    assert np.asarray(cg.output(x)).shape == (2, 2)
