@@ -171,6 +171,20 @@ def main():
             emit("D t4096 b4 remat-off", error=f"{type(e).__name__}: {e}"[:300])
         step_time("D t4096 b4 flash-forced",
                   cfg_for(4096, use_flash_attention=True), 4)
+        # THE comparison the r4 sweep never actually ran: flash OFF at
+        # T=4096 (the "auto" default silently engaged flash in every r4
+        # "xla"-tagged t4096 run — see sweep_transformer.py phase4 note).
+        # The tunnel's remote compiler may reject these; record that too.
+        for tag, kw in (("xla-true", dict(use_flash_attention=False,
+                                          attn_scores_bf16=False)),
+                        ("bf16s-true", dict(use_flash_attention=False,
+                                            attn_scores_bf16=True))):
+            try:
+                step_time(f"D t4096 b4 remat-full {tag}",
+                          cfg_for(4096, **kw), 4)
+            except Exception as e:  # noqa: BLE001
+                emit(f"D t4096 b4 remat-full {tag}",
+                     error=f"{type(e).__name__}: {e}"[:300])
         try:
             step_time("D t4096 b8 remat-full", cfg_for(4096), 8)
         except Exception as e:  # noqa: BLE001
