@@ -424,13 +424,21 @@ def _tuned_blocks(b, h, t, d, dtype, causal, interpret) -> tuple:
         return run
 
     chip = jax.devices()[0].device_kind.replace(" ", "_")
-    # "flash3": causal DMA-clamp revision (dead blocks no longer fetched) —
-    # block choices tuned for earlier kernels' traffic don't transfer
+    # "flash4": much larger block candidates (r5). At long T the grid is
+    # (B·H)·(T/bq)·(T/bk) SEQUENTIAL steps; with 128×128 blocks T=4096/b4
+    # runs 32768 steps of tiny (128·64)-operand matmuls — per-step grid +
+    # DMA overhead, not bandwidth, dominates (the T=4096 cliff). d=64 K/V
+    # rows are only 2·T·d·2B ≈ 1 MB per head at T=4096, so near-whole-row
+    # blocks fit VMEM easily; bk=T collapses the online-softmax loop to
+    # one pass. Candidates whose (bq·bk·4 + 2·bk·d·2) VMEM footprint gets
+    # close to the ~64 MB budget are still safe at these sizes (bq=512,
+    # bk=4096, d=64: s block 8 MB + kv 1 MB).
     return autotune(
-        f"flash3:{chip}:{b}x{h}x{t}x{d}:{jnp.dtype(dtype).name}:{causal}",
-        [(128, 128), (256, 128), (128, 256), (256, 256), (512, 128),
-         (128, 512), (512, 256), (256, 512), (512, 512), (1024, 256),
-         (1024, 512)],
+        f"flash4:{chip}:{b}x{h}x{t}x{d}:{jnp.dtype(dtype).name}:{causal}",
+        [(128, 128), (256, 256), (512, 256), (256, 512), (512, 512),
+         (1024, 256), (1024, 512), (512, 1024), (1024, 1024),
+         (2048, 512), (512, 2048), (2048, 1024), (1024, 2048),
+         (2048, 2048), (512, 4096), (1024, 4096), (4096, 512)],
         make_run)
 
 
