@@ -314,6 +314,9 @@ class SameDiff:
         self.updaters = _Namespace(self, sd_ops.UPDATER, "updater")
         self.assertions = _Namespace(self, sd_ops.ASSERT, "assert")
         self.bp = _Namespace(self, sd_ops.BP, "bp")
+        # r5: TensorArray family (upstream list ops). The (stack, count)
+        # pair threads through graph ops as a regular tuple value.
+        self.list = _Namespace(self, sd_ops.LIST, "list")
         self._training_config: Optional[TrainingConfig] = None
         self._loss_vars: List[str] = []
         self._opt_state = None

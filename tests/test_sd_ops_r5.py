@@ -145,3 +145,20 @@ def test_list_scatter_empty_indices_is_noop():
                             jnp.zeros((0, 2)))
     assert int(L["size_list"](ta2)) == 1
     np.testing.assert_array_equal(np.asarray(ta2[0]), np.asarray(ta[0]))
+
+
+def test_sd_list_namespace_in_graph():
+    """The list family works through the SameDiff graph builder
+    (sd.list.*) — the upstream SDList/TensorArray namespace."""
+    import numpy as np
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    sd = S_sd = SameDiff.create()
+    c = sd.constant("c", np.asarray([1.0, 2.0], np.float32))
+    ta = sd.list.create_list(3, (2,))
+    ta = sd.list.push_list(ta, c)
+    ta = sd.list.push_list(ta, c * 2.0)
+    assert int(np.asarray(sd.eval(sd.list.size_list(ta)))) == 2
+    stacked = np.asarray(sd.eval(sd.list.stack_list(ta)))
+    np.testing.assert_array_equal(
+        stacked, np.asarray([[1, 2], [2, 4], [0, 0]], np.float32))
