@@ -149,8 +149,56 @@ def xla_opinion(tag, cfg, batch):
     emit(tag, **out)
 
 
+def block_sweep(tag_prefix, t, b, h=8, d=64):
+    """Phase F: attention-only fwd+bwd time vs flash block size — the
+    direct test of the grid-overhead theory (steps = (B·H)(T/bq)(T/bk);
+    if per-step overhead dominates, time ~ 1/(bq·bk) until VMEM/MXU
+    effects take over)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.kernels.flash_attention import (
+        _flash_attention_pallas)
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, h, t, d), jnp.bfloat16)
+    for bq, bk in ((128, 128), (256, 256), (512, 512), (1024, 512),
+                   (512, 1024), (1024, 1024), (2048, 1024), (1024, 2048),
+                   (2048, 2048), (512, 4096), (1024, 4096), (4096, 1024)):
+        if t % bq or t % bk:
+            continue
+        try:
+            def loss(q_, k_, v_, _bq=bq, _bk=bk):
+                return jnp.sum(_flash_attention_pallas(
+                    q_, k_, v_, None, True, _bq, _bk, False
+                ).astype(jnp.float32))
+
+            jfn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            out = jfn(q, q, q)
+            float(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
+            n1, n2 = 2, 8
+            t0 = time.perf_counter()
+            for _ in range(n1):
+                out = jfn(q, q, q)
+            float(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
+            t1 = time.perf_counter()
+            for _ in range(n2):
+                out = jfn(q, q, q)
+            float(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
+            t2 = time.perf_counter()
+            dt = ((t2 - t1) - (t1 - t0)) / (n2 - n1)
+            steps = (b * h) * (t // bq) * (t // bk)
+            emit(f"{tag_prefix} flash bq{bq} bk{bk}",
+                 ms=round(dt * 1e3, 3), grid_steps=steps,
+                 us_per_step=round(dt * 1e6 / steps, 3))
+        except Exception as e:  # noqa: BLE001 — VMEM overflow etc.
+            emit(f"{tag_prefix} flash bq{bq} bk{bk}",
+                 error=f"{type(e).__name__}: {e}"[:200])
+
+
 def main():
-    phases = sys.argv[1:] or ["A", "B", "C", "D", "E"]
+    phases = sys.argv[1:] or ["A", "B", "C", "D", "E", "F"]
     if "A" in phases:
         step_time("A full t1024 b16 remat-full bf16s", cfg_for(1024), 16)
         step_time("A full t4096 b4 remat-full (auto->flash on TPU)",
@@ -192,6 +240,9 @@ def main():
     if "E" in phases:
         xla_opinion("E cost t1024 b16", cfg_for(1024), 16)
         xla_opinion("E cost t4096 b4", cfg_for(4096), 4)
+    if "F" in phases:
+        block_sweep("F t4096 b4", 4096, 4)
+        block_sweep("F t1024 b16", 1024, 16)
 
 
 if __name__ == "__main__":
