@@ -560,7 +560,11 @@ def _input_shape_from_json(d, layers):
 def write_model_upstream_format(net, path, save_updater: bool = False,
                                 normalizer=None):
     """Write ``net`` in the upstream DL4J zip layout (configuration.json +
-    coefficients.bin [+ updaterState.bin] [+ normalizer.bin])."""
+    coefficients.bin [+ updaterState.bin] [+ normalizer.bin]).
+    ComputationGraph nets route to the CG writer automatically."""
+    if not hasattr(net, "layers"):          # a ComputationGraph
+        return write_computation_graph_upstream_format(
+            net, path, save_updater=save_updater, normalizer=normalizer)
     top = json.loads(mln_conf_to_upstream_json(net.conf))
     top["iterationCount"] = int(getattr(net, "_step_count", 0))
     it = _input_type_json(net)   # net's resolved init shape beats the
