@@ -179,3 +179,34 @@ def test_two_process_spark_job(tmp_path):
     w1 = np.load(outs[1])["w"]
     # both workers ended on the same averaged params (last round synced all)
     np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-7)
+
+
+def test_spark_computation_graph_alias_trains_cg():
+    """SparkComputationGraph is the same driver — CG nets satisfy the
+    clone/params_flat/fit contract."""
+    from deeplearning4j_tpu.nn import (ComputationGraph,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import SparkComputationGraph
+
+    gb = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(5e-2))
+          .graph_builder()
+          .add_inputs("in")
+          .add_layer("d", DenseLayer(n_in=6, n_out=8, activation="tanh"),
+                     "in")
+          .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                        activation="softmax", loss="mcxent"),
+                     "d")
+          .set_outputs("out"))
+    cg = ComputationGraph(gb.build()).init([(6,)])
+    datasets = _data(n_batches=4)
+    x = np.concatenate([np.asarray(d.features) for d in datasets])
+    y = np.concatenate([np.asarray(d.labels) for d in datasets])
+    s0 = cg.clone().score(DataSet(x, y))
+    tm = ParameterAveragingTrainingMaster(
+        n_workers=2, averaging_frequency=2, epochs_per_fit=3,
+        worker_timeout=60.0)
+    spark = SparkComputationGraph(cg, tm)
+    spark.fit(datasets)
+    assert spark.rounds >= 1
+    assert cg.score(DataSet(x, y)) < s0
