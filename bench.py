@@ -360,11 +360,15 @@ def build_bert(batch, cfg):
 
 def bench_bert(batch, steps):
     from deeplearning4j_tpu.zoo import transformer as tfm
-    cfg = tfm.BertConfig(max_seq=128)
+    # r5 composition sweep (scripts/diag_bert_out.json): remat-full +
+    # bf16-scores frees enough HBM for b128, MFU 0.40 -> 0.61 (b32 base
+    # 0.40; b32 remat+bf16s 0.49; b64 0.59; b128 0.61)
+    cfg = tfm.BertConfig(max_seq=128, remat=True, attn_scores_bf16=True)
     run_chain, flops = build_bert(batch, cfg)
     timing = measure_marginal(run_chain, n1=3, n2=steps)
-    return _record("BERT-base fine-tune seq/sec/chip (T=128)", "seq/sec/chip",
-                   batch, timing, flops, batch=batch, seq=cfg.max_seq)
+    return _record(
+        "BERT-base fine-tune seq/sec/chip (T=128, remat-full bf16-scores)",
+        "seq/sec/chip", batch, timing, flops, batch=batch, seq=cfg.max_seq)
 
 
 def build_transformer(batch, cfg):
@@ -396,21 +400,21 @@ def build_transformer(batch, cfg):
 def bench_transformer(batch, steps):
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo import transformer as tfm
-    # r4 sweep winner (scripts/sweep_transformer_out.json): full remat +
-    # bf16 score materialization + fused chunked CE, batch 32 — MFU 0.379
-    # vs 0.205 for the r3 config (remat-off b16 naive CE). Full remat
-    # trades idle-MXU recompute for the HBM traffic of storing
-    # per-layer intermediates; bf16 scores halve the dominant attention
-    # traffic on the XLA path.
+    # r5 winner (scripts/diag_attn_r5_out.json): flash attention at the
+    # grad-tuned flash5 blocks + remat that pins the attention outputs
+    # ("save_attn") + fused chunked CE. At T=1024 b16 this measured 221k
+    # tokens/s vs 187k for the r4 bf16-scores XLA config; b32 flash was
+    # 223k. attn_scores_bf16 stays True for the off-TPU/multichip
+    # fallback path (flash is single-chip TPU only).
     cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
                                 n_layers=8, d_ff=2048, max_seq=1024,
                                 dtype=jnp.bfloat16, fused_loss=True,
-                                remat=True, remat_policy="full",
+                                remat=True, remat_policy="save_attn",
                                 attn_scores_bf16=True)
     run_chain, flops = build_transformer(batch, cfg)
     timing = measure_marginal(run_chain, n1=3, n2=steps)
     return _record(
-        "Transformer-LM (120M, T=1024, remat-full bf16-scores) tokens/sec/chip",
+        "Transformer-LM (120M, T=1024, flash save-attn remat) tokens/sec/chip",
         "tokens/sec/chip", batch * cfg.max_seq, timing, flops,
         batch=batch, seq=cfg.max_seq)
 
@@ -418,19 +422,19 @@ def bench_transformer(batch, steps):
 def bench_transformer_long(batch, steps):
     """Long-context config: T=4096 at the same tokens/step as the T=1024
     config. This is the regime the pallas flash kernel exists for — the
-    (B,H,T,T) score tensor the XLA path materializes would be 1.6 GB f32
-    per layer here (and the tunnel's remote compiler rejects it at
-    T>=2048), while the flash kernel streams it through VMEM. remat=dots
-    keeps the 8-layer residual stream resident."""
+    (B,H,T,T) score tensor the XLA path materializes is 1.6 GB bf16 per
+    layer here, while the flash kernel streams it through VMEM. The r4
+    0.057-MFU cliff was the fwd-only autotuner picking 128×128 blocks
+    (34 ms/layer fwd+bwd vs 6.1 ms at 1024×1024 — diag_t4096 phase F);
+    with grad-tuned flash5 blocks the r5 sweep measured 160k tokens/s
+    remat-OFF (activations fit HBM at b4 once scores stay in VMEM) vs
+    150k save-attn, 147k remat-full, 87k best-XLA
+    (scripts/diag_attn_r5_out.json)."""
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo import transformer as tfm
-    # remat-full measured ahead of remat-dots for flash at T=4096
-    # (sweep phase 4: 0.0575 vs 0.0508 with the f32-operand kernel; the
-    # bf16-operand kernel revision should widen the gap)
     cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
                                 n_layers=8, d_ff=2048, max_seq=4096,
-                                dtype=jnp.bfloat16, remat=True,
-                                remat_policy="full")
+                                dtype=jnp.bfloat16, remat=False)
     run_chain, flops = build_transformer(batch, cfg)
     timing = measure_marginal(run_chain, n1=3, n2=steps)
     return _record(
@@ -694,7 +698,9 @@ CONFIGS = {
 }
 
 DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
-    # peaks at 256 (MFU 0.245 vs 0.077 at 64 pre-fused-kernel)
+    # peaks at 256. r5: charnn runs the lax.scan LSTM path (the fused
+    # pallas kernel measured slower in both dtypes — see
+    # nn/layers/recurrent.py `fused` and scripts/diag_attn_r5_out.json)
     "resnet50": (128, 13),
     "resnet50_rawstep": (128, 13),
     "resnet50_fitscan": (128, 13),
@@ -702,9 +708,12 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     "lenet_scan": (512, 25),
     "charnn": (256, 25),
     "charnn_f32": (256, 25),
-    "bert": (32, 13),
-    # transformer: r4 sweep — remat-full + bf16-scores peaks at batch 32
-    # (MFU 0.379 vs 0.369 at b16/b64)
+    # bert: r5 composition sweep — remat-full + bf16-scores frees HBM for
+    # b128 (MFU 0.61 vs 0.40 at the r4 b32 base config)
+    "bert": (128, 13),
+    # transformer: b32 composes the two measured r5 winners (b16
+    # flash+save_attn 221.4k, b32 flash remat-full 223.3k tok/s); the
+    # composed cell is captured by the official bench run itself
     "transformer": (32, 13),
     "transformer_long": (4, 9),   # 16k tokens/step (T=1024 runs 32k at b32)
     "dpoverhead": (1024, 20),

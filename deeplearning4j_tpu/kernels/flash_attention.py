@@ -402,13 +402,14 @@ _flash_attention_lse_pallas.defvjp(_flash_fwd_lse, _flash_bwd_lse)
 
 def _tuned_blocks(b, h, t, d, dtype, causal, interpret) -> tuple:
     """Autotuned (block_q, block_k) for this attention shape — timed on the
-    real chip once, cached to disk (kernels/autotune.py); defaults to
-    (128, 128) off-TPU or when tuning is disabled."""
+    real chip once, cached to disk (kernels/autotune.py). Off-TPU (or with
+    tuning disabled) falls back to the measured v5e sweet spot
+    (min(512,T), min(1024,T)) rather than re-timing."""
     import os
 
     if interpret or jax.default_backend() != "tpu" \
             or os.environ.get("DL4J_TPU_AUTOTUNE", "1") != "1":
-        return 128, 128
+        return _block_sizes(t, d, 512, 1024)
     from .autotune import autotune
 
     def make_run(cand):
@@ -418,27 +419,35 @@ def _tuned_blocks(b, h, t, d, dtype, causal, interpret) -> tuple:
         key = jax.random.PRNGKey(0)
         q = jax.random.normal(key, (b, h, t, d), dtype)
 
+        # Time the TRAIN path (fwd + both bwd passes): block-size choice is
+        # dominated by the backward kernels, and a fwd-only race mispicks
+        # (the flash4 tuner's 128×128 regression).
+        def loss(q_, k_, v_):
+            return jnp.sum(_flash_attention_pallas(
+                q_, k_, v_, None, causal, bq, bk, False
+            ).astype(jnp.float32))
+
+        grad_fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
         def run():
-            return _flash_attention_pallas(q, q, q, None, causal, bq, bk,
-                                           False)
+            return grad_fn(q, q, q)[0]
         return run
 
     chip = jax.devices()[0].device_kind.replace(" ", "_")
-    # "flash4": much larger block candidates (r5). At long T the grid is
-    # (B·H)·(T/bq)·(T/bk) SEQUENTIAL steps; with 128×128 blocks T=4096/b4
-    # runs 32768 steps of tiny (128·64)-operand matmuls — per-step grid +
-    # DMA overhead, not bandwidth, dominates (the T=4096 cliff). d=64 K/V
-    # rows are only 2·T·d·2B ≈ 1 MB per head at T=4096, so near-whole-row
-    # blocks fit VMEM easily; bk=T collapses the online-softmax loop to
-    # one pass. Candidates whose (bq·bk·4 + 2·bk·d·2) VMEM footprint gets
-    # close to the ~64 MB budget are still safe at these sizes (bq=512,
-    # bk=4096, d=64: s block 8 MB + kv 1 MB).
+    # "flash5" (r5): tune on the GRAD path with large-block candidates.
+    # The flash4 tuner timed the forward kernel only and picked 128×128,
+    # but the 128-vs-1024 block gap lives in the two backward passes: the
+    # diag_t4096 phase-F fwd+bwd sweep (2026-08-01, v5e) measured t4096/b4
+    # 34.0 ms at 128×128 vs 6.1 ms at 1024×1024, and t1024/b16 9.9 ms vs
+    # 2.1 ms at 512×1024 — the grid is (B·H)(T/bq)(T/bk) SEQUENTIAL steps,
+    # and per-step grid+DMA overhead (~1 µs) dominates small blocks.
+    # Candidates ≥2048 are dropped: the remote compiler rejects them
+    # (HTTP 500, same sweep), and 1024×1024 (s block 4 MB f32 + kv 256 KB)
+    # already sits well inside VMEM at d=64.
     return autotune(
-        f"flash4:{chip}:{b}x{h}x{t}x{d}:{jnp.dtype(dtype).name}:{causal}",
-        [(128, 128), (256, 256), (512, 256), (256, 512), (512, 512),
-         (1024, 256), (1024, 512), (512, 1024), (1024, 1024),
-         (2048, 512), (512, 2048), (2048, 1024), (1024, 2048),
-         (2048, 2048), (512, 4096), (1024, 4096), (4096, 512)],
+        f"flash5:{chip}:{b}x{h}x{t}x{d}:{jnp.dtype(dtype).name}:{causal}",
+        [(512, 1024), (1024, 1024), (1024, 512), (512, 512),
+         (256, 512), (256, 256), (128, 128)],
         make_run)
 
 

@@ -116,9 +116,15 @@ class LSTM(BaseRecurrent):
 
     forget_gate_bias: float = 1.0
     gate_activation: Any = "sigmoid"
-    # "auto": use the fused pallas whole-sequence kernel on TPU when the
-    # cell is standard (sigmoid/tanh, no mask); True forces it (interpret
-    # mode off-TPU, for tests); False always uses the lax.scan path
+    # Fused pallas whole-sequence kernel policy. "auto" now resolves to
+    # the lax.scan path: the r5 on-chip A/B (process-isolated arms,
+    # scripts/diag_attn_r5_out.json, 2026-08-01, b256×T60×h256) measured
+    # scan ahead of the kernel in BOTH dtypes — bf16 11.0M vs 5.0M
+    # tokens/s, f32 4.4M vs 2.5M. Same verdict as the fused-BN kernel
+    # (docs/PERF.md): XLA's scan fusion beats the hand kernel at these
+    # recurrent shapes, where per-grid-step overhead dominates the tiny
+    # (B,4H) gate matmuls. True forces the kernel (interpret mode
+    # off-TPU — how CI covers it); False always uses lax.scan.
     fused: Any = "auto"
 
     def _has_peepholes(self):
@@ -129,9 +135,9 @@ class LSTM(BaseRecurrent):
             return False
         if self.activation != "tanh" or self.gate_activation != "sigmoid":
             return False
-        if self.fused is True:
-            return True
-        return jax.default_backend() == "tpu"
+        # only an explicit True engages the kernel — "auto" = scan (see
+        # the `fused` field comment for the measured adjudication)
+        return self.fused is True
 
     def init(self, key, input_shape):
         t, c = input_shape
@@ -191,8 +197,9 @@ class LSTM(BaseRecurrent):
             else:
                 peep = jnp.zeros((3, h), jnp.float32)
             z0 = jnp.zeros((b0, h), x.dtype)
-            y = fused_lstm_seq(xw, rw, peep, z0, z0,
-                               True if self.fused is True else None)
+            # interpret=None → kernels/_common.interpret_default: compiled
+            # on a real TPU, interpret mode elsewhere (how CI covers it)
+            y = fused_lstm_seq(xw, rw, peep, z0, z0, None)
             return y, state
         carry0 = (jnp.zeros((b0, h), x.dtype), jnp.zeros((b0, h), x.dtype))
 

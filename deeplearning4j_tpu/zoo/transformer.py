@@ -27,6 +27,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -60,15 +61,21 @@ class TransformerConfig:
     #           cheap recompute only)
     # "dots_no_batch" — dots_with_no_batch_dims_saveable (saves the
     #           small contraction results, not the big batched ones)
+    # "save_attn" — save only the attention outputs (checkpoint_name
+    #           "attn_out"), recompute the rest: remat-full's HBM saving
+    #           without re-running the T² attention op in backward
     remat_policy: str = "full"
     use_ring_attention: bool = False
     # True = always pallas flash kernel (TPU single-chip); False = XLA fused
-    # attention; "auto" = flash only from `flash_min_seq` up. Measured on
-    # v5e (2026-07-30, d_model 512/h8): XLA wins at T<=1024 (~+13% tokens/s)
-    # and the tunnel's remote compiler rejects the XLA path at T>=2048,
-    # where the flash kernel is both faster and the only one that compiles.
+    # attention; "auto" = flash from `flash_min_seq` up. Measured on v5e
+    # (2026-08-01, d_model 512/h8, grad-tuned flash5 blocks — the earlier
+    # "XLA wins at short T" result was an artifact of fwd-only autotuning
+    # picking 128×128 blocks): full-model train step, flash vs best XLA
+    # path, tokens/s — t1024 b16: 221k vs 187k; t4096 b4: 160k vs 87k;
+    # t8192 b2: 107k vs 44k (scripts/diag_attn_r5_out.json). Below 1024
+    # the XLA bf16-scores path is unmeasured-against and stays default.
     use_flash_attention: Any = "auto"
-    flash_min_seq: int = 2048
+    flash_min_seq: int = 1024
     # Default-on (r4): materialize attention scores in bf16 instead of f32
     # on the XLA path (matmuls still accumulate f32 in-register; softmax
     # still reduces in f32). Halves the dominant (B,H,T,T) HBM traffic at
@@ -192,6 +199,7 @@ def _attention(cfg, q, k, v, mask_bias=None):
         out = _xla_attention_bf16_scores(q, k, v)
     else:
         out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    out = checkpoint_name(out, "attn_out")  # remat_policy="save_attn" hook
     return out.reshape(b, t, cfg.n_heads * cfg.head_dim)
 
 
@@ -223,13 +231,23 @@ def _xla_attention_bf16_scores(q, k, v, causal=True, bias=None):
 
 
 def _remat_wrap(fn, policy: str):
-    """jax.checkpoint around a block fn under one of the three supported
+    """jax.checkpoint around a block fn under one of the supported
     rematerialization policies (shared by the LM and BERT encoders)."""
     policies = {
         "full": None,
         "dots": jax.checkpoint_policies.dots_saveable,
         "dots_no_batch":
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # "save_attn": save ONLY the attention outputs (B·T·D bf16 — tiny,
+        # ~16 MB/layer at T=4096 b4) and recompute everything else. This
+        # spares the block's DOWNSTREAM recompute (mlp/norms feeding the
+        # loss side) from re-running attention; the gradient THROUGH
+        # attention still re-executes the kernel forward to rebuild its
+        # unsaved vjp residuals, so the win over remat-full is the
+        # downstream share only (measured ~2-3% tokens/s at T=1024-8192,
+        # scripts/diag_attn_r5_out.json — consistent, not dramatic).
+        "save_attn":
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
     }
     if policy not in policies:
         raise ValueError(f"Unknown remat_policy {policy!r}; "
@@ -448,7 +466,9 @@ class BertConfig:
     # r5: the transformer-LM sweep's two HBM cuts, applied to the encoder
     # (VERDICT r4 item 5). Defaults off = r4 behavior; bench flips both.
     remat: bool = False
-    remat_policy: str = "full"   # "full" | "dots" | "dots_no_batch"
+    # "full" | "dots" | "dots_no_batch" | "save_attn" (pin the attention
+    # outputs via checkpoint_name — see _remat_wrap)
+    remat_policy: str = "full"
     attn_scores_bf16: bool = False
 
 
@@ -513,6 +533,7 @@ def bert_forward(params, cfg: BertConfig, ids, type_ids=None, attn_mask=None):
                 kw["bias"] = jnp.broadcast_to(bias, (b, nh, t, t))
             a = jax.nn.dot_product_attention(q, k, v, **kw
                                              ).reshape(b, t, nh * hd)
+        a = checkpoint_name(a, "attn_out")  # remat_policy="save_attn" hook
         x = x + jnp.einsum("bth,hd->btd", a, blk["wo"].astype(h.dtype))
         h2 = _rmsnorm(x, blk["ln2"])
         m = jnp.einsum("btf,fd->btd",

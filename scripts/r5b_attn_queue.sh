@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-5 follow-up queue: full-model attention A/B at the flash5 block
+# sizes + isolated charnn arms. Each phase is its own interpreter (the r4
+# shared-process bias lesson). Run AFTER r5_tpu_queue.sh finishes — one
+# chip, jobs must serialize. No timeout wrappers (axon relay fragility).
+cd "$(dirname "$0")/.." || exit 1
+LOG=/tmp/r5b_queue.log
+: > "$LOG"
+note() { echo "=== $1 $(date -u +%H:%M:%S) ===" >> "$LOG"; }
+
+for phase in S L XL Rf Rs Bf Bs; do
+  note "[attn $phase] start"
+  python scripts/diag_attn_r5.py "$phase" >> "$LOG" 2>&1
+  note "[attn $phase] done"
+done
+note "queue done"

@@ -196,8 +196,11 @@ def test_autotune_picks_and_caches(tmp_path, monkeypatch):
 
 
 def test_tuned_blocks_defaults_off_tpu():
+    # off-TPU fallback: the measured v5e sweet spot (512, 1024), clamped
+    # to divisors of T (diag_t4096 phase-F sweep, 2026-08-01)
     from deeplearning4j_tpu.kernels.flash_attention import _tuned_blocks
-    assert _tuned_blocks(2, 4, 256, 64, jnp.float32, True, None) == (128, 128)
+    assert _tuned_blocks(2, 4, 256, 64, jnp.float32, True, None) == (256, 256)
+    assert _tuned_blocks(4, 8, 4096, 64, jnp.bfloat16, True, None) == (512, 1024)
 
 
 def test_self_attention_layer_pallas_impl_matches_xla():
