@@ -63,7 +63,7 @@ def main():
             sec.get("resnet50_fitscan")),
         row("ResNet-50 raw train step", sec.get("resnet50_rawstep")),
         row("BERT-base fine-tune, T=128", sec.get("bert")),
-        row("Transformer-LM 120M, T=1024 (remat-full + bf16-scores, b32)",
+        row("Transformer-LM 120M, T=1024 (flash + save-attn remat, b32)",
             sec.get("transformer")),
         row("Transformer-LM long context, T=4096 (flash attention)",
             sec.get("transformer_long")),
