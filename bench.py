@@ -389,6 +389,17 @@ def build_transformer(batch, cfg):
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)))
     tgt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)))
     flops = total_flops(raw_step, params, opt_state, ids, tgt)
+    # total_flops counts jaxpr dots — it cannot see inside a pallas_call,
+    # so when the flash kernel engages the attention matmuls are missing
+    # from the trace and every flash config at equal tokens/step traces
+    # the same count. Add the kernel's analytic train-path flops (fwd 2 +
+    # dq pass 3 + dkv pass 4 = 9 matmuls of 2*B*H*T*T*D, halved causal)
+    # so flash-row MFU counts the T^2 work actually done. The engagement
+    # test is the model's own gate (tfm.flash_engages), not a copy.
+    t = cfg.max_seq
+    if tfm.flash_engages(cfg, t):
+        per_matmul = 0.5 * 2.0 * batch * cfg.n_heads * t * t * cfg.head_dim
+        flops += 9 * per_matmul * cfg.n_layers
 
     def step_once(p, o):
         p, o, loss = jstep(p, o, ids, tgt)
@@ -439,6 +450,27 @@ def bench_transformer_long(batch, steps):
     timing = measure_marginal(run_chain, n1=3, n2=steps)
     return _record(
         "Transformer-LM long-context (120M, T=4096, flash attn) tokens/sec/chip",
+        "tokens/sec/chip", batch * cfg.max_seq, timing, flops,
+        batch=batch, seq=cfg.max_seq)
+
+
+def bench_transformer_xlong(batch, steps):
+    """Extra-long context: T=8192 (double transformer_long's T at the same
+    model). Pure flash-kernel territory — the XLA path's per-layer score
+    tensor would be 4 GB bf16 and measured 2.4x slower (43.7k tokens/s,
+    scripts/diag_attn_r5_out.json). save_attn remat keeps the b2
+    activations resident without re-running attention downstream."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
+                                n_layers=8, d_ff=2048, max_seq=8192,
+                                dtype=jnp.bfloat16, remat=True,
+                                remat_policy="save_attn")
+    run_chain, flops = build_transformer(batch, cfg)
+    timing = measure_marginal(run_chain, n1=3, n2=steps)
+    return _record(
+        "Transformer-LM extra-long context (120M, T=8192, flash attn)"
+        " tokens/sec/chip",
         "tokens/sec/chip", batch * cfg.max_seq, timing, flops,
         batch=batch, seq=cfg.max_seq)
 
@@ -694,6 +726,7 @@ CONFIGS = {
     "bert": bench_bert,
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
+    "transformer_xlong": bench_transformer_xlong,
     "dpoverhead": bench_dpoverhead,
 }
 
@@ -716,6 +749,7 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     # composed cell is captured by the official bench run itself
     "transformer": (32, 13),
     "transformer_long": (4, 9),   # 16k tokens/step (T=1024 runs 32k at b32)
+    "transformer_xlong": (2, 9),  # T=8192 b2 — same 16k tokens/step
     "dpoverhead": (1024, 20),
 }
 
@@ -813,10 +847,13 @@ def main():
     secondary = {}
     script = os.path.abspath(__file__)
     repo = os.path.dirname(script)
+    # transformer_xlong runs LAST: its T=8192 compile+run took ~10.5 min
+    # in the first capture — against the 1500 s budget it must not be able
+    # to starve the established rows of their slots.
     for name in ("lenet", "lenet_scan", "charnn", "bert", "transformer",
-                 "transformer_long", "dpoverhead", "resnet50_rawstep",
-                 "resnet50_fitscan",
-                 "charnn_f32"):
+                 "transformer_long", "dpoverhead",
+                 "resnet50_rawstep", "resnet50_fitscan",
+                 "charnn_f32", "transformer_xlong"):
         if time.perf_counter() - t_start > 1500:
             secondary[name] = {"skipped": "time budget"}
         else:

@@ -174,25 +174,33 @@ def _constrain(x, *spec):
         return x
 
 
+def flash_engages(cfg, t) -> bool:
+    """True when :func:`_attention` will run the pallas flash kernel for a
+    length-``t`` sequence under ``cfg`` — THE single gate, shared with the
+    bench's analytic flash-flops accounting (the kernel's matmuls are
+    invisible to jaxpr flop tracing). Explicit ``True`` engages the kernel
+    even off-TPU (interpret mode — slow but correct, and the only way CI
+    covers the branch); "auto" stays TPU-only. Single-chip only either
+    way: pallas_call has no SPMD partitioning rule, so a tp/sp-sharded
+    mesh keeps the XLA fused path (which shards). Ring attention wins
+    over flash when both are requested."""
+    if cfg.use_ring_attention or jax.device_count() != 1:
+        return False
+    if cfg.use_flash_attention is True:
+        return True
+    return (cfg.use_flash_attention == "auto" and t >= cfg.flash_min_seq
+            and jax.default_backend() == "tpu")
+
+
 def _attention(cfg, q, k, v, mask_bias=None):
     b, t = q.shape[0], q.shape[1]
     q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
     k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
     v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
-    want_flash = (cfg.use_flash_attention is True
-                  or (cfg.use_flash_attention == "auto"
-                      and t >= cfg.flash_min_seq))
     if cfg.use_ring_attention:
         from ..parallel.ring_attention import ring_attention_inner
         out = ring_attention_inner(q, k, v, causal=True)
-    elif (want_flash and jax.device_count() == 1
-          and (cfg.use_flash_attention is True
-               or jax.default_backend() == "tpu")):
-        # explicit True engages the kernel even off-TPU (interpret mode —
-        # slow but correct, and the only way CI covers this branch);
-        # "auto" stays TPU-only
-        # single-chip only: pallas_call has no SPMD partitioning rule, so a
-        # tp/sp-sharded mesh must keep the XLA fused path (which shards)
+    elif flash_engages(cfg, t):
         from ..kernels.flash_attention import flash_attention_ntc
         out = flash_attention_ntc(q, k, v, causal=True)
     elif cfg.attn_scores_bf16 and q.dtype == jnp.bfloat16:

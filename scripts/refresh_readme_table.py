@@ -45,9 +45,16 @@ def main():
         return 1
     sha = head.get("git_sha", "?")
     date = str(head.get("captured_at", ""))[:10]
+    # The provenance sentence must be true by construction: if any row was
+    # captured at a different sha than the headline, say so.
+    shas = {r.get("git_sha") for r in sec.values()
+            if isinstance(r, dict) and r.get("git_sha")} | {sha}
+    sha_note = f"`{sha}`" if len(shas) == 1 else \
+        "shas " + ", ".join(f"`{s}`" for s in sorted(shas)) + \
+        " (per-row `git_sha` in the artifact)"
     lines = [BEGIN,
              f"Current single-chip (v5e) numbers — captured {date} on the "
-             f"real chip at `{sha}`; every row is generated from "
+             f"real chip at {sha_note}; every row is generated from "
              "`bench_secondary.json` by `scripts/refresh_readme_table.py` "
              "(each record carries `captured_at` + `git_sha` + "
              "`backend: tpu`):",
@@ -67,6 +74,8 @@ def main():
             sec.get("transformer")),
         row("Transformer-LM long context, T=4096 (flash attention)",
             sec.get("transformer_long")),
+        row("Transformer-LM extra-long context, T=8192 (flash + save-attn)",
+            sec.get("transformer_xlong")),
         row("GravesLSTM char-RNN, bf16", sec.get("charnn")),
         row("GravesLSTM char-RNN, f32 (delta record)",
             sec.get("charnn_f32")),

@@ -94,7 +94,10 @@ def test_bench_resnet50_fit_path():
 
 def test_bench_transformer_long_step():
     """The T=4096-style config (flash+remat-dots) compiles and steps, at
-    toy shapes: flash path interpret-mode on CPU, remat=dots engaged."""
+    toy shapes. On the 8-device CI mesh the forced-flash gate falls back
+    to the XLA attention path (pallas has no SPMD rule) — the flash
+    kernel itself is covered in interpret mode by tests/test_kernels.py;
+    remat=dots is engaged either way."""
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo import transformer as tfm
     cfg = tfm.TransformerConfig(vocab_size=128, d_model=32, n_heads=2,
@@ -105,6 +108,33 @@ def test_bench_transformer_long_step():
     run_chain, flops = bench.build_transformer(batch=2, cfg=cfg)
     assert flops > 0
     _run_one(run_chain)
+
+
+def test_bench_transformer_xlong_step():
+    """The T=8192-style config combination (flash + save_attn remat)
+    compiles and steps at toy shapes, with checkpoint_name-pinned
+    attention outputs under jax.checkpoint. On the 8-device CI mesh
+    `flash_engages` is False (pallas has no SPMD rule), so the analytic
+    flash-flops top-up must NOT be added — the traced flops of the
+    forced-flash and no-flash configs must agree, keeping the top-up in
+    lockstep with the model's own gate."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    kw = dict(vocab_size=128, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+              max_seq=32, dtype=jnp.float32, remat=True,
+              remat_policy="save_attn")
+    cfg = tfm.TransformerConfig(use_flash_attention=True, **kw)
+    run_chain, flops = bench.build_transformer(batch=2, cfg=cfg)
+    assert flops > 0
+    _run_one(run_chain)
+    _, flops_noflash = bench.build_transformer(
+        batch=2, cfg=tfm.TransformerConfig(use_flash_attention=False, **kw))
+    assert tfm.flash_engages(cfg, cfg.max_seq) == (jax.device_count() == 1)
+    if tfm.flash_engages(cfg, cfg.max_seq):
+        assert flops > flops_noflash
+    else:
+        assert flops == flops_noflash
 
 
 def test_bench_lenet_scan_step():
