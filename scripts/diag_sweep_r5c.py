@@ -45,18 +45,9 @@ def bert(batch):
 
 
 def t8192(batch):
-    import jax.numpy as jnp
-    from deeplearning4j_tpu.zoo import transformer as tfm
-    cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
-                                d_ff=2048, n_layers=8, max_seq=8192,
-                                dtype=jnp.bfloat16, remat=True,
-                                remat_policy="save_attn")
-    run_chain, flops = bench.build_transformer(batch, cfg)
-    timing = bench.measure_marginal(run_chain, n1=3, n2=9)
-    rec = bench._record(f"t8192 b{batch} flash save-attn", "tokens/sec/chip",
-                        batch * cfg.max_seq, timing, flops,
-                        batch=batch, seq=cfg.max_seq)
-    emit(rec.pop("metric"), **rec)
+    # one source of truth: measure the EXACT benched config
+    rec = bench.bench_transformer_xlong(batch, 9)
+    emit(rec.pop("metric") + f" b{batch}", **rec)
 
 
 ARMS = {
