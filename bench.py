@@ -765,9 +765,8 @@ def _write_secondary(headline, secondary):
     that capture is preserved under `last_verified` — explicitly stamped
     with its own sha/timestamp, never masquerading as current."""
     import os
-    import pathlib
     out = {"headline": headline, "secondary": secondary}
-    path = pathlib.Path(__file__).with_name("bench_secondary.json")
+    path = _artifact_path()
     this_run_failed = (isinstance(headline, dict)
                        and headline.get("value") is None)
     if this_run_failed:
@@ -785,18 +784,41 @@ def _write_secondary(headline, secondary):
     os.replace(tmp, path)
 
 
+def _artifact_path():
+    import os
+    import pathlib
+    return pathlib.Path(os.environ.get(
+        "DL4J_TPU_BENCH_ARTIFACT",
+        pathlib.Path(__file__).with_name("bench_secondary.json")))
+
+
+def _run_row_subprocess(name):
+    """One secondary row in a fresh interpreter (isolation: residual
+    allocator/compile state measurably depresses shared-process configs).
+    Returns the row's record dict, or {"error": ...} on any failure."""
+    import os
+    import subprocess
+    script = os.path.abspath(__file__)
+    try:
+        proc = subprocess.run([sys.executable, script, "--model", name],
+                              capture_output=True, text=True,
+                              timeout=900, cwd=os.path.dirname(script))
+        if proc.returncode == 0 and proc.stdout.strip():
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+        return {"error": (proc.stdout + proc.stderr)[-500:]}
+    except Exception as e:  # noqa: BLE001 — callers keep other rows' records
+        return {"error": f"{type(e).__name__}: {e}"[:500]}
+
+
 def _refresh_rows(names):
     """Re-capture the named secondary rows into the existing artifact —
     the tool-supported way to redo a contaminated row (e.g. a CPU-mesh
     measurement taken while the host was loaded) without hand-editing
-    bench_secondary.json or paying for a full re-capture. Each row runs
-    in a fresh subprocess exactly as the full run does; the headline and
-    untouched rows keep their records."""
-    import os
-    import pathlib
-    import subprocess
-    path = pathlib.Path(__file__).with_name("bench_secondary.json")
-    art = json.loads(path.read_text())
+    bench_secondary.json or paying for a full re-capture. The headline
+    and untouched rows keep their records; a row whose re-capture FAILS
+    also keeps its previous record (the error goes to stderr only —
+    never overwrite a verified capture with an error entry)."""
+    art = json.loads(_artifact_path().read_text())
     headline = art.get("headline", {})
     secondary = art.get("secondary", {})
     if headline.get("value") is None:
@@ -804,24 +826,24 @@ def _refresh_rows(names):
               file=sys.stderr)
         return
     secondary.pop("_incomplete", None)  # a crashed full run may have left it
-    script = os.path.abspath(__file__)
     for name in names:
+        if name == "resnet50":
+            print("resnet50 is the headline row — run a full capture "
+                  "(python bench.py) to refresh it", file=sys.stderr)
+            continue
         if name not in CONFIGS:
             print(f"unknown row {name!r}", file=sys.stderr)
             continue
-        try:
-            proc = subprocess.run([sys.executable, script, "--model", name],
-                                  capture_output=True, text=True,
-                                  timeout=900, cwd=os.path.dirname(script))
-            if proc.returncode == 0 and proc.stdout.strip():
-                secondary[name] = json.loads(
-                    proc.stdout.strip().splitlines()[-1])
-            else:
-                secondary[name] = {"error": (proc.stdout + proc.stderr)[-500:]}
-        except Exception as e:  # noqa: BLE001 — keep the other rows' captures
-            secondary[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
-        print(f"[bench] {name}: "
-              f"{secondary[name].get('value', secondary[name])}",
+        rec = _run_row_subprocess(name)
+        if rec.get("value") is None and name in secondary \
+                and isinstance(secondary[name], dict) \
+                and secondary[name].get("value") is not None:
+            print(f"[bench] {name}: refresh FAILED "
+                  f"({rec.get('error', rec)!s:.200}); previous record kept",
+                  file=sys.stderr, flush=True)
+            continue
+        secondary[name] = rec
+        print(f"[bench] {name}: {rec.get('value', rec)}",
               file=sys.stderr, flush=True)
         _write_secondary(headline, secondary)  # write per row (crash safety)
 
@@ -889,12 +911,8 @@ def main():
     # from the headline (and from each other) measurably depresses the
     # later configs when they share a process (observed: charnn 2.9M vs
     # 4.7M tokens/s isolated).
-    import os
-    import subprocess
     t_start = time.perf_counter()
     secondary = {}
-    script = os.path.abspath(__file__)
-    repo = os.path.dirname(script)
     # transformer_xlong runs LAST: its T=8192 compile+run took ~10.5 min
     # in the first capture — against the 1500 s budget it must not be able
     # to starve the established rows of their slots.
@@ -905,18 +923,7 @@ def main():
         if time.perf_counter() - t_start > 1500:
             secondary[name] = {"skipped": "time budget"}
         else:
-            try:
-                proc = subprocess.run(
-                    [sys.executable, script, "--model", name],
-                    capture_output=True, text=True, timeout=900, cwd=repo)
-                if proc.returncode == 0 and proc.stdout.strip():
-                    secondary[name] = json.loads(
-                        proc.stdout.strip().splitlines()[-1])
-                else:
-                    secondary[name] = {
-                        "error": (proc.stdout + proc.stderr)[-500:]}
-            except Exception as e:  # noqa: BLE001 — record, don't kill headline
-                secondary[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
+            secondary[name] = _run_row_subprocess(name)
         print(f"[bench] {name}: "
               f"{secondary[name].get('value', secondary[name])}",
               file=sys.stderr, flush=True)

@@ -1,8 +1,12 @@
 """Train a small GPT-style transformer LM from the zoo.
 
-The zoo transformer ships the TPU-tuned defaults measured in r4: bf16
-compute, full rematerialization, bf16 score materialization, fused
-chunked LM cross-entropy (the (B,T,V) logits are never materialized).
+The zoo transformer ships the TPU-tuned defaults adjudicated on-chip
+(docs/PERF.md): bf16 compute, rematerialization (the "save_attn" policy
+pins attention outputs so backward skips re-running the T^2 op in the
+block's downstream recompute), fused chunked LM cross-entropy (the
+(B,T,V) logits are never materialized), bf16 score materialization on
+the XLA attention path, and — on a single real TPU at T>=1024 — the
+pallas flash attention kernel with grad-tuned block sizes.
 Run: python examples/transformer_lm.py [--smoke]
 """
 

@@ -163,29 +163,73 @@ def test_bench_main_backend_unavailable_path(tmp_path, monkeypatch, capsys):
     import pathlib
     import bench
 
-    # a verified-looking previous artifact
+    # a verified-looking previous artifact, isolated from the real one
     prev = {"headline": {"metric": "m", "value": 123.0, "git_sha": "abc"},
             "secondary": {}}
-    art = pathlib.Path(bench.__file__).with_name("bench_secondary.json")
-    original = art.read_text()
+    art = tmp_path / "bench_secondary.json"
     art.write_text(_json.dumps(prev))
-    try:
-        monkeypatch.setattr(bench, "wait_for_backend",
-                            lambda *a, **k: (False, "synthetic outage"))
-        import jax as _jax
+    monkeypatch.setenv("DL4J_TPU_BENCH_ARTIFACT", str(art))
+    monkeypatch.setattr(bench, "wait_for_backend",
+                        lambda *a, **k: (False, "synthetic outage"))
+    import jax as _jax
 
-        def _boom(*a, **k):  # backend must never be touched on this path
-            raise AssertionError("backend initialized on unavailable path")
-        monkeypatch.setattr(_jax, "default_backend", _boom)
-        monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
-        bench.main()
-        out = capsys.readouterr().out.strip().splitlines()
-        assert len(out) == 1
-        rec = _json.loads(out[0])
-        assert rec["backend_unavailable"] is True
-        assert rec["backend"] == "unavailable"
-        disk = _json.loads(art.read_text())
-        assert disk["headline"]["backend_unavailable"] is True
-        assert disk["last_verified"]["headline"]["value"] == 123.0
-    finally:
-        art.write_text(original)
+    def _boom(*a, **k):  # backend must never be touched on this path
+        raise AssertionError("backend initialized on unavailable path")
+    monkeypatch.setattr(_jax, "default_backend", _boom)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    rec = _json.loads(out[0])
+    assert rec["backend_unavailable"] is True
+    assert rec["backend"] == "unavailable"
+    disk = _json.loads(art.read_text())
+    assert disk["headline"]["backend_unavailable"] is True
+    assert disk["last_verified"]["headline"]["value"] == 123.0
+
+
+def test_bench_refresh_rows_isolated(tmp_path, monkeypatch, capsys):
+    """--refresh semantics without a chip: unknown rows never touch the
+    artifact; a row whose subprocess fails records an error entry while
+    every other row's record (and the headline) survives, and a stale
+    _incomplete marker from a crashed full run is cleared."""
+    import json as _json
+    import bench
+
+    art = tmp_path / "bench_secondary.json"
+    prev = {"headline": {"metric": "m", "value": 100.0, "git_sha": "abc"},
+            "secondary": {"lenet": {"value": 5.0, "git_sha": "abc"},
+                          "_incomplete": "run in progress"}}
+    art.write_text(_json.dumps(prev))
+    monkeypatch.setenv("DL4J_TPU_BENCH_ARTIFACT", str(art))
+
+    # unknown row: message, artifact byte-identical
+    before = art.read_text()
+    bench._refresh_rows(["nosuchrow"])
+    assert art.read_text() == before
+
+    # the headline row is not refreshable in place
+    bench._refresh_rows(["resnet50"])
+    assert art.read_text() == before
+
+    # a failing re-capture of a VERIFIED row keeps the previous record
+    # (never overwrite a good capture with an error entry)
+    monkeypatch.setitem(bench.CONFIGS, "lenet", lambda b, s: {})
+    monkeypatch.setitem(bench.DEFAULTS, "lenet", (1, 1))
+    with monkeypatch.context() as m:
+        m.setattr(bench, "_run_row_subprocess",
+                  lambda name: {"error": "synthetic subprocess failure"})
+        bench._refresh_rows(["lenet"])
+    disk = _json.loads(art.read_text())
+    assert disk == prev  # untouched: failed refresh never persisted
+
+    # a row that exists in-process but fails in the fresh subprocess,
+    # with NO previous record: the error entry is recorded
+    monkeypatch.setitem(bench.CONFIGS, "synthetic_fail", lambda b, s: {})
+    monkeypatch.setitem(bench.DEFAULTS, "synthetic_fail", (1, 1))
+    bench._refresh_rows(["synthetic_fail"])
+    disk = _json.loads(art.read_text())
+    assert disk["headline"]["value"] == 100.0           # headline kept
+    assert disk["secondary"]["lenet"]["value"] == 5.0   # other rows kept
+    assert "error" in disk["secondary"]["synthetic_fail"]
+    assert "_incomplete" not in disk["secondary"]       # marker cleared
