@@ -458,14 +458,14 @@ def bench_transformer_xlong(batch, steps):
     """Extra-long context: T=8192 (double transformer_long's T at the same
     model). Pure flash-kernel territory — the XLA path's per-layer score
     tensor would be 4 GB bf16 and measured 2.4x slower (43.7k tokens/s,
-    scripts/diag_attn_r5_out.json). save_attn remat keeps the b2
-    activations resident without re-running attention downstream."""
+    scripts/diag_attn_r5_out.json). Same lesson as T=4096: with scores
+    streamed through VMEM the activations fit HBM without remat — b4
+    remat-off measured 112.2k tokens/s vs 107k for b2 save_attn."""
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo import transformer as tfm
     cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
                                 n_layers=8, d_ff=2048, max_seq=8192,
-                                dtype=jnp.bfloat16, remat=True,
-                                remat_policy="save_attn")
+                                dtype=jnp.bfloat16, remat=False)
     run_chain, flops = build_transformer(batch, cfg)
     timing = measure_marginal(run_chain, n1=3, n2=steps)
     return _record(
@@ -749,7 +749,7 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     # composed cell is captured by the official bench run itself
     "transformer": (32, 13),
     "transformer_long": (4, 9),   # 16k tokens/step (T=1024 runs 32k at b32)
-    "transformer_xlong": (2, 9),  # T=8192 b2 — same 16k tokens/step
+    "transformer_xlong": (4, 9),  # T=8192 b4 remat-off — 32k tokens/step
     "dpoverhead": (1024, 20),
 }
 

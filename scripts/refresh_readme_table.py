@@ -74,7 +74,7 @@ def main():
             sec.get("transformer")),
         row("Transformer-LM long context, T=4096 (flash attention)",
             sec.get("transformer_long")),
-        row("Transformer-LM extra-long context, T=8192 (flash + save-attn)",
+        row("Transformer-LM extra-long context, T=8192 (flash, remat-off)",
             sec.get("transformer_xlong")),
         row("GravesLSTM char-RNN, bf16", sec.get("charnn")),
         row("GravesLSTM char-RNN, f32 (delta record)",

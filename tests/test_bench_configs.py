@@ -111,19 +111,25 @@ def test_bench_transformer_long_step():
 
 
 def test_bench_transformer_xlong_step():
-    """The T=8192-style config combination (flash + save_attn remat)
-    compiles and steps at toy shapes, with checkpoint_name-pinned
-    attention outputs under jax.checkpoint. On the 8-device CI mesh
-    `flash_engages` is False (pallas has no SPMD rule), so the analytic
-    flash-flops top-up must NOT be added — the traced flops of the
-    forced-flash and no-flash configs must agree, keeping the top-up in
-    lockstep with the model's own gate."""
+    """The benched T=8192-style combination (flash + remat OFF — the
+    xlong row) and the flash + save_attn policy both compile and step at
+    toy shapes. On the 8-device CI mesh `flash_engages` is False (pallas
+    has no SPMD rule), so the analytic flash-flops top-up must NOT be
+    added — the traced flops of the forced-flash and no-flash configs
+    must agree, keeping the top-up in lockstep with the model's gate."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo import transformer as tfm
-    kw = dict(vocab_size=128, d_model=32, n_heads=2, n_layers=2, d_ff=64,
-              max_seq=32, dtype=jnp.float32, remat=True,
-              remat_policy="save_attn")
+    base = dict(vocab_size=128, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_seq=32, dtype=jnp.float32)
+    # the benched xlong combination: flash forced, remat off
+    cfg_benched = tfm.TransformerConfig(use_flash_attention=True,
+                                        remat=False, **base)
+    run_chain, flops = bench.build_transformer(batch=2, cfg=cfg_benched)
+    assert flops > 0
+    _run_one(run_chain)
+    # the save_attn policy combination (T=1024-row style remat)
+    kw = dict(remat=True, remat_policy="save_attn", **base)
     cfg = tfm.TransformerConfig(use_flash_attention=True, **kw)
     run_chain, flops = bench.build_transformer(batch=2, cfg=cfg)
     assert flops > 0
