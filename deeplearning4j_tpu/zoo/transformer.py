@@ -315,12 +315,19 @@ def _moe_mlp(cfg, x, router, we_in, we_out):
     return out.reshape(b, t, d), aux.astype(jnp.float32)
 
 
-def embed(params, cfg: TransformerConfig, ids):
-    """ids (B,T) → embedded activations (B,T,d) in compute dtype."""
+def embed(params, cfg: TransformerConfig, ids, pos_offset=0):
+    """ids (B,T) → embedded activations (B,T,d) in compute dtype.
+
+    ``pos_offset`` (static or traced int) shifts the learned position
+    table — required when the SEQUENCE is explicitly sharded (shard_map
+    ring step): shard i holds global positions [i·T_local, (i+1)·T_local)
+    but sees a local (B, T_local) slice."""
     t = ids.shape[1]
     x = jnp.take(params["embed"], ids, axis=0).astype(cfg.dtype)
     x = x * math.sqrt(cfg.d_model)
-    x = x + params["pos_embed"][:t].astype(cfg.dtype)
+    pos = lax.dynamic_slice_in_dim(params["pos_embed"],
+                                   pos_offset, t, axis=0)
+    x = x + pos.astype(cfg.dtype)
     return _constrain(x, "dp", "sp", None)
 
 
@@ -369,9 +376,10 @@ def apply_blocks(blocks, cfg: TransformerConfig, x):
     return x, jnp.sum(auxes)
 
 
-def forward(params, cfg: TransformerConfig, ids, *, train=False, rng=None):
+def forward(params, cfg: TransformerConfig, ids, *, train=False, rng=None,
+            pos_offset=0):
     """ids (B, T) int32 → logits (B, T, vocab). Returns (logits, aux_loss)."""
-    x = embed(params, cfg, ids)
+    x = embed(params, cfg, ids, pos_offset)
     x, aux = apply_blocks(params["blocks"], cfg, x)
     return head_logits(params, cfg, x), aux
 
@@ -427,17 +435,18 @@ def _chunked_ce(x, head, targets, chunk, weights=None, bias=None):
     return total
 
 
-def lm_loss(params, cfg: TransformerConfig, ids, targets, *, aux_weight=1e-2):
+def lm_loss(params, cfg: TransformerConfig, ids, targets, *, aux_weight=1e-2,
+            pos_offset=0):
     b, t = ids.shape
     if _use_fused_loss(cfg, b * t):
-        x = embed(params, cfg, ids)
+        x = embed(params, cfg, ids, pos_offset)
         x, aux = apply_blocks(params["blocks"], cfg, x)
         x = _rmsnorm(x, params["ln_f"])
         head = _resolve_head(params, cfg)
         nll = _chunked_ce(x.reshape(b * t, -1), head.astype(x.dtype),
                           targets.reshape(b * t), cfg.loss_chunk) / (b * t)
         return nll + aux_weight * aux
-    logits, aux = forward(params, cfg, ids, train=True)
+    logits, aux = forward(params, cfg, ids, train=True, pos_offset=pos_offset)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), -1)[..., 0]
     return nll.mean() + aux_weight * aux
@@ -455,6 +464,66 @@ def make_train_step(cfg: TransformerConfig, optimizer):
         return params, opt_state, loss
 
     return step
+
+
+def make_ring_train_step(cfg: TransformerConfig, optimizer, mesh: Mesh):
+    """Training step with EXPLICIT ring sequence parallelism: the whole
+    loss+grad runs under ``shard_map`` over the mesh's ('dp', 'sp') axes.
+    Data (B, T) is sharded batch-over-dp and SEQUENCE-over-sp; params and
+    optimizer state are replicated. Inside the mapped region
+    `cfg.use_ring_attention` routes attention onto the ppermute ring
+    (parallel/ring_attention.py — the (T,T) score matrix never exists on
+    any one device), the position table is indexed at each shard's global
+    offset, and loss/grads are pmean'd over both axes so the update is
+    identical to a monolithic step up to float reassociation.
+
+    Dense blocks only (MoE expert dispatch needs the 'ep' axis plumbing
+    of the GSPMD path); requires cfg.use_ring_attention=True so the
+    single-device fallback of `_attention` can never silently run full
+    attention per shard."""
+    if not cfg.use_ring_attention:
+        raise ValueError("make_ring_train_step requires "
+                         "cfg.use_ring_attention=True")
+    if getattr(cfg, "n_experts", 0):
+        raise NotImplementedError(
+            "ring step is dense-only; MoE routes through the GSPMD path "
+            "(make_train_step under jit with shardings_for)")
+    from jax import shard_map
+    import optax as _optax
+
+    def local_step(params, opt_state, ids, targets):
+        t_local = ids.shape[1]
+        pos_offset = lax.axis_index("sp") * t_local
+
+        def loss_fn(p):
+            return lm_loss(p, cfg, ids, targets, pos_offset=pos_offset)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss = lax.pmean(loss, ("dp", "sp"))
+        grads = lax.pmean(grads, ("dp", "sp"))
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = _optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def step(params, opt_state, ids, targets):
+        # dynamic_slice would silently CLAMP an out-of-table position
+        # offset (shards would reuse the last rows instead of failing the
+        # way the monolithic path does) — reject at trace time instead
+        if ids.shape[1] > cfg.max_seq:
+            raise ValueError(
+                f"global sequence length {ids.shape[1]} exceeds "
+                f"cfg.max_seq={cfg.max_seq}: position offsets past the "
+                "table would clamp, not error")
+        rep = jax.tree_util.tree_map(lambda _: P(), params)
+        rep_opt = jax.tree_util.tree_map(lambda _: P(), opt_state)
+        return shard_map(
+            local_step, mesh=mesh,
+            in_specs=(rep, rep_opt, P("dp", "sp"), P("dp", "sp")),
+            out_specs=(rep, rep_opt, P()),
+            check_vma=False,  # optax update replication is data-dependent
+        )(params, opt_state, ids, targets)
+
+    return jax.jit(step, donate_argnums=(0, 1))
 
 
 # ------------------------------------------------------------- BERT family
