@@ -202,7 +202,38 @@ def _record(metric, unit, samples_per_step, timing, flops_per_step,
     if not valid or (rec["mfu"] is not None and rec["mfu"] > 1.0):
         rec["timing_valid"] = False
     rec.update(extra)
+    _emit_row_metrics(rec)
     return _stamp(rec)
+
+
+def _emit_row_metrics(rec):
+    """Telemetry-plane mirror of a bench row: observe the row into the
+    process-wide dl4j_ registry AND embed the same schema beside the
+    record, so the floor table (ROADMAP item 5) and a live /metrics
+    scrape read identical names. Never fatal — a telemetry failure must
+    not cost a captured row."""
+    try:
+        from deeplearning4j_tpu.obs import get_registry
+        reg = get_registry()
+        config = rec["metric"]
+        step_s = rec["step_time_ms"] / 1e3
+        reg.histogram("dl4j_bench_step_seconds",
+                      "Measured marginal step time per bench row",
+                      labelnames=("config",)).observe(step_s, config=config)
+        reg.gauge("dl4j_bench_throughput",
+                  "Bench row value in the row's own unit",
+                  labelnames=("config", "unit")).set(
+            rec["value"], config=config, unit=rec["unit"])
+        metrics = {"dl4j_bench_step_seconds": step_s,
+                   "dl4j_bench_throughput": rec["value"]}
+        if rec.get("mfu") is not None:
+            reg.gauge("dl4j_bench_mfu",
+                      "Bench row model-flops utilization",
+                      labelnames=("config",)).set(rec["mfu"], config=config)
+            metrics["dl4j_bench_mfu"] = rec["mfu"]
+        rec["metrics"] = metrics
+    except Exception:  # noqa: BLE001 — decoration only
+        pass
 
 
 def _mln_chain(net, x, y):
@@ -396,6 +427,13 @@ def build_transformer(batch, cfg):
     # dq pass 3 + dkv pass 4 = 9 matmuls of 2*B*H*T*T*D, halved causal)
     # so flash-row MFU counts the T^2 work actually done. The engagement
     # test is the model's own gate (tfm.flash_engages), not a copy.
+    # Known asymmetry (ADVICE r5 #2): under remat the pallas fwd re-runs
+    # to rebuild vjp residuals (~2 extra matmuls/layer for save_attn and
+    # full alike), which this top-up does NOT count — while the XLA
+    # path's remat recompute IS in the jaxpr and counted. Flash rows'
+    # MFU is therefore slightly UNDERstated relative to XLA rows when
+    # cfg.remat is on; left uncounted deliberately (conservative skew —
+    # the flash wins in PERF.md survive the handicap).
     t = cfg.max_seq
     if tfm.flash_engages(cfg, t):
         per_matmul = 0.5 * 2.0 * batch * cfg.n_heads * t * t * cfg.head_dim
@@ -804,7 +842,13 @@ def _run_row_subprocess(name):
                               capture_output=True, text=True,
                               timeout=900, cwd=os.path.dirname(script))
         if proc.returncode == 0 and proc.stdout.strip():
-            return json.loads(proc.stdout.strip().splitlines()[-1])
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
+            if not isinstance(rec, dict):
+                # a stray print can make the last stdout line parse to a
+                # non-dict JSON value; callers rec.get() — never hand one
+                # back (ADVICE r5 #3: it aborted the remaining rows)
+                return {"error": f"non-dict record: {rec!r:.200}"}
+            return rec
         return {"error": (proc.stdout + proc.stderr)[-500:]}
     except Exception as e:  # noqa: BLE001 — callers keep other rows' records
         return {"error": f"{type(e).__name__}: {e}"[:500]}

@@ -1,0 +1,42 @@
+"""Version shims over the moving parts of the jax API surface.
+
+The code in this repo is written against the modern spellings
+(``jax.shard_map`` with ``check_vma=``, ``lax.axis_size``); older
+runtimes — the pinned container image runs jax 0.4.37 — only have
+``jax.experimental.shard_map.shard_map`` with ``check_rep=`` and no
+``lax.axis_size`` at all. Every internal user imports through this
+module so the mapping lives in exactly one place. Dependency-free and
+package-level on purpose: ``parallel``, ``zoo`` and ``serving`` all
+reach it as ``from .._jax_compat import shard_map``.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+    _MODERN = True
+except ImportError:  # jax < 0.6: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _MODERN = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+    """``jax.shard_map`` accepting the modern ``check_vma`` kwarg on any
+    jax: on old runtimes it is passed through as ``check_rep`` (same
+    meaning — disable the replication/varying-manual-axes check)."""
+    if not _MODERN and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` (modern) — spelled ``psum(1, axis)`` on runtimes
+    that predate it (a python-int psum constant-folds to the STATIC axis
+    size, so loop bounds and permutations stay trace-time constants).
+    Valid only inside a mapped (shard_map/pmap) region, same as the real
+    thing."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

@@ -29,6 +29,22 @@ def _disk_cache() -> dict:
         return {}
 
 
+def _entry_choice(entry):
+    """Disk entries are either the bare choice list (legacy) or a
+    ``{"choice": [...], "meta": {...}}`` record with measurement
+    provenance (TVM cost-record discipline: every cached verdict says
+    when and from what measurements it was reached)."""
+    return tuple(entry["choice"]) if isinstance(entry, dict) \
+        else tuple(entry)
+
+
+def measurement_meta(key: str) -> Optional[dict]:
+    """The measurement provenance recorded for `key`, or None (cache
+    miss / legacy entry)."""
+    entry = _disk_cache().get(key)
+    return entry.get("meta") if isinstance(entry, dict) else None
+
+
 def _save_disk_cache(cache: dict):
     try:
         _CACHE_PATH.parent.mkdir(parents=True, exist_ok=True)
@@ -76,11 +92,19 @@ def autotune(key: str, candidates: Iterable[Tuple],
     invalid for the shape. With enabled=False (or when every candidate
     fails) the FIRST valid candidate is returned untimed.
     """
+    from ..obs import get_registry
+    reg = get_registry()
     if key in _memory_cache:
+        reg.counter("dl4j_autotune_cache_hits_total",
+                    "Autotune lookups served from cache",
+                    labelnames=("level",)).inc(level="memory")
         return _memory_cache[key]
     disk = _disk_cache()
     if key in disk:
-        choice = tuple(disk[key])
+        reg.counter("dl4j_autotune_cache_hits_total",
+                    "Autotune lookups served from cache",
+                    labelnames=("level",)).inc(level="disk")
+        choice = _entry_choice(disk[key])
         _memory_cache[key] = choice
         return choice
 
@@ -90,20 +114,35 @@ def autotune(key: str, candidates: Iterable[Tuple],
         _memory_cache[key] = choice
         return choice
 
+    m_measure = reg.counter("dl4j_autotune_measurements_total",
+                            "Candidate configs timed on the device")
+    m_time = reg.histogram("dl4j_autotune_candidate_seconds",
+                           "Marginal per-call seconds of timed candidates")
     best, best_t = None, float("inf")
+    measurements = []   # per-candidate provenance for the disk record
     for cand in candidates:
         run = make_run(cand)
-        if run is None:
+        if run is None:                     # invalid for the shape
+            measurements.append([list(cand), None])
             continue
         try:
             t = _time_once(run)
         except Exception:  # noqa: BLE001 — config doesn't compile/fit VMEM
+            measurements.append([list(cand), None])
             continue
+        m_measure.inc()
+        m_time.observe(t)
+        measurements.append([list(cand), t])
         if t < best_t:
             best, best_t = cand, t
     if best is None:
         best = candidates[0]
     _memory_cache[key] = best
-    disk[key] = list(best)
+    disk[key] = {"choice": list(best),
+                 "meta": {"measured_at": time.time(),
+                          "best_s": None if best_t == float("inf")
+                          else best_t,
+                          "candidates": len(candidates),
+                          "measurements": measurements}}
     _save_disk_cache(disk)
     return best

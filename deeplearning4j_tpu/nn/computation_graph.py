@@ -648,6 +648,8 @@ class ComputationGraph:
                     fmask, lmask = ds.features_mask, ds.labels_mask
                 inputs = {n: jnp.asarray(f) for n, f in zip(self.conf.inputs, feats)}
                 labels = {n: jnp.asarray(l) for n, l in zip(self.conf.outputs, labs)}
+                # examples-throughput telemetry (MetricsListener)
+                self._last_batch_size = int(next(iter(inputs.values())).shape[0])
                 fm = None if fmask is None else jnp.asarray(fmask)
                 lm = None if lmask is None else jnp.asarray(lmask)
                 (self.params, self.states, self._opt_state, loss, gstats,

@@ -168,6 +168,88 @@ class NanScoreWatchdog(TrainingListener):
                     f"NaN/Inf score at iteration {iteration}: {score}")
 
 
+class MetricsListener(TrainingListener):
+    """Telemetry-plane listener: feeds the process-wide ``obs`` registry
+    (step-time histogram, loss, examples/s, device memory) so a running
+    fit is scrapeable at ``GET /metrics`` on the UI server.
+
+    Budgeted: the whole body is increments + one histogram observe on
+    host between steps (~µs); its own cumulative cost is exported as
+    ``dl4j_obs_overhead_seconds_total`` and tests/test_obs.py pins it
+    under 2% of the step time on the tier-1 CPU path. Device-memory
+    stats are polled every ``memory_frequency`` iterations only (the
+    one call that can cost >µs, and None off-TPU)."""
+
+    deferred_score_ok = True  # pure metrics: fit() may report the
+    # (step, score) pair one dispatch late to keep the device busy
+
+    def __init__(self, registry=None, memory_frequency: int = 50):
+        from ..obs import get_registry
+        reg = registry or get_registry()
+        self.registry = reg
+        self.memory_frequency = max(1, memory_frequency)
+        self._step_seconds = reg.histogram(
+            "dl4j_train_step_seconds",
+            "Wall time between training iterations (host-observed)")
+        self._iterations = reg.counter(
+            "dl4j_train_iterations_total", "Optimizer steps taken")
+        self._examples = reg.counter(
+            "dl4j_train_examples_total", "Training examples consumed")
+        self._epochs = reg.counter(
+            "dl4j_train_epochs_total", "Epochs completed")
+        self._loss = reg.gauge("dl4j_train_loss", "Last reported score")
+        self._eps = reg.gauge(
+            "dl4j_train_examples_per_second",
+            "Examples/s over the last inter-iteration interval")
+        self._mem = reg.gauge(
+            "dl4j_device_memory_bytes",
+            "jax device memory stats (polled every memory_frequency "
+            "iterations; absent on backends without memory_stats)",
+            labelnames=("stat",))
+        self._overhead = reg.counter(
+            "dl4j_obs_overhead_seconds_total",
+            "Cumulative host time spent inside MetricsListener "
+            "(budget: <2% of step time, tests/test_obs.py)")
+        self._last_t: Optional[float] = None
+
+    @property
+    def overhead_seconds(self) -> float:
+        return self._overhead.value()
+
+    def _poll_memory(self):
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 — memory stats are decoration
+            return
+        if not stats:
+            return
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                self._mem.set(float(stats[key]), stat=key)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        t0 = time.perf_counter()
+        batch = getattr(model, "_last_batch_size", None)
+        if self._last_t is not None:
+            dt = t0 - self._last_t
+            self._step_seconds.observe(dt)
+            if batch and dt > 0:
+                self._eps.set(batch / dt)
+        self._last_t = t0
+        self._iterations.inc()
+        if batch:
+            self._examples.inc(batch)
+        self._loss.set(float(score))
+        if iteration % self.memory_frequency == 0:
+            self._poll_memory()
+        self._overhead.inc(time.perf_counter() - t0)
+
+    def on_epoch_end(self, model):
+        self._epochs.inc()
+        self._last_t = None  # epoch boundary work is not a step interval
+
+
 class StatsListener(TrainingListener):
     """Training-UI analogue (reference StatsListener + UIServer): score,
     learning rate and per-layer update:param ratios — DL4J's headline

@@ -453,6 +453,8 @@ class MultiLayerNetwork:
                 for ds in run_iter:
                     x = jnp.asarray(ds.features)
                     y = jnp.asarray(ds.labels)
+                    # examples-throughput telemetry (MetricsListener)
+                    self._last_batch_size = int(x.shape[0])
                     fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
                     lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
                     (self.params, self.states, self._opt_state, loss, gstats,

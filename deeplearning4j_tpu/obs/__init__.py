@@ -1,0 +1,36 @@
+"""Unified telemetry plane (ISSUE 6): one process-wide metrics registry
+(counters / gauges / histograms, Prometheus text exposition on the UI
+server's ``/metrics``), and a span tracer whose context propagates over
+the scaleout wire so master rounds and worker fits stitch into one
+trace tree.
+
+Instrumented surfaces (all under the ``dl4j_`` namespace —
+``scripts/check_metric_names.py`` lints the sites):
+
+- ``nn.listeners.MetricsListener`` — train-step histogram, loss,
+  examples/s, device memory.
+- ``parallel.wrapper.ParallelInference`` — batch-occupancy gauge,
+  queue-wait histogram (the serving plane inherits these).
+- ``parallel.scaleout`` — round counters + stitched spans.
+- ``kernels.autotune`` — per-candidate measurement provenance.
+- ``bench.py`` — each row emits the same schema beside the record.
+"""
+
+from .registry import (Counter, DEFAULT_BUCKETS, Gauge,  # noqa: F401
+                       Histogram, MetricsRegistry)
+from .spans import (Span, SpanContext, Tracer, derived_span_id,  # noqa: F401
+                    get_tracer, load_spans, span)
+
+_registry = MetricsRegistry(namespace="dl4j")
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every built-in instrumentation site
+    writes to and ``/metrics`` exposes."""
+    return _registry
+
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "DEFAULT_BUCKETS", "get_registry", "Span", "SpanContext",
+           "Tracer", "get_tracer", "derived_span_id", "load_spans",
+           "span"]

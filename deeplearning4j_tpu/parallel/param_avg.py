@@ -25,7 +25,7 @@ import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .._jax_compat import shard_map
 
 from .mesh import data_parallel_mesh
 

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .._jax_compat import shard_map
 
 
 def _xla_attn_lse(q, k, v, causal):
@@ -87,7 +87,8 @@ def ring_attention_sharded(q, k, v, axis_name: str = "sp",
                            interpret=None):
     """Runs INSIDE shard_map: q/k/v are the local sequence shard
     (B, T_local, H, D). Exact causal attention across the full sequence."""
-    n = lax.axis_size(axis_name)
+    from .._jax_compat import axis_size
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     t_local = q.shape[1]
     flash = _use_flash(use_flash, t_local)

@@ -20,17 +20,26 @@ address; compose with ``bootstrap_distributed`` when each worker is
 itself a multi-chip jax.distributed process).
 
 Wire protocol (little-endian), one frame per message:
-  uint8   kind (0 = params, 1 = done)
+  uint8   kind (0 = params, 1 = done, 2 = hello, 3 = span context)
   uint32  payload byte length
   float32[] flat parameter vector (kind 0 only)
 Each round the hub averages the params frames of every LIVE worker and
 sends the mean back to those workers. Workers that disconnect, error, or
 time out are dropped from the job with a warning — training continues
 with the survivors.
+
+Telemetry (deeplearning4j_tpu.obs): the hub counts rounds / drops /
+live workers under ``dl4j_scaleout_*``, and span context propagates
+master -> worker over the wire (the hub answers every HELLO with a
+KIND_SPANCTX frame): the job root span, each averaging round's span
+(deterministic id ``derived_span_id(trace, "round", k)``), and every
+worker's fit spans parented under that round stitch into ONE trace
+tree, exportable as JSONL via ``obs.get_tracer().export_jsonl``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import struct
 import threading
@@ -40,13 +49,18 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from .transport import Address, _make_socket, _recv_exact
+from ..obs import SpanContext, derived_span_id, get_registry, get_tracer
+from .transport import (Address, _make_socket, _recv_exact,
+                        pack_span_context, unpack_span_context)
 
 _FHDR = struct.Struct("<BI")      # kind, payload bytes
 KIND_PARAMS = 0
 KIND_DONE = 1
 KIND_HELLO = 2    # uint32 worker id — sent once on connect, so the hub's
 # worker labels are the CALLER's ids, not TCP accept order
+KIND_SPANCTX = 3  # hub -> worker right after HELLO: the master's span
+# context header (empty payload = tracing off) — workers parent their
+# fit spans into the master's trace tree
 
 
 def _send(conn: socket.socket, kind: int, payload: bytes = b""):
@@ -92,10 +106,12 @@ class ParamAveragingHub:
 
     def __init__(self, n_workers: int, address: Address = ("127.0.0.1", 0),
                  worker_timeout: float = 120.0,
-                 on_round: Optional[Callable[[np.ndarray, int], None]] = None):
+                 on_round: Optional[Callable[[np.ndarray, int], None]] = None,
+                 span_ctx=None):
         self.n_workers = n_workers
         self.worker_timeout = worker_timeout
         self.on_round = on_round
+        self.span_ctx = span_ctx  # master trace context, sent to workers
         self._sock = _make_socket(address)
         if not isinstance(address, str):
             self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -114,6 +130,13 @@ class ParamAveragingHub:
         return self
 
     def _serve(self):
+        reg = get_registry()
+        m_rounds = reg.counter("dl4j_scaleout_rounds_total",
+                               "Parameter-averaging rounds completed")
+        m_dropped = reg.counter("dl4j_scaleout_workers_dropped_total",
+                                "Workers dropped mid-job")
+        m_live = reg.gauge("dl4j_scaleout_live_workers",
+                           "Workers currently in the averaging round")
         conns = {}
         try:
             self._sock.settimeout(self.worker_timeout)
@@ -126,42 +149,65 @@ class ParamAveragingHub:
                 while wid in conns:    # duplicate/defaulted ids stay unique
                     wid += self.n_workers
                 conns[wid] = conn
+                # reply with the master's trace context (empty = off)
+                _send(conn, KIND_SPANCTX, pack_span_context(self.span_ctx))
         except (OSError, socket.timeout, ConnectionError):
             pass      # provision what arrived; 0 workers handled below
         live = dict(conns)
+        m_live.set(len(live))
         mean = None
+        tracer = get_tracer()
         while live:
-            frames = {}
-            done_now = []
-            for wid, conn in list(live.items()):
-                try:
-                    kind, payload = _recv(conn)
-                except (ConnectionError, socket.timeout, OSError):
-                    warnings.warn(f"scaleout: worker {wid} failed mid-job — "
-                                  "continuing with the survivors")
-                    self.dropped.append(wid)
-                    del live[wid]
-                    continue
-                if kind == KIND_DONE:
-                    done_now.append(wid)
-                    del live[wid]
-                else:
-                    frames[wid] = np.frombuffer(payload, np.float32)
-            if frames:
-                mean = np.mean(list(frames.values()), axis=0)
-                self._final = mean
-                blob = mean.astype(np.float32).tobytes()
-                for wid in list(frames):
+            # the round span opens when the hub starts gathering and has
+            # the DETERMINISTIC id round k+1 — workers parent the fits
+            # feeding round k+1 to the same id without a wire round-trip
+            rnd = self.rounds + 1
+            span_kw = {"parent": self.span_ctx} if self.span_ctx else {}
+            rid = None if self.span_ctx is None else derived_span_id(
+                self.span_ctx.trace_id, "round", rnd)
+            with tracer.span("scaleout_round", attrs={"round": rnd},
+                             span_id=rid, **span_kw) as round_span:
+                frames = {}
+                for wid, conn in list(live.items()):
                     try:
-                        _send(live[wid], KIND_PARAMS, blob)
-                    except (ConnectionError, OSError):
-                        warnings.warn(f"scaleout: worker {wid} failed at "
-                                      "broadcast — dropping")
+                        kind, payload = _recv(conn)
+                    except (ConnectionError, socket.timeout, OSError):
+                        warnings.warn(
+                            f"scaleout: worker {wid} failed mid-job — "
+                            "continuing with the survivors")
                         self.dropped.append(wid)
+                        m_dropped.inc()
                         del live[wid]
-                self.rounds += 1
-                if self.on_round is not None:
-                    self.on_round(mean, self.rounds)
+                        continue
+                    if kind == KIND_DONE:
+                        del live[wid]
+                    else:
+                        frames[wid] = np.frombuffer(payload, np.float32)
+                m_live.set(len(live))
+                if frames:
+                    mean = np.mean(list(frames.values()), axis=0)
+                    self._final = mean
+                    blob = mean.astype(np.float32).tobytes()
+                    for wid in list(frames):
+                        try:
+                            _send(live[wid], KIND_PARAMS, blob)
+                        except (ConnectionError, OSError):
+                            warnings.warn(f"scaleout: worker {wid} failed at "
+                                          "broadcast — dropping")
+                            self.dropped.append(wid)
+                            m_dropped.inc()
+                            del live[wid]
+                    self.rounds += 1
+                    m_rounds.inc()
+                    m_live.set(len(live))   # broadcast may have dropped
+                    round_span.set_attr("workers", len(frames))
+                    if self.on_round is not None:
+                        self.on_round(mean, self.rounds)
+                else:
+                    # every worker finished/died before sending params:
+                    # not an averaging round — keep it out of the trace
+                    round_span.set_attr("empty", True)
+        m_live.set(0)
         for conn in conns.values():
             try:
                 conn.close()
@@ -189,6 +235,12 @@ class WorkerClient:
         self._sock.connect(tuple(address) if not isinstance(address, str)
                            else address)
         _send(self._sock, KIND_HELLO, struct.pack("<I", int(worker_id)))
+        # the hub answers every HELLO with the master's span context
+        # (empty payload when tracing is off) — adopt it so this
+        # worker's fit spans join the master's trace tree
+        kind, payload = _recv(self._sock)
+        self.span_ctx = unpack_span_context(payload) \
+            if kind == KIND_SPANCTX else None
 
     def average(self, flat: np.ndarray) -> np.ndarray:
         _send(self._sock, KIND_PARAMS,
@@ -215,12 +267,32 @@ def worker_main(address: Address, net, datasets: Sequence,
     thread, subprocess, or remote-host execution — only ``address``
     changes. ``fail_after_steps`` is a fault-injection hook for tests."""
     client = WorkerClient(address, worker_id=worker_id)
+    tracer = get_tracer()
+    ctx = client.span_ctx
+
+    def fit_span(step):
+        """Span for the fit feeding averaging round step//freq (+1):
+        parented to the ROUND's deterministic id, so the exported tree
+        reads master job -> round k -> this worker's fits."""
+        if ctx is None:
+            return contextlib.nullcontext()
+        rnd = step // averaging_frequency + 1
+        parent = SpanContext(ctx.trace_id,
+                             derived_span_id(ctx.trace_id, "round", rnd))
+        return tracer.span("scaleout_worker_fit", parent=parent,
+                           attrs={"worker": worker_id, "round": rnd,
+                                  "step": step + 1})
+
     step = 0
     try:
         for _ in range(epochs):
             for ds in datasets:
-                net.fit(ds)
+                with fit_span(step):
+                    net.fit(ds)
                 step += 1
+                get_registry().counter(
+                    "dl4j_scaleout_worker_steps_total",
+                    "Fit steps taken by scaleout workers").inc()
                 if fail_after_steps is not None and step >= fail_after_steps:
                     raise RuntimeError("injected worker failure")
                 if step % averaging_frequency == 0:
@@ -288,31 +360,40 @@ class SparkDl4jMultiLayer:
         if not parts:
             raise ValueError("no datasets to fit")
         n = len(parts)
-        hub = ParamAveragingHub(
-            n_workers=n, worker_timeout=tm.worker_timeout,
-            on_round=self._checkpoint(self.net.clone())).start()
+        tracer = get_tracer()
+        with tracer.span("scaleout_job", attrs={"workers": n}) as job_span:
+            # the job root span's context rides the hub's KIND_SPANCTX
+            # frames to every worker — thread, process, or remote host
+            hub = ParamAveragingHub(
+                n_workers=n, worker_timeout=tm.worker_timeout,
+                on_round=self._checkpoint(self.net.clone()),
+                span_ctx=job_span.context).start()
 
-        replicas = [self.net.clone() for _ in range(n)]
-        threads = []
-        errors: List[BaseException] = []
+            replicas = [self.net.clone() for _ in range(n)]
+            threads = []
+            errors: List[BaseException] = []
 
-        def run(wid, replica, part):
-            try:
-                worker_main(hub.address, replica, part,
-                            tm.averaging_frequency, tm.epochs_per_fit,
-                            fail_after_steps if wid == fail_worker else None,
-                            worker_id=wid)
-            except BaseException as e:  # noqa: BLE001 — collected for report
-                errors.append(e)
+            def run(wid, replica, part):
+                try:
+                    worker_main(hub.address, replica, part,
+                                tm.averaging_frequency, tm.epochs_per_fit,
+                                fail_after_steps if wid == fail_worker
+                                else None,
+                                worker_id=wid)
+                except BaseException as e:  # noqa: BLE001 — collected
+                    errors.append(e)
 
-        for wid, (replica, part) in enumerate(zip(replicas, parts)):
-            t = threading.Thread(target=run, args=(wid, replica, part),
-                                 daemon=True, name=f"dl4j-tpu-worker-{wid}")
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join()
-        final = hub.result(timeout=tm.worker_timeout)
+            for wid, (replica, part) in enumerate(zip(replicas, parts)):
+                t = threading.Thread(target=run, args=(wid, replica, part),
+                                     daemon=True,
+                                     name=f"dl4j-tpu-worker-{wid}")
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+            final = hub.result(timeout=tm.worker_timeout)
+            job_span.set_attr("rounds", hub.rounds)
+            job_span.set_attr("dropped", list(hub.dropped))
         if final is None:
             raise RuntimeError(
                 "scaleout job produced no averaged parameters (every worker "

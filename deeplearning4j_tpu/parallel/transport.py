@@ -45,6 +45,30 @@ Address = Union[str, Tuple[str, int]]
 _HDR = struct.Struct("<If")  # payload bytes, sender threshold
 
 
+# ---------------------------------------------------------------------------
+# Span-context wire encoding — the cross-transport half of obs.spans.
+# A SpanContext serializes to its JSON header (empty payload = no trace);
+# scaleout's hub sends one frame of this to every worker on connect, so a
+# master round and its worker fits share one trace tree.
+# ---------------------------------------------------------------------------
+
+def pack_span_context(ctx) -> bytes:
+    """``SpanContext | None`` -> wire payload bytes."""
+    return b"" if ctx is None else ctx.to_header().encode()
+
+
+def unpack_span_context(payload: bytes):
+    """Wire payload -> ``SpanContext | None`` (tolerates garbage: a trace
+    header must never take down a training job)."""
+    from ..obs.spans import SpanContext
+    if not payload:
+        return None
+    try:
+        return SpanContext.from_header(payload.decode())
+    except UnicodeDecodeError:
+        return None
+
+
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
     buf = bytearray(n)
     view = memoryview(buf)
@@ -114,6 +138,10 @@ class GradientExchangeServer:
                     for tokens, thr in frames:
                         _send_frame(c, tokens, thr)
                 self.rounds += 1
+                from ..obs import get_registry
+                get_registry().counter(
+                    "dl4j_gradex_rounds_total",
+                    "Gradient-exchange all-gather rounds served").inc()
         except (ConnectionError, OSError):
             pass  # workers done / stop() closed the socket
         finally:

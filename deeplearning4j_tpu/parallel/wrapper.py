@@ -13,6 +13,7 @@ Aeron. `fit()` is a drop-in for MultiLayerNetwork/ComputationGraph fit.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
 import jax
@@ -22,6 +23,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import get_registry
 from .mesh import data_parallel_mesh, shard_params_fsdp
 
 
@@ -160,6 +162,9 @@ class ParallelWrapper:
             self._step = None            # remat policy toggled — retrace
             self._scan_epoch = None
         step_fn = self._step or self._build_step()
+        m_batches = get_registry().counter(
+            "dl4j_parallel_fit_batches_total",
+            "Batches stepped through ParallelWrapper.fit")
         last = None
         n = self._batch_div
         anomaly_check = None
@@ -184,6 +189,7 @@ class ParallelWrapper:
                     if lmask is not None:
                         lmask = jax.tree_util.tree_map(_padder(pad, zero=True),
                                                        lmask)
+                net._last_batch_size = rows  # telemetry: pre-pad rows
                 as_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
                 (net.params, net.states, net._opt_state, loss, gstats,
                  net._host_key) = step_fn(
@@ -192,6 +198,7 @@ class ParallelWrapper:
                     None if fmask is None else as_dev(fmask),
                     None if lmask is None else as_dev(lmask))
                 net._step_count += 1
+                m_batches.inc()
                 if anomaly_check is not None and gstats is not None:
                     anomaly_check.push(gstats, net._step_count)
                 last = loss
@@ -319,6 +326,7 @@ class ParallelInference:
             lambda a: jax.device_put(a, self._rep), net.states)
         self._infer = None
         self._pending = []
+        self._pending_ts = []  # enqueue time per request (queue-wait metric)
 
     def refresh(self):
         """Re-copy the net's current params (e.g. after more training)."""
@@ -368,6 +376,10 @@ class ParallelInference:
     def submit(self, x):
         """Dynamic batching: queue a request; flush() runs one sweep."""
         self._pending.append(np.asarray(x))
+        self._pending_ts.append(time.perf_counter())
+        get_registry().counter(
+            "dl4j_inference_requests_total",
+            "Requests submitted to dynamic batching").inc()
         if sum(p.shape[0] for p in self._pending) >= self.max_batch:
             return self.flush()
         return None
@@ -377,7 +389,25 @@ class ParallelInference:
             return []
         sizes = [p.shape[0] for p in self._pending]
         batch = np.concatenate(self._pending)
+        # serving-plane telemetry: how full each device sweep runs under
+        # the offered traffic, and how long requests waited to board it —
+        # the two dials continuous batching tunes (μ-cuDNN occupancy
+        # analysis; ROADMAP item 1 inherits these for free)
+        reg = get_registry()
+        now = time.perf_counter()
+        wait_h = reg.histogram(
+            "dl4j_inference_queue_wait_seconds",
+            "Time a request waited in the dynamic-batching queue")
+        for ts in self._pending_ts:
+            wait_h.observe(now - ts)
+        reg.gauge(
+            "dl4j_inference_batch_occupancy",
+            "Rows in the last dynamic batch / max_batch").set(
+            batch.shape[0] / max(self.max_batch, 1))
+        reg.counter("dl4j_inference_batches_total",
+                    "Dynamic batches swept through the device").inc()
         self._pending = []
+        self._pending_ts = []
         out = self.output(batch)
         parts, off = [], 0
         for s in sizes:

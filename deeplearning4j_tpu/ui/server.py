@@ -17,6 +17,10 @@ Endpoints:
   GET /train/stats?sid= any session's records (FileStatsStorage read —
                         reattach to a finished run's history)
   GET /train/sessions   all session ids + static info in the storage
+  GET /metrics          Prometheus text exposition of the process-wide
+                        telemetry registry (deeplearning4j_tpu.obs) —
+                        train-step histograms, inference batch
+                        occupancy, scaleout round counters, …
 """
 
 from __future__ import annotations
@@ -147,6 +151,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/" or self.path == "/train" or self.path == "/index.html":
             body = _PAGE.encode()
             ctype = "text/html; charset=utf-8"
+        elif self.path == "/metrics" or self.path.startswith("/metrics?"):
+            # Prometheus scrape endpoint: the UI process exposes whatever
+            # the in-process registry has accumulated (a training script
+            # that starts the UIServer in-process exposes its own fit).
+            from ..obs import get_registry
+            body = get_registry().to_prometheus().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif self.path.startswith("/train/sessions"):
             sessions = [{"id": s["id"], "static": s["static"],
                          "n": len(s["updates"])}

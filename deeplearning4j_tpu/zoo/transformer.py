@@ -488,7 +488,7 @@ def make_ring_train_step(cfg: TransformerConfig, optimizer, mesh: Mesh):
         raise NotImplementedError(
             "ring step is dense-only; MoE routes through the GSPMD path "
             "(make_train_step under jit with shardings_for)")
-    from jax import shard_map
+    from .._jax_compat import shard_map
     import optax as _optax
 
     def local_step(params, opt_state, ids, targets):

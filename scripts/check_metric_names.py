@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Lint the telemetry instrumentation sites (ISSUE 6 tooling).
+
+Greps every ``.counter("...") / .gauge("...") / .histogram("...")`` call
+in the instrumented trees and fails on:
+
+- metric names outside the registered ``dl4j_`` namespace,
+- counter names not ending in ``_total`` (Prometheus convention the
+  registry also enforces at runtime),
+- names with invalid characters,
+- duplicate registrations: the same name used as two different
+  instrument kinds anywhere in the tree (the runtime raises on the
+  second registration — this catches it statically, before a rarely-
+  exercised code path does).
+
+Wired into the test suite as a fast unit test (tests/test_obs.py), so a
+stray name fails CI, not a Grafana query. Run standalone:
+``python scripts/check_metric_names.py``.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+
+# instrumented trees: the package + the bench/diag entry points.
+# tests/ excluded on purpose — they register deliberately-bad names to
+# assert the runtime rejects them.
+SCAN = ["deeplearning4j_tpu", "bench.py", "scripts"]
+
+_SITE = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+NAMESPACE = "dl4j_"
+
+
+def _files() -> List[Path]:
+    out: List[Path] = []
+    for entry in SCAN:
+        p = REPO / entry
+        if p.is_file():
+            out.append(p)
+        else:
+            out.extend(sorted(f for f in p.rglob("*.py")
+                              if "__pycache__" not in f.parts))
+    return out
+
+
+def check(files=None) -> List[str]:
+    """Returns a list of human-readable violations (empty = clean)."""
+    errors: List[str] = []
+    kinds: Dict[str, Set[str]] = {}
+    sites: Dict[str, List[str]] = {}
+    for f in files or _files():
+        if f.name == "check_metric_names.py":
+            continue
+        text = f.read_text()
+        for m in _SITE.finditer(text):
+            kind, name = m.group(1), m.group(2)
+            try:
+                shown = f.relative_to(REPO)
+            except ValueError:   # explicit file list outside the repo
+                shown = f
+            where = f"{shown}:{text[:m.start()].count(chr(10)) + 1}"
+            kinds.setdefault(name, set()).add(kind)
+            sites.setdefault(name, []).append(where)
+            if not _NAME_OK.match(name):
+                errors.append(f"{where}: invalid metric name {name!r}")
+            if not name.startswith(NAMESPACE):
+                errors.append(f"{where}: {name!r} outside the registered "
+                              f"{NAMESPACE} namespace")
+            if kind == "counter" and not name.endswith("_total"):
+                errors.append(f"{where}: counter {name!r} must end in "
+                              "'_total'")
+    for name, ks in sorted(kinds.items()):
+        if len(ks) > 1:
+            errors.append(
+                f"duplicate registration of {name!r} as {sorted(ks)} "
+                f"at {', '.join(sites[name])}")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(e, file=sys.stderr)
+    n_names = len({m.group(2) for f in _files()
+                   if f.name != "check_metric_names.py"
+                   for m in _SITE.finditer(f.read_text())})
+    print(f"check_metric_names: {n_names} metric names scanned, "
+          f"{len(errors)} violation(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,12 +133,15 @@ def main():
     # charnn arms as SEPARATE phases: the r4 lesson (charnn 2.9M shared
     # vs 4.7M isolated) says same-process A/B arms bias close races — run
     # each arm in its own interpreter: `python diag_attn_r5.py Rf`, `Rs`.
+    # kernel arms pass fused=True, NOT "auto": since the demotion "auto"
+    # resolves to the lax.scan path, so an "auto" arm would silently
+    # measure scan vs scan while labeled kernel vs scan (ADVICE r5 #1)
     if "Rf" in phases or "R" in phases:
-        charnn_f32("charnn b256 f32 fused-lstm-kernel", "auto")
+        charnn_f32("charnn b256 f32 fused-lstm-kernel", True)
     if "Rs" in phases or "R" in phases:
         charnn_f32("charnn b256 f32 xla-scan", False)
     if "Bf" in phases:
-        charnn_bf16_isolated("auto")
+        charnn_bf16_isolated(True)
     if "Bs" in phases:
         charnn_bf16_isolated(False)
 

@@ -150,6 +150,7 @@ def test_bench_lenet_scan_step():
     assert loss is not None and float(loss) == float(loss)
 
 
+@pytest.mark.slow   # ~95s: the ResNet fit_scanned epoch compile dominates
 def test_bench_resnet50_fitscan_parts():
     """build_resnet50_fit(return_parts=True) feeds the fitscan config; the
     scanned entry point runs on the tiny-config CI path."""

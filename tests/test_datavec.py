@@ -89,6 +89,7 @@ def test_record_reader_dataset_iterator_regression():
     assert ds.labels[0, 0] == pytest.approx(3.5)
 
 
+@pytest.mark.slow   # ~26s end-to-end ETL + fit
 def test_transform_into_network_fit():
     """End-to-end: CSV → transform → iterator → fit (the DataVec use case)."""
     from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,

@@ -15,6 +15,7 @@ from deeplearning4j_tpu.nn import (LSTM, ConvolutionLayer, DenseLayer,
 from deeplearning4j_tpu.train import Adam
 
 
+@pytest.mark.slow   # ~50s long-running convergence test
 def test_iris_convergence():
     conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-2))
             .list()

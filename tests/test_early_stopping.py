@@ -55,6 +55,7 @@ def test_max_epochs_termination():
     assert result.best_model_score < result.score_vs_epoch[0]
 
 
+@pytest.mark.slow   # ~29s: trains until patience runs out
 def test_score_improvement_patience_stops_early():
     cfg = EarlyStoppingConfiguration(
         epoch_termination_conditions=[

@@ -3,6 +3,11 @@
 import numpy as np
 import pytest
 
+# Slow: the TF/Keras import round-trips dominate (~40s of torch/TF
+# tracing) — outside the tier-1 truncation budget; runs in the full
+# (slow-inclusive) suite.
+pytestmark = pytest.mark.slow
+
 from deeplearning4j_tpu.data import ListDataSetIterator, MnistDataSetIterator
 from deeplearning4j_tpu.data.async_iter import AsyncDataSetIterator
 from deeplearning4j_tpu.data.dataset import DataSet

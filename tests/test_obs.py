@@ -1,0 +1,417 @@
+"""Telemetry plane (ISSUE 6): registry semantics, histogram quantiles,
+Prometheus exposition, span nesting + cross-transport context
+propagation (thread-harness scaleout), the MetricsListener's emitted
+names, the /metrics endpoint fed by a real fit + 4-worker scaleout +
+dynamic-batching inference, the documented <2% instrumentation-overhead
+budget, and the metric-name lint."""
+
+import json
+import re
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.obs import (DEFAULT_BUCKETS, MetricsRegistry,
+                                    SpanContext, Tracer, derived_span_id,
+                                    get_registry, get_tracer, load_spans)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _net(seed=11, n_in=6, hidden=8, n_out=3, lr=5e-2):
+    from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Sgd
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr))
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="tanh"))
+            .layer(OutputLayer(n_in=hidden, n_out=n_out,
+                               activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n_batches=8, batch=16, seed=0, n_in=6, n_out=3):
+    from deeplearning4j_tpu.data import DataSet
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(batch, n_in)).astype(np.float32),
+                    np.eye(n_out, dtype=np.float32)[
+                        rng.integers(0, n_out, batch)])
+            for _ in range(n_batches)]
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_registry_get_or_create_and_kind_mismatch():
+    r = MetricsRegistry()
+    c = r.counter("dl4j_x_total", "help")
+    assert r.counter("dl4j_x_total") is c          # idempotent
+    with pytest.raises(ValueError, match="duplicate registration"):
+        r.gauge("dl4j_x_total")                    # kind mismatch
+    with pytest.raises(ValueError, match="duplicate registration"):
+        r.counter("dl4j_x_total", labelnames=("k",))  # label mismatch
+
+
+def test_registry_namespace_and_counter_conventions():
+    r = MetricsRegistry()
+    with pytest.raises(ValueError, match="outside the registered"):
+        r.counter("steps_total")
+    with pytest.raises(ValueError, match="must end in '_total'"):
+        r.counter("dl4j_steps")
+    with pytest.raises(ValueError, match="invalid metric name"):
+        r.gauge("dl4j_bad name")
+    with pytest.raises(ValueError, match="counters only go up"):
+        r.counter("dl4j_ok_total").inc(-1)
+
+
+def test_counter_gauge_values_and_labels():
+    r = MetricsRegistry()
+    c = r.counter("dl4j_reqs_total", labelnames=("route",))
+    c.inc(route="a")
+    c.inc(2, route="a")
+    c.inc(route="b")
+    assert c.value(route="a") == 3 and c.value(route="b") == 1
+    with pytest.raises(ValueError, match="do not match"):
+        c.inc(wrong="a")
+    g = r.gauge("dl4j_depth")
+    g.set(5)
+    g.inc()
+    g.dec(3)
+    assert g.value() == 3
+
+
+# ---------------------------------------------------------------------------
+# histogram quantiles
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantiles_and_exponential_buckets():
+    r = MetricsRegistry()
+    # fine linear buckets -> tight quantile estimates
+    h = r.histogram("dl4j_t_seconds", buckets=[i / 100 for i in range(1, 201)])
+    for v in range(1, 1001):          # 0.001 .. 1.000, uniform
+        h.observe(v / 1000)
+    assert h.count() == 1000
+    assert h.sum() == pytest.approx(500.5, rel=1e-6)
+    assert h.quantile(0.50) == pytest.approx(0.50, abs=0.02)
+    assert h.quantile(0.95) == pytest.approx(0.95, abs=0.02)
+    assert h.quantile(0.99) == pytest.approx(0.99, abs=0.02)
+    assert h.quantile(0.0) == pytest.approx(0.001, abs=0.02)
+    assert h.quantile(1.0) == pytest.approx(1.0, abs=0.02)
+    assert r.histogram("dl4j_empty_seconds").quantile(0.5) is None
+    # default layout: exponential (powers of 2), strictly increasing
+    ratios = [b / a for a, b in zip(DEFAULT_BUCKETS, DEFAULT_BUCKETS[1:])]
+    assert all(r_ == pytest.approx(2.0) for r_ in ratios)
+    # estimates clamp to the observed range on a sparse tail
+    h2 = r.histogram("dl4j_sparse_seconds")
+    h2.observe(0.003)
+    assert h2.quantile(0.99) == pytest.approx(0.003)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+                     r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|NaN|[+-]Inf)$")
+
+
+def _validate_prom(text):
+    """Minimal exposition-format validator: every non-comment line is a
+    sample, histograms are cumulative and consistent."""
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE.match(line), f"bad sample line: {line!r}"
+
+
+def test_prometheus_text_format():
+    r = MetricsRegistry()
+    r.counter("dl4j_a_total", "a help").inc(3)
+    r.gauge("dl4j_g", labelnames=("k",)).set(1.5, k='va"l\\ue')
+    h = r.histogram("dl4j_h_seconds", "hist", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = r.to_prometheus()
+    _validate_prom(text)
+    assert "# TYPE dl4j_a_total counter" in text
+    assert "dl4j_a_total 3" in text
+    assert "# TYPE dl4j_h_seconds histogram" in text
+    assert 'dl4j_h_seconds_bucket{le="0.1"} 1' in text
+    assert 'dl4j_h_seconds_bucket{le="1"} 2' in text
+    assert 'dl4j_h_seconds_bucket{le="+Inf"} 3' in text
+    assert "dl4j_h_seconds_count 3" in text
+    assert r'va\"l\\ue' in text            # label escaping
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_jsonl_export(tmp_path):
+    t = Tracer()
+    with t.span("outer", attrs={"k": 1}) as outer:
+        with t.span("inner") as inner:
+            assert t.current_context().span_id == inner.span_id
+    assert inner.parent_id == outer.span_id
+    assert inner.trace_id == outer.trace_id
+    assert outer.parent_id is None
+    assert outer.time_s >= inner.time_s >= 0
+    path = tmp_path / "spans.jsonl"
+    assert t.export_jsonl(path, clear=True) == 2
+    assert t.spans() == []
+    recs = load_spans(path)
+    assert [r["name"] for r in recs] == ["inner", "outer"]
+    assert all(r["kind"] == "span" and "time_s" in r for r in recs)
+
+
+def test_span_device_sync_and_header_roundtrip():
+    import jax.numpy as jnp
+    t = Tracer()
+    with t.span("step", sync=jnp.zeros(4)) as sp:
+        pass
+    assert sp.synced
+    ctx = sp.context
+    assert SpanContext.from_header(ctx.to_header()) == ctx
+    assert SpanContext.from_header("") is None
+    assert SpanContext.from_header("garbage{") is None
+    # deterministic derived ids: both wire ends agree without a round-trip
+    assert derived_span_id("t", "round", 1) == derived_span_id("t", "round", 1)
+    assert derived_span_id("t", "round", 1) != derived_span_id("t", "round", 2)
+
+
+def test_use_context_adopts_remote_parent():
+    t = Tracer()
+    remote = SpanContext("remotetrace", "remotespan")
+    with t.use_context(remote):
+        with t.span("child") as sp:
+            pass
+    assert sp.trace_id == "remotetrace" and sp.parent_id == "remotespan"
+    assert t.current_context() is None
+
+
+# ---------------------------------------------------------------------------
+# cross-transport propagation: thread-harness scaleout -> one trace tree
+# ---------------------------------------------------------------------------
+
+def test_scaleout_stitches_one_trace_tree(tmp_path):
+    from deeplearning4j_tpu.parallel import (
+        ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+    tracer = get_tracer()
+    tracer.clear()
+    net = _net()
+    tm = ParameterAveragingTrainingMaster(
+        n_workers=4, averaging_frequency=2, epochs_per_fit=1,
+        worker_timeout=60.0)
+    SparkDl4jMultiLayer(net, tm).fit(_data(n_batches=8))
+
+    spans = [s for s in tracer.spans() if s.name.startswith("scaleout")]
+    jobs = [s for s in spans if s.name == "scaleout_job"]
+    rounds = [s for s in spans if s.name == "scaleout_round"
+              and not s.attrs.get("empty")]
+    fits = [s for s in spans if s.name == "scaleout_worker_fit"]
+    assert len(jobs) == 1 and rounds and len(fits) == 8
+    job = jobs[0]
+    # ONE stitched tree: single trace id, rounds under the job, worker
+    # fits under the round whose averaging they fed
+    assert all(s.trace_id == job.trace_id for s in spans)
+    assert all(s.parent_id == job.span_id for s in rounds)
+    round_ids = {s.span_id for s in rounds}
+    assert all(f.parent_id in round_ids for f in fits)
+    assert {f.attrs["worker"] for f in fits} == {0, 1, 2, 3}
+    # round ids are the deterministic derivation both wire ends compute
+    assert rounds[0].span_id == derived_span_id(
+        job.trace_id, "round", rounds[0].attrs["round"])
+
+    # JSONL export carries the whole tree for offline stitching
+    path = tmp_path / "trace.jsonl"
+    tracer.export_jsonl(path, clear=True)
+    recs = [r for r in load_spans(path) if r["name"].startswith("scaleout")]
+    by_id = {r["span_id"]: r for r in recs}
+    roots = set()
+    for r in recs:
+        node = r
+        while node["parent_id"] in by_id:
+            node = by_id[node["parent_id"]]
+        roots.add(node["span_id"])
+    assert roots == {job.span_id}
+
+
+# ---------------------------------------------------------------------------
+# MetricsListener names + /metrics endpoint integration
+# ---------------------------------------------------------------------------
+
+def test_metrics_listener_emits_registered_names():
+    from deeplearning4j_tpu.nn.listeners import MetricsListener
+    reg = MetricsRegistry()
+    listener = MetricsListener(registry=reg)
+    net = _net()
+    net.set_listeners(listener)
+    batches = _data(n_batches=5, batch=16)
+    net.fit(batches)
+
+    assert reg.counter("dl4j_train_iterations_total").value() == 5
+    assert reg.counter("dl4j_train_examples_total").value() == 5 * 16
+    assert reg.counter("dl4j_train_epochs_total").value() == 1
+    # first iteration has no previous timestamp -> 4 intervals
+    assert reg.histogram("dl4j_train_step_seconds").count() == 4
+    assert reg.histogram("dl4j_train_step_seconds").quantile(0.5) > 0
+    assert reg.gauge("dl4j_train_loss").value() > 0
+    assert reg.gauge("dl4j_train_examples_per_second").value() > 0
+    for name in ("dl4j_train_step_seconds", "dl4j_train_iterations_total",
+                 "dl4j_train_examples_total", "dl4j_train_loss",
+                 "dl4j_obs_overhead_seconds_total"):
+        assert name in reg.names()
+
+
+def test_metrics_endpoint_serves_fit_scaleout_and_inference(tmp_path,
+                                                            devices8):
+    """Acceptance: GET /metrics returns valid Prometheus text containing
+    train-step histograms, wrapper batch-occupancy, and scaleout round
+    counters after a small CPU fit + 4-worker thread-harness scaleout
+    run (+ a dynamic-batching inference flush)."""
+    from deeplearning4j_tpu.nn.listeners import MetricsListener
+    from deeplearning4j_tpu.parallel import (
+        ParallelInference, ParameterAveragingTrainingMaster,
+        SparkDl4jMultiLayer)
+    from deeplearning4j_tpu.ui import UIServer
+
+    reg = get_registry()
+    reg.reset()
+
+    # 1) small CPU fit with the telemetry listener
+    net = _net()
+    net.set_listeners(MetricsListener())
+    net.fit(_data(n_batches=4))
+
+    # 2) 4-worker thread-harness scaleout round(s)
+    tm = ParameterAveragingTrainingMaster(
+        n_workers=4, averaging_frequency=2, epochs_per_fit=1,
+        worker_timeout=60.0)
+    SparkDl4jMultiLayer(_net(), tm).fit(_data(n_batches=8))
+
+    # 3) dynamic-batching inference sweep (batch occupancy + queue wait)
+    inf = ParallelInference(net, max_batch=64)
+    for _ in range(3):
+        inf.submit(np.random.default_rng(0).normal(
+            size=(8, 6)).astype(np.float32))
+    parts = inf.flush()
+    assert len(parts) == 3
+
+    srv = UIServer(log_dir=str(tmp_path), port=0).start()
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ).read().decode()
+    finally:
+        srv.stop()
+    _validate_prom(text)
+    assert "dl4j_train_step_seconds_bucket" in text
+    assert "dl4j_train_step_seconds_count" in text
+    assert "dl4j_inference_batch_occupancy 0.375" in text  # 24/64
+    assert "dl4j_inference_queue_wait_seconds_count 3" in text
+    assert "dl4j_scaleout_rounds_total" in text
+    assert "dl4j_scaleout_worker_steps_total 8" in text
+
+
+# ---------------------------------------------------------------------------
+# overhead budget
+# ---------------------------------------------------------------------------
+
+def test_instrumentation_overhead_within_budget():
+    """Documented budget: MetricsListener costs <2% of the instrumented
+    step on the tier-1 CPU path. The listener self-times its body
+    (dl4j_obs_overhead_seconds_total), so the assertion is its own
+    cumulative host cost against the fit's wall clock — robust to
+    machine noise in a way an A/B of two separate fits is not."""
+    from deeplearning4j_tpu.nn.listeners import MetricsListener
+    reg = MetricsRegistry()
+    listener = MetricsListener(registry=reg)
+    net = _net(n_in=64, hidden=256)
+    batches = _data(n_batches=2, batch=256, n_in=64)
+    net.fit(batches)                      # compile outside the window
+    net.set_listeners(listener)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        net.fit(batches)
+    wall = time.perf_counter() - t0
+    assert listener.overhead_seconds < 0.02 * wall, (
+        f"instrumentation cost {listener.overhead_seconds * 1e3:.2f}ms "
+        f"of {wall * 1e3:.1f}ms fit wall ("
+        f"{100 * listener.overhead_seconds / wall:.2f}% > 2% budget)")
+    # and it actually measured: one interval per 2-batch fit (the epoch
+    # boundary resets the interval so epoch-end host work is not
+    # mistaken for a step)
+    assert reg.histogram("dl4j_train_step_seconds").count() >= 20
+
+
+# ---------------------------------------------------------------------------
+# tooling: metric-name lint as a fast unit test
+# ---------------------------------------------------------------------------
+
+def test_metric_name_lint_clean():
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import check_metric_names
+    finally:
+        sys.path.pop(0)
+    assert check_metric_names.check() == []
+
+
+def test_metric_name_lint_catches_violations(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "reg.counter('other_requests')\n"
+        "reg.gauge('dl4j_thing')\n"
+        "reg.histogram('dl4j_thing')\n")
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import check_metric_names
+    finally:
+        sys.path.pop(0)
+    errors = check_metric_names.check(files=[bad])
+    joined = "\n".join(errors)
+    assert "outside the registered dl4j_ namespace" in joined
+    assert "must end in '_total'" in joined
+    assert "duplicate registration of 'dl4j_thing'" in joined
+
+
+# ---------------------------------------------------------------------------
+# autotune measurement provenance (TVM cost-record discipline)
+# ---------------------------------------------------------------------------
+
+def test_autotune_records_measurement_metadata(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.kernels import autotune as at
+    monkeypatch.setattr(at, "_CACHE_PATH", tmp_path / "autotune.json")
+    at._memory_cache.clear()
+
+    def make_run(cand):
+        if cand == (9, 9):
+            return None
+        return lambda: jnp.zeros(1)
+
+    choice = at.autotune("meta_k", [(1, 1), (2, 2), (9, 9)], make_run)
+    assert choice in ((1, 1), (2, 2))
+    meta = at.measurement_meta("meta_k")
+    assert meta is not None
+    assert meta["candidates"] == 3
+    assert meta["measured_at"] > 0
+    timed = [m for m in meta["measurements"] if m[1] is not None]
+    assert len(timed) == 2                 # (9,9) was invalid: t=None
+    assert any(m[0] == [9, 9] and m[1] is None
+               for m in meta["measurements"])
+    # legacy bare-list entries still load
+    disk = json.loads((tmp_path / "autotune.json").read_text())
+    disk["legacy_k"] = [4, 4]
+    (tmp_path / "autotune.json").write_text(json.dumps(disk))
+    at._memory_cache.clear()
+    assert at.autotune("legacy_k", [(8, 8)], make_run) == (4, 4)
+    assert at.measurement_meta("legacy_k") is None

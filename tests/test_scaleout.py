@@ -14,6 +14,12 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+# Slow: each job provisions a socket hub + N worker replicas with their
+# own jitted fits (~20s/module) — outside the tier-1 truncation budget;
+# runs in the full (slow-inclusive) suite. Tier-1 scaleout coverage
+# (rounds, trace stitching, metrics) lives in tests/test_obs.py.
+pytestmark = pytest.mark.slow
+
 from deeplearning4j_tpu.data import DataSet
 from deeplearning4j_tpu.nn import (DenseLayer, MultiLayerNetwork,
                                    NeuralNetConfiguration, OutputLayer)
