@@ -43,8 +43,9 @@ from .layers.recurrent import (GRU, LSTM, BaseRecurrent, Bidirectional,
                                LastTimeStep, SimpleRnn, TimeDistributed)
 from .listeners import (CheckpointListener, CollectScoresListener,
                         EvaluativeListener, NanScoreWatchdog,
-                        PerformanceListener, ScoreIterationListener,
-                        StatsListener, TimeIterationListener)
+                        PerformanceListener, ProfilingListener,
+                        ScoreIterationListener, StatsListener,
+                        TimeIterationListener)
 from .losses import Loss
 from .computation_graph import ComputationGraph
 from .multi_layer_network import MultiLayerNetwork

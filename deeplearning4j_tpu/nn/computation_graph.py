@@ -123,6 +123,20 @@ class ComputationGraph:
         dropout/weight-noise draws of the monolithic walk."""
         node = self.conf.nodes[name]
         xs = [acts[i] for i in node.inputs]
+        # named scope: the node's ops carry <name>.<Type> in the fused
+        # executable's metadata (xprof layer map; trace-time only) —
+        # mirrors MultiLayerNetwork._apply_one and obs.profiler naming
+        scope = jax.named_scope(
+            f"{name}.{type(unwrap(node.op)).__name__}".replace("/", "_"))
+        with scope:
+            self._apply_node_inner(
+                name, node, xs, params, states, acts, pre_acts, new_states,
+                train=train, rng=rng, idx=idx, fmask=fmask, lmask=lmask,
+                stop_at_output_preact=stop_at_output_preact)
+
+    def _apply_node_inner(self, name, node, xs, params, states, acts,
+                          pre_acts, new_states, *, train, rng, idx, fmask,
+                          lmask, stop_at_output_preact):
         if isinstance(node.op, Layer):
             if getattr(node.op, "multi_input", False):
                 lrng = None if rng is None else jax.random.fold_in(rng, idx)
@@ -419,12 +433,19 @@ class ComputationGraph:
             op = unwrap(self.conf.nodes[name].op)
             y = labels[name]
             w = self.output_loss_weights.get(name, 1.0)
-            if isinstance(op, (OutputLayer, SameDiffOutputLayer)):
-                total = total + w * op.compute_loss(params[name], pre_acts[name], y, mask=lmask)
-            elif isinstance(op, LossLayer):
-                total = total + w * op.compute_loss(pre_acts[name], y, mask=lmask)
-            else:
-                raise ValueError(f"output node '{name}' is not an output/loss layer")
+            # output-node work happens here (forward stops at its
+            # pre-activation) — scope it like _apply_node scopes the rest
+            with jax.named_scope(
+                    f"{name}.{type(op).__name__}.loss".replace("/", "_")):
+                if isinstance(op, (OutputLayer, SameDiffOutputLayer)):
+                    total = total + w * op.compute_loss(
+                        params[name], pre_acts[name], y, mask=lmask)
+                elif isinstance(op, LossLayer):
+                    total = total + w * op.compute_loss(
+                        pre_acts[name], y, mask=lmask)
+                else:
+                    raise ValueError(
+                        f"output node '{name}' is not an output/loss layer")
         total = total + self._reg_score(params)
         return total, new_states
 

@@ -250,6 +250,64 @@ class MetricsListener(TrainingListener):
         self._last_t = None  # epoch boundary work is not a step interval
 
 
+class ProfilingListener(TrainingListener):
+    """Per-layer time attribution (ISSUE 7): every ``frequency``
+    iterations, run one ``obs.profiler`` attribution pass over
+    ``probe_data`` — forward + backward per layer, each timed in a named
+    ``Span`` — and feed the ``dl4j_layer_time_ms`` histogram (labels:
+    layer, direction) plus optional JSONL span export.
+
+    Unlike MetricsListener this is NOT hot-path-budgeted: a profile pass
+    costs roughly one un-fused train step (per-layer dispatch), which is
+    why it runs every `frequency` steps, off by default. ``probe_data``
+    is a DataSet/MultiDataSet shaped like the training batches (same
+    idiom as EvaluativeListener holding its own iterator); without one
+    the listener only profiles on explicit ``profile(model, ds)`` calls.
+
+    Reports accumulate on ``self.reports`` (total_ms / accounted_ms /
+    accounted_frac / per-layer rows) — the unit-test contract is
+    accounted_frac ≥ 0.9 on a CPU test model."""
+
+    deferred_score_ok = True  # profiling reads probe_data, not the
+    # live (step, score, params) triple — deferral is safe
+
+    def __init__(self, probe_data=None, frequency: int = 100,
+                 registry=None, tracer=None, jsonl_path=None,
+                 max_reports: int = 50):
+        self.probe_data = probe_data
+        self.frequency = max(1, frequency)
+        self._registry = registry
+        self._tracer = tracer
+        self.jsonl_path = jsonl_path
+        self.max_reports = max_reports
+        self.reports: List[dict] = []
+
+    def profile(self, model, ds=None):
+        from ..obs import profiler
+        ds = ds if ds is not None else self.probe_data
+        if ds is None:
+            return None
+        report = profiler.profile_step(model, ds, tracer=self._tracer)
+        profiler.observe_report(report, registry=self._registry)
+        # append exactly THIS pass's spans (the tracer ring also holds
+        # every earlier pass — re-exporting it would duplicate records)
+        recs = report.pop("span_records", [])
+        if self.jsonl_path is not None and recs:
+            p = Path(self.jsonl_path)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            with open(p, "a") as f:
+                for r in recs:
+                    f.write(json.dumps(r) + "\n")
+        self.reports.append(report)
+        del self.reports[:-self.max_reports]
+        return report
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self.probe_data is not None and \
+                iteration % self.frequency == 0:
+            self.profile(model)
+
+
 class StatsListener(TrainingListener):
     """Training-UI analogue (reference StatsListener + UIServer): score,
     learning rate and per-layer update:param ratios — DL4J's headline

@@ -13,13 +13,18 @@ Instrumented surfaces (all under the ``dl4j_`` namespace —
   queue-wait histogram (the serving plane inherits these).
 - ``parallel.scaleout`` — round counters + stitched spans.
 - ``kernels.autotune`` — per-candidate measurement provenance.
-- ``bench.py`` — each row emits the same schema beside the record.
+- ``bench.py`` — each row emits the same schema beside the record,
+  including the ``floor`` roofline block (``obs.floors``, ISSUE 7).
+- ``nn.listeners.ProfilingListener`` — per-layer time attribution
+  (``obs.profiler``): ``dl4j_layer_time_ms`` + JSONL layer spans.
 """
 
 from .registry import (Counter, DEFAULT_BUCKETS, Gauge,  # noqa: F401
                        Histogram, MetricsRegistry)
 from .spans import (Span, SpanContext, Tracer, derived_span_id,  # noqa: F401
                     get_tracer, load_spans, span)
+from . import floors  # noqa: F401  (roofline floor engine, ISSUE 7)
+from . import profiler  # noqa: F401  (per-layer attribution, ISSUE 7)
 
 _registry = MetricsRegistry(namespace="dl4j")
 

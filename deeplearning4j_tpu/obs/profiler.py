@@ -1,0 +1,296 @@
+"""Per-layer profiler — attributes step wall-time to named layer spans.
+
+The donated jitted train step is ONE fused XLA executable: host code
+cannot see where its milliseconds go. This profiler runs a separate
+*attribution pass* over the same layer math — forward layer-by-layer via
+``jax.vjp`` (which also records each layer's pullback), then backward
+layer-by-layer by replaying the pullbacks in reverse — timing every
+layer with a PR-6 ``Span`` and a device sync, so ≥90% of the pass's wall
+time lands in named per-layer spans with a forward/backward split.
+
+This is the OpProfiler-style interpreted account (utils/tracing.py level
+2), not the hot path: the pass pays per-layer dispatch and loses
+cross-layer fusion, so its *absolute* total differs from the jitted
+step; its value is the per-layer *shares* (the layer map that names
+which layer owns a regression) plus the ``jax.named_scope`` annotations
+threaded through both networks' layer apply, which label the fused
+executable's ops for XLA-level tools (tensorboard xprof) with the SAME
+names this profiler uses for its spans — ``layer_i.<Type>`` /
+``<node>.<Type>``, ``.loss`` suffix on the output tail — so an
+exact-name join between xprof op metadata, ``dl4j_layer_time_ms``
+labels, and JSONL spans works.
+
+Exports: per-layer ``Span`` records (JSONL via the shared tracer) and a
+``dl4j_layer_time_ms`` histogram labeled (layer, direction) in the
+process-wide registry. ``nn.listeners.ProfilingListener`` wires this
+into ``fit()`` at a configurable frequency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+# layer times span ~µs (a LeNet dense on CPU) to seconds (a profiled
+# ResNet conv stack): exponential ms buckets 0.001 ms .. ~8.4 s
+LAYER_MS_BUCKETS = tuple(1e-3 * (2.0 ** i) for i in range(24))
+
+
+def _sync(x):
+    import jax
+    try:
+        jax.block_until_ready(x)
+    except Exception:  # noqa: BLE001 — sync is best-effort off-CPU
+        pass
+
+
+def _one(dtype):
+    import jax.numpy as jnp
+    return jnp.ones((), dtype)
+
+
+def _layer_rows(spans) -> List[Dict[str, Any]]:
+    """Fold forward/<name> + backward/<name> span pairs into rows."""
+    rows: Dict[str, Dict[str, Any]] = {}
+    order: List[str] = []
+    for sp in spans:
+        direction, _, lname = sp.name.partition("/")
+        if direction not in ("forward", "backward") or not lname:
+            continue
+        if lname not in rows:
+            rows[lname] = {"layer": lname, "forward_ms": 0.0,
+                           "backward_ms": 0.0}
+            order.append(lname)
+        rows[lname][f"{direction}_ms"] = round(
+            rows[lname][f"{direction}_ms"] + sp.time_s * 1e3, 4)
+    return [rows[k] for k in order]
+
+
+def _report(model, root, spans) -> Dict[str, Any]:
+    layers = _layer_rows(spans)
+    accounted = sum(r["forward_ms"] + r["backward_ms"] for r in layers)
+    total = root.time_s * 1e3
+    return {
+        "model": type(model).__name__,
+        "total_ms": round(total, 4),
+        "accounted_ms": round(accounted, 4),
+        "accounted_frac": round(accounted / total, 4) if total > 0 else None,
+        "layers": layers,
+        "trace_id": root.trace_id,
+        # THIS pass's span records only — so a JSONL exporter can append
+        # exactly one pass per call instead of re-dumping the tracer's
+        # whole ring (which holds every earlier pass too)
+        "span_records": [sp.record() for sp in spans] + [root.record()],
+        "note": "interpreted per-layer attribution pass (per-layer "
+                "dispatch, no cross-layer fusion); shares are the "
+                "signal, the jitted step's absolute time is "
+                "dl4j_train_step_seconds",
+    }
+
+
+def profile_mln_step(net, ds, *, tracer=None, rng=None) -> Dict[str, Any]:
+    """One attributed train-step pass over a MultiLayerNetwork.
+
+    Returns a report dict (total/accounted ms, per-layer forward/backward
+    rows); the spans land in ``tracer`` (default: the process tracer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..nn.layers.core import LossLayer, OutputLayer
+    from ..nn.layers.samediff_layer import SameDiffOutputLayer
+    from ..nn.layers.wrappers import unwrap
+    from .spans import get_tracer
+
+    tracer = tracer or get_tracer()
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    x = jnp.asarray(ds.features)
+    y = jnp.asarray(ds.labels)
+    fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+    lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+
+    n = len(net.layers)
+    spans = []
+    with tracer.span("profile_step",
+                     attrs={"model": type(net).__name__}) as root:
+        entries = []          # (lname, pullback) in forward order
+        h = x
+        loss = None
+        for i, layer in enumerate(net.layers):
+            key = f"layer_{i}"
+            ul = unwrap(layer)
+            last_is_loss = i == n - 1 and isinstance(
+                ul, (OutputLayer, LossLayer, SameDiffOutputLayer))
+            # same names the named_scope threading stamps on the fused
+            # executable (MLN._apply_one / _loss), so spans and xprof
+            # metadata join exactly
+            lname = f"layer_{i}.{type(ul).__name__}" + (
+                ".loss" if last_is_loss else "")
+            if last_is_loss:
+                # the output layer's forward IS the loss computation
+                # (net._loss stops before it and calls compute_loss on
+                # the pre-activation) — profile exactly that
+                def f_loss(p, hh, _i=i, _ul=ul):
+                    if _i in net._preprocessors:
+                        hh = net._preprocessors[_i](hh)
+                    if isinstance(_ul, LossLayer):
+                        return _ul.compute_loss(hh, y, mask=lmask)
+                    return _ul.compute_loss(p, hh, y, mask=lmask)
+
+                with tracer.span(f"forward/{lname}") as sp:
+                    loss, pullback = jax.vjp(f_loss, net.params[key], h)
+                    _sync(loss)
+            else:
+                def f(p, hh, _i=i, _key=key):
+                    ns = {}
+                    h2, _ = net._apply_one(
+                        _i, {_key: p}, net.states, hh, ns, train=True,
+                        rng=rng, fmask=fmask, lmask=lmask,
+                        stop_before_output=False)
+                    return h2
+
+                with tracer.span(f"forward/{lname}") as sp:
+                    h, pullback = jax.vjp(f, net.params[key], h)
+                    _sync(h)
+            spans.append(sp)
+            entries.append((lname, pullback))
+
+        ct = _one(loss.dtype) if loss is not None else jnp.ones_like(h)
+        for lname, pullback in reversed(entries):
+            with tracer.span(f"backward/{lname}") as sp:
+                _dp, ct = pullback(ct)
+                _sync(ct)
+            spans.append(sp)
+    return _report(net, root, spans)
+
+
+def _accum(cts: dict, name: str, val):
+    cts[name] = val if name not in cts else cts[name] + val
+
+
+def profile_cg_step(net, ds, *, tracer=None, rng=None) -> Dict[str, Any]:
+    """One attributed train-step pass over a ComputationGraph: forward in
+    topo order (one vjp per node), backward in reverse topo order with
+    cotangents accumulated across fan-out. Output nodes profile their
+    ``compute_loss`` as ``<name>.<Type>.loss`` (the same name
+    CG._loss's named_scope stamps on the fused executable)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..data.dataset import MultiDataSet
+    from ..nn.layers.core import LossLayer, OutputLayer
+    from ..nn.layers.samediff_layer import SameDiffOutputLayer
+    from ..nn.layers.wrappers import unwrap
+    from .spans import get_tracer
+
+    tracer = tracer or get_tracer()
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    if isinstance(ds, MultiDataSet):
+        feats, labs = ds.features, ds.labels
+        fmask = None if ds.features_masks is None else ds.features_masks[0]
+        lmask = None if ds.labels_masks is None else ds.labels_masks[0]
+    else:
+        feats, labs = [ds.features], [ds.labels]
+        fmask, lmask = ds.features_mask, ds.labels_mask
+    inputs = {n_: jnp.asarray(f) for n_, f in zip(net.conf.inputs, feats)}
+    labels = {n_: jnp.asarray(l) for n_, l in zip(net.conf.outputs, labs)}
+    fmask = None if fmask is None else jnp.asarray(fmask)
+    lmask = None if lmask is None else jnp.asarray(lmask)
+
+    spans = []
+    with tracer.span("profile_step",
+                     attrs={"model": type(net).__name__}) as root:
+        acts = dict(inputs)
+        entries = []          # (name, pullback, input_names)
+        for idx, name in enumerate(net.conf.topo_order):
+            node = net.conf.nodes[name]
+            in_names = list(node.inputs)
+            lname = f"{name}.{type(unwrap(node.op)).__name__}".replace(
+                "/", "_")   # matches CG._apply_node's named_scope
+
+            def f(p, *ins, _idx=idx, _name=name, _in=tuple(in_names)):
+                local = {k: v for k, v in zip(_in, ins)}
+                pre, ns = {}, {}
+                net._apply_node(
+                    _idx, _name, {_name: p}, net.states, local, pre, ns,
+                    train=True, rng=rng, fmask=fmask, lmask=lmask,
+                    stop_at_output_preact=True)
+                return local[_name]
+
+            with tracer.span(f"forward/{lname}") as sp:
+                out, pullback = jax.vjp(
+                    f, net.params[name], *[acts[i] for i in in_names])
+                _sync(out)
+            spans.append(sp)
+            acts[name] = out
+            entries.append((name, lname, pullback, in_names))
+
+        # output nodes: loss forward (their params engage here, not above)
+        loss_entries = []
+        for o in net.conf.outputs:
+            op = unwrap(net.conf.nodes[o].op)
+            w = net.output_loss_weights.get(o, 1.0)
+            yo = labels[o]
+            oname = f"{o}.{type(op).__name__}.loss".replace("/", "_")
+
+            def f_loss(p, pre, _op=op, _w=w, _y=yo):
+                if isinstance(_op, LossLayer):
+                    return _w * _op.compute_loss(pre, _y, mask=lmask)
+                return _w * _op.compute_loss(p, pre, _y, mask=lmask)
+
+            with tracer.span(f"forward/{oname}") as sp:
+                loss_o, lvjp = jax.vjp(f_loss, net.params[o], acts[o])
+                _sync(loss_o)
+            spans.append(sp)
+            loss_entries.append((o, oname, lvjp, loss_o))
+
+        cts: Dict[str, Any] = {}
+        for o, oname, lvjp, loss_o in loss_entries:
+            with tracer.span(f"backward/{oname}") as sp:
+                _dp, dpre = lvjp(_one(loss_o.dtype))
+                _sync(dpre)
+            spans.append(sp)
+            _accum(cts, o, dpre)
+        input_names = set(net.conf.inputs)
+        for name, lname, pullback, in_names in reversed(entries):
+            ct = cts.pop(name, None)
+            if ct is None:      # output never consumed → zero cotangent
+                continue
+            with tracer.span(f"backward/{lname}") as sp:
+                outs = pullback(ct)
+                _sync(outs)
+            spans.append(sp)
+            for n_, d in zip(in_names, outs[1:]):
+                if n_ not in input_names:
+                    _accum(cts, n_, d)
+    return _report(net, root, spans)
+
+
+def profile_step(net, ds, *, tracer=None, rng=None) -> Dict[str, Any]:
+    """Dispatch on network type (MultiLayerNetwork / ComputationGraph)."""
+    from ..nn.computation_graph import ComputationGraph
+    if isinstance(net, ComputationGraph):
+        return profile_cg_step(net, ds, tracer=tracer, rng=rng)
+    return profile_mln_step(net, ds, tracer=tracer, rng=rng)
+
+
+def observe_report(report: Dict[str, Any], registry=None) -> None:
+    """Feed a profile report into the registry: one ``dl4j_layer_time_ms``
+    histogram observation per (layer, direction), plus the accounted
+    fraction gauge tests and dashboards read."""
+    if registry is None:
+        from . import get_registry
+        registry = get_registry()
+    hist = registry.histogram(
+        "dl4j_layer_time_ms",
+        "Per-layer attributed time (interpreted profile pass)",
+        labelnames=("layer", "direction"), buckets=LAYER_MS_BUCKETS)
+    for row in report["layers"]:
+        hist.observe(row["forward_ms"], layer=row["layer"],
+                     direction="forward")
+        if row["backward_ms"]:
+            hist.observe(row["backward_ms"], layer=row["layer"],
+                         direction="backward")
+    if report.get("accounted_frac") is not None:
+        registry.gauge(
+            "dl4j_profile_accounted_fraction",
+            "Share of the profile pass's wall time inside named layer "
+            "spans (target ≥0.9)").set(report["accounted_frac"])

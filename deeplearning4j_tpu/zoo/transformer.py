@@ -223,10 +223,14 @@ def _xla_attention_bf16_scores(q, k, v, causal=True, bias=None):
     scale = 1.0 / math.sqrt(q.shape[-1])
     q = (q.astype(jnp.float32) * scale).astype(q.dtype)  # pre-scale q (exact
     # for power-of-two head dims), so no extra pass over the T^2 logits
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k,
-        precision=lax.DotAlgorithmPreset.BF16_BF16_F32,
-        preferred_element_type=jnp.bfloat16)
+    dot_kw = {"preferred_element_type": jnp.bfloat16}
+    if jax.default_backend() == "tpu":
+        # explicit MXU algorithm: bf16 inputs, f32 in-register accumulate,
+        # bf16 store. XLA:CPU rejects this preset outright (tier-1 runs
+        # the same path at toy shapes), so off-TPU the einsum falls back
+        # to the default algorithm for the dtype — same math, CPU-legal.
+        dot_kw["precision"] = lax.DotAlgorithmPreset.BF16_BF16_F32
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, **dot_kw)
     if bias is not None:
         logits = logits + bias.astype(jnp.bfloat16)
     if causal:

@@ -13,6 +13,13 @@ in the instrumented trees and fails on:
   second registration — this catches it statically, before a rarely-
   exercised code path does).
 
+Also lints the DOCS (ISSUE 7): every ``dl4j_``-prefixed token in
+docs/*.md + README.md must be a name some instrumentation site actually
+registers (wildcards like ``dl4j_bench_*`` must match ≥1 registered
+name; Prometheus exposition suffixes ``_bucket/_sum/_count`` resolve to
+their histogram) — so a doc example can never promise a metric the
+registry doesn't serve.
+
 Wired into the test suite as a fast unit test (tests/test_obs.py), so a
 stray name fails CI, not a Grafana query. Run standalone:
 ``python scripts/check_metric_names.py``.
@@ -32,8 +39,17 @@ REPO = Path(__file__).resolve().parent.parent
 # assert the runtime rejects them.
 SCAN = ["deeplearning4j_tpu", "bench.py", "scripts"]
 
+# docs whose dl4j_ mentions must resolve to registered metric names
+DOCS = ["docs", "README.md"]
+
+# dl4j_-prefixed doc tokens that are NOT metrics (library/namespace
+# mentions) — keep this list short and literal
+DOC_NON_METRIC_TOKENS = {"dl4j_", "dl4j_*", "dl4j_tpu_native"}
+
 _SITE = re.compile(
     r"\.(counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
+_DOC_TOKEN = re.compile(r"dl4j_[a-zA-Z0-9_]*\*?")
+_EXPO_SUFFIX = re.compile(r"_(bucket|sum|count)$")
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 NAMESPACE = "dl4j_"
 
@@ -81,6 +97,47 @@ def check(files=None) -> List[str]:
             errors.append(
                 f"duplicate registration of {name!r} as {sorted(ks)} "
                 f"at {', '.join(sites[name])}")
+    if files is None:     # full-tree run: docs must match the registry
+        errors.extend(check_docs(set(kinds)))
+    return errors
+
+
+def _doc_files() -> List[Path]:
+    out: List[Path] = []
+    for entry in DOCS:
+        p = REPO / entry
+        if p.is_file():
+            out.append(p)
+        elif p.is_dir():
+            out.extend(sorted(p.glob("*.md")))
+    return out
+
+
+def check_docs(known: Set[str], doc_files=None) -> List[str]:
+    """Every dl4j_ token a doc promises must resolve to a registered
+    instrumentation-site name (wildcard prefix / exposition suffix
+    aware). Returns human-readable violations."""
+    errors: List[str] = []
+    for f in doc_files or _doc_files():
+        text = f.read_text()
+        for m in _DOC_TOKEN.finditer(text):
+            tok = m.group(0)
+            if tok in DOC_NON_METRIC_TOKENS:
+                continue
+            where = f"{f.relative_to(REPO) if f.is_relative_to(REPO) else f}" \
+                    f":{text[:m.start()].count(chr(10)) + 1}"
+            if tok.endswith("*"):
+                prefix = tok[:-1]
+                if not any(n.startswith(prefix) for n in known):
+                    errors.append(
+                        f"{where}: doc wildcard {tok!r} matches no "
+                        "registered metric")
+                continue
+            base = _EXPO_SUFFIX.sub("", tok)
+            if tok not in known and base not in known:
+                errors.append(
+                    f"{where}: doc mentions unregistered metric {tok!r} "
+                    "(no .counter/.gauge/.histogram site registers it)")
     return errors
 
 
@@ -91,8 +148,10 @@ def main() -> int:
     n_names = len({m.group(2) for f in _files()
                    if f.name != "check_metric_names.py"
                    for m in _SITE.finditer(f.read_text())})
+    n_doc = sum(len(_DOC_TOKEN.findall(f.read_text()))
+                for f in _doc_files())
     print(f"check_metric_names: {n_names} metric names scanned, "
-          f"{len(errors)} violation(s)")
+          f"{n_doc} doc mention(s) checked, {len(errors)} violation(s)")
     return 1 if errors else 0
 
 

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Quick observability gate (ISSUE 7): metric-name + doc lint, then the
+# telemetry-plane and roofline-floor suites. One command, <2 min on CPU;
+# run before touching instrumentation, bench schema, or docs examples.
+#
+#   bash scripts/ci_quick.sh
+#
+# The full tier-1 suite is ROADMAP.md's verify line; this is the fast
+# inner loop for the obs/bench surface only.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== metric-name + doc lint =="
+python scripts/check_metric_names.py
+
+echo "== obs + floors suites =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
+    -q -m 'not slow' -p no:cacheprovider -p no:randomly
+
+echo "ci_quick: all green"

@@ -2,7 +2,11 @@
 
 Keeps the README's numbers artifact-backed by construction: the table
 between the BENCH-TABLE markers is produced from the artifact, never
-hand-edited. Run after a bench capture:
+hand-edited. Also enforces the floor-or-lever discipline (ISSUE 7):
+every rendered row must carry a ``floor`` block (or explicitly lack one,
+``floor: {"na": ...}`` — the dpoverhead delta row); a record with NO
+floor key predates the floor engine and is flagged as stale so the next
+capture re-derives it. Run after a bench capture:
     python scripts/refresh_readme_table.py
 """
 
@@ -13,6 +17,30 @@ import sys
 REPO = pathlib.Path(__file__).resolve().parent.parent
 BEGIN = "<!-- BENCH-TABLE BEGIN (scripts/refresh_readme_table.py) -->"
 END = "<!-- BENCH-TABLE END -->"
+
+_floor_warnings = []
+
+
+def floor_cell(label, rec):
+    """'% of floor' column + stale-row flagging. Three cases:
+    floor block with pct → the number (the row explains itself);
+    explicit na → em-dash (the record SAYS why it has no floor);
+    no floor key at all → pre-floor capture, flagged for re-capture."""
+    fl = rec.get("floor") if isinstance(rec, dict) else None
+    if fl is None:
+        if "floor" not in rec:
+            _floor_warnings.append(
+                f"row {label!r}: pre-floor record (captured before the "
+                "floor engine) — re-capture to get its roofline account")
+            return "— *(pre-floor)*"
+        return "—"
+    if "pct_of_floor" in fl:
+        res = {"compute": "MXU", "memory": "HBM"}.get(
+            fl.get("binding_resource"), "?")
+        return f"{100 * fl['pct_of_floor']:.0f}% ({res})"
+    if "na" in fl:
+        return "—"
+    return "—"
 
 
 def fmt_value(rec):
@@ -33,7 +61,10 @@ def row(label, rec, extra=""):
         return None
     mfu = rec.get("mfu")
     mfu_s = f"{mfu:.2f}" if isinstance(mfu, (int, float)) else "—"
-    return f"| {label} | {fmt_value(rec)}{extra} | {mfu_s} |"
+    if rec.get("unstable"):
+        extra += f" *(unstable: median of {rec.get('median_of_k')})*"
+    return (f"| {label} | {fmt_value(rec)}{extra} | {mfu_s} "
+            f"| {floor_cell(label, rec)} |")
 
 
 def main():
@@ -59,8 +90,8 @@ def main():
              "(each record carries `captured_at` + `git_sha` + "
              "`backend: tpu`):",
              "",
-             "| config | throughput | MFU |",
-             "|---|---|---|"]
+             "| config | throughput | MFU | % of floor |",
+             "|---|---|---|---|"]
     vsb = head.get("vs_baseline")
     rows = [
         row("ResNet-50 **real `fit(DataSetIterator)`**, bf16, batch 128",
@@ -88,7 +119,11 @@ def main():
     if isinstance(dp, dict) and dp.get("value") is not None:
         lines.append(f"| dp-8 ParallelWrapper overhead (virtual CPU mesh) "
                      f"| +{dp['value']:.1f} ms/step at equal global batch "
-                     f"| — |")
+                     f"| — | {floor_cell('dpoverhead', dp)} |")
+    if _floor_warnings:
+        lines.append("")
+        lines.append("*(rows marked pre-floor predate the roofline "
+                     "accounting — re-capture to fill the floor column)*")
     lines.append(END)
 
     readme = REPO / "README.md"
@@ -101,7 +136,11 @@ def main():
         print("no BENCH-TABLE markers in README — add them first")
         return 1
     readme.write_text(t)
-    print(f"README table refreshed from artifact at {sha}")
+    for w in _floor_warnings:
+        print(f"WARNING: {w}", file=sys.stderr)
+    print(f"README table refreshed from artifact at {sha}"
+          + (f" ({len(_floor_warnings)} pre-floor row(s) flagged)"
+             if _floor_warnings else ""))
     return 0
 
 

@@ -191,9 +191,18 @@ def test_mln_remat_loss_grads_identical(data, n_segments):
     remat.remat_segments = n_segments
     l1, g1 = lg(remat)
     assert float(l0) == pytest.approx(float(l1), abs=0)
+    # grads: near-identical, not bit-identical. XLA:CPU fuses the
+    # conv+BN backward differently once jax.checkpoint cuts the MLN
+    # forward into segments, reassociating f32 sums at the ~1 ulp level
+    # (observed max 1.2e-7 abs / 9e-6 rel); the CG variant above happens
+    # to fuse identically and stays exact. A real remat bug (wrong rng
+    # replay, dropped segment state) shows up orders of magnitude above
+    # this bound.
     jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
-                                                   np.asarray(b)), g0, g1)
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b),
+                                                rtol=1e-4, atol=1e-6),
+        g0, g1)
 
 
 def test_mln_remat_fit_and_inference(data):
