@@ -165,6 +165,16 @@ class Tracer:
                     self.dropped += 1   # deque(maxlen) evicts the oldest
                 self._finished.append(sp)
 
+    def add_span(self, sp: Span):
+        """Record an externally-assembled span. The scaleout hub times a
+        round across several handler threads (first frame -> close), so
+        no single thread can hold the ``span()`` context manager open —
+        it builds the Span by hand and deposits it here."""
+        with self._lock:
+            if len(self._finished) == self.max_spans:
+                self.dropped += 1
+            self._finished.append(sp)
+
     # ------------------------------------------------------ export
     def spans(self) -> List[Span]:
         with self._lock:

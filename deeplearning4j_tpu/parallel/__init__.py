@@ -23,9 +23,12 @@ from .tp import (ChannelShardedConvolution, ColumnParallelDense,
 from .ring_attention import (ring_attention, ring_attention_inner,
                              ring_attention_sharded)
 from .param_avg import ParameterAveragingTrainer
-from .scaleout import (ParamAveragingHub, ParameterAveragingTrainingMaster,
+from .leases import LeaseTable
+from .scaleout import (MasterDiedError, ParamAveragingHub,
+                       ParameterAveragingTrainingMaster,
                        SparkComputationGraph, SparkDl4jMultiLayer,
-                       TrainingMaster, WorkerClient, worker_main)
+                       TrainingMaster, WorkerClient, read_resume_state,
+                       worker_main)
 from .wrapper import ParallelInference, ParallelWrapper
 
 __all__ = [
@@ -47,5 +50,6 @@ __all__ = [
     "SocketGradientTransport",
     "TrainingMaster", "ParameterAveragingTrainingMaster",
     "SparkDl4jMultiLayer", "SparkComputationGraph", "ParamAveragingHub",
-    "WorkerClient", "worker_main",
+    "WorkerClient", "worker_main", "LeaseTable", "MasterDiedError",
+    "read_resume_state",
 ]

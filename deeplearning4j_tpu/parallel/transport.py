@@ -26,6 +26,10 @@ Wire format per frame:
   float32 sender threshold
   int64[] tokens (threshold_encode output)
 Broadcast reply: uint32 worker count, then the workers' frames in order.
+
+This module also owns the SCALEOUT frame protocol (kind-tagged frames
+used by ``parallel/scaleout.py``'s parameter-averaging hub) — both wire
+formats live here so every socket-facing byte layout is in one file.
 """
 
 from __future__ import annotations
@@ -43,6 +47,53 @@ from .grad_sharing import AdaptiveThreshold
 Address = Union[str, Tuple[str, int]]
 
 _HDR = struct.Struct("<If")  # payload bytes, sender threshold
+
+
+# ---------------------------------------------------------------------------
+# Scaleout frame protocol (parameter-averaging hub <-> worker).
+# One frame per message, little-endian:
+#   uint8   kind
+#   uint32  payload byte length
+#   bytes   payload (kind-specific, see below)
+# ---------------------------------------------------------------------------
+
+FRAME_HEADER = struct.Struct("<BI")      # kind, payload bytes
+
+KIND_PARAMS = 0     # float32[] flat params; worker -> hub contributes to
+#                     the round, hub -> worker returns the round mean
+KIND_DONE = 1       # worker -> hub: partition finished, leaving the job
+KIND_HELLO = 2      # uint32 worker id — first frame on every connect, so
+#                     the hub's worker labels are the CALLER's ids (a
+#                     known id on a fresh connection is a REJOIN)
+KIND_SPANCTX = 3    # hub -> worker right after HELLO: the master's span
+#                     context header (empty payload = tracing off)
+KIND_REJOIN = 4     # hub -> worker after SPANCTX: uint32 current round,
+#                     then float32[] current mean params (absent = no
+#                     round completed yet) — a (re)joiner starts from the
+#                     job's live state instead of its stale local params
+KIND_LEASE_REQ = 5  # worker -> hub: request a partition lease (empty)
+KIND_LEASE = 6      # hub -> worker: uint8 grant status (leases.GRANT_*),
+#                     then uint32 item id when status == GRANT_OK
+KIND_LEASE_DONE = 7  # worker -> hub: uint32 item id completed (no ack —
+#                     a completion lost with the connection is re-run,
+#                     the at-least-once half of the lease contract)
+
+
+def send_frame(conn: socket.socket, kind: int, payload: bytes = b""):
+    conn.sendall(FRAME_HEADER.pack(kind, len(payload)) + payload)
+
+
+def recv_frame(conn: socket.socket) -> Tuple[int, bytes]:
+    kind, nbytes = FRAME_HEADER.unpack(_recv_exact(conn, FRAME_HEADER.size))
+    payload = _recv_exact(conn, nbytes) if nbytes else b""
+    return kind, bytes(payload)
+
+
+def backoff_delays(base: float, cap: float, n: int) -> List[float]:
+    """The bounded exponential-backoff schedule used by scaleout's
+    ``WorkerClient``: delay before retry i is ``min(cap, base * 2**i)``.
+    Pure so the fast suite can pin the schedule."""
+    return [min(cap, base * (2 ** i)) for i in range(max(0, n))]
 
 
 # ---------------------------------------------------------------------------

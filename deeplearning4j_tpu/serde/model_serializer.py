@@ -11,6 +11,7 @@ a zip holding ``conf.pkl`` (config object), ``params.npz`` / ``states.npz``
 from __future__ import annotations
 
 import io
+import os
 import pickle
 import zipfile
 from pathlib import Path
@@ -85,7 +86,10 @@ def save_model(model, path, save_updater: bool = False, normalizer=None):
         return model.save(path, save_updater=save_updater)
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+    # write-then-rename: a crash mid-save must never corrupt an existing
+    # checkpoint at `path` (scaleout's master-restart resumes from it)
+    tmp = path.with_name(path.name + ".tmp")
+    with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("conf.pkl", pickle.dumps({
             "kind": type(model).__name__,
             "conf": model.conf,
@@ -100,6 +104,7 @@ def save_model(model, path, save_updater: bool = False, normalizer=None):
                 jax.tree_util.tree_map(lambda a: np.asarray(a), model._opt_state)))
         if normalizer is not None:
             zf.writestr("normalizer.pkl", pickle.dumps(normalizer))
+    os.replace(tmp, path)
 
 
 def load_model(path):

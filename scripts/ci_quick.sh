@@ -1,20 +1,22 @@
 #!/usr/bin/env bash
-# Quick observability gate (ISSUE 7): metric-name + doc lint, then the
-# telemetry-plane and roofline-floor suites. One command, <2 min on CPU;
-# run before touching instrumentation, bench schema, or docs examples.
+# Quick gate (ISSUE 7 + 8): metric-name + doc lint, then the
+# telemetry-plane, roofline-floor, and elastic-scaleout fast suites.
+# One command, <2 min on CPU; run before touching instrumentation,
+# bench schema, docs examples, or the scaleout plane.
 #
 #   bash scripts/ci_quick.sh
 #
 # The full tier-1 suite is ROADMAP.md's verify line; this is the fast
-# inner loop for the obs/bench surface only.
+# inner loop for the obs/bench/scaleout surface only.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== metric-name + doc lint =="
 python scripts/check_metric_names.py
 
-echo "== obs + floors suites =="
+echo "== obs + floors + scaleout-fast suites =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
+    tests/test_scaleout_fast.py \
     -q -m 'not slow' -p no:cacheprovider -p no:randomly
 
 echo "ci_quick: all green"

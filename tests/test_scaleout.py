@@ -9,6 +9,7 @@ import json
 import subprocess
 import sys
 import textwrap
+import warnings
 from pathlib import Path
 
 import numpy as np
@@ -185,6 +186,109 @@ def test_two_process_spark_job(tmp_path):
     w1 = np.load(outs[1])["w"]
     # both workers ended on the same averaged params (last round synced all)
     np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection matrix (ISSUE 8 acceptance): each failure schedule
+# completes the job, covers every partition, and lands within tolerance
+# of the uninterrupted run's loss.
+# ---------------------------------------------------------------------------
+
+def _job_score(net, datasets):
+    x = np.concatenate([np.asarray(d.features) for d in datasets])
+    y = np.concatenate([np.asarray(d.labels) for d in datasets])
+    return float(net.score(DataSet(x, y)))
+
+
+_MATRIX_TM = dict(n_workers=4, averaging_frequency=2, epochs_per_fit=2,
+                  worker_timeout=20.0)
+# averaging over different (but complete) lease schedules is not
+# bit-identical to the clean run — partial fits from the killed worker
+# and reassignment reorderings shift the trajectory slightly
+_LOSS_TOL = 0.15
+
+
+def _clean_loss(datasets, **overrides):
+    net = _net()
+    tm = ParameterAveragingTrainingMaster(**{**_MATRIX_TM, **overrides})
+    SparkDl4jMultiLayer(net, tm).fit(datasets)
+    return _job_score(net, datasets)
+
+
+def test_fault_matrix_worker_kill_rejoins_and_job_completes():
+    """Kill one of four workers mid-job with re-provisioning on: the
+    replacement rejoins under the same id, every partition is consumed,
+    and the final loss matches the uninterrupted run within tolerance."""
+    datasets = _data()
+    clean = _clean_loss(datasets)
+    net = _net()
+    spark = SparkDl4jMultiLayer(
+        net, ParameterAveragingTrainingMaster(**_MATRIX_TM))
+    with pytest.warns(UserWarning, match="failed mid-job"):
+        spark.fit(datasets, fail_worker=2, fail_after_steps=1,
+                  respawn_failed=True)
+    assert 2 in spark.dropped_workers
+    assert spark.rejoins >= 1                 # the replacement re-attached
+    counts = spark.lease_table.counts()
+    assert spark.lease_table.all_done() and counts["leased"] == 0
+    loss = _job_score(net, datasets)
+    assert abs(loss - clean) < _LOSS_TOL, (loss, clean)
+
+
+def test_fault_matrix_worker_kill_no_rejoin_leases_reassigned():
+    """Kill one worker with NO replacement: its leases flow to the
+    survivors — no partition is lost, loss stays within tolerance."""
+    datasets = _data()
+    clean = _clean_loss(datasets)
+    net = _net()
+    spark = SparkDl4jMultiLayer(
+        net, ParameterAveragingTrainingMaster(**_MATRIX_TM))
+    with pytest.warns(UserWarning, match="failed mid-job"):
+        spark.fit(datasets, fail_worker=1, fail_after_steps=1)
+    assert spark.dropped_workers == [1] and spark.rejoins == 0
+    counts = spark.lease_table.counts()
+    assert spark.lease_table.all_done() and counts["leased"] == 0
+    assert counts["reassigned"] >= 1          # survivors took the orphans
+    loss = _job_score(net, datasets)
+    assert abs(loss - clean) < _LOSS_TOL, (loss, clean)
+
+
+def test_fault_matrix_master_kill_restart_from_checkpoint(tmp_path):
+    """Kill the master between rounds: fit raises MasterDiedError leaving
+    the interrupted-job stamp; a second fit against the same
+    checkpoint_dir resumes (params + round numbering + lease table),
+    completes the remaining partitions, and clears the stamp."""
+    from deeplearning4j_tpu.parallel import MasterDiedError, read_resume_state
+
+    datasets = _data()
+    clean = _clean_loss(datasets,
+                        checkpoint_dir=str(tmp_path / "ck_clean"))
+    ck = tmp_path / "ck"
+    kwargs = dict(_MATRIX_TM, checkpoint_dir=str(ck), worker_timeout=10.0,
+                  worker_retries=2, worker_backoff=0.1)
+    net = _net()
+    spark = SparkDl4jMultiLayer(net, ParameterAveragingTrainingMaster(**kwargs))
+    with pytest.raises(MasterDiedError):
+        spark.fit(datasets, fail_master_after_rounds=1)
+    stamp = read_resume_state(ck)
+    assert stamp is not None and stamp[0] == spark.rounds >= 1
+    assert not spark.lease_table.all_done()   # the job IS interrupted
+
+    net2 = _net(seed=99)     # params come from the checkpoint, not seed
+    spark2 = SparkDl4jMultiLayer(net2,
+                                 ParameterAveragingTrainingMaster(**kwargs))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")       # late drops of run-1 ghosts
+        spark2.fit(datasets)
+    assert spark2.resumed
+    assert spark2.rounds > spark.rounds       # round numbering continued
+    assert spark2.lease_table.all_done()
+    # union of run 1's checkpointed completions and run 2's covers all —
+    # run 2 started from exactly the items the stamp recorded
+    assert not (ck / "leases.json").exists()  # completed job clears stamp
+    assert int((ck / "round.txt").read_text()) == spark2.rounds
+    loss = _job_score(net2, datasets)
+    assert abs(loss - clean) < _LOSS_TOL, (loss, clean)
 
 
 def test_spark_computation_graph_alias_trains_cg():
