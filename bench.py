@@ -32,6 +32,12 @@ dp-8 overhead) run after the headline and are written to `bench_secondary.json`
 (stderr progress only, stdout stays one line). `--model NAME [batch steps]`
 runs a single config and prints its record alone.
 
+Serving-plane configs (ISSUE 10: KV-cache decode tokens/s, TTFT at
+T=1024/4096 prefill, ResNet/BERT batch-1 p50/p99 + best-batch throughput
+through ParallelInference) run last into the artifact's `inference`
+section; rows captured off-TPU carry `on_chip_todo` until a chip
+re-capture (`bench.py --refresh inference_decode,...`).
+
 Reference parity: DL4J's published ResNet-50 V100 cuDNN number (~360 img/s)
 is the `vs_baseline` denominator — see BASELINE.md.
 """
@@ -875,6 +881,221 @@ def bench_resnet50(batch, steps):
     return rec
 
 
+# ------------------------------------------------------------ inference
+# Serving-plane rows (ISSUE 10) — written to the `inference` section of
+# bench_secondary.json. Captured wherever they run; a row captured off-TPU
+# is flagged `on_chip_todo` the same way the floor tables flag CPU-derived
+# flops (the schema and code path are proven now, the chip re-capture is
+# `bench.py --refresh inference_...`).
+
+def _flag_on_chip(rec):
+    if rec.get("backend") != "tpu":
+        rec["on_chip"] = False
+        rec["on_chip_todo"] = ("CPU-derived row — re-capture on the real "
+                               "chip via bench.py --refresh")
+    return rec
+
+
+def _serving_engine(max_seq):
+    """Flagship 120M Transformer-LM generation engine at context max_seq.
+    remat off: generation is forward-only, there are no residuals to
+    trade; flash/bf16-scores gating is the model's own (prefill runs the
+    same _attention the training forward does)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.serving import GenerationEngine
+    from deeplearning4j_tpu.zoo import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512, n_heads=8,
+                                n_layers=8, d_ff=2048, max_seq=max_seq,
+                                dtype=jnp.bfloat16, remat=False)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return GenerationEngine(cfg, params), cfg
+
+
+def bench_inference_decode(batch, steps):
+    """Decode tokens/sec/chip: one jitted donated-cache decode_step +
+    greedy sample per sweep over a `batch`-slot pool (the serving hot
+    path, T=1024 cache). Marginal chained-step timing like every other
+    row; flops from the traced decode step (attention against the full
+    static cache length — the work actually dispatched)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.utils.tracing import total_flops
+
+    eng, cfg = _serving_engine(1024)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, 64)))
+    cache = eng.init_cache(batch)
+    logits, cache = eng.prefill(cache, prompt)
+    tokens = jnp.argmax(logits, -1).astype(jnp.int32)
+    flops = total_flops(eng._decode_raw, eng.params, cache, tokens)
+
+    def step_once(cache, tokens):
+        logits, cache = eng.decode_step(cache, tokens)
+        toks = jnp.argmax(logits, -1).astype(jnp.int32)
+        return (cache, toks), toks
+
+    run_chain = chain_runner(step_once, [cache, tokens])
+    run_chain.floor_probe = _make_floor_probe(eng._decode, eng.params,
+                                              cache, tokens)
+    timing = measure_stable(run_chain, n1=5, n2=steps)
+    rec = _record(
+        "Serving decode tokens/sec/chip (Transformer-LM 120M, KV-cache "
+        "T=1024, greedy)",
+        "tokens/sec/chip", batch, timing, flops, probe=run_chain,
+        slots=batch, prefill_tokens=64,
+        note="one continuous-batching decode sweep = one token per slot; "
+             "scheduler occupancy metrics: dl4j_serving_*")
+    return _flag_on_chip(rec)
+
+
+def _ttft_row(seq, reps):
+    """Time-to-first-token at a `seq`-token prompt: wall-clock of one
+    jitted prefill + greedy sample + host fetch (compile excluded,
+    median of `reps`). This is the latency a request pays before its
+    decode slot starts streaming."""
+    import jax.numpy as jnp
+    import numpy as np
+    import statistics
+
+    eng, cfg = _serving_engine(seq)
+    rng = np.random.default_rng(0)
+    prompt = np.asarray(rng.integers(0, cfg.vocab_size, (seq,)), np.int32)
+    # caches pre-allocated outside the timed region (prefill donates its
+    # cache arg; a served slot reuses pool HBM, it doesn't re-alloc)
+    caches = [eng.init_cache(1) for _ in range(reps + 1)]
+    samples = []
+    for i, cache in enumerate(caches):
+        t0 = time.perf_counter()
+        logits, cache = eng.prefill_slot(cache, prompt, 0)
+        tok = int(np.asarray(jnp.argmax(logits)))
+        dt = time.perf_counter() - t0
+        if i:                      # first call pays compile — excluded
+            samples.append(dt)
+    med = float(statistics.median(samples))
+    try:
+        from deeplearning4j_tpu.obs import get_registry
+        get_registry().histogram(
+            "dl4j_serving_ttft_seconds",
+            "Time from submit to first generated token").observe(med)
+    except Exception:  # noqa: BLE001 — telemetry mirror is decoration
+        pass
+    rec = {
+        "metric": f"Serving time-to-first-token, T={seq} prefill "
+                  "(Transformer-LM 120M)",
+        "value": round(med * 1e3, 1), "unit": "ms",
+        "prefill_tokens": seq, "reps": len(samples),
+        "ttft_ms_samples": [round(s * 1e3, 1) for s in samples],
+        "first_token": tok,
+        "timing": "wall-clock prefill_slot + greedy sample + host fetch, "
+                  "compile excluded, median of reps",
+        "metrics": {"dl4j_serving_ttft_seconds": med},
+    }
+    return _flag_on_chip(_stamp(rec))
+
+
+def bench_inference_ttft_1024(batch, steps):
+    return _ttft_row(1024, reps=max(steps, 2))
+
+
+def bench_inference_ttft_4096(batch, steps):
+    return _ttft_row(4096, reps=max(steps, 2))
+
+
+def _latency_sweep(pi, make_batch, iters, batches=(1, 8, 32)):
+    """batch-1 p50/p99 + best-batch throughput through a LIVE
+    ParallelInference (jit dispatch, padding, host round-trip included —
+    the quantity a serving SLO is written against)."""
+    import numpy as np
+    x1 = make_batch(1)
+    pi.output(x1)                       # compile
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        pi.output(x1)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
+    sweep, best = {}, (None, 0.0)
+    for b in batches:
+        xb = make_batch(b)
+        pi.output(xb)                   # compile this batch shape
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            pi.output(xb)
+            times.append(time.perf_counter() - t0)
+        thr = b / min(times)
+        sweep[str(b)] = round(thr, 2)
+        if thr > best[1]:
+            best = (b, thr)
+    return {"p50_ms": round(p50 * 1e3, 2), "p99_ms": round(p99 * 1e3, 2),
+            "iters": iters, "best_batch": best[0],
+            "best_batch_throughput": round(best[1], 2),
+            "batch_sweep_samples_per_s": sweep}
+
+
+def bench_inference_resnet_b1(batch, steps):
+    """ResNet-50 online-serving latency through ParallelInference."""
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.parallel import ParallelInference
+    from deeplearning4j_tpu.zoo.resnet import ResNet50
+
+    net = ResNet50(num_classes=1000, compute_dtype=jnp.bfloat16).init()
+    pi = ParallelInference(net, max_batch=64)
+    rng = np.random.default_rng(0)
+
+    def make_batch(b):
+        return rng.random((b, 224, 224, 3), np.float32)
+
+    stats = _latency_sweep(pi, make_batch, iters=max(steps, 5))
+    rec = {"metric": "ResNet-50 batch-1 serving latency via "
+                     "ParallelInference (bf16)",
+           "value": stats["p50_ms"], "unit": "ms p50 (batch 1)",
+           "best_batch_unit": "samples/sec", **stats,
+           "timing": "wall-clock ParallelInference.output round-trips, "
+                     "compile excluded"}
+    return _flag_on_chip(_stamp(rec))
+
+
+def bench_inference_bert_b1(batch, steps):
+    """BERT-base (T=128) serving latency: the functional encoder served
+    through ParallelInference via serving.FunctionalInferenceModel."""
+    import jax
+    import numpy as np
+    from deeplearning4j_tpu.parallel import ParallelInference
+    from deeplearning4j_tpu.serving import FunctionalInferenceModel
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    cfg = tfm.BertConfig(max_seq=128)
+    params = tfm.bert_init(jax.random.PRNGKey(0), cfg)
+    model = FunctionalInferenceModel(
+        params, lambda p, ids: tfm.bert_forward(p, cfg, ids)[0])
+    pi = ParallelInference(model, max_batch=64)
+    rng = np.random.default_rng(0)
+
+    def make_batch(b):
+        return rng.integers(0, cfg.vocab_size, (b, cfg.max_seq)).astype(
+            np.int32)
+
+    stats = _latency_sweep(pi, make_batch, iters=max(steps, 5),
+                           batches=(1, 8, 16))
+    rec = {"metric": "BERT-base batch-1 serving latency via "
+                     "ParallelInference (T=128)",
+           "value": stats["p50_ms"], "unit": "ms p50 (batch 1)",
+           "best_batch_unit": "samples/sec", **stats,
+           "timing": "wall-clock ParallelInference.output round-trips, "
+                     "compile excluded"}
+    return _flag_on_chip(_stamp(rec))
+
+
+INFERENCE_ROWS = ("inference_decode", "inference_ttft_1024",
+                  "inference_ttft_4096", "inference_resnet_b1",
+                  "inference_bert_b1")
+
 CONFIGS = {
     "resnet50": bench_resnet50_fit,   # headline: the REAL fit() entry point
     "resnet50_rawstep": bench_resnet50,
@@ -888,6 +1109,11 @@ CONFIGS = {
     "transformer_long": bench_transformer_long,
     "transformer_xlong": bench_transformer_xlong,
     "dpoverhead": bench_dpoverhead,
+    "inference_decode": bench_inference_decode,
+    "inference_ttft_1024": bench_inference_ttft_1024,
+    "inference_ttft_4096": bench_inference_ttft_4096,
+    "inference_resnet_b1": bench_inference_resnet_b1,
+    "inference_bert_b1": bench_inference_bert_b1,
 }
 
 DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
@@ -911,10 +1137,17 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     "transformer_long": (4, 9),   # 16k tokens/step (T=1024 runs 32k at b32)
     "transformer_xlong": (4, 9),  # T=8192 b4 remat-off — 32k tokens/step
     "dpoverhead": (1024, 20),
+    # serving rows: batch = decode slots / fixed 1; steps = chain length
+    # (decode) or timed reps (latency rows)
+    "inference_decode": (8, 25),
+    "inference_ttft_1024": (1, 3),
+    "inference_ttft_4096": (1, 2),   # T=4096 prefill is minutes on CPU
+    "inference_resnet_b1": (1, 15),
+    "inference_bert_b1": (1, 12),
 }
 
 
-def _write_secondary(headline, secondary):
+def _write_secondary(headline, secondary, inference=None):
     """Atomic write (temp + rename) after EVERY config, so a crash mid-run
     can never leave a stale artifact claiming to be current (the r3 failure:
     bench_secondary.json on disk was still the r2 output).
@@ -923,10 +1156,21 @@ def _write_secondary(headline, secondary):
     complementary failure, hit in r4 when the tunnel died for hours): when
     this run has no timings but the artifact on disk holds a real capture,
     that capture is preserved under `last_verified` — explicitly stamped
-    with its own sha/timestamp, never masquerading as current."""
+    with its own sha/timestamp, never masquerading as current.
+
+    ``inference`` (ISSUE 10 serving rows) defaults to whatever the
+    artifact on disk already holds — a training-only capture must not
+    silently drop the serving section."""
     import os
-    out = {"headline": headline, "secondary": secondary}
     path = _artifact_path()
+    if inference is None:
+        try:
+            inference = json.loads(path.read_text()).get("inference")
+        except Exception:  # noqa: BLE001 — absent/corrupt previous artifact
+            inference = None
+    out = {"headline": headline, "secondary": secondary}
+    if inference:
+        out["inference"] = inference
     this_run_failed = (isinstance(headline, dict)
                        and headline.get("value") is None)
     if this_run_failed:
@@ -955,14 +1199,17 @@ def _artifact_path():
 def _run_row_subprocess(name):
     """One secondary row in a fresh interpreter (isolation: residual
     allocator/compile state measurably depresses shared-process configs).
-    Returns the row's record dict, or {"error": ...} on any failure."""
+    Returns the row's record dict, or {"error": ...} on any failure.
+    Serving rows get a longer leash: a CPU-derived T=4096 prefill is
+    minutes per rep (wall-clock row, not a marginal chain)."""
     import os
     import subprocess
     script = os.path.abspath(__file__)
+    timeout = 1800 if name in INFERENCE_ROWS else 900
     try:
         proc = subprocess.run([sys.executable, script, "--model", name],
                               capture_output=True, text=True,
-                              timeout=900, cwd=os.path.dirname(script))
+                              timeout=timeout, cwd=os.path.dirname(script))
         if proc.returncode == 0 and proc.stdout.strip():
             rec = json.loads(proc.stdout.strip().splitlines()[-1])
             if not isinstance(rec, dict):
@@ -987,6 +1234,7 @@ def _refresh_rows(names):
     art = json.loads(_artifact_path().read_text())
     headline = art.get("headline", {})
     secondary = art.get("secondary", {})
+    inference = art.get("inference", {})
     if headline.get("value") is None:
         print("no headline in artifact; run a full capture first",
               file=sys.stderr)
@@ -1000,18 +1248,22 @@ def _refresh_rows(names):
         if name not in CONFIGS:
             print(f"unknown row {name!r}", file=sys.stderr)
             continue
+        # serving rows live in the `inference` section, everything else
+        # in `secondary` — one refresh path serves both
+        section = inference if name in INFERENCE_ROWS else secondary
         rec = _run_row_subprocess(name)
-        if rec.get("value") is None and name in secondary \
-                and isinstance(secondary[name], dict) \
-                and secondary[name].get("value") is not None:
+        if rec.get("value") is None and name in section \
+                and isinstance(section[name], dict) \
+                and section[name].get("value") is not None:
             print(f"[bench] {name}: refresh FAILED "
                   f"({rec.get('error', rec)!s:.200}); previous record kept",
                   file=sys.stderr, flush=True)
             continue
-        secondary[name] = rec
+        section[name] = rec
         print(f"[bench] {name}: {rec.get('value', rec)}",
               file=sys.stderr, flush=True)
-        _write_secondary(headline, secondary)  # write per row (crash safety)
+        # write per row (crash safety)
+        _write_secondary(headline, secondary, inference)
 
 
 def main():
@@ -1097,6 +1349,23 @@ def main():
         _write_secondary(headline, secondary)
     secondary.pop("_incomplete", None)
     _write_secondary(headline, secondary)
+
+    # Serving-plane rows (ISSUE 10) -> `inference` section. Own time
+    # budget so a slow training capture can't permanently starve the
+    # serving numbers (and vice versa); same per-row subprocess
+    # isolation. Prior rows are preserved on per-row failure by
+    # _write_secondary's read-back only when this loop never runs.
+    t_inf = time.perf_counter()
+    inference = {}
+    for name in INFERENCE_ROWS:
+        if time.perf_counter() - t_inf > 1200:
+            inference[name] = {"skipped": "time budget"}
+        else:
+            inference[name] = _run_row_subprocess(name)
+        print(f"[bench] {name}: "
+              f"{inference[name].get('value', inference[name])}",
+              file=sys.stderr, flush=True)
+        _write_secondary(headline, secondary, inference)
 
 
 if __name__ == "__main__":

@@ -17,6 +17,10 @@ Instrumented surfaces (all under the ``dl4j_`` namespace —
   including the ``floor`` roofline block (``obs.floors``, ISSUE 7).
 - ``nn.listeners.ProfilingListener`` — per-layer time attribution
   (``obs.profiler``): ``dl4j_layer_time_ms`` + JSONL layer spans.
+- ``serving.scheduler`` — the continuous-batching serving plane
+  (ISSUE 10): ``dl4j_serving_*`` slot occupancy, TTFT / queue-wait /
+  latency histograms, token + preemption counters, and
+  ``serving.prefill`` / ``serving.decode`` spans.
 """
 
 from .registry import (Counter, DEFAULT_BUCKETS, Gauge,  # noqa: F401

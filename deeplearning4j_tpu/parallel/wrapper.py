@@ -13,7 +13,9 @@ Aeron. `fit()` is a drop-in for MultiLayerNetwork/ComputationGraph fit.
 
 from __future__ import annotations
 
+import threading
 import time
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Optional
 
 import jax
@@ -300,13 +302,21 @@ class ParallelInference:
     """Sharded batched inference (reference ParallelInference).
 
     Splits incoming batches over the dp axis; with `dynamic_batching`,
-    requests accumulate to `max_batch` before one device sweep.
+    requests accumulate to `max_batch` before one device sweep. With
+    ``max_wait_ms`` set, a partial batch is flushed by a deadline timer
+    once its OLDEST request has waited that long — a trickle of traffic
+    below `max_batch` no longer waits forever for a flush it can't
+    trigger. Every ``submit`` returns a Future for that request's rows,
+    resolved at whichever flush carries them (size threshold, deadline,
+    or an explicit ``flush()``).
     """
 
-    def __init__(self, net, mesh: Optional[Mesh] = None, max_batch: int = 64):
+    def __init__(self, net, mesh: Optional[Mesh] = None, max_batch: int = 64,
+                 max_wait_ms: Optional[float] = None):
         self.net = net
         self.mesh = mesh or data_parallel_mesh()
         self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
         self._rep = NamedSharding(self.mesh, P())
         batch_axes = tuple(a for a in ("dp",) if a in self.mesh.axis_names)
         self._batch_sh = NamedSharding(self.mesh, P(batch_axes or None))
@@ -327,6 +337,30 @@ class ParallelInference:
         self._infer = None
         self._pending = []
         self._pending_ts = []  # enqueue time per request (queue-wait metric)
+        self._pending_futures = []   # one Future per submitted request
+        self._lock = threading.RLock()
+        self._timer: Optional[threading.Timer] = None
+        if max_wait_ms is not None:
+            # a deadline timer firing DURING interpreter shutdown
+            # dispatches into a jax runtime that is mid-teardown and
+            # aborts the process (std::terminate). atexit runs before
+            # jax's own exit hooks (LIFO; jax registered at import), so
+            # cancel-or-drain the timer while the runtime is still up.
+            import atexit
+            import weakref
+            ref = weakref.ref(self)
+            atexit.register(lambda: (lambda s: s and s._drain_timer())(
+                ref()))
+
+    def _drain_timer(self):
+        """Cancel a pending deadline timer; if its callback is already
+        mid-flush, wait for it to finish (process-exit path)."""
+        with self._lock:
+            t, self._timer = self._timer, None
+        if t is not None:
+            t.cancel()
+            if t.is_alive():
+                t.join(timeout=30)
 
     def refresh(self):
         """Re-copy the net's current params (e.g. after more training)."""
@@ -374,17 +408,63 @@ class ParallelInference:
         return np.asarray(out)[:orig]
 
     def submit(self, x):
-        """Dynamic batching: queue a request; flush() runs one sweep."""
-        self._pending.append(np.asarray(x))
-        self._pending_ts.append(time.perf_counter())
-        get_registry().counter(
-            "dl4j_inference_requests_total",
-            "Requests submitted to dynamic batching").inc()
-        if sum(p.shape[0] for p in self._pending) >= self.max_batch:
-            return self.flush()
-        return None
+        """Dynamic batching: queue a request. Flushes inline (and returns
+        the whole batch's parts, legacy contract) when the size threshold
+        is met; otherwise returns this request's Future, which resolves
+        at the flush that carries it — the deadline timer's flush when
+        ``max_wait_ms`` is set, or an explicit ``flush()``."""
+        x = np.asarray(x)
+        with self._lock:
+            if self._pending and x.shape[1:] != self._pending[0].shape[1:]:
+                raise ValueError(
+                    f"mixed-shape submission: request rows have shape "
+                    f"{x.shape[1:]} but the pending dynamic batch holds "
+                    f"{self._pending[0].shape[1:]} — flush() concatenates "
+                    "on axis 0, so per-request trailing dims must match "
+                    "(flush or use a separate ParallelInference per shape)")
+            fut: Future = Future()
+            self._pending.append(x)
+            self._pending_ts.append(time.perf_counter())
+            self._pending_futures.append(fut)
+            get_registry().counter(
+                "dl4j_inference_requests_total",
+                "Requests submitted to dynamic batching").inc()
+            if sum(p.shape[0] for p in self._pending) >= self.max_batch:
+                return self._flush_locked()
+            if self.max_wait_ms is not None and self._timer is None:
+                t = threading.Timer(self.max_wait_ms / 1e3,
+                                    lambda: self._deadline_flush(t))
+                t.daemon = True
+                t.start()
+                self._timer = t
+        return fut
+
+    def _deadline_flush(self, timer):
+        """Timer callback: the oldest pending request hit max_wait_ms —
+        sweep whatever is queued. Results reach callers via the Futures
+        submit returned. ``timer`` identity-guards the race where a
+        fired-but-lock-blocked timer outlives the flush that retired it:
+        a stale callback must neither flush the NEXT batch early nor
+        orphan that batch's live timer handle."""
+        with self._lock:
+            if self._timer is not timer:
+                return
+            self._timer = None
+            if self._pending:
+                get_registry().counter(
+                    "dl4j_inference_deadline_flushes_total",
+                    "Dynamic batches flushed by the max_wait_ms deadline "
+                    "rather than the size threshold").inc()
+                self._flush_locked()
 
     def flush(self):
+        with self._lock:
+            return self._flush_locked()
+
+    def _flush_locked(self):
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
         if not self._pending:
             return []
         sizes = [p.shape[0] for p in self._pending]
@@ -406,11 +486,26 @@ class ParallelInference:
             batch.shape[0] / max(self.max_batch, 1))
         reg.counter("dl4j_inference_batches_total",
                     "Dynamic batches swept through the device").inc()
+        futures = self._pending_futures
         self._pending = []
         self._pending_ts = []
-        out = self.output(batch)
+        self._pending_futures = []
+        try:
+            out = self.output(batch)
+        except Exception as e:
+            for f in futures:       # a deadline-flush caller only has the
+                try:                # Future to learn of the failure from
+                    f.set_exception(e)
+                except InvalidStateError:
+                    pass            # caller cancelled while queued
+            raise
         parts, off = [], 0
-        for s in sizes:
+        for s, f in zip(sizes, futures):
             parts.append(out[off:off + s])
+            try:
+                f.set_result(out[off:off + s])
+            except InvalidStateError:
+                pass   # this caller cancelled; its rows still ship in
+                       # the parts list, the OTHER futures must resolve
             off += s
         return parts

@@ -1,0 +1,38 @@
+"""deeplearning4j_tpu.serving — the inference/serving plane (ISSUE 10).
+
+Three layers over the zoo Transformer-LM:
+
+- :mod:`kvcache` — preallocated per-layer KV cache, fixed ``max_len``
+  slots, position cursors; a plain donated pytree.
+- :mod:`engine` — :class:`GenerationEngine`: jitted ``prefill`` (prompt →
+  cache + last logits) and donated-cache single-token ``decode_step``,
+  plus greedy/temperature/top-k :func:`sample_tokens` under an explicit
+  PRNG key. Logit-equivalent to the full forward at every position
+  (tests/test_serving.py).
+- :mod:`scheduler` — :class:`ContinuousBatchingScheduler`: fixed decode
+  slot pool, per-slot admission prefill interleaved with full-pool
+  decode sweeps, optional starvation preemption, per-request futures,
+  and ``dl4j_serving_*`` metrics on the unified telemetry plane.
+
+Plus :class:`FunctionalInferenceModel`, the shim that lets
+``ParallelInference`` dynamic-batch a pure-functional forward (BERT,
+the LM) like any network.
+
+Quickstart: ``zoo.transformer.generate(params, cfg, ids, 32)`` for a
+one-shot, or README "Serving quickstart" for the scheduler loop.
+"""
+
+from .adapter import FunctionalInferenceModel  # noqa: F401
+from .engine import (DEFAULT_PREFILL_BUCKETS, GenerationEngine,  # noqa: F401
+                     sample_tokens)
+from .kvcache import (cache_len, cache_nbytes, cache_slots,  # noqa: F401
+                      init_cache)
+from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
+                        GenerationResult, ServingRequest)
+
+__all__ = [
+    "ContinuousBatchingScheduler", "DEFAULT_PREFILL_BUCKETS",
+    "FunctionalInferenceModel", "GenerationEngine", "GenerationResult",
+    "ServingRequest", "cache_len", "cache_nbytes", "cache_slots",
+    "init_cache", "sample_tokens",
+]

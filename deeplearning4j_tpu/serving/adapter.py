@@ -1,0 +1,37 @@
+"""Adapter: serve a pure-functional forward through ``ParallelInference``.
+
+The zoo's functional models (the Transformer-LM, BERT) are params+fn
+pairs, not ``MultiLayerNetwork``/``ComputationGraph`` objects — so the
+dynamic-batching / dp-sharded serving machinery in ``parallel.wrapper``
+couldn't touch them. This shim gives a functional forward the four
+attributes ``ParallelInference`` actually uses (``params``, ``states``,
+``conf.nodes``, ``_forward``) and nothing else; params land under one
+``"model"`` key and resolve to replicated sharding (no layer op to
+declare tp pspecs).
+
+    bert = FunctionalInferenceModel(
+        params, lambda p, ids: tfm.bert_forward(p, cfg, ids)[0])
+    pi = ParallelInference(bert, max_batch=8, max_wait_ms=5.0)
+    logits = pi.output(ids)          # or pi.submit(ids) for batching
+"""
+
+from __future__ import annotations
+
+
+class _EmptyConf:
+    """Just enough of a net conf for ``network_param_shardings``."""
+    nodes: dict = {}
+
+
+class FunctionalInferenceModel:
+    """Wrap ``forward(params, x) -> y`` for ``ParallelInference``."""
+
+    def __init__(self, params, forward):
+        self.params = {"model": params}
+        self.states = {}
+        self.conf = _EmptyConf()
+        self._fwd = forward
+        self.initialized = True
+
+    def _forward(self, params, states, x, train=False, rng=None):
+        return self._fwd(params["model"], x), states

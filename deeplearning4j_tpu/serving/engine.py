@@ -1,0 +1,321 @@
+"""Generation engine for the zoo Transformer-LM: jitted KV-cache prefill
+and single-token decode, plus greedy/temperature/top-k sampling.
+
+Two device entry points, both compiled once per shape and reused for the
+life of the engine:
+
+- ``prefill`` — runs the prompt through the ordinary block stack (the
+  SAME ``apply_blocks`` the training forward uses, ``return_kv=True``),
+  writes every layer's k/v into the cache, and returns ONLY the last
+  valid position's logits (``(B, V)`` — never the ``(B, T, V)`` tensor a
+  generation step doesn't need; at T=4096/V=32k that tensor alone is
+  0.5 GB f32).
+- ``decode_step`` — one token per slot: embed at each slot's own
+  position cursor, scan the stacked blocks with the cache riding the
+  scan's xs/ys (layer l's k/v slab is consumed and re-emitted in place),
+  attend causally against the cache under a per-slot length mask. The
+  cache argument is DONATED, so the decode loop never holds two copies
+  of the K/V HBM.
+
+Correctness is anchored the ``rnn_time_step`` way (tests/test_serving.py):
+prefill+decode logits must match the full forward at every position
+within fp tolerance — the cache is an optimization, never a different
+model.
+
+Single-chip inference path: MoE (`n_experts`) and ring attention are
+training-parallelism features with no single-token analogue here and are
+rejected at construction. Prefill inherits the model's own attention
+gating (`flash_engages`), so a TPU prefill at flash-sized T runs the
+pallas kernel exactly like the training forward.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..zoo import transformer as tfm
+from . import kvcache
+
+# prompt lengths are padded up to one of these before the jitted
+# per-slot prefill runs, so mixed-length traffic compiles a handful of
+# kernels instead of one per distinct prompt length (clipped to the
+# engine's max_len; max_len itself is always a bucket)
+DEFAULT_PREFILL_BUCKETS = (32, 128, 512, 1024, 2048, 4096, 8192)
+
+_NEG_INF = -1e30  # mask value: finite, softmax-safe in f32
+
+
+def sample_tokens(key, logits, temperature, top_k):
+    """Vectorized next-token sampling: (B, V) f32 logits, per-slot
+    ``temperature`` (B,) and ``top_k`` (B,) — a slot with
+    ``temperature <= 0`` decodes greedily (argmax, key unused), one with
+    ``top_k > 0`` samples only among its k highest logits. Per-slot
+    knobs make one jitted sampler serve a mixed-request decode sweep.
+    """
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    temperature = jnp.asarray(temperature, jnp.float32).reshape(-1)
+    top_k = jnp.asarray(top_k, jnp.int32).reshape(-1)
+    # top-k filter: threshold at each row's k-th largest logit
+    desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    kk = jnp.clip(jnp.where(top_k > 0, top_k, v), 1, v)
+    thresh = jnp.take_along_axis(desc, (kk - 1)[:, None], axis=-1)
+    filtered = jnp.where(logits >= thresh, logits, _NEG_INF)
+    scaled = filtered / jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def _cached_attention(cfg, q, k, v, pos):
+    """Single-token attention against the cache: q (B, H, Dh) vs
+    k/v (B, S, H, Dh), each slot masked to its own length (positions
+    ``<= pos[b]`` — pos is the index the current token was just written
+    at). Scores accumulate f32 regardless of cache dtype; out-of-range
+    cache rows never contribute."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhd,bshd->bhs",
+                        (q.astype(jnp.float32) * scale),
+                        k.astype(jnp.float32))
+    s = k.shape[1]
+    mask = jnp.arange(s)[None, :] <= pos[:, None]          # (B, S)
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(cfg.dtype)
+
+
+class GenerationEngine:
+    """Prefill/decode engine bound to one (cfg, params) pair.
+
+    The engine owns the jitted functions; callers own the cache pytree
+    (``init_cache``) and thread it through ``prefill`` / ``decode_step``
+    — the functional style every other step in this codebase uses, so
+    the cache composes with donation and with schedulers that interleave
+    prefill and decode on one pool.
+    """
+
+    def __init__(self, cfg, params, *, max_len: Optional[int] = None,
+                 prefill_buckets=DEFAULT_PREFILL_BUCKETS):
+        if getattr(cfg, "n_experts", 0):
+            raise NotImplementedError(
+                "GenerationEngine is dense-only: MoE expert dispatch has "
+                "no single-token decode path yet (train MoE via the GSPMD "
+                "path; see ROADMAP)")
+        if cfg.use_ring_attention:
+            raise NotImplementedError(
+                "ring attention is a sequence-parallel TRAINING path; the "
+                "decode step attends one token against a local cache — "
+                "construct the engine with use_ring_attention=False")
+        self.cfg = cfg
+        self.params = params
+        self.max_len = int(cfg.max_seq if max_len is None else max_len)
+        if self.max_len > cfg.max_seq:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds cfg.max_seq="
+                f"{cfg.max_seq}: no position rows past the table")
+        self.prefill_buckets = tuple(sorted(
+            {min(b, self.max_len) for b in prefill_buckets} | {self.max_len}))
+        # jit once; cache (argnum 1 after params) donated on every path
+        self._decode = jax.jit(self._decode_raw, donate_argnums=(1,))
+        self._prefill = jax.jit(self._prefill_raw, donate_argnums=(1,))
+        self._prefill_slot = jax.jit(self._prefill_slot_raw,
+                                     donate_argnums=(1,))
+        self._sample = jax.jit(sample_tokens)
+
+    # ------------------------------------------------------------ cache
+    def init_cache(self, n_slots: int):
+        return kvcache.init_cache(self.cfg, n_slots, self.max_len)
+
+    def refresh(self, params):
+        """Swap in new params (e.g. after more training). Compiled fns
+        are shape-keyed, so no retrace as long as shapes match."""
+        self.params = params
+        return self
+
+    # ----------------------------------------------------- device fns
+    def _prefill_trunk(self, params, tokens):
+        """Shared prompt pass: embedded tokens through the block stack
+        with per-layer k/v capture. Returns (hidden, k, v)."""
+        cfg = self.cfg
+        x = tfm.embed(params, cfg, tokens)
+        x, _, (ks, vs) = tfm.apply_blocks(params["blocks"], cfg, x,
+                                          return_kv=True)
+        return x, ks, vs
+
+    def _prefill_raw(self, params, cache, tokens, lengths):
+        """Whole-pool prefill: tokens (B, T) — B must equal the cache's
+        slot count — lengths (B,) valid-prefix lengths (padding rows
+        beyond a row's length leave garbage k/v that the pos mask never
+        exposes). Returns (last-position logits (B, V) f32, cache)."""
+        x, ks, vs = self._prefill_trunk(params, tokens)
+        k_cache = lax.dynamic_update_slice(
+            cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0))
+        b, t = tokens.shape
+        last = jnp.clip(lengths - 1, 0, t - 1)
+        x_last = x[jnp.arange(b), last]
+        logits = tfm.head_logits_rows(params, self.cfg, x_last)
+        return logits, {"k": k_cache, "v": v_cache,
+                        "pos": lengths.astype(jnp.int32)}
+
+    def _prefill_slot_raw(self, params, cache, tokens, length, slot):
+        """Admit ONE request into slot ``slot`` of a live pool: tokens
+        (1, T_bucket) padded prompt, ``length`` its true length. Only
+        this slot's cache rows and cursor change — in-flight neighbours
+        are untouched, which is what lets admission interleave with
+        decode on the same cache."""
+        x, ks, vs = self._prefill_trunk(params, tokens)
+        k_cache = lax.dynamic_update_slice(
+            cache["k"], ks.astype(cache["k"].dtype), (0, slot, 0, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            cache["v"], vs.astype(cache["v"].dtype), (0, slot, 0, 0, 0))
+        t = tokens.shape[1]
+        x_last = x[0, jnp.clip(length - 1, 0, t - 1)]
+        logits = tfm.head_logits_rows(params, self.cfg, x_last[None])[0]
+        pos = cache["pos"].at[slot].set(length.astype(jnp.int32))
+        return logits, {"k": k_cache, "v": v_cache, "pos": pos}
+
+    def _decode_raw(self, params, cache, tokens):
+        """One decode step for the whole pool: tokens (B,) int32 → next
+        logits (B, V) f32 + advanced cache. Each slot writes its token's
+        k/v at its own cursor and attends to its own prefix; a slot past
+        capacity drops the write (scatter OOB is a no-op) and its output
+        is garbage the scheduler must mask — capacity accounting is the
+        scheduler's admission-time job, not a per-step branch here."""
+        cfg = self.cfg
+        pos = cache["pos"]
+        b = tokens.shape[0]
+        h_, dh = cfg.n_heads, cfg.head_dim
+        x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+        x = x * math.sqrt(cfg.d_model)
+        pos_rows = jnp.take(params["pos_embed"],
+                            jnp.clip(pos, 0, cfg.max_seq - 1), axis=0)
+        x = x + pos_rows.astype(cfg.dtype)                     # (B, d)
+
+        def block(x, xs):
+            blk, kl, vl = xs
+            hh = tfm._rmsnorm(x, blk["ln1"])
+            qkv = hh @ blk["wqkv"].astype(hh.dtype)            # (B, 3h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, h_, dh)
+            kl = kl.at[jnp.arange(b), pos].set(
+                k.reshape(b, h_, dh).astype(kl.dtype))
+            vl = vl.at[jnp.arange(b), pos].set(
+                v.reshape(b, h_, dh).astype(vl.dtype))
+            a = _cached_attention(cfg, q, kl, vl, pos).reshape(b, h_ * dh)
+            x = x + a @ blk["wo"].astype(hh.dtype)
+            h2 = tfm._rmsnorm(x, blk["ln2"])
+            m = jax.nn.gelu(h2 @ blk["w_in"].astype(h2.dtype)) \
+                @ blk["w_out"].astype(h2.dtype)
+            return x + m, (kl, vl)
+
+        x, (k_new, v_new) = lax.scan(block, x,
+                                     (params["blocks"], cache["k"],
+                                      cache["v"]))
+        logits = tfm.head_logits_rows(params, cfg, x)
+        return logits, {"k": k_new, "v": v_new, "pos": pos + 1}
+
+    # ------------------------------------------------------- host API
+    def prefill(self, cache, tokens, lengths=None):
+        """Prefill the whole pool. ``tokens`` (B, T) with B == cache
+        slots; ``lengths`` (B,) defaults to the full T per row."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        if tokens.ndim != 2:
+            raise ValueError(f"prefill wants (B, T) token ids, got shape "
+                             f"{tokens.shape}")
+        if tokens.shape[1] > self.max_len:
+            raise ValueError(f"prompt length {tokens.shape[1]} exceeds the "
+                             f"cache capacity max_len={self.max_len}")
+        if tokens.shape[0] != kvcache.cache_slots(cache):
+            raise ValueError(
+                f"prefill batch {tokens.shape[0]} != cache slots "
+                f"{kvcache.cache_slots(cache)} (use prefill_slot for "
+                "single-request admission)")
+        if lengths is None:
+            lengths = jnp.full((tokens.shape[0],), tokens.shape[1],
+                               jnp.int32)
+        return self._prefill(self.params, cache, tokens,
+                             jnp.asarray(lengths, jnp.int32))
+
+    def prefill_slot(self, cache, tokens, slot: int):
+        """Admit one 1-D prompt into ``slot``; pads to the next prefill
+        bucket so mixed lengths reuse a few compiled kernels. Returns
+        (last logits (V,), cache)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = tokens.shape[0]
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n > self.max_len:
+            raise ValueError(f"prompt length {n} exceeds cache capacity "
+                             f"max_len={self.max_len}")
+        bucket = next(b for b in self.prefill_buckets if b >= n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = tokens
+        return self._prefill_slot(self.params, cache, jnp.asarray(padded),
+                                  jnp.int32(n), jnp.int32(slot))
+
+    def decode_step(self, cache, tokens):
+        """One token for every slot: tokens (B,) → (logits (B, V), cache).
+        The passed cache is DONATED — keep only the returned one."""
+        return self._decode(self.params, cache,
+                            jnp.asarray(tokens, jnp.int32).reshape(-1))
+
+    def sample(self, key, logits, temperature=0.0, top_k=0):
+        """Next tokens from (B, V) logits; scalar knobs broadcast to the
+        pool, vectors give per-slot control."""
+        bsz = logits.shape[0]
+        temperature = jnp.broadcast_to(
+            jnp.asarray(temperature, jnp.float32), (bsz,))
+        top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (bsz,))
+        return self._sample(key, logits, temperature, top_k)
+
+    def generate(self, prompt_ids, max_new_tokens=32, *, key=None,
+                 temperature=0.0, top_k=0, eos_id=None):
+        """One-shot batched generation: prefill the prompt(s), then
+        sample/decode up to ``max_new_tokens``. Returns generated ids
+        (prompt excluded) as numpy — ``(B, n)`` (rows past their eos are
+        padded with ``eos_id``) or ``(n,)`` for a 1-D prompt."""
+        ids = np.asarray(prompt_ids, np.int32)
+        squeeze = ids.ndim == 1
+        if squeeze:
+            ids = ids[None, :]
+        if ids.ndim != 2 or ids.shape[1] < 1:
+            raise ValueError(f"prompt_ids must be (T,) or (B, T) with "
+                             f"T >= 1, got shape {ids.shape}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        bsz, t = ids.shape
+        # the last sampled token is never written back, hence the -1
+        if t + max_new_tokens - 1 > self.max_len:
+            raise ValueError(
+                f"prompt ({t}) + max_new_tokens ({max_new_tokens}) - 1 "
+                f"exceeds cache capacity max_len={self.max_len}")
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        cache = self.init_cache(bsz)
+        logits, cache = self.prefill(cache, ids)
+        out = np.zeros((bsz, max_new_tokens), np.int32)
+        done = np.zeros((bsz,), bool)
+        pad = 0 if eos_id is None else int(eos_id)
+        n = 0
+        for i in range(max_new_tokens):
+            key, sub = jax.random.split(key)
+            toks = np.asarray(self.sample(sub, logits, temperature, top_k))
+            out[:, i] = np.where(done, pad, toks)
+            n = i + 1
+            if eos_id is not None:
+                done |= (toks == eos_id)
+                if done.all():
+                    break
+            if i + 1 < max_new_tokens:
+                logits, cache = self.decode_step(cache, jnp.asarray(toks))
+        out = out[:, :n]
+        return out[0] if squeeze else out

@@ -1,0 +1,65 @@
+"""Preallocated per-layer KV cache for the transformer serving plane.
+
+One cache serves one fixed pool of decode SLOTS. Layout mirrors the
+model's stacked-block parameterization so a ``lax.scan`` over layers can
+consume and re-emit the cache layer-by-layer:
+
+    {"k":   (L, n_slots, max_len, H, Dh)   compute dtype,
+     "v":   (L, n_slots, max_len, H, Dh)   compute dtype,
+     "pos": (n_slots,)                     int32}
+
+``pos[s]`` is the number of tokens already resident in slot ``s`` —
+equivalently the index the NEXT token's k/v will be written at, and the
+inclusive upper bound of the attention mask for that slot. The cache is
+a plain pytree: the engine's jitted ``decode_step`` donates it, so the
+HBM buffers are updated in place across the whole decode loop and the
+allocation cost is paid once per pool, not per token.
+
+Fixed ``max_len`` by design (μ-cuDNN-style static slotting): admission
+slices variable-length traffic into fixed-capacity slots instead of
+reshaping device buffers per request — the scheduler keeps the sweep
+full, the compiler sees one shape.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def init_cache(cfg, n_slots: int, max_len=None, dtype=None):
+    """Allocate an empty cache for ``n_slots`` concurrent sequences.
+
+    ``max_len`` defaults to ``cfg.max_seq`` and may not exceed it: the
+    learned position table has ``cfg.max_seq`` rows, so a longer cache
+    would hold positions the model cannot embed.
+    """
+    max_len = int(cfg.max_seq if max_len is None else max_len)
+    if max_len > cfg.max_seq:
+        raise ValueError(
+            f"max_len {max_len} exceeds cfg.max_seq={cfg.max_seq}: the "
+            "position-embedding table has no rows past max_seq")
+    if max_len < 1 or n_slots < 1:
+        raise ValueError(f"need max_len >= 1 and n_slots >= 1, got "
+                         f"max_len={max_len}, n_slots={n_slots}")
+    dt = cfg.dtype if dtype is None else dtype
+    shape = (cfg.n_layers, int(n_slots), max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt),
+            "v": jnp.zeros(shape, dt),
+            "pos": jnp.zeros((int(n_slots),), jnp.int32)}
+
+
+def cache_len(cache) -> int:
+    """Static per-slot capacity (tokens)."""
+    return cache["k"].shape[2]
+
+
+def cache_slots(cache) -> int:
+    """Number of decode slots the cache was allocated for."""
+    return cache["k"].shape[1]
+
+
+def cache_nbytes(cache) -> int:
+    """Total device bytes held by the cache (capacity planning: at the
+    flagship 120M config a T=1024 slot is L8·T1024·H8·Dh64 · 2 tensors
+    · 2 bytes = 16 MiB)."""
+    return int(sum(a.size * a.dtype.itemsize for a in cache.values()))

@@ -1,0 +1,445 @@
+"""Continuous-batching inference scheduler over a fixed decode-slot pool.
+
+The μ-cuDNN idea (PAPERS.md, arXiv 1804.04806) applied to serving: keep
+the device sweep FULL by slicing admission into fixed-capacity slots
+instead of reshaping the batch around each request. One
+``GenerationEngine`` cache holds ``n_slots`` sequences; the scheduler
+loop interleaves
+
+    admit:  free slot + queued request → jitted per-slot prefill
+            (neighbour slots keep decoding state untouched), first
+            token sampled from the prefill logits (this is TTFT)
+    decode: ONE jitted sweep advances every active slot a token —
+            per-slot temperature/top-k vectors let mixed requests share
+            the sweep; finished slots free immediately for re-admission
+
+so mixed-length traffic never drains the pool to prefill and a finished
+request never strands its neighbours. Each request resolves a
+``concurrent.futures.Future`` with a :class:`GenerationResult`.
+
+Preemption (optional, ``starvation_ms``): when the queue head has waited
+past the deadline and no slot is free, the active request with the most
+REMAINING budget is preempted — its slot frees, its context
+(prompt + generated so far) re-queues and is later re-prefilled
+(vLLM-style recompute preemption). Greedy decoding is preemption-
+transparent: prefill(prompt+generated) reproduces the exact logits the
+interrupted decode would have seen (the engine's equivalence guarantee),
+so the output is unchanged.
+
+Telemetry rides the unified plane (``dl4j_serving_*`` on the process
+registry, spans on the tracer): slot occupancy, queue depth, TTFT /
+queue-wait / request-latency histograms, decode-step timing, token and
+preemption counters. ``scripts/check_metric_names.py`` lints the sites.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import get_registry, span
+from . import kvcache
+from .engine import GenerationEngine
+
+
+@dataclass
+class GenerationResult:
+    """What a request's future resolves to."""
+    tokens: np.ndarray          # generated ids, prompt excluded
+    finish_reason: str          # "eos" | "length"
+    request_id: int
+    ttft_s: Optional[float]     # submit → first token
+    latency_s: float            # submit → completion
+    preemptions: int
+
+
+@dataclass
+class ServingRequest:
+    id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float
+    top_k: int
+    eos_id: Optional[int]
+    future: Future
+    submitted_ts: float
+    queued_ts: float            # reset on re-queue after preemption
+    first_token_ts: Optional[float] = None
+    generated: List[int] = field(default_factory=list)
+    preemptions: int = 0
+
+    def context(self) -> np.ndarray:
+        """Token ids to prefill on (re-)admission: the original prompt
+        plus everything generated so far (recompute preemption)."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+
+class ContinuousBatchingScheduler:
+    """Slot-based admission + full-pool decode over one engine cache.
+
+    Synchronous core: ``step()`` performs one admit+decode iteration and
+    is what tests script; ``run_until_idle()`` loops it; ``start()`` /
+    ``stop()`` run the same loop on a daemon thread for callers that
+    ``submit`` from elsewhere. Metadata (queue/slots) lives under a
+    short-held lock so submit never waits on device work; a second lock
+    serializes step() iterations (the cache is donated — one dispatch
+    at a time). A request whose Future is cancelled while queued is
+    dropped before it costs a prefill.
+    """
+
+    def __init__(self, engine: GenerationEngine, n_slots: int = 4, *,
+                 starvation_ms: Optional[float] = None, key=None):
+        if n_slots < 1:
+            raise ValueError("need at least one decode slot")
+        self.engine = engine
+        self.n_slots = int(n_slots)
+        self.starvation_ms = starvation_ms
+        self.cache = engine.init_cache(self.n_slots)
+        self.slots: List[Optional[ServingRequest]] = [None] * self.n_slots
+        self._queue: deque = deque()
+        # two locks: `_lock` guards the cheap metadata (queue, slots,
+        # key, last_tokens) so submit()/inspection never wait on device
+        # work; `_step_lock` serializes whole step() iterations — the
+        # cache is donated through prefill/decode, so two concurrent
+        # steps would hand the same buffer to XLA twice
+        self._lock = threading.RLock()
+        self._step_lock = threading.Lock()
+        self._key = jax.random.PRNGKey(0) if key is None else key
+        self._last_tokens = np.zeros((self.n_slots,), np.int32)
+        self._next_id = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+
+    # ------------------------------------------------------- metrics
+    @staticmethod
+    def _m():
+        reg = get_registry()
+        return {
+            "requests": reg.counter(
+                "dl4j_serving_requests_total",
+                "Requests submitted to the continuous-batching scheduler"),
+            "completions": reg.counter(
+                "dl4j_serving_completions_total",
+                "Requests completed, by finish reason",
+                labelnames=("reason",)),
+            "preemptions": reg.counter(
+                "dl4j_serving_preemptions_total",
+                "Active requests preempted (recompute on re-admission)"),
+            "prefills": reg.counter(
+                "dl4j_serving_prefills_total",
+                "Per-slot prefill admissions (includes re-admissions)"),
+            "decode_steps": reg.counter(
+                "dl4j_serving_decode_steps_total",
+                "Full-pool decode sweeps executed"),
+            "tokens": reg.counter(
+                "dl4j_serving_tokens_total",
+                "Tokens generated across all requests"),
+            "occupancy": reg.gauge(
+                "dl4j_serving_slot_occupancy",
+                "Active slots / pool size at the last decode sweep"),
+            "queue_depth": reg.gauge(
+                "dl4j_serving_queue_depth",
+                "Requests waiting for a decode slot"),
+            "tokens_per_s": reg.gauge(
+                "dl4j_serving_tokens_per_second",
+                "Generated tokens per second over the last decode sweep"),
+            "ttft": reg.histogram(
+                "dl4j_serving_ttft_seconds",
+                "Time from submit to first generated token"),
+            "queue_wait": reg.histogram(
+                "dl4j_serving_queue_wait_seconds",
+                "Time a request waited in the admission queue"),
+            "decode_s": reg.histogram(
+                "dl4j_serving_decode_step_seconds",
+                "Wall time of one full-pool decode sweep"),
+            "latency": reg.histogram(
+                "dl4j_serving_request_latency_seconds",
+                "Time from submit to request completion"),
+        }
+
+    # -------------------------------------------------------- submit
+    def submit(self, prompt_ids, max_new_tokens: int = 32, *,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_id: Optional[int] = None) -> Future:
+        """Queue a generation request; returns a Future resolving to a
+        :class:`GenerationResult`. Rejects requests that could never fit
+        a slot (prompt + budget beyond the cache's ``max_len``) up
+        front — admission never has to partially honour a request."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + max_new_tokens - 1
+        if total > self.engine.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) - 1 = {total} exceeds the slot "
+                f"capacity max_len={self.engine.max_len}")
+        now = time.perf_counter()
+        fut: Future = Future()
+        with self._lock:
+            req = ServingRequest(
+                id=self._next_id, prompt=prompt,
+                max_new_tokens=int(max_new_tokens),
+                temperature=float(temperature), top_k=int(top_k),
+                eos_id=eos_id, future=fut, submitted_ts=now, queued_ts=now)
+            self._next_id += 1
+            self._queue.append(req)
+            m = self._m()
+            m["requests"].inc()
+            m["queue_depth"].set(len(self._queue))
+        return fut
+
+    # ---------------------------------------------------------- step
+    def step(self) -> bool:
+        """One scheduler iteration: preempt-if-starved, admit, decode.
+        Returns True if any work happened (False = fully idle).
+
+        Device work (prefill, the decode sweep, any compile it
+        triggers) runs OUTSIDE the metadata lock — a client thread's
+        submit() never waits on a sweep — while ``_step_lock``
+        serializes iterations so the donated cache is never dispatched
+        twice."""
+        with self._step_lock:
+            m = self._m()
+            with self._lock:
+                did = self._maybe_preempt(m)
+                admissions = self._pop_admissions(m)
+            for slot, req in admissions:
+                self._admit_one(slot, req, m)
+            did = did or bool(admissions)
+            did = self._decode_sweep(m) or did
+            with self._lock:
+                m["queue_depth"].set(len(self._queue))
+        return did
+
+    def run_until_idle(self, max_steps: int = 100000):
+        """Drive step() until queue and pool are empty (tests, batch
+        jobs). ``max_steps`` is a runaway guard, generous vs any real
+        trace (one step ≥ one token for every active slot)."""
+        for _ in range(max_steps):
+            with self._lock:
+                idle = not self._queue and not any(self.slots)
+            if idle:
+                return
+            self.step()
+        raise RuntimeError(f"scheduler not idle after {max_steps} steps")
+
+    # ---------------------------------------------------- background
+    def start(self, poll_s: float = 0.001):
+        """Serve from a daemon thread until stop(): step() when there is
+        work, sleep ``poll_s`` when idle. The thread is stopped at
+        interpreter exit if still running — a daemon thread caught
+        mid-decode while jax tears down aborts the process."""
+        if self._thread is not None:
+            return self
+        if not getattr(self, "_atexit_registered", False):
+            import atexit
+            import weakref
+            ref = weakref.ref(self)
+            atexit.register(lambda: (lambda s: s and s.stop())(ref()))
+            self._atexit_registered = True
+        self._stop_evt.clear()
+
+        def loop():
+            while not self._stop_evt.is_set():
+                try:
+                    worked = self.step()
+                except Exception as e:  # noqa: BLE001 — a dying serve
+                    # thread must FAIL the in-flight futures, not strand
+                    # their callers on result() forever
+                    self._fail_all(e)
+                    raise
+                if not worked:
+                    self._stop_evt.wait(poll_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="dl4j-serving-scheduler")
+        self._thread.start()
+        return self
+
+    def _fail_all(self, exc: BaseException):
+        """Resolve every queued and in-flight future with ``exc`` and
+        clear the pool (serve-loop crash path)."""
+        with self._lock:
+            doomed = [r for r in self.slots if r is not None] + \
+                list(self._queue)
+            self.slots = [None] * self.n_slots
+            self._queue.clear()
+        for req in doomed:
+            try:
+                req.future.set_exception(exc)
+            except InvalidStateError:
+                pass
+
+    def stop(self):
+        if self._thread is None:
+            return
+        self._stop_evt.set()
+        self._thread.join(timeout=30)
+        self._thread = None
+
+    # ------------------------------------------------------ internals
+    def _free_slots(self):
+        return [i for i, r in enumerate(self.slots) if r is None]
+
+    def _maybe_preempt(self, m) -> bool:
+        """Starvation guard: queue head waited past the deadline with no
+        free slot → preempt the active request with the most remaining
+        budget (it blocks the pool longest). Its context re-queues at
+        the BACK; the head admits into the freed slot this same step."""
+        if self.starvation_ms is None or not self._queue:
+            return False
+        if self._free_slots():
+            return False
+        waited_ms = (time.perf_counter() - self._queue[0].queued_ts) * 1e3
+        if waited_ms <= self.starvation_ms:
+            return False
+        victim_slot = max(
+            (i for i, r in enumerate(self.slots) if r is not None),
+            key=lambda i: self.slots[i].remaining())
+        victim = self.slots[victim_slot]
+        if victim.remaining() <= 0 or not victim.generated:
+            return False       # nothing to save / about to finish anyway
+        self.slots[victim_slot] = None
+        victim.preemptions += 1
+        victim.queued_ts = time.perf_counter()
+        self._queue.append(victim)
+        m["preemptions"].inc()
+        return True
+
+    def _pop_admissions(self, m):
+        """Under the metadata lock: pair free slots with queued requests
+        and RESERVE the slots (so occupancy readers see them) before the
+        device-side prefills run lock-free. A request whose future was
+        cancelled while queued is dropped here — it never costs a
+        prefill."""
+        out = []
+        for slot in self._free_slots():
+            while self._queue:
+                req = self._queue.popleft()
+                # fresh requests are PENDING → claim them (rejecting
+                # cancelled ones); a re-queued preemption victim is
+                # already RUNNING and must not be re-claimed
+                if not req.future.running() and \
+                        not req.future.set_running_or_notify_cancel():
+                    m["completions"].inc(reason="cancelled")
+                    continue
+                m["queue_wait"].observe(time.perf_counter() - req.queued_ts)
+                self.slots[slot] = req        # reserve
+                out.append((slot, req))
+                break
+        return out
+
+    def _admit_one(self, slot, req, m):
+        """Device-side admission for one reserved slot: prefill the
+        request's context, sample its first token (TTFT). Runs outside
+        the metadata lock — `_step_lock` already serializes cache use."""
+        ctx = req.context()
+        with span("serving.prefill",
+                  attrs={"request": req.id, "slot": slot,
+                         "tokens": int(ctx.size)}):
+            logits, self.cache = self.engine.prefill_slot(
+                self.cache, ctx, slot)
+        m["prefills"].inc()
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+        tok = int(np.asarray(self.engine.sample(
+            sub, logits[None], req.temperature, req.top_k))[0])
+        now = time.perf_counter()
+        with self._lock:
+            if req.first_token_ts is None:
+                req.first_token_ts = now
+                m["ttft"].observe(now - req.submitted_ts)
+            req.generated.append(tok)
+            m["tokens"].inc()
+            if self._done(req, tok):
+                self.slots[slot] = None
+                self._finish(req, tok, m)
+            else:
+                self._last_tokens[slot] = tok
+
+    def _decode_sweep(self, m) -> bool:
+        with self._lock:      # snapshot; only step() (serialized) mutates
+            active = [i for i, r in enumerate(self.slots) if r is not None]
+            if not active:
+                return False
+            temps = np.zeros((self.n_slots,), np.float32)
+            topks = np.zeros((self.n_slots,), np.int32)
+            for i in active:
+                temps[i] = self.slots[i].temperature
+                topks[i] = self.slots[i].top_k
+            tokens_in = jnp.asarray(self._last_tokens)
+            self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
+        with span("serving.decode", attrs={"active": len(active)}):
+            logits, self.cache = self.engine.decode_step(
+                self.cache, tokens_in)
+            toks = np.asarray(self.engine.sample(sub, logits, temps, topks))
+        dt = time.perf_counter() - t0
+        m["decode_steps"].inc()
+        m["decode_s"].observe(dt)
+        m["occupancy"].set(len(active) / self.n_slots)
+        m["tokens"].inc(len(active))
+        if dt > 0:
+            m["tokens_per_s"].set(len(active) / dt)
+        with self._lock:
+            for i in active:
+                req = self.slots[i]
+                tok = int(toks[i])
+                req.generated.append(tok)
+                self._last_tokens[i] = tok
+                if self._done(req, tok):
+                    self._finish(req, tok, m)
+                    self.slots[i] = None
+        return True
+
+    @staticmethod
+    def _done(req: ServingRequest, tok: int) -> bool:
+        return (req.eos_id is not None and tok == req.eos_id) \
+            or len(req.generated) >= req.max_new_tokens
+
+    def _finish(self, req: ServingRequest, last_tok: int, m):
+        reason = "eos" if (req.eos_id is not None
+                           and last_tok == req.eos_id) else "length"
+        now = time.perf_counter()
+        m["completions"].inc(reason=reason)
+        m["latency"].observe(now - req.submitted_ts)
+        try:
+            req.future.set_result(GenerationResult(
+                tokens=np.asarray(req.generated, np.int32),
+                finish_reason=reason, request_id=req.id,
+                ttft_s=(None if req.first_token_ts is None
+                        else req.first_token_ts - req.submitted_ts),
+                latency_s=now - req.submitted_ts,
+                preemptions=req.preemptions))
+        except InvalidStateError:
+            pass   # the caller gave up on an in-flight request; the
+            # pool must keep serving its neighbours regardless
+
+    # ---------------------------------------------------- inspection
+    def occupancy(self) -> float:
+        with self._lock:
+            return sum(r is not None for r in self.slots) / self.n_slots
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def cache_nbytes(self) -> int:
+        return kvcache.cache_nbytes(self.cache)

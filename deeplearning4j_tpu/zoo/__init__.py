@@ -10,4 +10,5 @@ from .resnet import ResNet50
 from .unet import UNet
 from .transformer import (BertConfig, TransformerConfig, bert_forward,
                           bert_init, forward as transformer_forward,
+                          generate as transformer_generate,
                           init_params as transformer_init)

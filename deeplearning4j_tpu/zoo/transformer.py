@@ -350,8 +350,44 @@ def head_logits(params, cfg: TransformerConfig, x):
     return _constrain(logits, "dp", "sp", "tp").astype(jnp.float32)
 
 
-def apply_blocks(blocks, cfg: TransformerConfig, x):
-    """Scan the stacked transformer blocks over x. Returns (x, aux_sum)."""
+def head_logits_rows(params, cfg: TransformerConfig, x):
+    """head_logits for (N, d) hidden ROWS (no time axis) → (N, V) f32.
+    The serving engine's shape: one row per decode slot / per prefill's
+    last position — never the (B, T, V) tensor a generation step doesn't
+    need."""
+    x = _rmsnorm(x, params["ln_f"])
+    head = _resolve_head(params, cfg)
+    return jnp.einsum("nd,dv->nv", x, head.astype(x.dtype)
+                      ).astype(jnp.float32)
+
+
+def generate(params, cfg: TransformerConfig, prompt_ids, max_new_tokens=32,
+             *, key=None, temperature=0.0, top_k=0, eos_id=None,
+             max_len=None):
+    """Autoregressive generation from the LM — the zoo-level serving entry
+    point. Prefills the prompt into a preallocated KV cache, then decodes
+    one token per jitted donated-cache step; ``temperature=0`` is greedy,
+    ``top_k`` restricts sampling to the k most likely tokens, and all
+    randomness flows from the explicit PRNG ``key``. Returns the generated
+    ids (without the prompt) as a numpy array — ``(B, n)`` for a batched
+    prompt, ``(n,)`` for a single sequence. For sustained mixed-length
+    traffic use ``serving.ContinuousBatchingScheduler`` on top of a shared
+    ``serving.GenerationEngine`` instead of this one-shot helper."""
+    from ..serving.engine import GenerationEngine
+    eng = GenerationEngine(cfg, params, max_len=max_len)
+    return eng.generate(prompt_ids, max_new_tokens, key=key,
+                        temperature=temperature, top_k=top_k, eos_id=eos_id)
+
+
+def apply_blocks(blocks, cfg: TransformerConfig, x, *, return_kv=False):
+    """Scan the stacked transformer blocks over x. Returns (x, aux_sum).
+
+    ``return_kv=True`` is the serving-plane prefill hook: the SAME block
+    math additionally yields each layer's per-head key/value activations,
+    stacked ``(L, B, T, H, Dh)`` in compute dtype, and the return becomes
+    ``(x, aux_sum, (k, v))``. Remat is skipped on that path — prefill is
+    forward-only, there are no residuals to trade for recompute — which
+    keeps the captured k/v out of any checkpoint policy's hands."""
 
     def block(x, blk):
         h = _rmsnorm(x, blk["ln1"])
@@ -367,16 +403,24 @@ def apply_blocks(blocks, cfg: TransformerConfig, x):
         else:
             m, aux = _dense_mlp(cfg, h2, blk["w_in"], blk["w_out"]), 0.0
         x = x + _constrain(m, "dp", "sp", None)
-        return x, aux
+        kv = None
+        if return_kv:
+            b, t = x.shape[0], x.shape[1]
+            kv = (k.reshape(b, t, cfg.n_heads, cfg.head_dim),
+                  v.reshape(b, t, cfg.n_heads, cfg.head_dim))
+        return x, (aux, kv)
 
-    blk_fn = _remat_wrap(block, cfg.remat_policy) if cfg.remat else block
+    blk_fn = block if (return_kv or not cfg.remat) \
+        else _remat_wrap(block, cfg.remat_policy)
 
     def scan_body(carry, blk):
         x = carry
-        x, aux = blk_fn(x, blk)
-        return x, aux
+        x, ys = blk_fn(x, blk)
+        return x, ys
 
-    x, auxes = lax.scan(scan_body, x, blocks)
+    x, (auxes, kvs) = lax.scan(scan_body, x, blocks)
+    if return_kv:
+        return x, jnp.sum(auxes), kvs
     return x, jnp.sum(auxes)
 
 

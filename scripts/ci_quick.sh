@@ -1,22 +1,23 @@
 #!/usr/bin/env bash
-# Quick gate (ISSUE 7 + 8): metric-name + doc lint, then the
-# telemetry-plane, roofline-floor, and elastic-scaleout fast suites.
-# One command, <2 min on CPU; run before touching instrumentation,
-# bench schema, docs examples, or the scaleout plane.
+# Quick gate (ISSUE 7 + 8 + 10): metric-name + doc lint, then the
+# telemetry-plane, roofline-floor, elastic-scaleout, and serving-plane
+# fast suites. One command, <3 min on CPU; run before touching
+# instrumentation, bench schema, docs examples, the scaleout plane, or
+# the serving engine/scheduler.
 #
 #   bash scripts/ci_quick.sh
 #
 # The full tier-1 suite is ROADMAP.md's verify line; this is the fast
-# inner loop for the obs/bench/scaleout surface only.
+# inner loop for the obs/bench/scaleout/serving surface only.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== metric-name + doc lint =="
 python scripts/check_metric_names.py
 
-echo "== obs + floors + scaleout-fast suites =="
+echo "== obs + floors + scaleout-fast + serving suites =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
-    tests/test_scaleout_fast.py \
+    tests/test_scaleout_fast.py tests/test_serving.py \
     -q -m 'not slow' -p no:cacheprovider -p no:randomly
 
 echo "ci_quick: all green"

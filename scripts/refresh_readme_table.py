@@ -67,6 +67,57 @@ def row(label, rec, extra=""):
             f"| {floor_cell(label, rec)} |")
 
 
+INFERENCE_LABELS = {
+    "inference_decode": "Transformer-LM decode (KV-cache, 8 slots, T=1024)",
+    "inference_ttft_1024": "Time-to-first-token, T=1024 prefill",
+    "inference_ttft_4096": "Time-to-first-token, T=4096 prefill",
+    "inference_resnet_b1": "ResNet-50 batch-1 latency (ParallelInference)",
+    "inference_bert_b1": "BERT-base batch-1 latency (ParallelInference)",
+}
+
+
+def inference_row(name, rec):
+    """One serving-plane table row: value + the row's own detail column
+    (best-batch throughput for the latency rows, p99 where measured),
+    and an explicit capture flag — a CPU-derived value must SAY so in
+    the README, the same contract the floor tables follow."""
+    if not isinstance(rec, dict) or rec.get("value") is None:
+        return None
+    label = INFERENCE_LABELS.get(name, name)
+    unit = rec.get("unit", "")
+    if "tokens" in unit:
+        val = f"{rec['value']:,.1f} tokens/s"
+    else:
+        val = f"{rec['value']:,.1f} ms"
+    details = []
+    if rec.get("p99_ms") is not None:
+        details.append(f"p99 {rec['p99_ms']:.1f} ms")
+    if rec.get("best_batch") is not None:
+        details.append(f"best batch {rec['best_batch']}: "
+                       f"{rec['best_batch_throughput']:,.1f} samples/s")
+    if rec.get("slots") is not None:
+        details.append(f"{rec['slots']} decode slots")
+    captured = ("on-chip" if rec.get("backend") == "tpu"
+                else "⏳ CPU-derived, on-chip TODO")
+    return f"| {label} | {val} | {'; '.join(details) or '—'} | {captured} |"
+
+
+def inference_lines(inf):
+    """Render the artifact's `inference` section (ISSUE 10). Absent
+    section → no serving table (pre-serving artifact)."""
+    rows = [inference_row(n, inf.get(n)) for n in INFERENCE_LABELS]
+    rows = [r for r in rows if r]
+    if not rows:
+        return []
+    return ["",
+            "**Serving / inference** (`inference` section of the same "
+            "artifact; rows marked ⏳ await their on-chip capture — "
+            "`bench.py --refresh inference_decode,...`):",
+            "",
+            "| config | value | detail | captured |",
+            "|---|---|---|---|"] + rows
+
+
 def main():
     art = json.loads((REPO / "bench_secondary.json").read_text())
     head = art.get("headline", {})
@@ -120,6 +171,7 @@ def main():
         lines.append(f"| dp-8 ParallelWrapper overhead (virtual CPU mesh) "
                      f"| +{dp['value']:.1f} ms/step at equal global batch "
                      f"| — | {floor_cell('dpoverhead', dp)} |")
+    lines += inference_lines(art.get("inference", {}))
     if _floor_warnings:
         lines.append("")
         lines.append("*(rows marked pre-floor predate the roofline "

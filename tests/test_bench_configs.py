@@ -240,3 +240,55 @@ def test_bench_refresh_rows_isolated(tmp_path, monkeypatch, capsys):
     assert disk["secondary"]["lenet"]["value"] == 5.0   # other rows kept
     assert "error" in disk["secondary"]["synthetic_fail"]
     assert "_incomplete" not in disk["secondary"]       # marker cleared
+
+
+def test_bench_inference_helpers_and_refresh_routing(tmp_path, monkeypatch):
+    """Serving bench surface at CI scale (ISSUE 10): the latency-sweep
+    helper drives a live ParallelInference at tiny shapes, off-TPU rows
+    get the on_chip_todo flag, and --refresh routes inference_* rows
+    into the artifact's `inference` section without touching
+    secondary."""
+    import json as _json
+    import bench
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.parallel import ParallelInference
+    from deeplearning4j_tpu.serving import FunctionalInferenceModel
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    # latency sweep through the functional-adapter front end
+    cfg = tfm.BertConfig(vocab_size=32, d_model=16, n_heads=2, n_layers=1,
+                         d_ff=32, max_seq=8, dtype=jnp.float32)
+    params = tfm.bert_init(jax.random.PRNGKey(0), cfg)
+    model = FunctionalInferenceModel(
+        params, lambda p, ids: tfm.bert_forward(p, cfg, ids)[0])
+    pi = ParallelInference(model, max_batch=8)
+
+    def make_batch(b):
+        return np.random.default_rng(0).integers(
+            0, 32, (b, 8)).astype(np.int32)
+
+    stats = bench._latency_sweep(pi, make_batch, iters=3, batches=(1, 2))
+    assert stats["p50_ms"] > 0 and stats["p99_ms"] >= stats["p50_ms"]
+    assert stats["best_batch"] in (1, 2)
+    assert stats["best_batch_throughput"] > 0
+
+    # off-TPU rows must say so; TPU rows must not be flagged
+    assert "on_chip_todo" in bench._flag_on_chip({"backend": "cpu"})
+    assert "on_chip_todo" not in bench._flag_on_chip({"backend": "tpu"})
+
+    # --refresh routing: inference rows land in the `inference` section
+    art = tmp_path / "bench_secondary.json"
+    prev = {"headline": {"metric": "m", "value": 100.0, "git_sha": "abc"},
+            "secondary": {"lenet": {"value": 5.0}}}
+    art.write_text(_json.dumps(prev))
+    monkeypatch.setenv("DL4J_TPU_BENCH_ARTIFACT", str(art))
+    assert "inference_decode" in bench.INFERENCE_ROWS
+    with monkeypatch.context() as m:
+        m.setattr(bench, "_run_row_subprocess",
+                  lambda name: {"value": 42.0, "metric": name})
+        bench._refresh_rows(["inference_decode"])
+    disk = _json.loads(art.read_text())
+    assert disk["inference"]["inference_decode"]["value"] == 42.0
+    assert disk["secondary"] == {"lenet": {"value": 5.0}}  # untouched
+    assert disk["headline"]["value"] == 100.0
