@@ -21,6 +21,11 @@ Instrumented surfaces (all under the ``dl4j_`` namespace —
   (ISSUE 10): ``dl4j_serving_*`` slot occupancy, TTFT / queue-wait /
   latency histograms, token + preemption counters, and
   ``serving.prefill`` / ``serving.decode`` spans.
+- ``obs.reqtrace`` / ``obs.slo`` — the serving SLO plane (ISSUE 11):
+  per-request lifecycle timelines stitched into the span tree, the
+  ``dl4j_serving_itl_seconds`` inter-token-latency histogram, rolling
+  ``dl4j_slo_*`` goodput/attainment/burn-rate gauges (``replica``-
+  labeled), and the crash flight recorder behind ``/debug/serving``.
 """
 
 from .registry import (Counter, DEFAULT_BUCKETS, Gauge,  # noqa: F401
@@ -39,7 +44,13 @@ def get_registry() -> MetricsRegistry:
     return _registry
 
 
+# imported after the registry exists: slo lazily resolves get_registry()
+from .reqtrace import (FlightRecorder, RequestTrace,  # noqa: E402,F401
+                       live_flight_recorders, load_flight_records)
+from .slo import SLOConfig, SLOTracker  # noqa: E402,F401
+
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_BUCKETS", "get_registry", "Span", "SpanContext",
            "Tracer", "get_tracer", "derived_span_id", "load_spans",
-           "span"]
+           "span", "FlightRecorder", "RequestTrace", "SLOConfig",
+           "SLOTracker", "live_flight_recorders", "load_flight_records"]

@@ -175,6 +175,17 @@ class Tracer:
                 self.dropped += 1
             self._finished.append(sp)
 
+    def add_spans(self, spans):
+        """Deposit a batch of externally-assembled spans under ONE lock
+        acquisition — what a request-trace assembly (root + prefills +
+        per-token events, ``obs.reqtrace``) uses so a long generation's
+        close-out doesn't pay the lock per token."""
+        with self._lock:
+            for sp in spans:
+                if len(self._finished) == self.max_spans:
+                    self.dropped += 1
+                self._finished.append(sp)
+
     # ------------------------------------------------------ export
     def spans(self) -> List[Span]:
         with self._lock:

@@ -18,10 +18,18 @@ Plus :class:`FunctionalInferenceModel`, the shim that lets
 ``ParallelInference`` dynamic-batch a pure-functional forward (BERT,
 the LM) like any network.
 
+The SLO plane (ISSUE 11) rides on the scheduler: per-request
+``obs.RequestTrace`` lifecycle timelines (→ ``dl4j_serving_itl_seconds``
+and span trees), a crash :class:`~..obs.FlightRecorder` black box
+(``scheduler.flight_recorder.dump()``, ``GET /debug/serving`` /
+``/debug/requests`` on the UI server), and rolling goodput/burn-rate
+accounting via ``slo=SLOConfig(...)`` (re-exported here).
+
 Quickstart: ``zoo.transformer.generate(params, cfg, ids, 32)`` for a
 one-shot, or README "Serving quickstart" for the scheduler loop.
 """
 
+from ..obs import SLOConfig, SLOTracker  # noqa: F401  (serving SLO plane)
 from .adapter import FunctionalInferenceModel  # noqa: F401
 from .engine import (DEFAULT_PREFILL_BUCKETS, GenerationEngine,  # noqa: F401
                      sample_tokens)
@@ -33,6 +41,6 @@ from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
 __all__ = [
     "ContinuousBatchingScheduler", "DEFAULT_PREFILL_BUCKETS",
     "FunctionalInferenceModel", "GenerationEngine", "GenerationResult",
-    "ServingRequest", "cache_len", "cache_nbytes", "cache_slots",
-    "init_cache", "sample_tokens",
+    "SLOConfig", "SLOTracker", "ServingRequest", "cache_len",
+    "cache_nbytes", "cache_slots", "init_cache", "sample_tokens",
 ]

@@ -30,6 +30,31 @@ Telemetry rides the unified plane (``dl4j_serving_*`` on the process
 registry, spans on the tracer): slot occupancy, queue depth, TTFT /
 queue-wait / request-latency histograms, decode-step timing, token and
 preemption counters. ``scripts/check_metric_names.py`` lints the sites.
+
+The SLO plane (ISSUE 11) rides on top, host-side only — the device
+dispatch sequence is untouched, so greedy scheduler output stays
+bit-identical to ``generate()`` with everything below enabled:
+
+- every request carries an ``obs.RequestTrace`` lifecycle timeline
+  (submit → queue → admit → prefill → each token → preempt/requeue →
+  finish/cancel/fail), stitched into the span tracer on completion and
+  feeding the ``dl4j_serving_itl_seconds`` inter-token-latency
+  histogram PER REQUEST — a preemption's requeue gap is one (large)
+  ITL sample, invisible to per-sweep timing;
+- a bounded :class:`~..obs.FlightRecorder` black box keeps the last N
+  completed traces + per-step scheduler snapshots (slot map, queue,
+  occupancy), dumped as JSONL on demand and automatically when the
+  serve loop crashes (``_fail_all``), and served live at
+  ``GET /debug/serving`` / ``GET /debug/requests``;
+- pass ``slo=SLOConfig(...)`` to account rolling goodput / attainment
+  / burn-rate (``dl4j_slo_*`` gauges, ``scheduler.slo.report()``);
+- point-in-time gauges carry a ``replica`` label (default ``"0"``) so
+  the multi-host router (ROADMAP item 2) reads per-replica load
+  unchanged.
+
+The trace bookkeeping self-times (``trace_overhead_seconds``, the
+MetricsListener precedent); tests pin it under 2% of the decode-sweep
+wall clock.
 """
 
 from __future__ import annotations
@@ -39,13 +64,14 @@ import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..obs import get_registry, span
+from ..obs import (FlightRecorder, RequestTrace, SLOConfig, SLOTracker,
+                   get_registry, span)
 from . import kvcache
 from .engine import GenerationEngine
 
@@ -75,6 +101,7 @@ class ServingRequest:
     first_token_ts: Optional[float] = None
     generated: List[int] = field(default_factory=list)
     preemptions: int = 0
+    trace: Optional[RequestTrace] = None
 
     def context(self) -> np.ndarray:
         """Token ids to prefill on (re-)admission: the original prompt
@@ -102,12 +129,19 @@ class ContinuousBatchingScheduler:
     """
 
     def __init__(self, engine: GenerationEngine, n_slots: int = 4, *,
-                 starvation_ms: Optional[float] = None, key=None):
+                 starvation_ms: Optional[float] = None, key=None,
+                 replica: str = "0",
+                 slo: Union[SLOConfig, SLOTracker, None] = None,
+                 recorder_requests: int = 256,
+                 recorder_snapshots: int = 512,
+                 crash_dump_path: Optional[str] = None,
+                 trace_spans: bool = True):
         if n_slots < 1:
             raise ValueError("need at least one decode slot")
         self.engine = engine
         self.n_slots = int(n_slots)
         self.starvation_ms = starvation_ms
+        self.replica = str(replica)
         self.cache = engine.init_cache(self.n_slots)
         self.slots: List[Optional[ServingRequest]] = [None] * self.n_slots
         self._queue: deque = deque()
@@ -123,6 +157,21 @@ class ContinuousBatchingScheduler:
         self._next_id = 0
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
+        # SLO plane (ISSUE 11): black box + per-request traces + SLO
+        self.flight_recorder = FlightRecorder(
+            capacity_requests=recorder_requests,
+            capacity_snapshots=recorder_snapshots, replica=self.replica,
+            crash_dump_path=crash_dump_path)
+        self.flight_recorder.extra_state = self._debug_extra
+        if isinstance(slo, SLOTracker):
+            self.slo: Optional[SLOTracker] = slo
+        elif slo is not None:
+            self.slo = SLOTracker(slo, replica=self.replica)
+        else:
+            self.slo = None
+        self.trace_spans = trace_spans
+        self._steps = 0
+        self._trace_overhead = 0.0
 
     # ------------------------------------------------------- metrics
     @staticmethod
@@ -150,13 +199,18 @@ class ContinuousBatchingScheduler:
                 "Tokens generated across all requests"),
             "occupancy": reg.gauge(
                 "dl4j_serving_slot_occupancy",
-                "Active slots / pool size at the last decode sweep"),
+                "Active slots / pool size at the last decode sweep "
+                "(0 when the pool is idle)",
+                labelnames=("replica",)),
             "queue_depth": reg.gauge(
                 "dl4j_serving_queue_depth",
-                "Requests waiting for a decode slot"),
+                "Requests waiting for a decode slot",
+                labelnames=("replica",)),
             "tokens_per_s": reg.gauge(
                 "dl4j_serving_tokens_per_second",
-                "Generated tokens per second over the last decode sweep"),
+                "Generated tokens per second over the last decode sweep "
+                "(0 when the pool is idle)",
+                labelnames=("replica",)),
             "ttft": reg.histogram(
                 "dl4j_serving_ttft_seconds",
                 "Time from submit to first generated token"),
@@ -166,6 +220,11 @@ class ContinuousBatchingScheduler:
             "decode_s": reg.histogram(
                 "dl4j_serving_decode_step_seconds",
                 "Wall time of one full-pool decode sweep"),
+            "itl": reg.histogram(
+                "dl4j_serving_itl_seconds",
+                "Inter-token latency, derived per request from its "
+                "lifecycle trace (a preemption requeue gap is one "
+                "sample)"),
             "latency": reg.histogram(
                 "dl4j_serving_request_latency_seconds",
                 "Time from submit to request completion"),
@@ -198,11 +257,17 @@ class ContinuousBatchingScheduler:
                 max_new_tokens=int(max_new_tokens),
                 temperature=float(temperature), top_k=int(top_k),
                 eos_id=eos_id, future=fut, submitted_ts=now, queued_ts=now)
+            req.trace = RequestTrace(request_id=req.id,
+                                     replica=self.replica)
+            req.trace.event("submit", ts=now,
+                            prompt_tokens=int(prompt.size),
+                            max_new_tokens=int(max_new_tokens))
+            req.trace.event("queue", ts=now)
             self._next_id += 1
             self._queue.append(req)
             m = self._m()
             m["requests"].inc()
-            m["queue_depth"].set(len(self._queue))
+            m["queue_depth"].set(len(self._queue), replica=self.replica)
         return fut
 
     # ---------------------------------------------------------- step
@@ -225,7 +290,19 @@ class ContinuousBatchingScheduler:
             did = did or bool(admissions)
             did = self._decode_sweep(m) or did
             with self._lock:
-                m["queue_depth"].set(len(self._queue))
+                m["queue_depth"].set(len(self._queue),
+                                     replica=self.replica)
+            if did:
+                t_ov = time.perf_counter()
+                self._record_snapshot()
+                self._trace_overhead += time.perf_counter() - t_ov
+            else:
+                # idle reset: the occupancy/throughput gauges used to
+                # freeze at their last busy value after the pool
+                # drained — a router reading them would keep routing
+                # around a replica that is actually free
+                m["occupancy"].set(0.0, replica=self.replica)
+                m["tokens_per_s"].set(0.0, replica=self.replica)
         return did
 
     def run_until_idle(self, max_steps: int = 100000):
@@ -274,9 +351,15 @@ class ContinuousBatchingScheduler:
         return self
 
     def _fail_all(self, exc: BaseException):
-        """Resolve every queued and in-flight future with ``exc`` and
-        clear the pool (serve-loop crash path)."""
+        """Resolve every queued and in-flight future with ``exc``, clear
+        the pool, and leave a black box: a crash snapshot of the dying
+        slot map + every doomed request's trace, dumped as JSONL (the
+        serve-loop crash path). The futures fail FIRST — callers
+        blocked in result() must not wait out the recording pass — and
+        none of the recording may mask ``exc``."""
         with self._lock:
+            slot_ids = [None if r is None else r.id for r in self.slots]
+            queued_ids = [r.id for r in self._queue]
             doomed = [r for r in self.slots if r is not None] + \
                 list(self._queue)
             self.slots = [None] * self.n_slots
@@ -286,6 +369,20 @@ class ContinuousBatchingScheduler:
                 req.future.set_exception(exc)
             except InvalidStateError:
                 pass
+        err = repr(exc)[:300]
+        try:
+            m = self._m()
+            self._steps += 1
+            self.flight_recorder.record_snapshot(
+                step=self._steps, crash=True, error=err, slots=slot_ids,
+                queue=queued_ids, queue_depth=len(queued_ids),
+                occupancy=sum(s is not None for s in slot_ids)
+                / self.n_slots)
+            for req in doomed:
+                self._close_trace(req, "fail", m, error=err)
+            self.flight_recorder.dump(reason="fail_all")
+        except Exception:  # noqa: BLE001 — a failed postmortem (full
+            pass           # disk, torn state) must not mask exc
 
     def stop(self):
         if self._thread is None:
@@ -319,6 +416,11 @@ class ContinuousBatchingScheduler:
         self.slots[victim_slot] = None
         victim.preemptions += 1
         victim.queued_ts = time.perf_counter()
+        if victim.trace is not None:
+            victim.trace.event("preempt", ts=victim.queued_ts,
+                               slot=victim_slot,
+                               generated=len(victim.generated))
+            victim.trace.event("requeue", ts=victim.queued_ts)
         self._queue.append(victim)
         m["preemptions"].inc()
         return True
@@ -339,8 +441,12 @@ class ContinuousBatchingScheduler:
                 if not req.future.running() and \
                         not req.future.set_running_or_notify_cancel():
                     m["completions"].inc(reason="cancelled")
+                    self._close_trace(req, "cancel", m)
                     continue
-                m["queue_wait"].observe(time.perf_counter() - req.queued_ts)
+                now = time.perf_counter()
+                m["queue_wait"].observe(now - req.queued_ts)
+                if req.trace is not None:
+                    req.trace.event("admit", ts=now, slot=slot)
                 self.slots[slot] = req        # reserve
                 out.append((slot, req))
                 break
@@ -351,11 +457,13 @@ class ContinuousBatchingScheduler:
         request's context, sample its first token (TTFT). Runs outside
         the metadata lock — `_step_lock` already serializes cache use."""
         ctx = req.context()
+        t0 = time.perf_counter()
         with span("serving.prefill",
                   attrs={"request": req.id, "slot": slot,
                          "tokens": int(ctx.size)}):
             logits, self.cache = self.engine.prefill_slot(
                 self.cache, ctx, slot)
+        prefill_s = time.perf_counter() - t0
         m["prefills"].inc()
         with self._lock:
             self._key, sub = jax.random.split(self._key)
@@ -366,6 +474,12 @@ class ContinuousBatchingScheduler:
             if req.first_token_ts is None:
                 req.first_token_ts = now
                 m["ttft"].observe(now - req.submitted_ts)
+            if req.trace is not None:
+                t_ov = time.perf_counter()
+                req.trace.event("prefill", ts=now, slot=slot,
+                                tokens=int(ctx.size), time_s=prefill_s)
+                req.trace.event("token", ts=now, i=len(req.generated))
+                self._trace_overhead += time.perf_counter() - t_ov
             req.generated.append(tok)
             m["tokens"].inc()
             if self._done(req, tok):
@@ -394,11 +508,22 @@ class ContinuousBatchingScheduler:
         dt = time.perf_counter() - t0
         m["decode_steps"].inc()
         m["decode_s"].observe(dt)
-        m["occupancy"].set(len(active) / self.n_slots)
+        m["occupancy"].set(len(active) / self.n_slots,
+                           replica=self.replica)
         m["tokens"].inc(len(active))
         if dt > 0:
-            m["tokens_per_s"].set(len(active) / dt)
+            m["tokens_per_s"].set(len(active) / dt, replica=self.replica)
         with self._lock:
+            # trace bookkeeping first (self-timed): one shared token
+            # timestamp per sweep — the whole pool's tokens land
+            # together, which is exactly what each caller observes
+            t_ov = time.perf_counter()
+            for i in active:
+                req = self.slots[i]
+                if req is not None and req.trace is not None:
+                    req.trace.event("token", ts=t_ov,
+                                    i=len(req.generated))
+            self._trace_overhead += time.perf_counter() - t_ov
             for i in active:
                 req = self.slots[i]
                 tok = int(toks[i])
@@ -420,6 +545,9 @@ class ContinuousBatchingScheduler:
         now = time.perf_counter()
         m["completions"].inc(reason=reason)
         m["latency"].observe(now - req.submitted_ts)
+        t_ov = time.perf_counter()
+        self._close_trace(req, "finish", m, reason=reason)
+        self._trace_overhead += time.perf_counter() - t_ov
         try:
             req.future.set_result(GenerationResult(
                 tokens=np.asarray(req.generated, np.int32),
@@ -432,7 +560,62 @@ class ContinuousBatchingScheduler:
             pass   # the caller gave up on an in-flight request; the
             # pool must keep serving its neighbours regardless
 
+    def _close_trace(self, req: ServingRequest, kind: str, m, **attrs):
+        """Terminal trace bookkeeping for one request: terminal event,
+        per-request ITL samples into the histogram, black-box record,
+        span-tree assembly, SLO accounting."""
+        tr = req.trace
+        if tr is None:
+            return
+        tr.event(kind, **attrs)
+        summary = tr.summary()    # computed once: histogram + SLO share
+        for s in summary["itl_s"]:
+            m["itl"].observe(s)
+        self.flight_recorder.record_request(tr)
+        if self.slo is not None:
+            self.slo.observe_summary(summary)
+        if self.trace_spans:
+            tr.assemble_spans()
+
+    def _record_snapshot(self, **extra):
+        """One flight-recorder snapshot of the scheduler state (called
+        per working step, under ``_step_lock``)."""
+        with self._lock:
+            slot_ids = [None if r is None else r.id for r in self.slots]
+            queued_ids = [r.id for r in self._queue]
+        self._steps += 1
+        self.flight_recorder.record_snapshot(
+            step=self._steps, slots=slot_ids, queue=queued_ids,
+            queue_depth=len(queued_ids),
+            occupancy=sum(s is not None for s in slot_ids) / self.n_slots,
+            **extra)
+
+    def _debug_extra(self):
+        """Live state merged into ``flight_recorder.debug_state()`` —
+        what ``GET /debug/serving`` shows beyond the recorded past."""
+        with self._lock:
+            state = {
+                "n_slots": self.n_slots,
+                "occupancy": sum(r is not None for r in self.slots)
+                / self.n_slots,
+                "queue_depth": len(self._queue),
+                "slots": [None if r is None else r.id
+                          for r in self.slots],
+                "steps": self._steps,
+                "trace_overhead_seconds": round(self._trace_overhead, 6),
+            }
+        if self.slo is not None:
+            state["slo"] = self.slo.report()
+        return state
+
     # ---------------------------------------------------- inspection
+    @property
+    def trace_overhead_seconds(self) -> float:
+        """Cumulative host cost of the SLO-plane bookkeeping (trace
+        events, snapshots, trace close-out) — the MetricsListener-style
+        self-timing the <2% budget test asserts against."""
+        return self._trace_overhead
+
     def occupancy(self) -> float:
         with self._lock:
             return sum(r is not None for r in self.slots) / self.n_slots

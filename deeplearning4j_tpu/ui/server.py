@@ -21,6 +21,14 @@ Endpoints:
                         telemetry registry (deeplearning4j_tpu.obs) —
                         train-step histograms, inference batch
                         occupancy, scaleout round counters, …
+  GET /debug/serving    live serving-plane state (ISSUE 11): one entry
+                        per in-process flight recorder — replica, slot
+                        map, queue depth, occupancy, last snapshot,
+                        SLO report when configured
+  GET /debug/requests   recent completed request traces (lifecycle
+                        event timelines) from every flight recorder;
+                        ?n= caps the count (default 50, newest last),
+                        ?replica= filters
 """
 
 from __future__ import annotations
@@ -158,6 +166,34 @@ class _Handler(BaseHTTPRequestHandler):
             from ..obs import get_registry
             body = get_registry().to_prometheus().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif self.path.startswith("/debug/serving"):
+            # serving black boxes (ISSUE 11): every live FlightRecorder's
+            # state — the postmortem data, while the process is alive
+            from ..obs import live_flight_recorders
+            body = json.dumps({"replicas": [
+                fr.debug_state() for fr in live_flight_recorders()
+            ]}).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/debug/requests"):
+            from ..obs import live_flight_recorders
+            q = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query)
+            try:
+                n = max(1, int(q.get("n", ["50"])[0]))
+            except ValueError:
+                n = 50
+            replica = q.get("replica", [None])[0]
+            recs = []
+            for fr in live_flight_recorders():
+                if replica is not None and fr.replica != replica:
+                    continue
+                recs.extend(tr.to_record() for tr in fr.requests())
+            # newest last ACROSS replicas — a per-recorder concat would
+            # let one replica's backlog evict every other's under ?n=
+            recs.sort(key=lambda r: r.get("t0_epoch", 0.0)
+                      + (r["events"][-1][1] if r.get("events") else 0.0))
+            body = json.dumps({"requests": recs[-n:]}).encode()
+            ctype = "application/json"
         elif self.path.startswith("/train/sessions"):
             sessions = [{"id": s["id"], "static": s["static"],
                          "n": len(s["updates"])}

@@ -11,7 +11,13 @@ in the instrumented trees and fails on:
 - duplicate registrations: the same name used as two different
   instrument kinds anywhere in the tree (the runtime raises on the
   second registration — this catches it statically, before a rarely-
-  exercised code path does).
+  exercised code path does),
+- label cardinality (ISSUE 11): label NAMES must come from the small
+  ``ALLOWED_LABELS`` allowlist (extend it deliberately, in review —
+  every new label multiplies series), and nothing that smells like a
+  request/trace/span id may appear as a label name or be fed as a
+  label value (``replica=req.id`` style) — per-request identity
+  belongs in spans and flight-recorder records, not the registry.
 
 Also lints the DOCS (ISSUE 7): every ``dl4j_``-prefixed token in
 docs/*.md + README.md must be a name some instrumentation site actually
@@ -53,6 +59,27 @@ _EXPO_SUFFIX = re.compile(r"_(bucket|sum|count)$")
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 NAMESPACE = "dl4j_"
 
+# -------- label-cardinality lint (ISSUE 11) --------
+# Every label NAME any instrumentation site registers. Extending this
+# is a deliberate act: each new label multiplies time series, and an
+# unbounded one (request id, trace id) melts the registry.
+ALLOWED_LABELS = {"config", "direction", "layer", "level", "reason",
+                  "replica", "stat", "unit"}
+# label names that smell like per-request/per-trace identity — never
+# allowed even if someone adds them to the allowlist above by mistake
+_ID_LABEL = re.compile(
+    r"(^|_)(id|ids|uuid|request|requests|trace|span|session)(_|$)")
+_LABELNAMES = re.compile(
+    r"labelnames\s*=\s*[\(\[]\s*([^\)\]]*?)\s*[,\s]*[\)\]]")
+_LABEL_LIT = re.compile(r"[\"']([^\"']+)[\"']")
+# observation calls whose kwargs are label values: .inc/.set/.observe
+_OBS_CALL = re.compile(r"\.(inc|set|observe)\(")
+# a label VALUE expression that smuggles a request/trace id into the
+# registry, e.g. `replica=req.id` / `reason=trace_id`
+_ID_VALUE = re.compile(
+    r"\b[a-z_]+\s*=\s*(?:str\(|f[\"'])?[^,()]*"
+    r"\b(?:req(?:uest)?\.id|request_id|trace_id|span_id|\.trace_id\(\))")
+
 
 def _files() -> List[Path]:
     out: List[Path] = []
@@ -64,6 +91,23 @@ def _files() -> List[Path]:
             out.extend(sorted(f for f in p.rglob("*.py")
                               if "__pycache__" not in f.parts))
     return out
+
+
+def _call_text(text: str, open_idx: int) -> str:
+    """The argument text of a call: from the ``(`` at ``open_idx`` to
+    its matching close paren. String-naive — adequate because help
+    strings at these sites keep their parens balanced; a truncated
+    match only makes the label lint conservative."""
+    depth = 0
+    for i in range(open_idx, len(text)):
+        c = text[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_idx:i + 1]
+    return text[open_idx:open_idx + 400]
 
 
 def check(files=None) -> List[str]:
@@ -92,6 +136,33 @@ def check(files=None) -> List[str]:
             if kind == "counter" and not name.endswith("_total"):
                 errors.append(f"{where}: counter {name!r} must end in "
                               "'_total'")
+            args = _call_text(text, text.find("(", m.start()))
+            lm = _LABELNAMES.search(args)
+            for lab in (_LABEL_LIT.findall(lm.group(1)) if lm else ()):
+                if _ID_LABEL.search(lab):
+                    errors.append(
+                        f"{where}: label {lab!r} on {name!r} looks like "
+                        "a request/trace id — per-request identity "
+                        "belongs in spans / flight-recorder records, "
+                        "not metric labels")
+                elif lab not in ALLOWED_LABELS:
+                    errors.append(
+                        f"{where}: label {lab!r} on {name!r} not in the "
+                        f"allowlist {sorted(ALLOWED_LABELS)} — extend "
+                        "ALLOWED_LABELS deliberately if this is a real "
+                        "low-cardinality label")
+        # label VALUES: an id smuggled into .inc/.set/.observe kwargs
+        for m in _OBS_CALL.finditer(text):
+            args = _call_text(text, text.find("(", m.start()))
+            v = _ID_VALUE.search(args)
+            if v:
+                where = f"{f.relative_to(REPO) if f.is_relative_to(REPO) else f}" \
+                        f":{text[:m.start()].count(chr(10)) + 1}"
+                errors.append(
+                    f"{where}: {v.group(0).strip()!r} feeds a "
+                    "request/trace id as a metric label value — "
+                    "unbounded cardinality; put it in a span or "
+                    "flight-recorder record instead")
     for name, ks in sorted(kinds.items()):
         if len(ks) > 1:
             errors.append(

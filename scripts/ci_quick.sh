@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
-# Quick gate (ISSUE 7 + 8 + 10): metric-name + doc lint, then the
-# telemetry-plane, roofline-floor, elastic-scaleout, and serving-plane
-# fast suites. One command, <3 min on CPU; run before touching
-# instrumentation, bench schema, docs examples, the scaleout plane, or
-# the serving engine/scheduler.
+# Quick gate (ISSUE 7 + 8 + 10 + 11): metric-name/label + doc lint,
+# then the telemetry-plane, roofline-floor, elastic-scaleout,
+# serving-plane, and SLO-plane fast suites. One command, <3 min on CPU;
+# run before touching instrumentation, bench schema, docs examples, the
+# scaleout plane, the serving engine/scheduler, or the SLO/flight-
+# recorder plane.
 #
 #   bash scripts/ci_quick.sh
 #
@@ -15,9 +16,9 @@ cd "$(dirname "$0")/.."
 echo "== metric-name + doc lint =="
 python scripts/check_metric_names.py
 
-echo "== obs + floors + scaleout-fast + serving suites =="
+echo "== obs + floors + scaleout-fast + serving + slo suites =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
-    tests/test_scaleout_fast.py tests/test_serving.py \
+    tests/test_scaleout_fast.py tests/test_serving.py tests/test_slo.py \
     -q -m 'not slow' -p no:cacheprovider -p no:randomly
 
 echo "ci_quick: all green"

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Render a goodput/SLO table from a serving flight-recorder JSONL
+(ISSUE 11 tooling — the offline half of ``scheduler.slo.report()``).
+
+A flight-recorder dump (``scheduler.flight_recorder.dump()``, or the
+automatic ``fail_all`` black box a crashing serve loop leaves) carries
+per-request lifecycle traces. This script replays them through the SAME
+``obs.slo.SLOTracker`` the live scheduler uses — one semantics, two
+entry points — and prints a per-replica table: requests, goodput,
+TTFT/ITL p50/p99 vs target, error rate, burn rate, verdict. Torn
+trailing lines (a dump written by a dying process) are tolerated, the
+``obs.spans.load_spans`` discipline.
+
+    python scripts/slo_report.py runs/serving_blackbox.jsonl
+    python scripts/slo_report.py dump.jsonl --ttft 0.5 --itl 0.1 --json
+
+Exit code: 0 when every replica's SLO is met (or no verdict possible),
+1 when any replica misses — usable as a post-run gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from deeplearning4j_tpu.obs import load_flight_records  # noqa: E402
+from deeplearning4j_tpu.obs.slo import SLOConfig, SLOTracker  # noqa: E402
+
+
+def _fmt_s(v, target=None):
+    if v is None:
+        return "-"
+    s = f"{v * 1e3:.1f}ms"
+    if target is not None:
+        s += " ✓" if v <= target else " ✗"
+    return s
+
+
+def _fmt_pct(v):
+    return "-" if v is None else f"{100 * v:.1f}%"
+
+
+def build_reports(records, cfg: SLOConfig):
+    """Replica -> SLOTracker report for every reqtrace record. The
+    window is the whole dump (offline replay: window_s=inf) so a
+    postmortem judges everything the black box kept."""
+    offline = SLOConfig(ttft_s=cfg.ttft_s, itl_s=cfg.itl_s,
+                        quantile=cfg.quantile,
+                        max_error_rate=cfg.max_error_rate,
+                        window_s=math.inf,
+                        window_max=max(cfg.window_max, 1 << 20))
+    trackers = {}
+    # a dump may hold several appended sections; dedupe on (replica,
+    # request id, trace epoch anchor), keeping the LAST record — the
+    # same request re-dumped collapses to its most complete timeline,
+    # while a LATER serve session's request 0 (ids restart per
+    # scheduler) stays a distinct row and can still trip the gate
+    latest = {}
+    for rec in records:
+        if rec.get("kind") != "reqtrace":
+            continue
+        replica = str(rec.get("replica", "0"))
+        latest[(replica, rec.get("request_id"),
+                rec.get("t0_epoch"))] = rec
+    for (replica, _, _), rec in sorted(latest.items(),
+                                       key=lambda kv: kv[0][1] or 0):
+        tr = trackers.setdefault(
+            replica, SLOTracker(offline, replica=replica, registry=False))
+        summary = rec.get("summary") or {}
+        ts = rec.get("t0_epoch")
+        tr.observe_summary(summary, ts=ts)
+    return {replica: tr.report() for replica, tr in trackers.items()}
+
+
+def render(reports, crash_headers) -> str:
+    lines = []
+    if crash_headers:
+        for h in crash_headers:
+            lines.append(f"!! crash dump: replica {h.get('replica')} "
+                         f"reason={h.get('reason')} "
+                         f"({h.get('n_requests')} traces, "
+                         f"{h.get('n_snapshots')} snapshots)")
+        lines.append("")
+    hdr = (f"{'replica':>8} {'reqs':>5} {'fail':>5} {'goodput':>8} "
+           f"{'ttft p50':>10} {'ttft p99':>10} {'itl p50':>10} "
+           f"{'itl p99':>10} {'err':>6} {'burn':>6}  verdict")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for replica, rep in sorted(reports.items()):
+        w = rep.get("window", {})
+        if not w.get("requests"):
+            lines.append(f"{replica:>8} {'0':>5}  (no eligible requests)")
+            continue
+        t = rep["targets"]
+        ttft, itl = rep["ttft"], rep["itl"]
+        verdict = {True: "MET", False: "MISSED", None: "-"}[rep["met"]]
+        lines.append(
+            f"{replica:>8} {w['requests']:>5} {w.get('failed', 0):>5} "
+            f"{_fmt_pct(rep['goodput']):>8} "
+            f"{_fmt_s(ttft['p50_s']):>10} "
+            f"{_fmt_s(ttft['p99_s'], t['ttft_s']):>10} "
+            f"{_fmt_s(itl['p50_s']):>10} "
+            f"{_fmt_s(itl['p99_s'], t['itl_s']):>10} "
+            f"{_fmt_pct(rep['error_rate']):>6} "
+            f"{rep['burn_rate']:>6.2f}  {verdict}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="goodput/SLO table from a flight-recorder JSONL")
+    ap.add_argument("dump", help="flight-recorder JSONL path")
+    ap.add_argument("--ttft", type=float, default=1.0,
+                    help="TTFT target seconds (default 1.0)")
+    ap.add_argument("--itl", type=float, default=0.25,
+                    help="worst inter-token gap target seconds "
+                         "(default 0.25)")
+    ap.add_argument("--quantile", type=float, default=0.99,
+                    help="attainment objective (default 0.99)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report dicts as JSON instead of "
+                         "the table")
+    args = ap.parse_args(argv)
+
+    records = load_flight_records(args.dump)
+    if not records:
+        print(f"slo_report: no flight-recorder records in {args.dump}",
+              file=sys.stderr)
+        return 1
+    cfg = SLOConfig(ttft_s=args.ttft, itl_s=args.itl,
+                    quantile=args.quantile)
+    reports = build_reports(records, cfg)
+    crash_headers = [r for r in records if r.get("kind") == "flightrec"
+                     and r.get("reason") == "fail_all"]
+    if args.json:
+        # the offline window is math.inf, which json.dumps would render
+        # as the non-standard literal `Infinity` — strict parsers (jq,
+        # every non-Python consumer) reject it; emit null instead
+        def _finite(o):
+            if isinstance(o, float) and not math.isfinite(o):
+                return None
+            if isinstance(o, dict):
+                return {k: _finite(v) for k, v in o.items()}
+            if isinstance(o, list):
+                return [_finite(v) for v in o]
+            return o
+        print(json.dumps(_finite({"reports": reports,
+                                  "crash_dumps": len(crash_headers)}),
+                         indent=2))
+    else:
+        print(render(reports, crash_headers))
+    return 1 if any(rep.get("met") is False
+                    for rep in reports.values()) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

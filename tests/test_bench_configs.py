@@ -242,6 +242,37 @@ def test_bench_refresh_rows_isolated(tmp_path, monkeypatch, capsys):
     assert "_incomplete" not in disk["secondary"]       # marker cleared
 
 
+def test_bench_slo_serve_block_tiny_engine():
+    """The `slo` block every inference row now embeds (ISSUE 11): a
+    real scheduler serve at CI scale yields goodput / ITL p99 / TTFT
+    p99 with the targets riding along."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.serving import GenerationEngine
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq=32,
+                                dtype=jnp.float32, attn_scores_bf16=False)
+    eng = GenerationEngine(cfg, tfm.init_params(jax.random.PRNGKey(0),
+                                                cfg))
+    block = bench._slo_serve_block(eng, slots=2, n_requests=4,
+                                   new_tokens=4, prompt_len=6)
+    assert 0.0 <= block["goodput"] <= 1.0
+    assert block["itl_p99_ms"] > 0 and block["ttft_p99_ms"] > 0
+    assert block["requests"] == 4
+    assert block["itl_samples"] == 4 * 3
+    assert block["targets"]["quantile"] == 0.99
+    assert isinstance(block["met"], bool)
+    # the offline TTFT-row derivation shares _slo_compact
+    from deeplearning4j_tpu.obs import SLOConfig, SLOTracker
+    tr = SLOTracker(SLOConfig(), registry=False)
+    for s in (0.01, 0.02):
+        tr.observe_summary({"status": "finish", "ttft_s": s, "itl_s": []})
+    compact = bench._slo_compact(tr.report())
+    assert compact["goodput"] == 1.0 and compact["itl_p99_ms"] is None
+
+
 def test_bench_inference_helpers_and_refresh_routing(tmp_path, monkeypatch):
     """Serving bench surface at CI scale (ISSUE 10): the latency-sweep
     helper drives a live ParallelInference at tiny shapes, off-TPU rows

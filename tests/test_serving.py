@@ -241,7 +241,15 @@ def test_scheduler_mixed_length_trace_slot_invariants(model, engine):
     assert reg.get("dl4j_serving_tokens_total").value() == sum(budgets)
     assert reg.get("dl4j_serving_ttft_seconds").count() == 6
     assert reg.get("dl4j_serving_prefills_total").value() == 6
-    assert 0 < reg.get("dl4j_serving_slot_occupancy").value() <= 1.0
+    # occupancy is replica-labeled now (fabric groundwork, ISSUE 11);
+    # the pool is idle after run_until_idle but run_until_idle never
+    # executes an idle step, so the last busy value is still visible
+    assert 0 < reg.get("dl4j_serving_slot_occupancy").value(
+        replica="0") <= 1.0
+    # per-request inter-token latency: every request contributes
+    # len(tokens) - 1 samples
+    assert reg.get("dl4j_serving_itl_seconds").count() == \
+        sum(b - 1 for b in budgets)
 
 
 def test_scheduler_eos_stops_early(model, engine):
@@ -316,6 +324,227 @@ def test_scheduler_background_thread(model, engine):
         assert res.tokens.tolist() == engine.generate(prompt, 3).tolist()
     finally:
         sched.stop()
+
+
+# ------------------------------------------- SLO plane (ISSUE 11)
+
+def test_idle_gauges_reset_after_pool_drains(model, engine):
+    """Regression: occupancy/tokens-per-second were only written inside
+    the decode sweep, so after the pool drained they froze at the last
+    busy value — a load-aware router would keep avoiding a free
+    replica. An idle step() must zero them."""
+    reg = get_registry()
+    reg.reset()
+    sched = ContinuousBatchingScheduler(engine, n_slots=2)
+    fut = sched.submit(_toks((1, 4), seed=81)[0], max_new_tokens=3)
+    sched.run_until_idle()
+    fut.result(timeout=5)
+    occ = reg.get("dl4j_serving_slot_occupancy")
+    tps = reg.get("dl4j_serving_tokens_per_second")
+    assert occ.value(replica="0") > 0          # frozen busy reading
+    assert tps.value(replica="0") > 0
+    assert sched.step() is False               # fully idle iteration
+    assert occ.value(replica="0") == 0.0
+    assert tps.value(replica="0") == 0.0
+
+
+def test_preempted_request_trace_spans_and_itl(model, engine):
+    """Trace assembly under adversity: a preempted-and-resumed request's
+    timeline records the admission, BOTH prefills and the requeue gap —
+    and the gap is one of its ITL samples (the stall its caller actually
+    saw, invisible to per-sweep timing)."""
+    from deeplearning4j_tpu.obs import get_tracer
+    reg = get_registry()
+    reg.reset()
+    tracer = get_tracer()
+    tracer.clear()
+    sched = ContinuousBatchingScheduler(engine, n_slots=1,
+                                        starvation_ms=0.0)
+    long_p = _toks((1, 5), seed=41)[0]
+    short_p = _toks((1, 3), seed=42)[0]
+    f_long = sched.submit(long_p, max_new_tokens=10)
+    sched.step()                      # admit the long request
+    time.sleep(0.002)
+    f_short = sched.submit(short_p, max_new_tokens=2)
+    time.sleep(0.002)
+    sched.run_until_idle()
+    assert f_long.result(5).preemptions >= 1
+    f_short.result(5)
+
+    traces = {t.request_id: t for t in sched.flight_recorder.requests()}
+    tr = traces[0]                    # the long request submitted first
+    assert len(tr.all("prefill")) == 2          # admission + re-admission
+    assert len(tr.all("admit")) == 2
+    assert len(tr.all("preempt")) == 1 and len(tr.all("requeue")) == 1
+    assert tr.finish_reason() == "length" and tr.n_tokens() == 10
+    # the requeue gap (last pre-preempt token -> first post-readmit
+    # token) is exactly one of the ITL samples
+    toks = tr.token_timestamps()
+    t_pre = tr.all("preempt")[0][1]
+    t_resume = tr.all("prefill")[1][1]
+    before = max(t for t in toks if t <= t_pre)
+    after = min(t for t in toks if t >= t_resume)
+    gap = after - before
+    itl = tr.itl_samples()
+    assert len(itl) == 9
+    assert any(abs(s - gap) < 1e-9 for s in itl)
+    assert max(itl) >= gap            # nothing in-stream beats the stall
+    # the ITL histogram saw every sample of both requests
+    assert reg.get("dl4j_serving_itl_seconds").count() == 9 + 1
+
+    # span tree: request root -> one serving.prefill per admission ->
+    # token events parented to their own admission segment
+    spans = [s for s in tracer.spans() if s.trace_id == tr.trace_id()]
+    roots = [s for s in spans if s.name == "serving.request"]
+    assert len(roots) == 1 and roots[0].parent_id is None
+    root = roots[0]
+    assert root.attrs["preemptions"] == 1
+    prefills = sorted((s for s in spans if s.name == "serving.prefill"),
+                      key=lambda s: s.attrs["admission"])
+    assert len(prefills) == 2
+    assert all(s.parent_id == root.span_id for s in prefills)
+    tokens = sorted((s for s in spans if s.name == "serving.token"),
+                    key=lambda s: s.attrs["i"])
+    assert len(tokens) == 10
+    # first segment's tokens hang off prefill 0, the rest off prefill 1
+    seg_parents = {s.parent_id for s in tokens}
+    assert seg_parents == {prefills[0].span_id, prefills[1].span_id}
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_serve_loop_crash_dumps_flight_recorder(model, engine, tmp_path,
+                                                monkeypatch):
+    """An injected serve-loop crash must fail every future AND leave a
+    black box: a JSONL dump whose crash snapshot lists exactly the
+    doomed request ids and whose traces carry the terminal fail."""
+    from deeplearning4j_tpu.obs import load_flight_records
+    dump = tmp_path / "blackbox.jsonl"
+    sched = ContinuousBatchingScheduler(engine, n_slots=1,
+                                        crash_dump_path=str(dump))
+    f1 = sched.submit(_toks((1, 4), seed=91)[0], max_new_tokens=4)
+    sched.step()                      # admit into slot 0 (healthy)
+    f2 = sched.submit(_toks((1, 5), seed=92)[0], max_new_tokens=4)
+
+    def boom(cache, tokens):
+        raise RuntimeError("injected decode crash")
+    monkeypatch.setattr(sched.engine, "decode_step", boom)
+    sched.start(poll_s=0.001)
+    with pytest.raises(RuntimeError, match="injected decode crash"):
+        f1.result(timeout=30)
+    with pytest.raises(RuntimeError):
+        f2.result(timeout=30)
+    sched._thread.join(timeout=30)    # dump written before the re-raise
+
+    recs = load_flight_records(dump)
+    assert any(r["kind"] == "flightrec" and r["reason"] == "fail_all"
+               for r in recs)
+    snaps = [r for r in recs if r["kind"] == "snapshot"]
+    crash = [s for s in snaps if s.get("crash")]
+    assert crash, snaps
+    last = crash[-1]
+    # the crash snapshot matches the failed futures: slot 0 held
+    # request 0, request 1 was still queued
+    assert last["slots"] == [0] and last["queue"] == [1]
+    assert "injected decode crash" in last["error"]
+    traces = [r for r in recs if r["kind"] == "reqtrace"]
+    assert {t["request_id"] for t in traces} == {0, 1}
+    assert all(t["summary"]["status"] == "fail" for t in traces)
+
+
+def test_scheduler_with_slo_is_output_transparent_and_reports(model,
+                                                              engine):
+    """Acceptance (ISSUE 11): with the recorder, span assembly, ITL
+    tracing AND an SLOTracker enabled, greedy scheduler output is
+    bit-identical to generate(), and the SLO report carries goodput /
+    ITL verdicts with replica-labeled gauges behind it."""
+    from deeplearning4j_tpu.serving import SLOConfig
+    reg = get_registry()
+    reg.reset()
+    sched = ContinuousBatchingScheduler(
+        engine, n_slots=2, slo=SLOConfig(ttft_s=60.0, itl_s=60.0))
+    prompts = [_toks((1, n), seed=100 + n)[0] for n in (3, 6, 4)]
+    futs = [sched.submit(p, max_new_tokens=5) for p in prompts]
+    sched.run_until_idle()
+    for p, f in zip(prompts, futs):
+        assert f.result(5).tokens.tolist() == \
+            engine.generate(p, 5).tolist()
+    rep = sched.slo.report()
+    assert rep["window"]["requests"] == 3
+    assert rep["goodput"] == 1.0 and rep["error_rate"] == 0.0
+    assert rep["burn_rate"] == 0.0 and rep["met"] is True
+    assert rep["itl"]["samples"] == 3 * 4 and rep["itl"]["p99_s"] > 0
+    assert reg.get("dl4j_slo_goodput_ratio").value(replica="0") == 1.0
+    assert reg.get("dl4j_slo_window_requests").value(replica="0") == 3
+    # the flight recorder kept every trace and the debug state sees SLO
+    dbg = sched.flight_recorder.debug_state()
+    assert dbg["requests_recorded"] == 3
+    assert dbg["slo"]["goodput"] == 1.0
+
+
+def test_trace_overhead_within_budget():
+    """Documented budget (the MetricsListener precedent): the SLO-plane
+    bookkeeping — trace events, snapshots, close-out — self-times, and
+    must cost <2% of the tier-1 CPU decode sweep's wall clock with
+    everything enabled. Like test_obs's listener-budget test, this uses
+    a deliberately non-trivial config: against a microscopic model the
+    percentage measures Python noise, not the budget."""
+    from deeplearning4j_tpu.serving import SLOConfig
+    cfg = tiny_cfg(vocab_size=512, d_model=256, n_heads=4, n_layers=4,
+                   d_ff=512, max_seq=64)
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg)
+    eng = GenerationEngine(cfg, params)
+    sched = ContinuousBatchingScheduler(eng, n_slots=4, slo=SLOConfig())
+    # compile outside the window
+    sched.submit(_toks((1, 4), vocab=512, seed=110)[0], max_new_tokens=2)
+    sched.run_until_idle()
+    base = sched.trace_overhead_seconds
+    futs = [sched.submit(_toks((1, 3 + (i % 4)), vocab=512,
+                               seed=120 + i)[0], max_new_tokens=24)
+            for i in range(8)]
+    t0 = time.perf_counter()
+    sched.run_until_idle()
+    wall = time.perf_counter() - t0
+    for f in futs:
+        f.result(timeout=5)
+    cost = sched.trace_overhead_seconds - base
+    assert cost < 0.02 * wall, (
+        f"SLO-plane bookkeeping cost {cost * 1e3:.2f}ms of "
+        f"{wall * 1e3:.1f}ms serve wall "
+        f"({100 * cost / wall:.2f}% > 2% budget)")
+
+
+def test_debug_endpoints_serve_flight_recorder(model, engine):
+    """GET /debug/serving and /debug/requests on the UI server expose
+    the live black box next to /metrics."""
+    import json
+    import urllib.request
+    from deeplearning4j_tpu.ui import UIServer
+    sched = ContinuousBatchingScheduler(engine, n_slots=1,
+                                        replica="dbg")
+    fut = sched.submit(_toks((1, 4), seed=130)[0], max_new_tokens=3)
+    sched.run_until_idle()
+    fut.result(timeout=5)
+    srv = UIServer(log_dir="runs/_dbg_test", port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        serving = json.loads(urllib.request.urlopen(
+            base + "/debug/serving", timeout=10).read())
+        mine = [r for r in serving["replicas"] if r["replica"] == "dbg"]
+        assert mine and mine[0]["requests_recorded"] == 1
+        assert mine[0]["queue_depth"] == 0 and mine[0]["occupancy"] == 0
+        reqs = json.loads(urllib.request.urlopen(
+            base + "/debug/requests?replica=dbg&n=5", timeout=10).read())
+        assert len(reqs["requests"]) == 1
+        rec = reqs["requests"][0]
+        assert rec["kind"] == "reqtrace"
+        assert rec["summary"]["status"] == "finish"
+        assert rec["summary"]["tokens"] == 3
+        names = [e[0] for e in rec["events"]]
+        assert names[:3] == ["submit", "queue", "admit"]
+        assert names.count("token") == 3 and names[-1] == "finish"
+    finally:
+        srv.stop()
 
 
 # -------------------------------- ParallelInference satellites (ISSUE 10)

@@ -1,0 +1,296 @@
+"""SLO plane unit suite (ISSUE 11): RequestTrace timelines + ITL
+derivation, SLOTracker goodput/attainment/burn-rate semantics,
+FlightRecorder ring + dump/load round-trip, the label-cardinality lint,
+and the slo_report offline tool. Pure host-side — no jax device work, so
+this stays in the fast tier-1 set.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+import sys
+
+import pytest
+
+from deeplearning4j_tpu.obs import (FlightRecorder, MetricsRegistry,
+                                    RequestTrace, SLOConfig, SLOTracker,
+                                    Tracer, load_flight_records)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _trace(rid=0, replica="0", ttft=0.1, gaps=(0.01, 0.01), fail=False):
+    """Synthetic lifecycle: submit at t=0, first token at `ttft`, then
+    one token per entry of `gaps`."""
+    tr = RequestTrace(request_id=rid, replica=replica)
+    t = 100.0
+    tr.event("submit", ts=t)
+    tr.event("queue", ts=t)
+    tr.event("admit", ts=t + ttft / 2, slot=0)
+    tr.event("prefill", ts=t + ttft, slot=0, tokens=4, time_s=ttft / 2)
+    tr.event("token", ts=t + ttft, i=0)
+    for i, g in enumerate(gaps):
+        t += g
+        tr.event("token", ts=t + ttft, i=i + 1)
+    if fail:
+        tr.event("fail", ts=t + ttft, error="boom")
+    else:
+        tr.event("finish", ts=t + ttft, reason="length")
+    return tr
+
+
+# ------------------------------------------------------- RequestTrace
+
+def test_trace_derivations():
+    tr = _trace(ttft=0.2, gaps=(0.01, 0.03, 0.02))
+    assert tr.ttft_s() == pytest.approx(0.2)
+    assert tr.n_tokens() == 4
+    assert tr.itl_samples() == pytest.approx([0.01, 0.03, 0.02])
+    assert tr.finish_reason() == "length"
+    s = tr.summary()
+    assert s["status"] == "finish" and s["tokens"] == 4
+    assert s["itl_s"] == pytest.approx([0.01, 0.03, 0.02])
+    assert tr.latency_s() == pytest.approx(0.2 + 0.06)
+
+
+def test_trace_requeue_gap_is_an_itl_sample():
+    """The core ITL semantics: a preempt → requeue → re-prefill stall
+    appears as one inter-token gap, derived per request."""
+    tr = RequestTrace(request_id=1)
+    tr.event("submit", ts=0.0)
+    tr.event("prefill", ts=0.1, slot=0, tokens=3, time_s=0.1)
+    tr.event("token", ts=0.1, i=0)
+    tr.event("token", ts=0.11, i=1)
+    tr.event("preempt", ts=0.112, slot=0, generated=2)
+    tr.event("requeue", ts=0.112)
+    tr.event("prefill", ts=0.5, slot=1, tokens=5, time_s=0.05)
+    tr.event("token", ts=0.5, i=2)
+    tr.event("token", ts=0.51, i=3)
+    tr.event("finish", reason="length")
+    itl = tr.itl_samples()
+    assert itl == pytest.approx([0.01, 0.39, 0.01])
+    assert max(itl) == pytest.approx(0.39)   # the requeue stall
+
+
+def test_trace_span_tree_deterministic_ids():
+    tr = _trace(rid=7, replica="r1", gaps=(0.01,))
+    tracer = Tracer()
+    spans = tr.assemble_spans(tracer)
+    assert tr.assemble_spans(Tracer())[0].span_id == spans[0].span_id
+    by_name = {}
+    for sp in tracer.spans():
+        by_name.setdefault(sp.name, []).append(sp)
+    root = by_name["serving.request"][0]
+    assert root.parent_id is None and root.attrs["request"] == 7
+    assert root.attrs["replica"] == "r1"
+    for sp in by_name["serving.prefill"]:
+        assert sp.parent_id == root.span_id
+    for sp in by_name["serving.token"]:
+        assert sp.parent_id == by_name["serving.prefill"][0].span_id
+    # one trace id for the whole tree
+    assert len({sp.trace_id for sp in tracer.spans()}) == 1
+
+
+# --------------------------------------------------------- SLOTracker
+
+def _cfg(**kw):
+    base = dict(ttft_s=0.5, itl_s=0.05, quantile=0.9,
+                max_error_rate=0.1, window_s=math.inf)
+    base.update(kw)
+    return SLOConfig(**base)
+
+
+def test_slo_goodput_attainment_burn_rate():
+    reg = MetricsRegistry()
+    tr = SLOTracker(_cfg(), replica="2", registry=reg)
+    for _ in range(8):
+        tr.observe(_trace(ttft=0.1, gaps=(0.01, 0.02)))      # good
+    tr.observe(_trace(ttft=0.9, gaps=(0.01,)))               # ttft miss
+    tr.observe(_trace(ttft=0.1, gaps=(0.2,)))                # itl miss
+    rep = tr.report()
+    assert rep["window"]["requests"] == 10
+    assert rep["goodput"] == pytest.approx(0.8)
+    assert rep["ttft"]["attainment"] == pytest.approx(0.9)
+    assert rep["itl"]["attainment"] == pytest.approx(0.9)
+    assert rep["error_rate"] == 0.0
+    # 20% violating / 10% budget = burn rate 2
+    assert rep["burn_rate"] == pytest.approx(2.0)
+    assert rep["met"] is False
+    assert reg.get("dl4j_slo_goodput_ratio").value(
+        replica="2") == pytest.approx(0.8)
+    assert reg.get("dl4j_slo_burn_rate").value(
+        replica="2") == pytest.approx(2.0)
+    assert reg.get("dl4j_slo_window_requests").value(replica="2") == 10
+
+
+def test_slo_failures_and_cancels():
+    tr = SLOTracker(_cfg(), registry=False)
+    tr.observe(_trace(ttft=0.1))                 # good
+    tr.observe(_trace(ttft=0.1, fail=True))      # failed -> error + bad
+    assert tr.observe_summary({"status": "cancel"}) is None  # excluded
+    rep = tr.report()
+    assert rep["window"]["requests"] == 2
+    assert rep["error_rate"] == pytest.approx(0.5)
+    assert rep["goodput"] == pytest.approx(0.5)
+    assert rep["met"] is False                   # error rate over ceiling
+
+
+def test_slo_single_token_request_meets_itl_vacuously():
+    tr = SLOTracker(_cfg(), registry=False)
+    assert tr.observe(_trace(ttft=0.1, gaps=())) is True
+
+
+def test_slo_window_prunes_by_latest_ts_and_counts_stay_consistent():
+    tr = SLOTracker(_cfg(window_s=10.0), registry=False)
+    tr.observe(_trace(ttft=0.9), ts=0.0)         # bad, will expire
+    tr.observe(_trace(ttft=0.1), ts=5.0)
+    assert tr.goodput() == pytest.approx(0.5)
+    tr.observe(_trace(ttft=0.1), ts=11.0)        # expires the ts=0 entry
+    rep = tr.report()
+    assert rep["window"]["requests"] == 2
+    assert rep["goodput"] == 1.0 and rep["burn_rate"] == 0.0
+    assert rep["met"] is True
+
+
+def test_slo_window_max_bounds_population():
+    tr = SLOTracker(_cfg(window_max=4), registry=False)
+    for i in range(10):
+        tr.observe(_trace(ttft=0.1), ts=float(i))
+    assert tr.report()["window"]["requests"] == 4
+
+
+def test_slo_config_validation():
+    with pytest.raises(ValueError, match="quantile"):
+        SLOConfig(quantile=1.5)
+    with pytest.raises(ValueError, match="positive"):
+        SLOConfig(ttft_s=-1.0)
+
+
+# ----------------------------------------------------- FlightRecorder
+
+def test_flight_recorder_rings_dump_and_load(tmp_path):
+    fr = FlightRecorder(capacity_requests=3, capacity_snapshots=2,
+                        replica="9")
+    for i in range(5):
+        fr.record_request(_trace(rid=i, replica="9"))
+        fr.record_snapshot(step=i, slots=[i], queue=[],
+                           queue_depth=0, occupancy=1.0)
+    assert [t.request_id for t in fr.requests()] == [2, 3, 4]  # bounded
+    assert [s["step"] for s in fr.snapshots()] == [3, 4]
+    path = fr.dump(tmp_path / "bb.jsonl", reason="test")
+    recs = load_flight_records(path)
+    hdr = [r for r in recs if r["kind"] == "flightrec"]
+    assert hdr[0]["reason"] == "test" and hdr[0]["n_requests"] == 3
+    assert len([r for r in recs if r["kind"] == "reqtrace"]) == 3
+    assert len([r for r in recs if r["kind"] == "snapshot"]) == 2
+    assert fr.dumps == 1
+    st = fr.debug_state()
+    assert st["replica"] == "9" and st["requests_recorded"] == 3
+    assert st["last_snapshot"]["step"] == 4
+
+
+def test_load_flight_records_tolerates_torn_line(tmp_path):
+    p = tmp_path / "torn.jsonl"
+    good = json.dumps({"kind": "snapshot", "step": 1})
+    p.write_text(good + "\n" + json.dumps({"kind": "ignored"}) + "\n"
+                 + '{"kind": "reqtrace", "request_id": 1, "summ')
+    recs = load_flight_records(p)
+    assert len(recs) == 1 and recs[0]["step"] == 1
+    assert load_flight_records(tmp_path / "missing.jsonl") == []
+
+
+def test_live_flight_recorders_registry():
+    from deeplearning4j_tpu.obs import live_flight_recorders
+    fr = FlightRecorder(replica="zz-live")
+    assert any(r is fr for r in live_flight_recorders())
+
+
+# ------------------------------------------------- label lint (ISSUE 11)
+
+def _lint():
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import check_metric_names
+        return check_metric_names
+    finally:
+        sys.path.pop(0)
+
+
+def test_label_lint_flags_bad_labels_and_id_values(tmp_path):
+    c = _lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'reg.gauge("dl4j_x", "h", labelnames=("request_id",))\n'
+        'reg.gauge("dl4j_y", "h", labelnames=("flavor",))\n'
+        'reg.gauge("dl4j_z", "h", labelnames=("replica",)).set(\n'
+        '    1.0, replica=req.id)\n')
+    errors = c.check(files=[bad])
+    assert len(errors) == 3
+    assert any("request_id" in e and "flight-recorder" in e
+               for e in errors)
+    assert any("flavor" in e and "allowlist" in e for e in errors)
+    assert any("req.id" in e and "cardinality" in e for e in errors)
+
+
+def test_label_lint_green_over_slo_and_serving_sites():
+    """The real obs/ + serving/ trees (all dl4j_slo_* and replica-
+    labeled additions) pass the extended lint."""
+    c = _lint()
+    files = sorted((REPO / "deeplearning4j_tpu" / "obs").rglob("*.py")) \
+        + sorted((REPO / "deeplearning4j_tpu" / "serving").rglob("*.py"))
+    assert c.check(files=files) == []
+
+
+# ------------------------------------------------------- slo_report.py
+
+def test_slo_report_renders_table_and_gates(tmp_path, capsys):
+    fr = FlightRecorder(replica="0")
+    for i in range(6):
+        fr.record_request(_trace(rid=i, ttft=0.1, gaps=(0.01, 0.01)))
+    fr.record_request(_trace(rid=6, ttft=0.1, fail=True))
+    path = fr.dump(tmp_path / "bb.jsonl")
+
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import slo_report
+    finally:
+        sys.path.pop(0)
+    rc = slo_report.main([str(path), "--ttft", "0.5", "--itl", "0.05"])
+    out = capsys.readouterr().out
+    assert "goodput" in out and "MISSED" in out   # 1 failure / 7 reqs
+    assert rc == 1                                # gate trips
+    rc = slo_report.main([str(path), "--ttft", "0.5", "--itl", "0.05",
+                          "--quantile", "0.5", "--json"])
+    raw = capsys.readouterr().out
+    assert "Infinity" not in raw     # strict JSON: inf window -> null
+    rep = json.loads(raw)
+    r0 = rep["reports"]["0"]
+    assert r0["window"]["requests"] == 7
+    assert r0["targets"]["window_s"] is None
+    assert r0["goodput"] == pytest.approx(6 / 7)
+    assert rc == 1   # error-rate ceiling (1%) still exceeded
+
+
+def test_slo_report_keeps_distinct_sessions_dedupes_redumps(tmp_path,
+                                                            capsys):
+    """Request ids restart at 0 per scheduler: two serve sessions
+    appended to one dump must BOTH be judged (a first-session miss
+    cannot vanish), while the same request dumped twice collapses."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import slo_report
+    finally:
+        sys.path.pop(0)
+    fr = FlightRecorder(replica="0")
+    t1 = _trace(rid=0, ttft=0.9)                 # session 1: ttft miss
+    fr.record_request(t1)
+    path = fr.dump(tmp_path / "bb.jsonl")
+    fr.record_request(_trace(rid=0, ttft=0.1))   # session 2: same rid
+    fr.dump(path)                                # t1 re-dumped here too
+    cfg = slo_report.SLOConfig(ttft_s=0.5, itl_s=0.05)
+    reports = slo_report.build_reports(
+        slo_report.load_flight_records(path), cfg)
+    assert reports["0"]["window"]["requests"] == 2   # not 1, not 3
+    assert reports["0"]["goodput"] == pytest.approx(0.5)
