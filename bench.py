@@ -937,14 +937,44 @@ def _slo_compact(report):
     return out
 
 
-def _slo_serve_block(eng, slots, n_requests=None, new_tokens=8,
-                     prompt_len=64):
-    """Goodput/SLO verdict from a REAL continuous-batching serve over
-    the row's engine (ISSUE 11): submit a mixed wave through the
-    scheduler with the flight recorder + per-request ITL tracing on,
-    report the rolling-window verdict. The warm-up request keeps
-    compile time out of the steady-state verdict (the same discipline
-    every timed row uses). Never fatal — the row survives SLO-less."""
+def _mem_peak(pytree_total):
+    """(peak_bytes, source): the allocator's peak where the backend has
+    memory_stats (TPU/GPU), else the pytree census total — the CPU
+    tier-1 path still gets a number (ISSUE 12)."""
+    from deeplearning4j_tpu.obs import device_memory_stats
+    stats = device_memory_stats()
+    if stats and stats.get("peak_bytes_in_use"):
+        return int(stats["peak_bytes_in_use"]), "memory_stats"
+    return int(pytree_total), "pytree"
+
+
+def _mem_basic(params_tree, **kv_fields):
+    """Memory block builder — the ONE place the row schema lives
+    (peak/source/params_bytes core + optional kv_* fields), so the
+    decode, TTFT, and batch-1 rows can't drift apart. Never fatal."""
+    try:
+        from deeplearning4j_tpu.obs import tree_bytes
+        pb = tree_bytes(params_tree)
+        peak, src = _mem_peak(pb + kv_fields.get("kv_allocated_bytes", 0))
+        return {"peak_bytes": peak, "source": src, "params_bytes": pb,
+                **kv_fields}
+    except Exception as e:  # noqa: BLE001 — the row survives block-less
+        return {"na": f"memory block failed: "
+                      f"{type(e).__name__}: {e}"[:300]}
+
+
+def _serve_blocks(eng, slots, n_requests=None, new_tokens=8,
+                  prompt_len=64):
+    """(slo, memory) evidence from ONE real continuous-batching serve
+    over the row's engine: submit a mixed-length wave through the
+    scheduler with per-request ITL tracing + KV residency accounting
+    on, report the rolling-window SLO verdict beside the memory
+    attribution (ISSUE 11 + 12). The warm-up request keeps compile time
+    out of the steady-state verdict (the same discipline every timed
+    row uses); prompt lengths step down across the wave so the
+    kv_waste_ratio is measured under genuinely mixed traffic — the
+    number that sizes the paged-KV PR. Never fatal — the row survives
+    block-less."""
     import numpy as np
     from deeplearning4j_tpu.obs import SLOConfig, SLOTracker
     from deeplearning4j_tpu.serving import ContinuousBatchingScheduler
@@ -956,15 +986,35 @@ def _slo_serve_block(eng, slots, n_requests=None, new_tokens=8,
                         max_new_tokens=2)
     sched.run_until_idle()
     warm.result(timeout=600)
+    eng.mark_warm()    # any compile past here is a warned retrace
     sched.slo = SLOTracker(SLOConfig())   # measured window starts here
+    sched.reset_kv_window()   # memory evidence covers the SAME window
+    lstep = max(1, prompt_len // 16)
     futs = [sched.submit(
         rng.integers(0, eng.cfg.vocab_size,
-                     (prompt_len - (i % 8),)),
-        max_new_tokens=new_tokens) for i in range(n_requests)]
+                     (max(1, prompt_len - (i % 8) * lstep),)),
+        max_new_tokens=new_tokens + (i % 3)) for i in range(n_requests)]
     sched.run_until_idle()
     for f in futs:
         f.result(timeout=600)
-    return _slo_compact(sched.slo.report())
+    kv = sched.kv_report()
+    mem = _mem_basic(
+        eng.params,
+        kv_allocated_bytes=kv["allocated_bytes"],
+        kv_token_bytes=kv["token_bytes"],
+        kv_waste_ratio=kv["waste_ratio_mean"],
+        final_residency_mean=kv["final_residency_mean"],
+        retraces_after_warm=sum(s["retraces_after_warm"]
+                                for s in eng.compile_report().values()))
+    # HBM bytes the pool pays per token actually resident (mean over
+    # the serve) — the serving-efficiency number paged KV and quantized
+    # caches (ROADMAP items 1, 3) must push down
+    res_tokens = (kv["resident_bytes_mean"] / kv["token_bytes"]
+                  if kv["token_bytes"] else 0.0)
+    if "peak_bytes" in mem:
+        mem["bytes_per_resident_token"] = \
+            round(mem["peak_bytes"] / res_tokens, 1) if res_tokens else None
+    return _slo_compact(sched.slo.report()), mem
 
 
 def bench_inference_decode(batch, steps):
@@ -1002,14 +1052,16 @@ def bench_inference_decode(batch, steps):
         slots=batch, prefill_tokens=64,
         note="one continuous-batching decode sweep = one token per slot; "
              "scheduler occupancy metrics: dl4j_serving_*")
-    # the SLO verdict beside the floor block (ISSUE 11): goodput at
-    # target from a real scheduler serve — the number the decode-slot
-    # sweep optimizes, not raw tokens/s
+    # the SLO + memory verdicts beside the floor block (ISSUE 11 + 12):
+    # goodput at target AND kv waste from ONE real mixed-length
+    # scheduler serve — goodput is what the decode-slot sweep
+    # optimizes, kv_waste_ratio is what sizes the paged-KV PR
     try:
-        rec["slo"] = _slo_serve_block(eng, slots=batch)
-    except Exception as e:  # noqa: BLE001 — the row survives SLO-less
+        rec["slo"], rec["memory"] = _serve_blocks(eng, slots=batch)
+    except Exception as e:  # noqa: BLE001 — the row survives block-less
         rec["slo"] = {"na": f"slo serve failed: "
                             f"{type(e).__name__}: {e}"[:300]}
+        rec["memory"] = {"na": "see slo"}
     return _flag_on_chip(rec)
 
 
@@ -1067,6 +1119,22 @@ def _ttft_row(seq, reps):
     except Exception as e:  # noqa: BLE001 — the row survives SLO-less
         rec["slo"] = {"na": f"slo derivation failed: "
                             f"{type(e).__name__}: {e}"[:300]}
+    # memory attribution for the prefill path (ISSUE 12): one slot
+    # filled to its prompt length — waste is the tail of max_len the
+    # fixed slot preallocates past the prompt
+    try:
+        from deeplearning4j_tpu.serving import cache_nbytes, token_nbytes
+        rec["memory"] = _mem_basic(
+            eng.params,
+            kv_allocated_bytes=cache_nbytes(cache),
+            kv_token_bytes=token_nbytes(cache),
+            kv_waste_ratio=round(1.0 - seq / eng.max_len, 6))
+        if "peak_bytes" in rec["memory"]:
+            rec["memory"]["bytes_per_resident_token"] = \
+                round(rec["memory"]["peak_bytes"] / seq, 1)
+    except Exception as e:  # noqa: BLE001 — the row survives block-less
+        rec["memory"] = {"na": f"memory block failed: "
+                               f"{type(e).__name__}: {e}"[:300]}
     return _flag_on_chip(_stamp(rec))
 
 
@@ -1131,6 +1199,7 @@ def bench_inference_resnet_b1(batch, steps):
                      "ParallelInference (bf16)",
            "value": stats["p50_ms"], "unit": "ms p50 (batch 1)",
            "best_batch_unit": "samples/sec", **stats,
+           "memory": _mem_basic(net.params),
            "timing": "wall-clock ParallelInference.output round-trips, "
                      "compile excluded"}
     return _flag_on_chip(_stamp(rec))
@@ -1162,6 +1231,7 @@ def bench_inference_bert_b1(batch, steps):
                      "ParallelInference (T=128)",
            "value": stats["p50_ms"], "unit": "ms p50 (batch 1)",
            "best_batch_unit": "samples/sec", **stats,
+           "memory": _mem_basic(params),
            "timing": "wall-clock ParallelInference.output round-trips, "
                      "compile excluded"}
     return _flag_on_chip(_stamp(rec))

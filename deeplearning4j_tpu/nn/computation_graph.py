@@ -531,7 +531,11 @@ class ComputationGraph:
                         states, new_states)
                 return new_params, new_states, new_opt_state, loss, stats, next_rng
 
-            self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+            # compile sentinel (ISSUE 12) — see MLN._get_train_step
+            from ..obs.compiles import CompileSentinel
+            self._train_step = CompileSentinel(
+                "cg_train_step",
+                jax.jit(step, donate_argnums=(0, 1, 2)))
         return self._train_step
 
     def enable_gradient_anomaly_detection(self, detector=None):

@@ -216,17 +216,48 @@ class MetricsListener(TrainingListener):
     def overhead_seconds(self) -> float:
         return self._overhead.value()
 
-    def _poll_memory(self):
+    def _poll_memory(self, model=None):
+        """Device-memory poll + component census (ISSUE 12).
+
+        The allocator stats feed ``dl4j_device_memory_bytes{stat=}``
+        where the backend has them; on CPU ``memory_stats()`` is absent
+        and this used to export NOTHING — the tier-1 suite ran memory-
+        blind. Now the pytree census always runs: params / optimizer /
+        states bytes land in ``dl4j_mem_component_bytes{component,}``
+        regardless of backend, so a dryrun sizes the same attribution a
+        chip run does."""
         try:
-            import jax
-            stats = jax.local_devices()[0].memory_stats()
+            from ..obs import memory as obs_memory
         except Exception:  # noqa: BLE001 — memory stats are decoration
             return
-        if not stats:
+        stats = obs_memory.device_memory_stats()
+        if stats:
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit"):
+                if key in stats:
+                    self._mem.set(float(stats[key]), stat=key)
+        if model is None:
             return
-        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
-            if key in stats:
-                self._mem.set(float(stats[key]), stat=key)
+        components = {}
+        if getattr(model, "params", None) is not None:
+            components["params"] = model.params
+        if getattr(model, "_opt_state", None) is not None:
+            components["optimizer"] = model._opt_state
+        if getattr(model, "states", None) is not None:
+            components["states"] = model.states
+        if components:
+            try:
+                # per_replica: the gauge's replica label ALWAYS means
+                # "bytes this device holds" — the same semantics the
+                # ParallelWrapper census writes, so the two emitters
+                # agree on a sharded net instead of clobbering each
+                # other's replica="0" row (on one device, shard bytes
+                # == the full tree)
+                obs_memory.emit_census(components, source="train",
+                                       registry=self.registry,
+                                       per_replica=True)
+            except Exception:  # noqa: BLE001 — census is decoration
+                pass
 
     def iteration_done(self, model, iteration, epoch, score):
         t0 = time.perf_counter()
@@ -242,7 +273,7 @@ class MetricsListener(TrainingListener):
             self._examples.inc(batch)
         self._loss.set(float(score))
         if iteration % self.memory_frequency == 0:
-            self._poll_memory()
+            self._poll_memory(model)
         self._overhead.inc(time.perf_counter() - t0)
 
     def on_epoch_end(self, model):

@@ -380,7 +380,14 @@ class MultiLayerNetwork:
                         states, new_states)
                 return new_params, new_states, new_opt_state, loss, stats, next_rng
 
-            self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+            # compile sentinel (ISSUE 12): counts/times every compile of
+            # the donated step and warns on post-warmup retraces — the
+            # wrapper is transparent (fit_scanned's `.__wrapped__` and
+            # floor probes' `.lower` delegate through)
+            from ..obs.compiles import CompileSentinel
+            self._train_step = CompileSentinel(
+                "mln_train_step",
+                jax.jit(step, donate_argnums=(0, 1, 2)))
         return self._train_step
 
     def enable_gradient_anomaly_detection(self, detector=None):

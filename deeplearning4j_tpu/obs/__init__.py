@@ -26,6 +26,15 @@ Instrumented surfaces (all under the ``dl4j_`` namespace —
   ``dl4j_serving_itl_seconds`` inter-token-latency histogram, rolling
   ``dl4j_slo_*`` goodput/attainment/burn-rate gauges (``replica``-
   labeled), and the crash flight recorder behind ``/debug/serving``.
+- ``obs.memory`` / ``obs.compiles`` — the memory & compile plane
+  (ISSUE 12): pytree memory census over named components
+  (``dl4j_mem_component_bytes{component, replica}``, allocator view
+  attached where ``memory_stats`` exists, pytree fallback on CPU), KV
+  residency accounting on the serving scheduler (``dl4j_kv_*``), and
+  the :class:`CompileSentinel` retrace guard on every jitted entry
+  point (``dl4j_compile_*``, post-warmup retraces warned). Forensics:
+  ``GET /debug/memory``, census + residency records in flight-recorder
+  dumps, ``scripts/mem_report.py``.
 """
 
 from .registry import (Counter, DEFAULT_BUCKETS, Gauge,  # noqa: F401
@@ -34,6 +43,10 @@ from .spans import (Span, SpanContext, Tracer, derived_span_id,  # noqa: F401
                     get_tracer, load_spans, span)
 from . import floors  # noqa: F401  (roofline floor engine, ISSUE 7)
 from . import profiler  # noqa: F401  (per-layer attribution, ISSUE 7)
+from . import memory  # noqa: F401  (memory census, ISSUE 12)
+from .compiles import CompileSentinel  # noqa: F401  (retrace sentinel)
+from .memory import (device_memory_stats, emit_census,  # noqa: F401
+                     tree_bytes)
 
 _registry = MetricsRegistry(namespace="dl4j")
 
@@ -53,4 +66,6 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_BUCKETS", "get_registry", "Span", "SpanContext",
            "Tracer", "get_tracer", "derived_span_id", "load_spans",
            "span", "FlightRecorder", "RequestTrace", "SLOConfig",
-           "SLOTracker", "live_flight_recorders", "load_flight_records"]
+           "SLOTracker", "live_flight_recorders", "load_flight_records",
+           "CompileSentinel", "device_memory_stats", "emit_census",
+           "tree_bytes"]

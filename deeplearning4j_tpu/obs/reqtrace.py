@@ -313,8 +313,20 @@ class FlightRecorder:
         header = {"kind": "flightrec", "replica": self.replica,
                   "reason": reason, "dumped_at": time.time(),
                   "n_snapshots": len(snaps), "n_requests": len(traces)}
+        # memory plane (ISSUE 12): this replica's latest censuses ride
+        # the dump so mem_report.py renders attribution AND waste from
+        # one file — a crash postmortem answers "whose bytes" offline
+        censuses = []
+        try:
+            from .memory import latest_censuses
+            censuses = [c for c in latest_censuses()
+                        if c.get("replica") == self.replica]
+        except Exception:  # noqa: BLE001 — census is decoration
+            pass
         with open(path, "a") as f:
             f.write(json.dumps(header) + "\n")
+            for c in censuses:
+                f.write(json.dumps(c) + "\n")
             for snap in snaps:
                 f.write(json.dumps(snap) + "\n")
             for tr in traces:
@@ -341,6 +353,6 @@ def load_flight_records(path) -> List[dict]:
         except ValueError:
             continue
         if isinstance(rec, dict) and rec.get("kind") in (
-                "flightrec", "snapshot", "reqtrace"):
+                "flightrec", "snapshot", "reqtrace", "memcensus"):
             out.append(rec)
     return out

@@ -143,14 +143,15 @@ class ParallelWrapper:
             return new_params, new_states, new_opt_state, loss, stats, next_rng
 
         self._step_raw = step    # unjitted: fit_scanned scans over it
-        self._step = jax.jit(
+        from ..obs.compiles import CompileSentinel
+        self._step = CompileSentinel("pw_train_step", jax.jit(
             step, donate_argnums=(0, 1, 2),
             in_shardings=(self._param_sh,
                           jax.tree_util.tree_map(lambda _: self._rep, net.states),
                           None,  # opt state: let the compiler propagate
                           self._batch_sh, self._batch_sh, self._rep,
                           self._batch_sh, self._batch_sh),
-            )
+            ))
         return self._step
 
     def fit(self, iterator, *, epochs: int = 1):
@@ -167,6 +168,21 @@ class ParallelWrapper:
         m_batches = get_registry().counter(
             "dl4j_parallel_fit_batches_total",
             "Batches stepped through ParallelWrapper.fit")
+        # memory census (ISSUE 12), per replica: an fsdp-sharded param
+        # tree reports what EACH device holds — the gauge the ZeRO
+        # update-sharding PR (ROADMAP item 4) reads for its per-chip
+        # memory-drop proof. Once per fit call, off the batch loop.
+        try:
+            from ..obs import memory as obs_memory
+            components = {"params": net.params}
+            if getattr(net, "_opt_state", None) is not None:
+                components["optimizer"] = net._opt_state
+            if getattr(net, "states", None) is not None:
+                components["states"] = net.states
+            obs_memory.emit_census(components, source="parallel_fit",
+                                   per_replica=True)
+        except Exception:  # noqa: BLE001 — census is decoration
+            pass
         last = None
         n = self._batch_div
         anomaly_check = None

@@ -34,7 +34,7 @@ from .adapter import FunctionalInferenceModel  # noqa: F401
 from .engine import (DEFAULT_PREFILL_BUCKETS, GenerationEngine,  # noqa: F401
                      sample_tokens)
 from .kvcache import (cache_len, cache_nbytes, cache_slots,  # noqa: F401
-                      init_cache)
+                      init_cache, token_nbytes)
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         GenerationResult, ServingRequest)
 
@@ -43,4 +43,5 @@ __all__ = [
     "FunctionalInferenceModel", "GenerationEngine", "GenerationResult",
     "SLOConfig", "SLOTracker", "ServingRequest", "cache_len",
     "cache_nbytes", "cache_slots", "init_cache", "sample_tokens",
+    "token_nbytes",
 ]

@@ -122,12 +122,25 @@ class GenerationEngine:
                 f"{cfg.max_seq}: no position rows past the table")
         self.prefill_buckets = tuple(sorted(
             {min(b, self.max_len) for b in prefill_buckets} | {self.max_len}))
-        # jit once; cache (argnum 1 after params) donated on every path
-        self._decode = jax.jit(self._decode_raw, donate_argnums=(1,))
-        self._prefill = jax.jit(self._prefill_raw, donate_argnums=(1,))
-        self._prefill_slot = jax.jit(self._prefill_slot_raw,
-                                     donate_argnums=(1,))
-        self._sample = jax.jit(sample_tokens)
+        # jit once; cache (argnum 1 after params) donated on every path.
+        # Each entry point is wrapped in a CompileSentinel (ISSUE 12):
+        # compiles are counted/timed per abstract signature, and after
+        # mark_warm() any further compile is a warned retrace — the
+        # zero-recompile-after-warmup contract the regression tests pin.
+        # The sentinel is transparent (.lower etc. delegate), so floor
+        # probes keep working on eng._decode unchanged.
+        from ..obs.compiles import CompileSentinel
+        self._decode = CompileSentinel(
+            "decode_step", jax.jit(self._decode_raw, donate_argnums=(1,)))
+        self._prefill = CompileSentinel(
+            "prefill", jax.jit(self._prefill_raw, donate_argnums=(1,)))
+        self._prefill_slot = CompileSentinel(
+            "prefill_slot", jax.jit(self._prefill_slot_raw,
+                                    donate_argnums=(1,)))
+        self._sample = CompileSentinel("sample_tokens",
+                                       jax.jit(sample_tokens))
+        self.sentinels = {s.name: s for s in (
+            self._decode, self._prefill, self._prefill_slot, self._sample)}
 
     # ------------------------------------------------------------ cache
     def init_cache(self, n_slots: int):
@@ -138,6 +151,20 @@ class GenerationEngine:
         are shape-keyed, so no retrace as long as shapes match."""
         self.params = params
         return self
+
+    # -------------------------------------------------- compile plane
+    def mark_warm(self):
+        """Declare warmup over on every sentinel: the decode sweep and
+        the bucketed prefills seen so far are the working set; any
+        compile after this is a warned retrace (ISSUE 12)."""
+        for s in self.sentinels.values():
+            s.mark_warm()
+        return self
+
+    def compile_report(self):
+        """{entry point: {compiles, signatures, retraces_after_warm}} —
+        what the retrace regression tests and ``/debug/memory`` read."""
+        return {name: s.report() for name, s in self.sentinels.items()}
 
     # ----------------------------------------------------- device fns
     def _prefill_trunk(self, params, tokens):

@@ -63,3 +63,14 @@ def cache_nbytes(cache) -> int:
     flagship 120M config a T=1024 slot is L8·T1024·H8·Dh64 · 2 tensors
     · 2 bytes = 16 MiB)."""
     return int(sum(a.size * a.dtype.itemsize for a in cache.values()))
+
+
+def token_nbytes(cache) -> int:
+    """Bytes ONE resident token occupies in one slot: k + v rows across
+    every layer. ``resident tokens × token_nbytes`` vs ``cache_nbytes``
+    is the KV residency accounting (ISSUE 12) — the number that sizes
+    the paged-KV cache PR (ROADMAP item 1): waste is exactly the
+    ``(max_len - resident) × token_nbytes`` a short request pays under
+    fixed slotting."""
+    layers, _, _, heads, head_dim = cache["k"].shape
+    return int(2 * layers * heads * head_dim * cache["k"].dtype.itemsize)

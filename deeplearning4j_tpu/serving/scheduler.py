@@ -52,9 +52,23 @@ bit-identical to ``generate()`` with everything below enabled:
   the multi-host router (ROADMAP item 2) reads per-replica load
   unchanged.
 
+The memory & compile plane (ISSUE 12) rides the same host-side-only
+contract: a construction-time memory census (params + KV under this
+replica's label), per-step KV residency accounting —
+``dl4j_kv_allocated_bytes`` vs ``dl4j_kv_resident_bytes`` and the
+``dl4j_kv_waste_ratio`` that sizes the paged-KV PR, resident counts
+taken from the host-side ``prompt+generated`` mirrors (never a device
+fetch) — a per-request ``dl4j_kv_final_residency_ratio`` histogram at
+completion, and residency fields on every flight-recorder snapshot so
+the black box doubles as the memory timeline (``kv_report()`` /
+``GET /debug/memory`` / ``scripts/mem_report.py``). The engine's
+jitted entry points sit behind compile sentinels; after
+``engine.mark_warm()`` any recompile warns and counts
+(``dl4j_compile_retraces_total``).
+
 The trace bookkeeping self-times (``trace_overhead_seconds``, the
 MetricsListener precedent); tests pin it under 2% of the decode-sweep
-wall clock.
+wall clock — with census, sentinel, and residency accounting all on.
 """
 
 from __future__ import annotations
@@ -143,6 +157,17 @@ class ContinuousBatchingScheduler:
         self.starvation_ms = starvation_ms
         self.replica = str(replica)
         self.cache = engine.init_cache(self.n_slots)
+        # memory plane (ISSUE 12): fixed-slot KV accounting — allocated
+        # bytes are static (slots × max_len), resident bytes follow the
+        # per-slot token counts the scheduler already tracks host-side
+        # (prompt + generated — no device fetch on the hot path)
+        self._kv_allocated = kvcache.cache_nbytes(self.cache)
+        self._kv_token_bytes = kvcache.token_nbytes(self.cache)
+        self._kv_last_resident = 0
+        self._kv_resident_sum = 0.0
+        self._kv_samples = 0
+        self._final_res_sum = 0.0
+        self._final_res_n = 0
         self.slots: List[Optional[ServingRequest]] = [None] * self.n_slots
         self._queue: deque = deque()
         # two locks: `_lock` guards the cheap metadata (queue, slots,
@@ -172,6 +197,21 @@ class ContinuousBatchingScheduler:
         self.trace_spans = trace_spans
         self._steps = 0
         self._trace_overhead = 0.0
+        # publish the pool's memory census once (construction, not hot
+        # path): params + KV attribution under this replica's label,
+        # and the static allocated-bytes gauge. Decoration only — a
+        # census failure (e.g. a user metric squatting on the name with
+        # other labels) must not take down serving.
+        try:
+            from ..obs import memory as obs_memory
+            obs_memory.emit_census(
+                {"params": engine.params, "kv_cache": self.cache},
+                replica=self.replica, source="serving")
+            m = self._m()
+            m["kv_alloc"].set(float(self._kv_allocated),
+                              replica=self.replica)
+        except Exception:  # noqa: BLE001 — census is decoration
+            pass
 
     # ------------------------------------------------------- metrics
     @staticmethod
@@ -228,6 +268,28 @@ class ContinuousBatchingScheduler:
             "latency": reg.histogram(
                 "dl4j_serving_request_latency_seconds",
                 "Time from submit to request completion"),
+            # KV residency accounting (ISSUE 12): allocated vs resident
+            # bytes of the fixed (slots, max_len) cache — the waste the
+            # paged-KV PR (ROADMAP item 1) must recover
+            "kv_alloc": reg.gauge(
+                "dl4j_kv_allocated_bytes",
+                "Static KV-cache allocation: slots x max_len, k+v, all "
+                "layers", labelnames=("replica",)),
+            "kv_res": reg.gauge(
+                "dl4j_kv_resident_bytes",
+                "KV bytes actually holding tokens (active slots' "
+                "prompt+generated counts x per-token bytes)",
+                labelnames=("replica",)),
+            "kv_waste": reg.gauge(
+                "dl4j_kv_waste_ratio",
+                "1 - resident/allocated over the fixed-slot KV cache "
+                "(1.0 = idle pool; the paged-KV sizing number)",
+                labelnames=("replica",)),
+            "kv_final": reg.histogram(
+                "dl4j_kv_final_residency_ratio",
+                "Per-request final residency: (prompt+generated) / "
+                "max_len at completion — how much of its slot a request "
+                "ever used", buckets=tuple(i / 20 for i in range(1, 21))),
         }
 
     # -------------------------------------------------------- submit
@@ -294,15 +356,20 @@ class ContinuousBatchingScheduler:
                                      replica=self.replica)
             if did:
                 t_ov = time.perf_counter()
-                self._record_snapshot()
+                self._record_snapshot(m)
                 self._trace_overhead += time.perf_counter() - t_ov
             else:
                 # idle reset: the occupancy/throughput gauges used to
                 # freeze at their last busy value after the pool
                 # drained — a router reading them would keep routing
-                # around a replica that is actually free
+                # around a replica that is actually free. Residency
+                # drains with it: an idle fixed pool is 100% waste.
                 m["occupancy"].set(0.0, replica=self.replica)
                 m["tokens_per_s"].set(0.0, replica=self.replica)
+                m["kv_res"].set(0.0, replica=self.replica)
+                m["kv_waste"].set(1.0, replica=self.replica)
+                with self._lock:   # writers-hold-_lock invariant
+                    self._kv_last_resident = 0
         return did
 
     def run_until_idle(self, max_steps: int = 100000):
@@ -546,7 +613,18 @@ class ContinuousBatchingScheduler:
         m["completions"].inc(reason=reason)
         m["latency"].observe(now - req.submitted_ts)
         t_ov = time.perf_counter()
-        self._close_trace(req, "finish", m, reason=reason)
+        # per-request final residency (ISSUE 12): how much of its fixed
+        # slot this request EVER used — the histogram that sizes the
+        # paged-KV page count (ROADMAP item 1)
+        resident = min(req.prompt.size + len(req.generated),
+                       self.engine.max_len)
+        ratio = resident / self.engine.max_len
+        m["kv_final"].observe(ratio)
+        self._final_res_sum += ratio
+        self._final_res_n += 1
+        self._close_trace(req, "finish", m, reason=reason,
+                          resident_tokens=int(resident),
+                          residency_ratio=round(ratio, 6))
         self._trace_overhead += time.perf_counter() - t_ov
         try:
             req.future.set_result(GenerationResult(
@@ -577,17 +655,44 @@ class ContinuousBatchingScheduler:
         if self.trace_spans:
             tr.assemble_spans()
 
-    def _record_snapshot(self, **extra):
+    def _record_snapshot(self, m=None, **extra):
         """One flight-recorder snapshot of the scheduler state (called
-        per working step, under ``_step_lock``)."""
+        per working step, under ``_step_lock``). Carries the KV
+        residency accounting (ISSUE 12) so the flight recorder IS the
+        memory timeline: allocated vs resident bytes per step ride the
+        same black box the crash dump and ``mem_report.py`` read.
+        ``m`` is the caller's already-fetched metric map — re-fetching
+        per snapshot would pay ~16 registry lookups per step, the
+        single biggest avoidable cost against the <2% budget."""
         with self._lock:
             slot_ids = [None if r is None else r.id for r in self.slots]
             queued_ids = [r.id for r in self._queue]
+            resident_tokens = sum(
+                min(r.prompt.size + len(r.generated), self.engine.max_len)
+                for r in self.slots if r is not None)
+            # accumulators update under the cheap metadata lock — the
+            # lock kv_report/reset_kv_window also take — so a reader
+            # never sees a sum without its count, and never waits on
+            # device work to see either
+            resident = resident_tokens * self._kv_token_bytes
+            waste = (1.0 - resident / self._kv_allocated) \
+                if self._kv_allocated else 0.0
+            self._kv_last_resident = resident
+            self._kv_resident_sum += resident
+            self._kv_samples += 1
+        if m is None:
+            m = self._m()
+        m["kv_res"].set(float(resident), replica=self.replica)
+        m["kv_waste"].set(waste, replica=self.replica)
         self._steps += 1
         self.flight_recorder.record_snapshot(
             step=self._steps, slots=slot_ids, queue=queued_ids,
             queue_depth=len(queued_ids),
             occupancy=sum(s is not None for s in slot_ids) / self.n_slots,
+            kv_allocated_bytes=self._kv_allocated,
+            kv_resident_bytes=resident,
+            kv_token_bytes=self._kv_token_bytes,
+            kv_waste_ratio=round(waste, 6),
             **extra)
 
     def _debug_extra(self):
@@ -604,6 +709,9 @@ class ContinuousBatchingScheduler:
                 "steps": self._steps,
                 "trace_overhead_seconds": round(self._trace_overhead, 6),
             }
+        state["kv"] = self.kv_report()
+        if hasattr(self.engine, "compile_report"):
+            state["compiles"] = self.engine.compile_report()
         if self.slo is not None:
             state["slo"] = self.slo.report()
         return state
@@ -626,3 +734,58 @@ class ContinuousBatchingScheduler:
 
     def cache_nbytes(self) -> int:
         return kvcache.cache_nbytes(self.cache)
+
+    def reset_kv_window(self):
+        """Restart the KV residency accumulators (running means and
+        final-residency samples). Benches call this after warm-up, next
+        to swapping in a fresh SLOTracker, so the memory evidence and
+        the SLO evidence in one row cover the SAME measured window —
+        warm-up's near-empty pool would otherwise bias the waste ratio
+        upward. Gauges and flight-recorder snapshots are untouched.
+
+        Takes the metadata ``_lock`` — the lock every accumulator
+        writer holds (``_record_snapshot`` updates inside its locked
+        block; ``_finish`` runs inside the admit/sweep locked blocks) —
+        so a reset never lands between a sum and its count, and never
+        waits out a device dispatch."""
+        with self._lock:
+            self._kv_resident_sum = 0.0
+            self._kv_samples = 0
+            self._final_res_sum = 0.0
+            self._final_res_n = 0
+        return self
+
+    def kv_report(self) -> dict:
+        """KV residency accounting (ISSUE 12), plain data: allocated vs
+        resident bytes, running-mean waste ratio over the serve since
+        construction (or the last ``reset_kv_window``), per-token
+        bytes, and mean final residency. This is the block ``bench.py``
+        embeds as a row's ``memory`` evidence and ``GET /debug/memory``
+        aggregates across live schedulers.
+
+        Reads under the metadata ``_lock`` — the writers' lock — so a
+        live report never sees a sum without its count, and a debug
+        endpoint never blocks on an in-flight device sweep (the PR-11
+        discipline: device work runs outside the metadata lock)."""
+        with self._lock:
+            return self._kv_report_locked()
+
+    def _kv_report_locked(self) -> dict:
+        alloc = self._kv_allocated
+        mean_res = (self._kv_resident_sum / self._kv_samples
+                    if self._kv_samples else 0.0)
+        return {
+            "allocated_bytes": alloc,
+            "token_bytes": self._kv_token_bytes,
+            "resident_bytes_last": self._kv_last_resident,
+            "resident_bytes_mean": round(mean_res, 1),
+            "waste_ratio_last": round(1.0 - self._kv_last_resident
+                                      / alloc, 6) if alloc else 0.0,
+            "waste_ratio_mean": round(1.0 - mean_res / alloc, 6)
+            if alloc else 0.0,
+            "snapshots": self._kv_samples,
+            "final_residency_mean": round(
+                self._final_res_sum / self._final_res_n, 6)
+            if self._final_res_n else None,
+            "finished_requests": self._final_res_n,
+        }

@@ -29,6 +29,10 @@ Endpoints:
                         event timelines) from every flight recorder;
                         ?n= caps the count (default 50, newest last),
                         ?replica= filters
+  GET /debug/memory     memory plane (ISSUE 12): latest memory census
+                        per source/replica (component bytes, allocator
+                        view) + live KV residency accounting per
+                        scheduler replica
 """
 
 from __future__ import annotations
@@ -173,6 +177,13 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps({"replicas": [
                 fr.debug_state() for fr in live_flight_recorders()
             ]}).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/debug/memory"):
+            # memory plane (ISSUE 12): latest census per source/replica
+            # (component bytes + allocator view) and the KV residency
+            # accounting of every live scheduler
+            from ..obs import memory as obs_memory
+            body = json.dumps(obs_memory.debug_state()).encode()
             ctype = "application/json"
         elif self.path.startswith("/debug/requests"):
             from ..obs import live_flight_recorders

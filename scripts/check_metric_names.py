@@ -63,8 +63,18 @@ NAMESPACE = "dl4j_"
 # Every label NAME any instrumentation site registers. Extending this
 # is a deliberate act: each new label multiplies time series, and an
 # unbounded one (request id, trace id) melts the registry.
-ALLOWED_LABELS = {"config", "direction", "layer", "level", "reason",
-                  "replica", "stat", "unit"}
+ALLOWED_LABELS = {"component", "config", "direction", "layer", "level",
+                  "reason", "replica", "stat", "unit"}
+# per-prefix restriction (ISSUE 12): the memory/compile plane may label
+# ONLY by component and replica — component names are a small fixed
+# vocabulary (obs.memory.KNOWN_COMPONENTS / sentinel names), never
+# per-request identity. A dl4j_mem_* gauge with a `reason` label is a
+# design smell this catches before it ships.
+PLANE_LABELS = {
+    "dl4j_mem_": {"component", "replica"},
+    "dl4j_kv_": {"component", "replica"},
+    "dl4j_compile_": {"component", "replica"},
+}
 # label names that smell like per-request/per-trace identity — never
 # allowed even if someone adds them to the allowlist above by mistake
 _ID_LABEL = re.compile(
@@ -151,6 +161,13 @@ def check(files=None) -> List[str]:
                         f"allowlist {sorted(ALLOWED_LABELS)} — extend "
                         "ALLOWED_LABELS deliberately if this is a real "
                         "low-cardinality label")
+                else:
+                    for prefix, allowed in PLANE_LABELS.items():
+                        if name.startswith(prefix) and lab not in allowed:
+                            errors.append(
+                                f"{where}: label {lab!r} on {name!r} — "
+                                f"the {prefix}* plane restricts labels "
+                                f"to {sorted(allowed)}")
         # label VALUES: an id smuggled into .inc/.set/.observe kwargs
         for m in _OBS_CALL.finditer(text):
             args = _call_text(text, text.find("(", m.start()))

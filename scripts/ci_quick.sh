@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
-# Quick gate (ISSUE 7 + 8 + 10 + 11): metric-name/label + doc lint,
-# then the telemetry-plane, roofline-floor, elastic-scaleout,
-# serving-plane, and SLO-plane fast suites. One command, <3 min on CPU;
-# run before touching instrumentation, bench schema, docs examples, the
-# scaleout plane, the serving engine/scheduler, or the SLO/flight-
-# recorder plane.
+# Quick gate (ISSUE 7 + 8 + 10 + 11 + 12): metric-name/label + doc
+# lint, then the telemetry-plane, roofline-floor, elastic-scaleout,
+# serving-plane, SLO-plane, and memory/compile-plane fast suites. One
+# command, <3 min on CPU; run before touching instrumentation, bench
+# schema, docs examples, the scaleout plane, the serving
+# engine/scheduler, the SLO/flight-recorder plane, or the memory
+# census / retrace sentinel.
 #
 #   bash scripts/ci_quick.sh
 #
@@ -16,9 +17,10 @@ cd "$(dirname "$0")/.."
 echo "== metric-name + doc lint =="
 python scripts/check_metric_names.py
 
-echo "== obs + floors + scaleout-fast + serving + slo suites =="
+echo "== obs + floors + scaleout-fast + serving + slo + memplane suites =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
     tests/test_scaleout_fast.py tests/test_serving.py tests/test_slo.py \
+    tests/test_memplane.py \
     -q -m 'not slow' -p no:cacheprovider -p no:randomly
 
 echo "ci_quick: all green"

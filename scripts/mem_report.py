@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Render a memory attribution / KV-waste table from a serving
+flight-recorder JSONL (ISSUE 12 tooling — the offline half of
+``GET /debug/memory``).
+
+A flight-recorder dump now carries three memory-plane record kinds:
+
+- ``memcensus`` — component attribution at dump time (params /
+  kv_cache / ... bytes, plus the allocator view where the backend had
+  ``memory_stats``);
+- ``snapshot`` — the per-step KV residency timeline
+  (``kv_allocated_bytes`` / ``kv_resident_bytes`` / ``kv_waste_ratio``
+  beside the slot map every step already recorded);
+- ``reqtrace`` — per-request timelines whose ``finish`` event carries
+  ``residency_ratio`` (how much of its fixed slot the request ever
+  used).
+
+This script aggregates all three into a per-replica table: attribution,
+mean/max resident bytes, mean waste ratio, bytes-per-resident-token,
+and the final-residency distribution — the numbers that size the
+paged-KV PR (ROADMAP item 1) and prove the ZeRO memory drop (item 4).
+Torn trailing lines are tolerated (``load_spans`` discipline).
+
+    python scripts/mem_report.py runs/serving_blackbox.jsonl
+    python scripts/mem_report.py dump.jsonl --max-waste 0.9 --json
+
+Exit code: 0, or 1 when ``--max-waste`` is given and any replica's mean
+KV waste ratio exceeds it — a post-run gate, like slo_report's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from deeplearning4j_tpu.obs import load_flight_records  # noqa: E402
+from deeplearning4j_tpu.obs.memory import format_bytes as _fmt_bytes  # noqa: E402
+
+
+def _fmt_pct(v) -> str:
+    return "-" if v is None else f"{100 * float(v):.1f}%"
+
+
+def build_report(records) -> dict:
+    """Replica -> aggregated memory evidence from one dump's records."""
+    out: dict = {}
+
+    def rep(replica):
+        return out.setdefault(str(replica), {
+            "census": None, "snapshots": 0, "kv_allocated_bytes": None,
+            "kv_token_bytes": None, "resident_sum": 0.0,
+            "resident_max": 0, "waste_sum": 0.0,
+            "final_residency": [], "requests": 0})
+
+    def _better_census(old, new):
+        """A serving census (it carries kv_cache) beats a training one
+        for a serving postmortem; within one source, newest ts wins —
+        dump file order is alphabetical by (source, replica), not
+        chronological, so it must not decide."""
+        if old is None:
+            return new
+        old_s = old.get("source") == "serving"
+        new_s = new.get("source") == "serving"
+        if old_s != new_s:
+            return new if new_s else old
+        return new if new.get("ts", 0) >= old.get("ts", 0) else old
+
+    for r in records:
+        kind = r.get("kind")
+        if kind == "memcensus":
+            d = rep(r.get("replica", "0"))
+            d["census"] = _better_census(d["census"], r)
+        elif kind == "snapshot" and "kv_resident_bytes" in r:
+            d = rep(r.get("replica", "0"))
+            d["snapshots"] += 1
+            d["kv_allocated_bytes"] = r.get("kv_allocated_bytes")
+            d["kv_token_bytes"] = r.get("kv_token_bytes")
+            res = float(r.get("kv_resident_bytes", 0))
+            d["resident_sum"] += res
+            d["resident_max"] = max(d["resident_max"], res)
+            d["waste_sum"] += float(r.get("kv_waste_ratio", 0.0))
+        elif kind == "reqtrace":
+            d = rep(r.get("replica", "0"))
+            d["requests"] += 1
+            for name, _, attrs in reversed(r.get("events") or []):
+                if name == "finish" and "residency_ratio" in attrs:
+                    d["final_residency"].append(
+                        float(attrs["residency_ratio"]))
+                    break
+    for d in out.values():
+        # pop the accumulators unconditionally: a zero-snapshot replica
+        # (pre-memory-plane dump) must not leak them into the report
+        n = d.pop("snapshots")
+        resident_sum = d.pop("resident_sum")
+        resident_max = d.pop("resident_max")
+        waste_sum = d.pop("waste_sum")
+        d["n_snapshots"] = n
+        d["resident_bytes_mean"] = resident_sum / n if n else None
+        d["resident_bytes_max"] = resident_max if n else None
+        d["waste_ratio_mean"] = waste_sum / n if n else None
+        fr = d.pop("final_residency")
+        d["final_residency_mean"] = sum(fr) / len(fr) if fr else None
+        d["final_residency_n"] = len(fr)
+        census = d["census"]
+        total = None
+        if census:
+            # total footprint: the allocator's peak where the backend
+            # had one, else the census pytree total
+            peak = (census.get("device") or {}).get("peak_bytes_in_use")
+            total = peak or census.get("component_bytes", {}).get("total")
+        d["total_bytes"] = total
+        # bytes the pool pays per mean resident token — the efficiency
+        # number paged KV / quantized caches must push down
+        d["bytes_per_resident_token"] = None
+        if total and d["resident_bytes_mean"] and d["kv_token_bytes"]:
+            tokens = d["resident_bytes_mean"] / d["kv_token_bytes"]
+            if tokens:
+                d["bytes_per_resident_token"] = round(total / tokens, 1)
+    return out
+
+
+def render(report) -> str:
+    lines = []
+    for replica, d in sorted(report.items()):
+        lines.append(f"replica {replica}:")
+        census = d.get("census")
+        if census:
+            lines.append(f"  attribution (census, "
+                         f"source={census.get('source')}, "
+                         f"device={census.get('device_source')}):")
+            for comp, nbytes in sorted(
+                    census.get("component_bytes", {}).items()):
+                lines.append(f"    {comp:<12} {_fmt_bytes(nbytes):>12}")
+            dev = census.get("device")
+            if dev:
+                lines.append(
+                    f"    device: in_use={_fmt_bytes(dev.get('bytes_in_use'))} "
+                    f"peak={_fmt_bytes(dev.get('peak_bytes_in_use'))} "
+                    f"limit={_fmt_bytes(dev.get('bytes_limit'))}")
+        else:
+            lines.append("  (no census record in dump)")
+        if d.get("n_snapshots"):
+            lines.append(
+                f"  KV residency over {d['n_snapshots']} snapshots: "
+                f"allocated {_fmt_bytes(d['kv_allocated_bytes'])}, "
+                f"resident mean {_fmt_bytes(d['resident_bytes_mean'])} "
+                f"/ max {_fmt_bytes(d['resident_bytes_max'])}, "
+                f"waste mean {_fmt_pct(d['waste_ratio_mean'])}")
+            if d.get("bytes_per_resident_token"):
+                lines.append(
+                    f"  bytes per resident token: "
+                    f"{_fmt_bytes(d['bytes_per_resident_token'])} "
+                    f"(total {_fmt_bytes(d['total_bytes'])} over mean "
+                    "residency)")
+        else:
+            lines.append("  (no KV residency snapshots in dump)")
+        if d.get("final_residency_n"):
+            lines.append(
+                f"  requests: {d['requests']} traced, "
+                f"{d['final_residency_n']} finished — final residency "
+                f"mean {_fmt_pct(d['final_residency_mean'])} of max_len")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="memory attribution / KV-waste table from a "
+                    "flight-recorder JSONL")
+    ap.add_argument("dump", help="flight-recorder JSONL path")
+    ap.add_argument("--max-waste", type=float, default=None,
+                    help="gate: exit 1 if any replica's mean KV waste "
+                         "ratio exceeds this")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report dict as JSON")
+    args = ap.parse_args(argv)
+
+    records = load_flight_records(args.dump)
+    if not records:
+        print(f"mem_report: no flight-recorder records in {args.dump}",
+              file=sys.stderr)
+        return 1
+    report = build_report(records)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report))
+    if args.max_waste is not None:
+        for replica, d in report.items():
+            w = d.get("waste_ratio_mean")
+            if w is not None and w > args.max_waste:
+                print(f"mem_report: replica {replica} mean KV waste "
+                      f"{w:.3f} > gate {args.max_waste}",
+                      file=sys.stderr)
+                return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,11 +10,22 @@ capture re-derives it. Run after a bench capture:
     python scripts/refresh_readme_table.py
 """
 
+import importlib.util
 import json
 import pathlib
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# one byte formatter shared with scripts/mem_report.py, loaded by FILE
+# path: obs/memory.py is standalone-importable by design, so this script
+# stays runnable without jax (the full package import would pull it in)
+_spec = importlib.util.spec_from_file_location(
+    "_dl4j_obs_memory_standalone",
+    REPO / "deeplearning4j_tpu" / "obs" / "memory.py")
+_mem = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mem)
+_fmt_bytes = _mem.format_bytes
 BEGIN = "<!-- BENCH-TABLE BEGIN (scripts/refresh_readme_table.py) -->"
 END = "<!-- BENCH-TABLE END -->"
 
@@ -76,11 +87,36 @@ INFERENCE_LABELS = {
 }
 
 
+def mem_cell(rec):
+    """The serving memory column (ISSUE 12): KV waste from a real
+    mixed-length serve + bytes per resident token, or peak bytes for
+    rows without a KV cache. A record with no `memory` block predates
+    the memory plane — em-dash, the floor-column precedent."""
+    m = rec.get("memory") if isinstance(rec, dict) else None
+    if not isinstance(m, dict) or "na" in m:
+        return "—"
+    parts = []
+    if m.get("kv_waste_ratio") is not None:
+        parts.append(f"KV waste {100 * m['kv_waste_ratio']:.0f}%")
+    if m.get("bytes_per_resident_token") is not None:
+        parts.append(f"{_fmt_bytes(m['bytes_per_resident_token'])}/tok")
+    if not parts and m.get("peak_bytes") is not None:
+        # only an allocator-backed number is a measured PEAK; the
+        # pytree fallback is a static lower bound (params only — no
+        # activations/workspace) and must say so
+        if m.get("source") == "memory_stats":
+            parts.append(f"peak {_fmt_bytes(m['peak_bytes'])}")
+        else:
+            parts.append(f"≥{_fmt_bytes(m['peak_bytes'])} (pytree)")
+    return "; ".join(parts) or "—"
+
+
 def inference_row(name, rec):
     """One serving-plane table row: value + the row's own detail column
     (best-batch throughput for the latency rows, p99 where measured),
-    and an explicit capture flag — a CPU-derived value must SAY so in
-    the README, the same contract the floor tables follow."""
+    the memory column (ISSUE 12), and an explicit capture flag — a
+    CPU-derived value must SAY so in the README, the same contract the
+    floor tables follow."""
     if not isinstance(rec, dict) or rec.get("value") is None:
         return None
     label = INFERENCE_LABELS.get(name, name)
@@ -99,7 +135,8 @@ def inference_row(name, rec):
         details.append(f"{rec['slots']} decode slots")
     captured = ("on-chip" if rec.get("backend") == "tpu"
                 else "⏳ CPU-derived, on-chip TODO")
-    return f"| {label} | {val} | {'; '.join(details) or '—'} | {captured} |"
+    return (f"| {label} | {val} | {'; '.join(details) or '—'} "
+            f"| {mem_cell(rec)} | {captured} |")
 
 
 def inference_lines(inf):
@@ -112,10 +149,14 @@ def inference_lines(inf):
     return ["",
             "**Serving / inference** (`inference` section of the same "
             "artifact; rows marked ⏳ await their on-chip capture — "
-            "`bench.py --refresh inference_decode,...`):",
+            "`bench.py --refresh inference_decode,...`). CPU-derived "
+            "values drift with host performance between sessions "
+            "(sandbox CPU is not a stable reference) — compare them "
+            "only against their own floor/memory evidence, not across "
+            "captures:",
             "",
-            "| config | value | detail | captured |",
-            "|---|---|---|---|"] + rows
+            "| config | value | detail | memory | captured |",
+            "|---|---|---|---|---|"] + rows
 
 
 def main():

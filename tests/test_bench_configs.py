@@ -243,9 +243,10 @@ def test_bench_refresh_rows_isolated(tmp_path, monkeypatch, capsys):
 
 
 def test_bench_slo_serve_block_tiny_engine():
-    """The `slo` block every inference row now embeds (ISSUE 11): a
-    real scheduler serve at CI scale yields goodput / ITL p99 / TTFT
-    p99 with the targets riding along."""
+    """The `slo` + `memory` blocks every inference row now embeds
+    (ISSUE 11 + 12): ONE real mixed-length scheduler serve at CI scale
+    yields goodput / ITL p99 / TTFT p99 with the targets riding along,
+    beside the KV-waste attribution that sizes the paged-KV PR."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.serving import GenerationEngine
@@ -256,14 +257,21 @@ def test_bench_slo_serve_block_tiny_engine():
                                 dtype=jnp.float32, attn_scores_bf16=False)
     eng = GenerationEngine(cfg, tfm.init_params(jax.random.PRNGKey(0),
                                                 cfg))
-    block = bench._slo_serve_block(eng, slots=2, n_requests=4,
-                                   new_tokens=4, prompt_len=6)
+    block, mem = bench._serve_blocks(eng, slots=2, n_requests=4,
+                                     new_tokens=4, prompt_len=6)
     assert 0.0 <= block["goodput"] <= 1.0
     assert block["itl_p99_ms"] > 0 and block["ttft_p99_ms"] > 0
     assert block["requests"] == 4
-    assert block["itl_samples"] == 4 * 3
+    # mixed budgets: request i generates new_tokens + (i % 3) tokens,
+    # each contributing (tokens - 1) inter-token gaps
+    assert block["itl_samples"] == sum(4 + (i % 3) - 1 for i in range(4))
     assert block["targets"]["quantile"] == 0.99
     assert isinstance(block["met"], bool)
+    assert mem["params_bytes"] > 0 and mem["kv_allocated_bytes"] > 0
+    assert 0.0 < mem["kv_waste_ratio"] < 1.0
+    assert mem["bytes_per_resident_token"] > 0
+    assert mem["retraces_after_warm"] == 0
+    assert mem["source"] in ("memory_stats", "pytree")
     # the offline TTFT-row derivation shares _slo_compact
     from deeplearning4j_tpu.obs import SLOConfig, SLOTracker
     tr = SLOTracker(SLOConfig(), registry=False)
