@@ -963,6 +963,73 @@ def _mem_basic(params_tree, **kv_fields):
                       f"{type(e).__name__}: {e}"[:300]}
 
 
+def _fid_compact(report):
+    """The compact per-pair fidelity evidence a bench row embeds."""
+    r = lambda v, n=8: round(float(v), n)  # noqa: E731
+    return {"max_abs_err": r(report["max_abs_err"]),
+            "mean_abs_err": r(report["mean_abs_err"]),
+            "kl_mean": r(report["kl_mean"], 9),
+            "kl_max": r(report["kl_max"], 9),
+            "topk_agreement": r(report["topk_agreement"], 4),
+            "greedy_match_frac": r(report["greedy_match_frac"], 4),
+            "greedy_prefix_len": report["greedy_prefix_len"]}
+
+
+def _fidelity_block(eng, probe_tokens=128):
+    """Fidelity evidence beside the floor/slo/memory blocks (ISSUE 13):
+    the row's engine forward run over the SAME probe prompt through
+    three attention/dtype paths, compared by ``obs.fidelity``:
+
+    - ``flash_vs_xla``: pallas flash kernel (interpret mode off-TPU —
+      the same numerics CI covers) vs the row's XLA attention path,
+      same compute dtype;
+    - ``bf16_vs_fp32``: the row's deployed path (bf16 activations +
+      bf16-scores gating as configured) vs an exact-f32 reference.
+
+    These are the measured logit-error baselines the quantized-KV and
+    spec-decode rows (ROADMAP 3) will be judged against — a candidate
+    that beats the floor but drifts past today's flash/bf16 envelope
+    is a different model, not a faster one. Never fatal."""
+    import dataclasses
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.obs.fidelity import FidelityProbe
+    from deeplearning4j_tpu.zoo import transformer as tfm
+
+    cfg = eng.cfg
+    t = int(min(probe_tokens, cfg.max_seq))
+    ids = jnp.asarray(np.random.default_rng(7).integers(
+        0, cfg.vocab_size, (1, t)), jnp.int32)
+
+    def logits(**over):
+        c = dataclasses.replace(cfg, **over) if over else cfg
+        return np.asarray(tfm.forward(eng.params, c, ids)[0], np.float32)
+
+    # the row's deployed XLA path at its own dtype/score gating — also
+    # the bf16 candidate (flash's auto-gate never engages at the probe
+    # length, so this IS what the row serves off-flash)
+    xla = logits(use_flash_attention=False)
+    flash = logits(use_flash_attention=True)
+    f32 = logits(use_flash_attention=False, dtype=jnp.float32,
+                 attn_scores_bf16=False)
+    return {
+        "probe_tokens": t,
+        "flash_vs_xla": _fid_compact(
+            FidelityProbe("flash_vs_xla").compare(xla, flash)),
+        "bf16_vs_fp32": _fid_compact(
+            FidelityProbe("bf16_vs_fp32").compare(f32, xla)),
+    }
+
+
+def _attach_fidelity(rec, eng):
+    try:
+        rec["fidelity"] = _fidelity_block(eng)
+    except Exception as e:  # noqa: BLE001 — the row survives block-less
+        rec["fidelity"] = {"na": f"fidelity probe failed: "
+                                 f"{type(e).__name__}: {e}"[:300]}
+    return rec
+
+
 def _serve_blocks(eng, slots, n_requests=None, new_tokens=8,
                   prompt_len=64):
     """(slo, memory) evidence from ONE real continuous-batching serve
@@ -1062,6 +1129,10 @@ def bench_inference_decode(batch, steps):
         rec["slo"] = {"na": f"slo serve failed: "
                             f"{type(e).__name__}: {e}"[:300]}
         rec["memory"] = {"na": "see slo"}
+    # fidelity evidence (ISSUE 13): flash-vs-XLA + bf16-vs-fp32 logit
+    # error over the row's own engine — the measured numerics envelope
+    # the quantized-KV / spec-decode rows must stay inside
+    _attach_fidelity(rec, eng)
     return _flag_on_chip(rec)
 
 
@@ -1135,6 +1206,8 @@ def _ttft_row(seq, reps):
     except Exception as e:  # noqa: BLE001 — the row survives block-less
         rec["memory"] = {"na": f"memory block failed: "
                                f"{type(e).__name__}: {e}"[:300]}
+    # fidelity evidence (ISSUE 13) beside the slo/memory blocks
+    _attach_fidelity(rec, eng)
     return _flag_on_chip(_stamp(rec))
 
 

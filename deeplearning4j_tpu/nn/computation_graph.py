@@ -512,6 +512,9 @@ class ComputationGraph:
             optimizer = self._optimizer
 
             with_stats = getattr(self, "_anomaly_detector", None) is not None
+            # numerics sentinel (ISSUE 13) — see MLN._get_train_step
+            gate = with_stats and getattr(self._anomaly_detector,
+                                          "gate_updates", True)
 
             def step(params, states, opt_state, inputs, labels, rng, fmask, lmask):
                 # split inside jit; next key rides the outputs (no separate
@@ -525,10 +528,11 @@ class ComputationGraph:
                     optax.apply_updates(params, updates))
                 stats = None
                 if with_stats:
-                    from ..train.anomaly import stats_and_gate
-                    stats, new_params, new_opt_state, new_states = stats_and_gate(
-                        grads, params, new_params, opt_state, new_opt_state,
-                        states, new_states)
+                    from ..train.anomaly import maybe_stats_and_gate
+                    stats, new_params, new_opt_state, new_states = \
+                        maybe_stats_and_gate(
+                            gate, grads, params, new_params, opt_state,
+                            new_opt_state, states, new_states)
                 return new_params, new_states, new_opt_state, loss, stats, next_rng
 
             # compile sentinel (ISSUE 12) — see MLN._get_train_step

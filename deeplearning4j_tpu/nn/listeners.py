@@ -281,6 +281,107 @@ class MetricsListener(TrainingListener):
         self._last_t = None  # epoch boundary work is not a step interval
 
 
+class NumericsListener(TrainingListener):
+    """Numerics-plane listener (ISSUE 13): every iteration feeds the
+    loss to the attached :class:`~..obs.numerics.NumericsSentinel`
+    (non-finite-loss trip + rolling z-score spike detector), and every
+    ``frequency`` iterations samples the model's params (and the
+    step's in-jit grad stats, when the sentinel is wired into the
+    train step) through the jitted one-pass stat engine into
+    ``dl4j_num_*{layer, kind}`` gauges.
+
+    Budgeted like MetricsListener: the per-iteration body is a float
+    check + a deque append (~µs, self-timed via the sentinel +
+    ``overhead_seconds``); the stat sampling pays one fused reduction
+    pass + one small host fetch per ``frequency`` steps.
+
+    ``attach(net)`` is the one-call setup: adds this listener AND
+    installs the sentinel as the net's gradient-anomaly detector, so
+    grad stats are computed inside the jitted step and the
+    ``skip_step`` / ``raise`` policies gate the update in-jit
+    (bit-identical no-op on a poisoned batch).
+
+    NOT deferred_score_ok: the sentinel's stat-tree dump reads live
+    params, so the (step, score, params) triple must stay synchronous
+    — a deferred score would snapshot the step AFTER the offender.
+    """
+
+    def __init__(self, sentinel=None, frequency: int = 25,
+                 registry=None, source: str = "train",
+                 replica: str = "0", sample_params: bool = True):
+        from ..obs.numerics import NumericsSentinel
+        self.sentinel = sentinel if sentinel is not None \
+            else NumericsSentinel()
+        self.frequency = max(1, int(frequency))
+        self.registry = registry
+        self.source = str(source)
+        self.replica = str(replica)
+        self.sample_params = bool(sample_params)
+        self._overhead = 0.0
+
+    @property
+    def overhead_seconds(self) -> float:
+        """Listener + sentinel bookkeeping cost (the <2%-of-step
+        budget tests/test_numerics.py pins)."""
+        return self._overhead + self.sentinel.overhead_seconds
+
+    def attach(self, net) -> "NumericsListener":
+        """Wire the whole plane onto ``net``: listener + in-step grad
+        stats/gating via the sentinel. The net has ONE anomaly-detector
+        slot — replacing a configured detector drops its explosion/
+        vanishing thresholds (the sentinel only watches finiteness), so
+        that replacement is warned, never silent."""
+        existing = getattr(net, "_anomaly_detector", None)
+        if existing is not None and existing is not self.sentinel:
+            import warnings
+            warnings.warn(
+                f"NumericsListener.attach replaces the net's existing "
+                f"{type(existing).__name__} gradient-anomaly detector "
+                "with the numerics sentinel — explosion/vanishing "
+                "detection stops; keep the old detector by wiring the "
+                "listener alone (net.add_listeners) and leaving "
+                "enable_gradient_anomaly_detection as it was",
+                RuntimeWarning, stacklevel=2)
+        net.add_listeners(self)
+        net.enable_gradient_anomaly_detection(self.sentinel)
+        return self
+
+    def iteration_done(self, model, iteration, epoch, score):
+        import time as _time
+        self.sentinel.observe_loss(model, iteration, score)  # self-times
+        t0 = _time.perf_counter()
+        sample = iteration % self.frequency == 0
+        if sample:
+            from ..obs import numerics as obs_numerics
+            import math as _math
+            if _math.isfinite(float(score)):
+                try:
+                    obs_numerics.record_stats(
+                        {"loss": {"mean": float(score),
+                                  "nonfinite": 0.0}},
+                        "loss", source=self.source,
+                        replica=self.replica, registry=self.registry)
+                except Exception:  # noqa: BLE001 — stats are decoration
+                    pass
+            if self.sample_params and \
+                    getattr(model, "params", None):
+                try:
+                    obs_numerics.emit_stats(
+                        model.params, "params", source=self.source,
+                        replica=self.replica, registry=self.registry)
+                except Exception:  # noqa: BLE001 — stats are decoration
+                    pass
+            gs = self.sentinel.last_grad_stats
+            if gs:
+                try:
+                    obs_numerics.record_stats(
+                        gs, "grads", source=self.source,
+                        replica=self.replica, registry=self.registry)
+                except Exception:  # noqa: BLE001 — stats are decoration
+                    pass
+        self._overhead += _time.perf_counter() - t0
+
+
 class ProfilingListener(TrainingListener):
     """Per-layer time attribution (ISSUE 7): every ``frequency``
     iterations, run one ``obs.profiler`` attribution pass over

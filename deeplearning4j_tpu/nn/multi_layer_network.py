@@ -356,6 +356,12 @@ class MultiLayerNetwork:
         if self._train_step is None:
             optimizer = self._optimizer
             with_stats = getattr(self, "_anomaly_detector", None) is not None
+            # numerics sentinel (ISSUE 13): a detector with
+            # gate_updates=False (policy "warn") observes grad stats
+            # WITHOUT the in-jit finiteness gate — the poisoned update
+            # is applied, which is exactly what "warn" promises
+            gate = with_stats and getattr(self._anomaly_detector,
+                                          "gate_updates", True)
 
             def step(params, states, opt_state, x, y, rng, fmask, lmask):
                 # the per-step key split happens INSIDE the jitted step and
@@ -374,10 +380,11 @@ class MultiLayerNetwork:
                     # A non-finite batch becomes a whole-step no-op (params,
                     # opt state, BN running stats) so the detector can raise
                     # without the run already being poisoned.
-                    from ..train.anomaly import stats_and_gate
-                    stats, new_params, new_opt_state, new_states = stats_and_gate(
-                        grads, params, new_params, opt_state, new_opt_state,
-                        states, new_states)
+                    from ..train.anomaly import maybe_stats_and_gate
+                    stats, new_params, new_opt_state, new_states = \
+                        maybe_stats_and_gate(
+                            gate, grads, params, new_params, opt_state,
+                            new_opt_state, states, new_states)
                 return new_params, new_states, new_opt_state, loss, stats, next_rng
 
             # compile sentinel (ISSUE 12): counts/times every compile of

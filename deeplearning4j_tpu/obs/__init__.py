@@ -35,6 +35,16 @@ Instrumented surfaces (all under the ``dl4j_`` namespace —
   point (``dl4j_compile_*``, post-warmup retraces warned). Forensics:
   ``GET /debug/memory``, census + residency records in flight-recorder
   dumps, ``scripts/mem_report.py``.
+- ``obs.numerics`` / ``obs.fidelity`` — the numerics & fidelity plane
+  (ISSUE 13): jitted one-pass tensor-stat engine
+  (``dl4j_num_*{layer, kind}``), the :class:`NumericsSentinel`
+  (warn/raise/skip-step on non-finite loss or grads + z-score
+  loss-spike auto-dump through the flight recorder), cross-replica
+  :class:`DriftAuditor` (``dl4j_replica_*`` — the ZeRO lockstep
+  proof), and :class:`FidelityProbe` candidate-vs-reference logit
+  comparisons (``dl4j_fidelity_*{kind}``, the spec-decode /
+  quantized-KV acceptance oracle). Forensics: ``GET /debug/numerics``,
+  ``scripts/fidelity_report.py``.
 """
 
 from .registry import (Counter, DEFAULT_BUCKETS, Gauge,  # noqa: F401
@@ -61,6 +71,14 @@ def get_registry() -> MetricsRegistry:
 from .reqtrace import (FlightRecorder, RequestTrace,  # noqa: E402,F401
                        live_flight_recorders, load_flight_records)
 from .slo import SLOConfig, SLOTracker  # noqa: E402,F401
+from . import numerics  # noqa: E402,F401  (numerics plane, ISSUE 13)
+from . import fidelity  # noqa: E402,F401  (fidelity probes, ISSUE 13)
+from .numerics import (DriftAuditor, NumericsSentinel,  # noqa: E402,F401
+                       audit_params, drift_report, emit_stats,
+                       summarize)
+from .fidelity import (FidelityProbe, MeasuredBound,  # noqa: E402,F401
+                       assert_trees_close, compare_logits,
+                       compare_trees)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_BUCKETS", "get_registry", "Span", "SpanContext",
@@ -68,4 +86,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "span", "FlightRecorder", "RequestTrace", "SLOConfig",
            "SLOTracker", "live_flight_recorders", "load_flight_records",
            "CompileSentinel", "device_memory_stats", "emit_census",
-           "tree_bytes"]
+           "tree_bytes", "NumericsSentinel", "DriftAuditor",
+           "FidelityProbe", "MeasuredBound", "assert_trees_close",
+           "compare_logits", "compare_trees", "audit_params",
+           "drift_report", "emit_stats", "summarize"]

@@ -1,0 +1,783 @@
+"""Numerics plane — tensor-health sentinels + cross-replica drift audit
+(ISSUE 13).
+
+ROADMAP items 3 (int8/fp8 KV, int8 weights, speculative decoding) and 4
+(ZeRO update sharding) are numerics plays, and nothing measured tensor
+health before this module: a NaN'd batch surfaced only as a poisoned
+run, a loss spike only as a worse convergence plot, and "the dp
+replicas hold the same params" was an article of faith. Three pieces
+make those first-class observables:
+
+- :func:`summarize` — a jitted streaming tensor-stat engine: per-leaf
+  mean / rms / absmax / zero-fraction / nonfinite-count for a whole
+  pytree in ONE fused reduction pass over each leaf (XLA fuses the five
+  reductions into a single read of the tensor), returning a DEVICE stat
+  tree — no host round-trip until :func:`export_summary` fetches the
+  tiny stat vectors in one ``device_get``. :func:`emit_stats` publishes
+  a summary as ``dl4j_num_*{layer, kind}`` gauges (kind ∈ params /
+  grads / loss) and remembers the latest per (source, replica) for
+  ``GET /debug/numerics``.
+- :class:`NumericsSentinel` — a configurable policy (``warn`` /
+  ``raise`` / ``skip_step``) on non-finite loss or grads, plus a
+  rolling z-score loss-spike detector. It plugs into the SAME
+  ``_anomaly_detector`` slot the train steps already wire
+  (``net.enable_gradient_anomaly_detection(sentinel)``): grad stats are
+  computed inside the jitted step, and for ``raise`` / ``skip_step``
+  the in-jit :func:`~..train.anomaly.gate_on_finite` makes the poisoned
+  step a bit-identical no-op BEFORE the host ever sees it. Every trip
+  auto-dumps the offending step's full stat tree through the PR 11
+  flight-recorder machinery (``kind: "numerics"`` records in the same
+  JSONL black box), so a NaN postmortem starts from data, not a rerun.
+- :class:`DriftAuditor` — param checksums per replica per round.
+  ``ParallelWrapper.fit`` audits its device replicas at the end of
+  every fit call (:func:`audit_params` — per-device crc + f64 sum over
+  each REPLICATED leaf's addressable shards); the scaleout round
+  barrier records the mean each end of the wire saw (hub at round
+  close, every worker after applying it). Replicas that report the
+  same (source, round) are compared: ``dl4j_replica_checksum{replica}``
+  / ``dl4j_replica_drift_max`` gauges, divergence warned and counted
+  (``dl4j_replica_drift_detected_total``). Zero drift here is the
+  lockstep proof the ZeRO update-sharding equivalence case will cite.
+
+Label discipline (``scripts/check_metric_names.py`` enforces): the
+``dl4j_num_*`` plane labels by ``layer`` / ``kind`` / ``replica`` ONLY,
+``dl4j_replica_*`` by ``replica`` only — never per-request identity.
+
+No jax import at module load (the memory.py discipline): the sentinel
+report and drift tables must be readable from the UI process without
+paying the jax import chain; everything device-touching imports jax
+inside the function.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import warnings
+import weakref
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .reqtrace import FlightRecorder
+
+# the per-leaf stat vector summarize() produces, in order
+STAT_FIELDS = ("mean", "rms", "absmax", "zero_frac", "nonfinite")
+
+# stat trees the listener/sentinel publish under these kinds only — a
+# stable label vocabulary, like memory.KNOWN_COMPONENTS
+KNOWN_KINDS = ("params", "grads", "loss", "optimizer", "states",
+               "activations")
+
+
+# ------------------------------------------------------------ summarize
+
+_SUMMARIZE_JIT = None
+
+
+def _leaf_stats(x):
+    """One fused pass over one leaf → (5,) f32 stat vector.
+
+    mean/rms treat non-finite elements as 0 (so the summary itself
+    stays finite and readable while the nonfinite count tells the
+    story); zero_frac counts exact zeros among FINITE elements."""
+    import jax.numpy as jnp
+    xf = jnp.asarray(x).astype(jnp.float32).reshape(-1)
+    n = max(int(xf.size), 1)
+    finite = jnp.isfinite(xf)
+    xz = jnp.where(finite, xf, 0.0)
+    mean = jnp.sum(xz) / n
+    rms = jnp.sqrt(jnp.sum(xz * xz) / n)
+    absmax = jnp.max(jnp.abs(xz)) if xf.size else jnp.float32(0.0)
+    zero = jnp.sum(finite & (xf == 0.0)) / n
+    nonf = jnp.sum(~finite)
+    return jnp.stack([mean, rms, absmax, zero,
+                      nonf.astype(jnp.float32)])
+
+
+def summarize(tree):
+    """Device-side stat tree: every array leaf of ``tree`` mapped to its
+    (5,) stat vector (see :data:`STAT_FIELDS`) in one jitted dispatch —
+    no host round-trip happens here. ``None`` leaves are dropped.
+    Scalars (a loss) work: ``summarize(loss)`` is a single stat leaf."""
+    global _SUMMARIZE_JIT
+    import jax
+    if _SUMMARIZE_JIT is None:
+        _SUMMARIZE_JIT = jax.jit(
+            lambda t: jax.tree_util.tree_map(_leaf_stats, t))
+    return _SUMMARIZE_JIT(tree)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        for attr in ("key", "name", "idx"):
+            v = getattr(k, attr, None)
+            if v is not None:
+                parts.append(str(v))
+                break
+        else:
+            parts.append(str(k))
+    return "/".join(parts) or "value"
+
+
+def export_summary(stat_tree) -> Dict[str, Dict[str, float]]:
+    """ONE host fetch of a :func:`summarize` result →
+    ``{leaf_path: {mean, rms, absmax, zero_frac, nonfinite}}``."""
+    import jax
+    host = jax.device_get(stat_tree)
+    flat, _ = jax.tree_util.tree_flatten_with_path(host)
+    out: Dict[str, Dict[str, float]] = {}
+    for path, vec in flat:
+        out[_path_str(path)] = {
+            f: float(vec[i]) for i, f in enumerate(STAT_FIELDS)}
+    return out
+
+
+# latest exported summaries per (source, replica) — /debug/numerics
+_LATEST: Dict[Tuple[str, str], Dict[str, Any]] = {}
+_LOCK = threading.Lock()
+
+
+# per-registry gauge cache (the NumericsSentinel._m discipline): five
+# registry get-or-creates (regex + lock) per record_stats call would
+# be the listener's single biggest per-sample cost
+_GAUGE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _gauges(registry):
+    if registry is None:
+        from . import get_registry
+        registry = get_registry()
+    try:
+        cached = _GAUGE_CACHE.get(registry)
+    except TypeError:           # unhashable/unweakrefable test double
+        cached = None
+    if cached is not None:
+        return cached
+    lab = ("layer", "kind")
+    g = {
+        "mean": registry.gauge(
+            "dl4j_num_mean", "Per-leaf mean (non-finite as 0) of a "
+            "sampled tensor tree", labelnames=lab),
+        "rms": registry.gauge(
+            "dl4j_num_rms", "Per-leaf root-mean-square of a sampled "
+            "tensor tree", labelnames=lab),
+        "absmax": registry.gauge(
+            "dl4j_num_absmax", "Per-leaf max |x| of a sampled tensor "
+            "tree", labelnames=lab),
+        "zero_frac": registry.gauge(
+            "dl4j_num_zero_fraction", "Per-leaf fraction of exact "
+            "zeros (dead-unit / sparsity watch)", labelnames=lab),
+        "nonfinite": registry.gauge(
+            "dl4j_num_nonfinite_count", "Per-leaf count of NaN/Inf "
+            "elements (anything >0 is a sentinel trip)",
+            labelnames=lab),
+    }
+    try:
+        _GAUGE_CACHE[registry] = g
+    except TypeError:
+        pass
+    return g
+
+
+def emit_stats(tree, kind: str, *, source: str = "train",
+               replica: str = "0", registry=None
+               ) -> Dict[str, Dict[str, float]]:
+    """Summarize ``tree`` and publish every leaf's stats as
+    ``dl4j_num_*{layer, kind}`` gauges; the export is also recorded per
+    (source, replica) for ``GET /debug/numerics``. Returns the exported
+    ``{leaf_path: stats}`` dict."""
+    if kind not in KNOWN_KINDS:
+        raise ValueError(f"unknown stat kind {kind!r}: pick from "
+                         f"{KNOWN_KINDS} (a stable label vocabulary)")
+    stats = export_summary(summarize(tree))
+    record_stats(stats, kind, source=source, replica=replica,
+                 registry=registry)
+    return stats
+
+
+def record_stats(stats: Dict[str, Dict[str, float]], kind: str, *,
+                 source: str = "train", replica: str = "0",
+                 registry=None):
+    """Publish an ALREADY-exported stat dict (gauges + /debug/numerics
+    record) — the path for stats that were computed elsewhere (the
+    in-jit grad stats the sentinel receives)."""
+    g = _gauges(registry)
+    for layer, vec in stats.items():
+        if not isinstance(vec, dict):
+            continue            # e.g. an {"error": ...} forensics entry
+        for field, gauge in g.items():
+            if field in vec:
+                gauge.set(float(vec[field]), layer=layer, kind=kind)
+    with _LOCK:
+        # replace wholesale, never mutate in place: latest_stats hands
+        # out the record object itself, and the UI thread json.dumps it
+        # concurrently — a dict growing mid-iteration would 500 the
+        # debug endpoint (the memory.py fresh-dict-per-census pattern)
+        key = (str(source), str(replica))
+        old = _LATEST.get(key)
+        kinds = dict(old["kinds"]) if old else {}
+        kinds[kind] = stats
+        _LATEST[key] = {"source": str(source), "replica": str(replica),
+                        "kinds": kinds, "ts": time.time()}
+
+
+def latest_stats() -> List[Dict[str, Any]]:
+    """Every (source, replica)'s most recent stat export, stable order."""
+    with _LOCK:
+        return [_LATEST[k] for k in sorted(_LATEST)]
+
+
+def reset_stats():
+    """Drop recorded stat exports (tests)."""
+    with _LOCK:
+        _LATEST.clear()
+
+
+# ------------------------------------------------------------- sentinel
+
+_SENTINELS: "weakref.WeakSet[NumericsSentinel]" = weakref.WeakSet()
+
+POLICIES = ("warn", "raise", "skip_step")
+
+
+class NumericsSentinel:
+    """Tensor-health tripwire with a configurable policy.
+
+    Wire it twice (or once via ``NumericsListener(...).attach(net)``):
+
+    - ``net.enable_gradient_anomaly_detection(sentinel)`` — the jitted
+      train step computes per-layer grad stats and, when
+      :attr:`gate_updates` (policies ``raise`` / ``skip_step``), gates
+      params/opt-state/layer-state on grad finiteness INSIDE jit — a
+      poisoned batch leaves them bit-identical (the
+      ``train.anomaly.gate_on_finite`` contract). Host-side,
+      :meth:`check` receives the (one-step-delayed) stats and trips on
+      any non-finite element.
+    - ``NumericsListener`` — calls :meth:`observe_loss` every step:
+      trips on non-finite loss, and keeps a rolling window for the
+      z-score loss-spike detector (|score − mean| / std over the last
+      ``window`` scores; std is floored at ``rel_floor·|mean|`` so a
+      flat loss doesn't alarm on noise).
+
+    Every trip increments ``dl4j_num_sentinel_trips_total{kind}`` and
+    auto-dumps the offending step's full stat tree — params summarized
+    via :func:`summarize`, the step's grad stats, the recent loss
+    window — as a ``kind: "numerics"`` record through the PR 11 flight
+    recorder (JSONL at ``dump_path``). Policy then decides: ``warn``
+    warns and lets the run proceed (no in-jit gate — observe only),
+    ``raise`` raises :class:`FloatingPointError` (the gated step never
+    applied, so the run is salvageable), ``skip_step`` warns and
+    continues with the update skipped. The loss-spike detector never
+    escalates past warn+dump — a spike is a lead, not a verdict.
+
+    Policy is captured when the train step compiles (the gate is traced
+    in); change it by constructing a new sentinel and re-enabling.
+    """
+
+    def __init__(self, policy: str = "warn", *, z_threshold: float = 6.0,
+                 window: int = 64, min_window: int = 16,
+                 rel_floor: float = 1e-3, replica: str = "0",
+                 dump_path: Optional[str] = "runs/numerics_blackbox.jsonl",
+                 registry=None):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r}: pick from "
+                             f"{POLICIES}")
+        self.policy = policy
+        self.z_threshold = float(z_threshold)
+        self.min_window = max(2, int(min_window))
+        self.rel_floor = float(rel_floor)
+        self.replica = str(replica)
+        self._registry = registry
+        self._scores: "deque[float]" = deque(maxlen=max(int(window),
+                                                        self.min_window))
+        # O(1) rolling moments: recomputing mean/var over the window
+        # every step would be the plane's single biggest per-step cost
+        self._sum = 0.0
+        self._sumsq = 0.0
+        self._m_cache = None
+        self.trips: List[Dict[str, Any]] = []
+        # incident gating: a persistent-NaN run (policy "warn" applies
+        # the poisoned update, so every later loss is NaN too) must not
+        # pay a full stat pass + ring re-dump PER STEP — only the FIRST
+        # trip of each kind per incident dumps; repeats count + record
+        # lightweight. An incident ends when the signal goes clean
+        # (finite loss / finite grads), re-arming the dump.
+        self._active_trips: set = set()
+        self._last_raw_grads = None   # as pushed by the step (host)
+        self._model = None       # weakref, bound by observe_loss
+        self._overhead = 0.0
+        self.recorder = FlightRecorder(
+            capacity_requests=4, capacity_snapshots=64,
+            replica=self.replica, crash_dump_path=dump_path)
+        _SENTINELS.add(self)
+
+    # ---------------------------------------------------------- wiring
+    @property
+    def gate_updates(self) -> bool:
+        """True → the train step gates params/opt-state on grad
+        finiteness inside jit (``raise`` / ``skip_step``); ``warn``
+        observes without touching the update."""
+        return self.policy in ("raise", "skip_step")
+
+    @property
+    def overhead_seconds(self) -> float:
+        """Cumulative host bookkeeping cost (the MetricsListener
+        self-timing discipline; trips excluded — a dump is forensics,
+        not steady-state overhead)."""
+        return self._overhead
+
+    def _m(self):
+        # cached like MetricsListener's instruments: a registry
+        # get-or-create per observation (regex + lock) would be the
+        # sentinel's single biggest per-step cost
+        m = self._m_cache
+        if m is not None:
+            return m
+        reg = self._registry
+        if reg is None:
+            from . import get_registry
+            reg = get_registry()
+        m = (
+            reg.counter(
+                "dl4j_num_sentinel_trips_total",
+                "Numerics-sentinel trips, by trip kind (nonfinite_grads "
+                "/ nonfinite_loss / loss_spike)", labelnames=("kind",)),
+            reg.gauge(
+                "dl4j_num_loss_zscore",
+                "Rolling z-score of the last observed loss against the "
+                "sentinel window"),
+        )
+        self._m_cache = m
+        return m
+
+    # ------------------------------------------------------- grad path
+    def check(self, stats, iteration: int):
+        """GradientAnomalyDetector-compatible entry point: host-fetched
+        per-layer grad stats from the jitted step (one step late via
+        ``DelayedAnomalyCheck`` — the gate already ran in-jit). Hot
+        path: two float reads per layer; the rms/absmax export shape is
+        derived lazily by :attr:`last_grad_stats` (frequency-gated
+        sampling and trips only)."""
+        t0 = time.perf_counter()
+        self._last_raw_grads = stats
+        nonfinite = 0.0
+        bad_l2 = False
+        for s in stats.values():
+            nonfinite += float(s.get("nonfinite", 0.0))
+            if not math.isfinite(float(s.get("l2", 0.0))):
+                bad_l2 = True
+        self._overhead += time.perf_counter() - t0
+        if nonfinite or bad_l2:
+            self._trip("nonfinite_grads", iteration,
+                       f"{int(nonfinite)} non-finite gradient "
+                       "element(s)"
+                       + (" (l2 overflowed)" if bad_l2 else "")
+                       + ("" if self.gate_updates else
+                          " (policy 'warn': update was APPLIED)"))
+        else:
+            self._active_trips.discard("nonfinite_grads")  # incident over
+        return []   # detector API: anomalies list (sentinel keeps own)
+
+    @property
+    def last_grad_stats(self) -> Optional[Dict[str, Dict[str, float]]]:
+        """The most recent step's per-layer grad stats in the numerics
+        export shape ({layer: {l2, rms, absmax, nonfinite}}), converted
+        on demand — None before the first step."""
+        raw = self._last_raw_grads
+        if raw is None:
+            return None
+        out: Dict[str, Dict[str, float]] = {}
+        for layer, s in raw.items():
+            d = {k: float(v) for k, v in s.items()}
+            size = d.pop("size", 0.0)
+            if size > 0:
+                d["rms"] = d.get("l2", 0.0) / math.sqrt(size)
+            d["absmax"] = d.pop("max_abs", d.get("absmax", 0.0))
+            d["nonfinite"] = d.get("nonfinite", 0.0)
+            out[str(layer)] = d
+        return out
+
+    # ------------------------------------------------------- loss path
+    def observe_loss(self, model, iteration: int, score: float):
+        """Called by ``NumericsListener`` every iteration: non-finite
+        loss trips immediately; otherwise the score feeds the rolling
+        z-score spike detector."""
+        t0 = time.perf_counter()
+        if model is not None and (self._model is None
+                                  or self._model() is not model):
+            self._model = weakref.ref(model)
+        score = float(score)
+        if not math.isfinite(score):
+            self._overhead += time.perf_counter() - t0
+            self._trip("nonfinite_loss", iteration, f"loss = {score}")
+            return
+        self._active_trips.discard("nonfinite_loss")       # incident over
+        z = None
+        n = len(self._scores)
+        if n >= self.min_window:
+            mean = self._sum / n
+            var = max(self._sumsq / n - mean * mean, 0.0)
+            floor = self.rel_floor * max(abs(mean), 1e-12)
+            std = max(math.sqrt(var), floor)
+            z = abs(score - mean) / std
+            _, g_z = self._m()
+            g_z.set(z)
+        if n == self._scores.maxlen:      # evict before append
+            old = self._scores[0]
+            self._sum -= old
+            self._sumsq -= old * old
+        self._scores.append(score)
+        self._sum += score
+        self._sumsq += score * score
+        self._overhead += time.perf_counter() - t0
+        if z is not None and z > self.z_threshold:
+            self._trip("loss_spike", iteration,
+                       f"loss {score:.6g} is {z:.1f} sigma off the "
+                       f"rolling window (threshold {self.z_threshold})")
+
+    # ------------------------------------------------------------ trip
+    def _stat_tree(self) -> Dict[str, Any]:
+        """The offending step's full stat tree: params summarized live
+        (one fused pass + one fetch), the step's grad stats, the recent
+        loss window."""
+        stats: Dict[str, Any] = {}
+        model = self._model() if self._model is not None else None
+        if model is not None and getattr(model, "params", None):
+            try:
+                stats["params"] = export_summary(summarize(model.params))
+            except Exception as e:  # noqa: BLE001 — forensics must not
+                stats["params"] = {"error": repr(e)}   # mask the trip
+        if self.last_grad_stats is not None:
+            stats["grads"] = self.last_grad_stats
+        stats["loss_window"] = [round(s, 8) for s in self._scores]
+        return stats
+
+    def _trip(self, kind: str, iteration: int, detail: str):
+        c_trips, _ = self._m()
+        c_trips.inc(kind=kind)
+        trip = {"reason": kind, "iteration": int(iteration),
+                "detail": detail, "policy": self.policy,
+                "ts": time.time()}
+        self.trips.append(trip)
+        del self.trips[:-64]
+        if kind in self._active_trips:
+            # repeat within one incident: counted and recorded above,
+            # but no stat pass / re-dump / warning storm — the first
+            # trip already left the forensics (and under policy "warn"
+            # a poisoned run would otherwise pay a full device stat
+            # pass + a whole ring dump EVERY step, uncounted by the
+            # overhead budget)
+            if self.policy == "raise":
+                raise FloatingPointError(
+                    f"numerics sentinel [{kind}] at iteration "
+                    f"{iteration}: {detail} (repeat within incident)")
+            return
+        if kind != "loss_spike":
+            # spikes are one-shot by construction (the spike value
+            # enters the rolling window and damps immediate repeats);
+            # gating them would swallow a genuinely new spike later
+            self._active_trips.add(kind)
+        stats = self._stat_tree()
+        # publish the grads/params snapshot under the numerics gauges
+        # too (layer-labeled) so /metrics shows WHICH layer poisoned
+        for k in ("params", "grads"):
+            if isinstance(stats.get(k), dict):
+                try:
+                    record_stats(stats[k], k, source="sentinel",
+                                 replica=self.replica,
+                                 registry=self._registry)
+                except Exception:  # noqa: BLE001 — gauges are decoration
+                    pass
+        dump_path = None
+        try:
+            self.recorder.record_snapshot(kind="numerics", **trip,
+                                          stats=stats)
+            # append ONLY this trip's record (not recorder.dump(): that
+            # re-appends the whole ring, duplicating earlier trips on
+            # every new incident). dump_path=None at construction keeps
+            # the record in the in-memory ring only (tests, embedded).
+            if self.recorder.crash_dump_path:
+                import json
+                from pathlib import Path
+                p = Path(self.recorder.crash_dump_path)
+                p.parent.mkdir(parents=True, exist_ok=True)
+                with open(p, "a") as f:
+                    f.write(json.dumps({"kind": "numerics",
+                                        "replica": self.replica,
+                                        **trip, "stats": stats}) + "\n")
+                dump_path = str(p)
+        except Exception:  # noqa: BLE001 — a failed dump (full disk)
+            pass           # must not mask the trip itself
+        msg = (f"numerics sentinel [{kind}] at iteration {iteration}: "
+               f"{detail}"
+               + (f" — stat tree dumped to {dump_path}" if dump_path
+                  else ""))
+        if kind != "loss_spike" and self.policy == "raise":
+            raise FloatingPointError(msg)
+        warnings.warn(msg, RuntimeWarning, stacklevel=4)
+
+    def report(self) -> Dict[str, Any]:
+        """Plain-data state for /debug/numerics."""
+        return {"policy": self.policy, "replica": self.replica,
+                "trips": list(self.trips),
+                "window": len(self._scores),
+                "z_threshold": self.z_threshold,
+                "overhead_seconds": round(self._overhead, 6)}
+
+
+def live_sentinels() -> List[NumericsSentinel]:
+    return sorted(_SENTINELS, key=lambda s: (s.replica, id(s)))
+
+
+# ---------------------------------------------------------- drift audit
+
+def checksum_ndarray(a) -> Dict[str, Any]:
+    """Order-stable checksum of one host array: f64 sum (a drift
+    MAGNITUDE when replicas diverge) + crc32 of the raw bytes (the
+    bit-identity verdict)."""
+    import numpy as np
+    a = np.ascontiguousarray(a)
+    return {"checksum": float(np.sum(a, dtype=np.float64)),
+            "crc": zlib.crc32(a.tobytes()), "nbytes": int(a.nbytes)}
+
+
+def tree_replica_checksums(tree) -> Dict[str, Dict[str, Any]]:
+    """Per-device checksums over every REPLICATED leaf of ``tree``.
+
+    A leaf whose addressable shards are full copies (dp replication)
+    contributes each device's copy to that device's checksum — the
+    copies MUST be bit-identical, and this measures whether they are.
+    Sharded leaves (fsdp/tp: each device holds a different slice) are
+    skipped — there is no cross-replica copy to compare. Host arrays
+    and single-device leaves are one shared copy, not per-replica
+    state: they fold IDENTICALLY into every replica's checksum (so
+    crc equality across replicas is unaffected by them — a mixed tree
+    must not raise a false drift alarm). With no replicated leaf at
+    all, everything lands under replica "0"."""
+    import jax
+    import numpy as np
+    acc: Dict[str, Tuple[float, int, int]] = {}
+
+    def add(dev: str, data):
+        data = np.ascontiguousarray(np.asarray(data))
+        s, crc, nb = acc.get(dev, (0.0, 0, 0))
+        acc[dev] = (s + float(np.sum(data, dtype=np.float64)),
+                    zlib.crc32(data.tobytes(), crc), nb + data.nbytes)
+
+    # pass 1: classify leaves; the replica set comes from the
+    # replicated leaves (checksums are order-chained crc32, so the
+    # device set must be known before the first leaf is folded)
+    leaves = jax.tree_util.tree_leaves(tree)
+    kinds: List[Optional[str]] = []
+    devices: set = set()
+    for leaf in leaves:
+        shards = getattr(leaf, "addressable_shards", None)
+        shape = getattr(leaf, "shape", None)
+        if shards and len(shards) > 1:
+            if any(tuple(sh.data.shape) != tuple(shape)
+                   for sh in shards):
+                kinds.append(None)  # genuinely sharded: nothing to compare
+                continue
+            kinds.append("replicated")
+            devices.update(str(getattr(sh.device, "id", 0))
+                           for sh in shards)
+        else:
+            kinds.append("shared")
+    if not devices:
+        devices = {"0"}
+    for leaf, kind in zip(leaves, kinds):
+        if kind is None:
+            continue
+        if kind == "replicated":
+            for sh in leaf.addressable_shards:
+                add(str(getattr(sh.device, "id", 0)), sh.data)
+        else:
+            data = np.ascontiguousarray(np.asarray(leaf))
+            for dev in devices:
+                add(dev, data)
+    return {dev: {"checksum": s, "crc": crc, "nbytes": nb}
+            for dev, (s, crc, nb) in acc.items()}
+
+
+class DriftAuditor:
+    """Collects (source, round, replica) checksums and compares the
+    replicas of each round as they arrive: max |Δchecksum| and crc
+    agreement across every replica that reported the round. In-process
+    emitters (ParallelWrapper devices, threaded scaleout workers + hub)
+    meet in the process-wide instance; multi-process deployments each
+    export their own ``dl4j_replica_checksum`` gauge and an external
+    scraper does the comparing — same metric either way."""
+
+    def __init__(self, registry=None, keep_rounds: int = 64):
+        self._registry = registry
+        self.keep_rounds = int(keep_rounds)
+        self._rounds: Dict[str, Dict[int, Dict[str, Dict]]] = {}
+        self._summary: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._m_cache = None
+
+    def _m(self):
+        # cached (the sentinel-_m discipline): record() runs once per
+        # replica per round — 4 registry get-or-creates each would add
+        # up on a long scaleout job
+        if self._m_cache is not None:
+            return self._m_cache
+        reg = self._registry
+        if reg is None:
+            from . import get_registry
+            reg = get_registry()
+        self._m_cache = {
+            "checksum": reg.gauge(
+                "dl4j_replica_checksum",
+                "Per-replica f64 param checksum at the last audited "
+                "round", labelnames=("replica",)),
+            "drift": reg.gauge(
+                "dl4j_replica_drift_max",
+                "Max |checksum delta| across replicas at the last "
+                "audited round (0.0 = lockstep)"),
+            "rounds": reg.counter(
+                "dl4j_replica_drift_rounds_total",
+                "Rounds with >=2 replica checksums compared"),
+            "detected": reg.counter(
+                "dl4j_replica_drift_detected_total",
+                "Audited rounds where replica params were NOT "
+                "bit-identical"),
+        }
+        return self._m_cache
+
+    def record(self, source: str, replica: str, round_idx: int, *,
+               checksum: float, crc: int, nbytes: int = 0):
+        m = self._m()
+        m["checksum"].set(checksum, replica=str(replica))
+        with self._lock:
+            rounds = self._rounds.setdefault(str(source), {})
+            entry = rounds.setdefault(int(round_idx), {})
+            entry[str(replica)] = {"checksum": checksum, "crc": crc,
+                                   "nbytes": nbytes}
+            summ = self._summary.setdefault(str(source), {
+                "rounds_audited": 0, "max_drift": 0.0,
+                "detected": 0, "last": None})
+            reps = {k: v for k, v in entry.items()
+                    if not k.startswith("_")}
+            compared = len(reps) >= 2
+            drift, identical, newly_detected = 0.0, True, False
+            if compared:
+                sums = [e["checksum"] for e in reps.values()]
+                crcs = {e["crc"] for e in reps.values()}
+                drift = max(sums) - min(sums)
+                identical = len(crcs) == 1
+                first_cmp = not entry.get("_compared")
+                entry["_compared"] = True
+                newly_detected = (not identical
+                                  and not entry.get("_detected"))
+                if newly_detected:
+                    entry["_detected"] = True
+                summ["last"] = {"round": int(round_idx),
+                                "replicas": sorted(reps),
+                                "max_drift": drift,
+                                "bit_identical": identical}
+                if first_cmp:
+                    summ["rounds_audited"] += 1
+                    m["rounds"].inc()
+                summ["max_drift"] = max(summ["max_drift"], drift)
+                if newly_detected:
+                    summ["detected"] += 1
+            # prune old rounds so a long job stays bounded
+            while len(rounds) > self.keep_rounds:
+                del rounds[min(rounds)]
+        if compared:
+            m["drift"].set(drift)
+        if newly_detected:
+            m["detected"].inc()
+            warnings.warn(
+                f"replica drift detected: source {source!r} round "
+                f"{round_idx} — checksums span {drift:.3e} across "
+                f"replicas {sorted(reps)} (params are NOT "
+                "bit-identical; the lockstep contract is broken)",
+                RuntimeWarning, stacklevel=3)
+
+    def round_detail(self, source: str, round_idx: int) -> Dict:
+        with self._lock:
+            entry = self._rounds.get(str(source), {}).get(int(round_idx),
+                                                          {})
+            return {k: dict(v) for k, v in entry.items()
+                    if not k.startswith("_")}
+
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            return {src: dict(summ)
+                    for src, summ in sorted(self._summary.items())}
+
+    def reset_source(self, source: str):
+        """Drop one source's rounds and summary — a FRESH job reusing
+        an address (round counter back at 0) must not be compared
+        against the previous job's stale checksums."""
+        with self._lock:
+            self._rounds.pop(str(source), None)
+            self._summary.pop(str(source), None)
+
+    def reset(self):
+        with self._lock:
+            self._rounds.clear()
+            self._summary.clear()
+
+
+_AUDITOR = DriftAuditor()
+
+
+def get_auditor() -> DriftAuditor:
+    """The process-wide drift auditor every built-in emitter records
+    into (ParallelWrapper, the scaleout hub + workers)."""
+    return _AUDITOR
+
+
+def drift_report() -> Dict[str, Any]:
+    return _AUDITOR.report()
+
+
+# per-source auto round counter for audit_params
+_AUDIT_ROUNDS: Dict[str, int] = {}
+
+
+def audit_params(tree, *, source: str = "parallel_fit",
+                 round_idx: Optional[int] = None,
+                 auditor: Optional[DriftAuditor] = None) -> Dict[str, Any]:
+    """Audit one replicated pytree NOW: per-device checksums over every
+    replicated leaf, recorded into the auditor under ``source`` (round
+    auto-increments per source when not given). Returns the round's
+    verdict: ``{replicas, max_drift, bit_identical, round}``."""
+    auditor = auditor or _AUDITOR
+    with _LOCK:
+        if round_idx is None:
+            round_idx = _AUDIT_ROUNDS.get(source, 0) + 1
+        _AUDIT_ROUNDS[source] = int(round_idx)
+    by_dev = tree_replica_checksums(tree)
+    for dev, cs in sorted(by_dev.items()):
+        auditor.record(source, dev, int(round_idx), **cs)
+    detail = auditor.round_detail(source, int(round_idx))
+    sums = [e["checksum"] for e in detail.values()]
+    crcs = {e["crc"] for e in detail.values()}
+    return {"round": int(round_idx), "replicas": sorted(detail),
+            "max_drift": (max(sums) - min(sums)) if len(sums) > 1 else 0.0,
+            "bit_identical": len(crcs) <= 1}
+
+
+# ------------------------------------------------------------ debug API
+
+def debug_state() -> Dict[str, Any]:
+    """What ``GET /debug/numerics`` returns: latest stat exports per
+    (source, replica), every live sentinel's report, the drift-audit
+    summary, and the latest fidelity-probe reports."""
+    fid: Any = []
+    try:
+        from . import fidelity as obs_fidelity
+        fid = obs_fidelity.latest_reports()
+    except Exception:  # noqa: BLE001 — debug must not raise
+        pass
+    return {"stats": latest_stats(),
+            "sentinels": [s.report() for s in live_sentinels()],
+            "drift": drift_report(),
+            "fidelity": fid}

@@ -353,6 +353,7 @@ def load_flight_records(path) -> List[dict]:
         except ValueError:
             continue
         if isinstance(rec, dict) and rec.get("kind") in (
-                "flightrec", "snapshot", "reqtrace", "memcensus"):
+                "flightrec", "snapshot", "reqtrace", "memcensus",
+                "numerics", "fidelity"):
             out.append(rec)
     return out

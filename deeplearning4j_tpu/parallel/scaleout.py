@@ -84,6 +84,21 @@ from .transport import (Address, KIND_DONE, KIND_HELLO, KIND_LEASE,
 _SOCK_ERRORS = (ConnectionError, socket.timeout, OSError)
 
 
+def _drift_source(address) -> str:
+    """Drift-audit source key for one scaleout job: scoped by the hub
+    address so two jobs in one process (tests run dozens) can't collide
+    on round indexes, while BOTH wire ends derive the same key. TCP
+    keys by PORT only — the hub sees its bound form (('0.0.0.0', p))
+    and a worker its dial form (('localhost', p)); the host string
+    differs, the port never does, and two in-process hubs can't share
+    a port. AF_UNIX keys by path (identical on both ends). A master
+    RESTART on the same address keeps the source, so resumed rounds
+    land in the same audit series."""
+    if isinstance(address, str):
+        return f"scaleout:{address}"
+    return "scaleout:port:%s" % tuple(address)[1]
+
+
 class MasterDiedError(RuntimeError):
     """The master hub died mid-job (fault injection or crash); the job
     is resumable from ``checkpoint_dir``."""
@@ -191,6 +206,18 @@ class ParamAveragingHub:
         self._sock.bind(address)
         self._sock.listen(max(n_workers, 8))
         self.address = self._sock.getsockname()
+        # drift audit (ISSUE 13): a FRESH job (round counter starting
+        # at 0) on a reused address must not be compared against the
+        # previous job's checksums for the same round indexes — clear
+        # the source. A RESUMED hub (start_round > 0) keeps them: its
+        # rounds continue the same series. Decoration only.
+        if start_round == 0:
+            try:
+                from ..obs import numerics as obs_numerics
+                obs_numerics.get_auditor().reset_source(
+                    _drift_source(self.address))
+            except Exception:  # noqa: BLE001 — audit is decoration
+                pass
         self.dropped: List[int] = []
         self.rejoins = 0
         self._final: Optional[np.ndarray] = None
@@ -200,7 +227,9 @@ class ParamAveragingHub:
         self._live: Dict[int, socket.socket] = {}
         self._ever: Set[int] = set()
         self._frames: Dict[int, np.ndarray] = {}
-        self._means: Dict[int, np.ndarray] = {}
+        self._means: Dict[int, Tuple[np.ndarray, int]] = {}  # wid ->
+        # (round mean, hub round index) — the index rides the PARAMS
+        # reply so workers key their drift audit by the hub's counter
         self._deadline: Optional[float] = None
         self._round_t0: Optional[Tuple[float, float]] = None
         self._after_q: List[tuple] = []
@@ -307,7 +336,11 @@ class ParamAveragingHub:
                     rnd = self.rounds
                     mean = self._last_mean if self._last_mean is not None \
                         else self._initial_params
-                ack = struct.pack("<I", rnd) + \
+                # the ack echoes the REGISTERED wid: a live-duplicate
+                # dialer was uniquified by _register, and its drift
+                # audit (ISSUE 13) must label by the hub-side identity
+                # or two workers would overwrite one replica's checksums
+                ack = struct.pack("<II", rnd, wid) + \
                     (mean.astype(np.float32).tobytes()
                      if mean is not None else b"")
                 send_frame(conn, KIND_REJOIN, ack)
@@ -357,12 +390,19 @@ class ParamAveragingHub:
                     self._leave(wid, conn, done=True)
                     return
                 if kind == KIND_PARAMS:
-                    mean = self._contribute(
+                    res = self._contribute(
                         wid, np.frombuffer(payload, np.float32))
-                    if mean is None:        # hub stopped mid-round
+                    if res is None:         # hub stopped mid-round
                         return
+                    mean, rnd = res
+                    # reply = 4-byte round index + f32 mean: the worker
+                    # keys its drift-audit checksum (ISSUE 13) by the
+                    # hub's OWN round counter, so elastic membership
+                    # (stragglers, rejoins) can never skew the audit
+                    # onto the wrong round
                     send_frame(conn, KIND_PARAMS,
-                               mean.astype(np.float32).tobytes())
+                               struct.pack("<I", rnd)
+                               + mean.astype(np.float32).tobytes())
                 elif kind == KIND_LEASE_REQ:
                     status, item = self._grant(wid)
                     pl = bytes([status]) + (struct.pack("<I", item)
@@ -407,11 +447,12 @@ class ParamAveragingHub:
 
     # ------------------------------------------------------------ rounds
     def _contribute(self, wid: int,
-                    vec: np.ndarray) -> Optional[np.ndarray]:
+                    vec: np.ndarray) -> Optional[Tuple[np.ndarray, int]]:
         """Deposit ``wid``'s params frame into the current round; block
-        until the round containing it closes; return the round mean
-        (None = hub stopped). Rounds close when every live worker has
-        contributed, or at the deadline — whichever comes first."""
+        until the round containing it closes; return (round mean, round
+        index) — None = hub stopped. Rounds close when every live
+        worker has contributed, or at the deadline — whichever comes
+        first."""
         vec = np.asarray(vec, np.float32)
         with self._cv:
             if self._stopped or self._live.get(wid) is None:
@@ -450,9 +491,9 @@ class ParamAveragingHub:
         mean = np.mean(list(contributors.values()), axis=0).astype(np.float32)
         self._last_mean = mean
         self._final = mean
-        for w in contributors:
-            self._means[w] = mean
         self.rounds += 1
+        for w in contributors:
+            self._means[w] = (mean, self.rounds)
         self._provisioned = True    # whoever averaged IS the working set
         t0 = self._round_t0
         self._round_t0 = None
@@ -496,6 +537,18 @@ class ParamAveragingHub:
             parent_id=parent, start_ts=start_ts,
             time_s=time.perf_counter() - t0p,
             attrs={"round": rnd, "workers": n_contrib}))
+        # drift audit (ISSUE 13): record the broadcast mean's checksum
+        # under replica "hub" for this round; every worker records the
+        # mean IT received after applying it, and the auditor compares —
+        # all ends of the wire must enter round rnd+1 from bit-identical
+        # state (dl4j_replica_drift_*). Decoration only.
+        try:
+            from ..obs import numerics as obs_numerics
+            obs_numerics.get_auditor().record(
+                _drift_source(self.address), "hub", rnd,
+                **obs_numerics.checksum_ndarray(mean))
+        except Exception:  # noqa: BLE001 — audit is decoration
+            pass
         if self.on_round is not None:
             try:
                 self.on_round(mean, rnd)
@@ -566,6 +619,7 @@ class WorkerClient:
         self.span_ctx: Optional[SpanContext] = None
         self.rejoin_params: Optional[np.ndarray] = None
         self.round_offset = 0     # hub's round counter when we joined
+        self.last_round = 0       # hub round of the last average() reply
         self._sock: Optional[socket.socket] = None
         self._connect()
 
@@ -582,11 +636,11 @@ class WorkerClient:
             span_ctx = unpack_span_context(payload) \
                 if kind == KIND_SPANCTX else None
             kind, payload = recv_frame(sock)
-            round_offset, rejoin = 0, None
-            if kind == KIND_REJOIN and len(payload) >= 4:
-                (round_offset,) = struct.unpack("<I", payload[:4])
-                if len(payload) > 4:
-                    rejoin = np.frombuffer(payload[4:], np.float32).copy()
+            round_offset, rejoin, assigned = 0, None, self.worker_id
+            if kind == KIND_REJOIN and len(payload) >= 8:
+                round_offset, assigned = struct.unpack("<II", payload[:8])
+                if len(payload) > 8:
+                    rejoin = np.frombuffer(payload[8:], np.float32).copy()
         except BaseException:
             with contextlib.suppress(OSError):
                 sock.close()
@@ -594,6 +648,11 @@ class WorkerClient:
         self._sock = sock
         self.span_ctx = span_ctx
         self.round_offset = int(round_offset)
+        # hub-side identity: differs from worker_id when a live
+        # duplicate dialer was uniquified at _register — the drift
+        # audit labels by THIS id so colliding workers never share a
+        # replica series
+        self.assigned_id = int(assigned)
         self.rejoin_params = rejoin
 
     def _connect(self):
@@ -647,15 +706,20 @@ class WorkerClient:
 
     # ------------------------------------------------------------ ops
     def average(self, flat: np.ndarray) -> np.ndarray:
+        """Contribute ``flat`` and return the round mean. The reply's
+        4-byte header is the hub's round index — kept on
+        ``self.last_round`` so the drift audit (ISSUE 13) keys its
+        checksum by the hub's counter, immune to membership skew."""
         blob = np.ascontiguousarray(flat, np.float32).tobytes()
 
         def op():
             self._ensure()
             send_frame(self._sock, KIND_PARAMS, blob)
             kind, payload = recv_frame(self._sock)
-            if kind != KIND_PARAMS:
+            if kind != KIND_PARAMS or len(payload) < 4:
                 raise ConnectionError("hub closed mid-round")
-            return np.frombuffer(payload, np.float32).copy()
+            self.last_round = struct.unpack("<I", payload[:4])[0]
+            return np.frombuffer(payload[4:], np.float32).copy()
 
         return self._retrying(op, "average")
 
@@ -770,6 +834,25 @@ def worker_main(address: Address, net, datasets: Sequence,
                            attrs={"worker": worker_id, "round": rnd,
                                   "step": state["step"] + 1})
 
+    def audit_mean(mean: np.ndarray):
+        """Drift audit (ISSUE 13): checksum the round mean this worker
+        just applied, keyed by the hub's OWN round index (carried in
+        the PARAMS reply) under this worker's replica id. The hub
+        records the same round under "hub" and the auditor compares
+        every end of the wire (dl4j_replica_drift_*) — zero drift is
+        the proof all replicas entered the next round from identical
+        state. Host numpy only; decoration."""
+        try:
+            from ..obs import numerics as obs_numerics
+            obs_numerics.get_auditor().record(
+                _drift_source(address),
+                str(getattr(client, "assigned_id", worker_id)),
+                client.last_round,
+                **obs_numerics.checksum_ndarray(
+                    np.ascontiguousarray(mean, np.float32)))
+        except Exception:  # noqa: BLE001 — audit is decoration
+            pass
+
     def fit_one(ds):
         with fit_span():
             net.fit(ds)
@@ -782,6 +865,7 @@ def worker_main(address: Address, net, datasets: Sequence,
         if state["step"] % averaging_frequency == 0:
             mean = client.average(np.asarray(net.params_flat(), np.float32))
             net.set_params_flat(mean)
+            audit_mean(mean)
 
     try:
         if lease:
@@ -800,6 +884,7 @@ def worker_main(address: Address, net, datasets: Sequence,
         if state["step"] % averaging_frequency:
             mean = client.average(np.asarray(net.params_flat(), np.float32))
             net.set_params_flat(mean)
+            audit_mean(mean)
         client.done()
     except BaseException:
         # crash without done(): the hub must drop us (releasing our

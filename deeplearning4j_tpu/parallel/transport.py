@@ -59,8 +59,11 @@ _HDR = struct.Struct("<If")  # payload bytes, sender threshold
 
 FRAME_HEADER = struct.Struct("<BI")      # kind, payload bytes
 
-KIND_PARAMS = 0     # float32[] flat params; worker -> hub contributes to
-#                     the round, hub -> worker returns the round mean
+KIND_PARAMS = 0     # worker -> hub: float32[] flat params contributing
+#                     to the round; hub -> worker reply: uint32 round
+#                     index + float32[] round mean (the round header
+#                     keys the ISSUE 13 drift audit by the hub's own
+#                     counter — elastic membership can't skew it)
 KIND_DONE = 1       # worker -> hub: partition finished, leaving the job
 KIND_HELLO = 2      # uint32 worker id — first frame on every connect, so
 #                     the hub's worker labels are the CALLER's ids (a
@@ -68,6 +71,9 @@ KIND_HELLO = 2      # uint32 worker id — first frame on every connect, so
 KIND_SPANCTX = 3    # hub -> worker right after HELLO: the master's span
 #                     context header (empty payload = tracing off)
 KIND_REJOIN = 4     # hub -> worker after SPANCTX: uint32 current round,
+#                     uint32 registered worker id (echoed so a
+#                     uniquified duplicate dialer learns its hub-side
+#                     identity — the drift audit labels by it),
 #                     then float32[] current mean params (absent = no
 #                     round completed yet) — a (re)joiner starts from the
 #                     job's live state instead of its stale local params

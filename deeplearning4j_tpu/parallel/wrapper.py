@@ -69,12 +69,15 @@ class ParallelWrapper:
     """Data-parallel trainer over a mesh's 'dp' (and optional 'fsdp') axis."""
 
     def __init__(self, net, mesh: Optional[Mesh] = None, use_fsdp: bool = False,
-                 prefetch_buffer: int = 2):
+                 prefetch_buffer: int = 2, drift_audit: bool = True):
         if not net.initialized:
             raise ValueError("initialize the network first (net.init(...))")
         self.net = net
         self.mesh = mesh or data_parallel_mesh()
         self.use_fsdp = use_fsdp and "fsdp" in self.mesh.axis_names
+        # ISSUE 13: checksum the per-device param replicas at the end of
+        # each fit call (dl4j_replica_* — the dp lockstep audit)
+        self.drift_audit = bool(drift_audit)
         self._step = None
         self._rep = NamedSharding(self.mesh, P())
         batch_axes = tuple(a for a in ("dp", "fsdp") if a in self.mesh.axis_names)
@@ -120,7 +123,10 @@ class ParallelWrapper:
         optimizer = self.net._optimizer
         net = self.net
         with_stats = getattr(net, "_anomaly_detector", None) is not None
-        self._step_with_stats = with_stats
+        # numerics sentinel (ISSUE 13) — see MLN._get_train_step
+        gate = with_stats and getattr(net._anomaly_detector,
+                                      "gate_updates", True)
+        self._step_with_stats = (with_stats, gate)
         # the compiled step traced net._loss, which routes on the net's
         # remat policy — record it so a later toggle forces a rebuild
         self._built_remat = getattr(net, "remat_segments", None)
@@ -136,10 +142,11 @@ class ParallelWrapper:
             new_params = optax.apply_updates(params, updates)
             stats = None
             if with_stats:  # same failure-detection path as single-device fit
-                from ..train.anomaly import stats_and_gate
-                stats, new_params, new_opt_state, new_states = stats_and_gate(
-                    grads, params, new_params, opt_state, new_opt_state,
-                    states, new_states)
+                from ..train.anomaly import maybe_stats_and_gate
+                stats, new_params, new_opt_state, new_states = \
+                    maybe_stats_and_gate(
+                        gate, grads, params, new_params, opt_state,
+                        new_opt_state, states, new_states)
             return new_params, new_states, new_opt_state, loss, stats, next_rng
 
         self._step_raw = step    # unjitted: fit_scanned scans over it
@@ -157,8 +164,10 @@ class ParallelWrapper:
     def fit(self, iterator, *, epochs: int = 1):
         net = self.net
         want_stats = getattr(net, "_anomaly_detector", None) is not None
-        if self._step is not None and getattr(self, "_step_with_stats", None) != want_stats:
-            self._step = None  # detector toggled since compile — rebuild
+        want = (want_stats, want_stats and getattr(
+            net._anomaly_detector, "gate_updates", True))
+        if self._step is not None and getattr(self, "_step_with_stats", None) != want:
+            self._step = None  # detector/gate toggled since compile — rebuild
             self._scan_epoch = None  # scans over _step_raw — same staleness
         if self._step is not None and getattr(self, "_built_remat", None) != \
                 getattr(net, "remat_segments", None):
@@ -229,7 +238,29 @@ class ParallelWrapper:
                 iterator.reset()
         if anomaly_check is not None:
             anomaly_check.flush()
+        # drift audit (ISSUE 13): per-device checksums over the
+        # replicated params at the end of every fit call — the dp
+        # replicas hold COPIES of the same logical array and must be
+        # bit-identical; zero drift here is the lockstep proof the
+        # ZeRO update-sharding equivalence case (ROADMAP 4) cites.
+        # Once per fit (not per batch): the audit fetches every
+        # replica's copy to host. Decoration — never takes down a fit.
+        if self.drift_audit and self.workers > 1:
+            try:
+                self.audit_drift()
+            except Exception:  # noqa: BLE001 — audit is decoration
+                pass
         return None if last is None else float(last)
+
+    def audit_drift(self):
+        """Checksum every device's copy of the replicated params NOW
+        (``obs.numerics.audit_params``) and return the verdict:
+        ``{round, replicas, max_drift, bit_identical}``. fsdp/tp-sharded
+        leaves are skipped — each device holds a different slice, there
+        is no cross-replica copy to compare."""
+        from ..obs import numerics as obs_numerics
+        return obs_numerics.audit_params(self.net.params,
+                                         source="parallel_fit")
 
     def fit_scanned(self, data, *, epochs: int = 1):
         """One jit dispatch per EPOCH across the dp mesh: the epoch's
@@ -270,7 +301,8 @@ class ParallelWrapper:
         if self._step is not None and (
                 getattr(self, "_built_remat", None) !=
                 getattr(net, "remat_segments", None)
-                or getattr(self, "_step_with_stats", None)):
+                or (getattr(self, "_step_with_stats", None)
+                    or (False,))[0]):
             # remat policy toggled, or the cached step was compiled with
             # anomaly-stats gating (detector since disabled) — retrace
             self._step = None

@@ -149,13 +149,26 @@ class ContinuousBatchingScheduler:
                  recorder_requests: int = 256,
                  recorder_snapshots: int = 512,
                  crash_dump_path: Optional[str] = None,
-                 trace_spans: bool = True):
+                 trace_spans: bool = True,
+                 sample_obs_every: int = 32):
         if n_slots < 1:
             raise ValueError("need at least one decode slot")
         self.engine = engine
         self.n_slots = int(n_slots)
         self.starvation_ms = starvation_ms
         self.replica = str(replica)
+        # sampler observability (ISSUE 13): every Nth sampling event
+        # (decode sweeps and admission first-tokens share one
+        # counter), derive next-token entropy + top-k truncated mass
+        # host-side from the logits that event produced (0 disables;
+        # 1 = every event). Each observation is one (active, V) fetch
+        # + a numpy softmax; the default subsamples aggressively
+        # because the serving trace budget (<2% of the sweep wall,
+        # tests pin it) has little headroom on tiny models — fidelity
+        # work that wants every sweep sets 1 explicitly. Counted into
+        # trace_overhead_seconds.
+        self.sample_obs_every = max(0, int(sample_obs_every))
+        self._obs_events = 0
         self.cache = engine.init_cache(self.n_slots)
         # memory plane (ISSUE 12): fixed-slot KV accounting — allocated
         # bytes are static (slots × max_len), resident bytes follow the
@@ -290,6 +303,24 @@ class ContinuousBatchingScheduler:
                 "Per-request final residency: (prompt+generated) / "
                 "max_len at completion — how much of its slot a request "
                 "ever used", buckets=tuple(i / 20 for i in range(1, 21))),
+            # sampler observability (ISSUE 13): health of the model's
+            # next-token distribution at the sampling sites — a
+            # quantized KV cache or int8 weights (ROADMAP 3) that
+            # flattens or spikes it shows up here first
+            "sample_entropy": reg.histogram(
+                "dl4j_serving_sample_entropy",
+                "Per-observation mean entropy (nats) of the MODEL's "
+                "next-token distribution (softmax at temperature 1, "
+                "before per-request temperature/top-k shaping) over "
+                "active slots — the sharpness signal quantization "
+                "drift shows up in, meaningful for greedy pools too",
+                buckets=tuple(0.25 * i for i in range(1, 61))),
+            "topk_mass": reg.histogram(
+                "dl4j_serving_topk_mass",
+                "Per-observation mean probability mass (at temperature "
+                "1) the top-k truncation keeps, over active slots with "
+                "top_k > 0",
+                buckets=tuple(i / 20 for i in range(1, 21))),
         }
 
     # -------------------------------------------------------- submit
@@ -536,8 +567,15 @@ class ContinuousBatchingScheduler:
             self._key, sub = jax.random.split(self._key)
         tok = int(np.asarray(self.engine.sample(
             sub, logits[None], req.temperature, req.top_k))[0])
+        # the TTFT timestamp is taken BEFORE the sampler-obs pass: its
+        # cost is booked to trace_overhead, so it must not also ride
+        # the recorded first-token latency (no double counting)
         now = time.perf_counter()
+        # sampler obs (ISSUE 13) on the first (TTFT) token
+        obs_cost = self._maybe_sample_obs(m, lambda: np.asarray(logits),
+                                          [req.top_k])
         with self._lock:
+            self._trace_overhead += obs_cost
             if req.first_token_ts is None:
                 req.first_token_ts = now
                 m["ttft"].observe(now - req.submitted_ts)
@@ -554,6 +592,54 @@ class ContinuousBatchingScheduler:
                 self._finish(req, tok, m)
             else:
                 self._last_tokens[slot] = tok
+
+    def _maybe_sample_obs(self, m, rows_fn, topks) -> float:
+        """Shared sampler-obs cadence for admissions and sweeps (one
+        counter, one modulo, one timing discipline): returns the
+        self-timed cost to add to trace_overhead. ``rows_fn`` defers
+        the logits fetch until the cadence says observe — runs under
+        ``_step_lock`` only, like its two callers."""
+        if not self.sample_obs_every:
+            return 0.0
+        self._obs_events += 1
+        if self._obs_events % self.sample_obs_every:
+            return 0.0
+        t_obs = time.perf_counter()
+        try:
+            self._sample_obs(m, rows_fn(), topks)
+        except Exception:  # noqa: BLE001 — observability must never
+            pass           # perturb the admission or sweep
+        return time.perf_counter() - t_obs
+
+    @staticmethod
+    def _sample_obs(m, logits_rows, topks):
+        """Sampler observability (ISSUE 13), host-side only: mean
+        next-token entropy over the given logit rows, and the mean
+        probability mass the top-k filter keeps for rows with
+        top_k > 0. No device computation — one fetch of logits the
+        sampler produced anyway; f32 + in-place numpy + partition
+        (not sort) keep an observation in the tens of microseconds."""
+        lg = np.array(logits_rows, np.float32, copy=True)
+        if lg.ndim == 1:
+            lg = lg[None, :]
+        if lg.size == 0:
+            return
+        lg -= lg.max(axis=-1, keepdims=True)
+        np.exp(lg, out=lg)
+        lg /= lg.sum(axis=-1, keepdims=True)        # lg is now p
+        ent = -(lg * np.log(lg + 1e-30)).sum(axis=-1)
+        m["sample_entropy"].observe(float(ent.mean()))
+        mass, n_k = 0.0, 0
+        for row, k in zip(lg, topks):
+            k = int(k)
+            if k <= 0:
+                continue
+            k = min(k, row.size)
+            mass += float(np.partition(row, row.size - k)
+                          [row.size - k:].sum())
+            n_k += 1
+        if n_k:
+            m["topk_mass"].observe(mass / n_k)
 
     def _decode_sweep(self, m) -> bool:
         with self._lock:      # snapshot; only step() (serialized) mutates
@@ -580,15 +666,24 @@ class ContinuousBatchingScheduler:
         m["tokens"].inc(len(active))
         if dt > 0:
             m["tokens_per_s"].set(len(active) / dt, replica=self.replica)
+        # token timestamp BEFORE the sampler-obs pass: its cost is
+        # booked to trace_overhead, so it must not also skew the ITL
+        # samples derived from consecutive token events (the same
+        # no-double-counting discipline as _admit's TTFT timestamp)
+        tok_ts = time.perf_counter()
+        obs_cost = self._maybe_sample_obs(
+            m, lambda: np.asarray(logits)[active],
+            [topks[i] for i in active])
         with self._lock:
             # trace bookkeeping first (self-timed): one shared token
             # timestamp per sweep — the whole pool's tokens land
             # together, which is exactly what each caller observes
+            self._trace_overhead += obs_cost   # sampler obs (ISSUE 13)
             t_ov = time.perf_counter()
             for i in active:
                 req = self.slots[i]
                 if req is not None and req.trace is not None:
-                    req.trace.event("token", ts=t_ov,
+                    req.trace.event("token", ts=tok_ts,
                                     i=len(req.generated))
             self._trace_overhead += time.perf_counter() - t_ov
             for i in active:

@@ -41,8 +41,11 @@ def grad_stats(grads) -> Dict[str, Dict[str, jnp.ndarray]]:
                                 for l in leaves]))
         nonfinite = sum(jnp.sum(~jnp.isfinite(l.astype(jnp.float32)))
                         for l in leaves)
+        # element count is trace-time static — free, and it lets the
+        # numerics plane derive rms = l2/sqrt(size) host-side (ISSUE 13)
+        size = jnp.float32(sum(int(l.size) for l in leaves))
         out[str(group)] = {"l2": jnp.sqrt(sq), "max_abs": mx,
-                           "nonfinite": nonfinite}
+                           "nonfinite": nonfinite, "size": size}
     return out
 
 
@@ -67,6 +70,20 @@ def stats_and_gate(grads, params, new_params, opt_state, new_opt_state,
         stats, (new_params, params), (new_opt_state, opt_state),
         (new_states, states))
     return stats, new_params, new_opt_state, new_states
+
+
+def maybe_stats_and_gate(gate, grads, params, new_params, opt_state,
+                         new_opt_state, states, new_states):
+    """Jit-able: :func:`stats_and_gate` when ``gate`` is set (policies
+    that must leave a poisoned step bit-identical), plain
+    :func:`grad_stats` with the step outputs passed through when it is
+    not (observe-only detectors / sentinel policy "warn"). ``gate`` is
+    a trace-time Python bool — the three step builders resolve it from
+    the detector's ``gate_updates`` before compiling."""
+    if gate:
+        return stats_and_gate(grads, params, new_params, opt_state,
+                              new_opt_state, states, new_states)
+    return grad_stats(grads), new_params, new_opt_state, new_states
 
 
 class DelayedAnomalyCheck:

@@ -33,6 +33,11 @@ Endpoints:
                         per source/replica (component bytes, allocator
                         view) + live KV residency accounting per
                         scheduler replica
+  GET /debug/numerics   numerics & fidelity plane (ISSUE 13): latest
+                        tensor-stat exports per source/replica, every
+                        live sentinel's trip log, the cross-replica
+                        drift-audit summary, and the latest
+                        fidelity-probe reports
 """
 
 from __future__ import annotations
@@ -184,6 +189,12 @@ class _Handler(BaseHTTPRequestHandler):
             # accounting of every live scheduler
             from ..obs import memory as obs_memory
             body = json.dumps(obs_memory.debug_state()).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/debug/numerics"):
+            # numerics & fidelity plane (ISSUE 13): stat exports,
+            # sentinel trip logs, drift audits, fidelity reports
+            from ..obs import numerics as obs_numerics
+            body = json.dumps(obs_numerics.debug_state()).encode()
             ctype = "application/json"
         elif self.path.startswith("/debug/requests"):
             from ..obs import live_flight_recorders

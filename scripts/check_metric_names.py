@@ -63,17 +63,22 @@ NAMESPACE = "dl4j_"
 # Every label NAME any instrumentation site registers. Extending this
 # is a deliberate act: each new label multiplies time series, and an
 # unbounded one (request id, trace id) melts the registry.
-ALLOWED_LABELS = {"component", "config", "direction", "layer", "level",
-                  "reason", "replica", "stat", "unit"}
-# per-prefix restriction (ISSUE 12): the memory/compile plane may label
-# ONLY by component and replica — component names are a small fixed
-# vocabulary (obs.memory.KNOWN_COMPONENTS / sentinel names), never
-# per-request identity. A dl4j_mem_* gauge with a `reason` label is a
-# design smell this catches before it ships.
+ALLOWED_LABELS = {"component", "config", "direction", "kind", "layer",
+                  "level", "reason", "replica", "stat", "unit"}
+# per-prefix restriction (ISSUE 12/13): each observability plane may
+# label ONLY from its own small fixed vocabulary — component names,
+# stat kinds and probe-pair kinds are bounded sets, never per-request
+# identity. A dl4j_mem_* gauge with a `reason` label (or a
+# dl4j_fidelity_* gauge labeled by layer AND reason) is a design smell
+# this catches before it ships.
 PLANE_LABELS = {
     "dl4j_mem_": {"component", "replica"},
     "dl4j_kv_": {"component", "replica"},
     "dl4j_compile_": {"component", "replica"},
+    # numerics & fidelity plane (ISSUE 13): layer/kind/replica only
+    "dl4j_num_": {"kind", "layer", "replica"},
+    "dl4j_fidelity_": {"kind", "layer", "replica"},
+    "dl4j_replica_": {"replica"},
 }
 # label names that smell like per-request/per-trace identity — never
 # allowed even if someone adds them to the allowlist above by mistake

@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
-# Quick gate (ISSUE 7 + 8 + 10 + 11 + 12): metric-name/label + doc
-# lint, then the telemetry-plane, roofline-floor, elastic-scaleout,
-# serving-plane, SLO-plane, and memory/compile-plane fast suites. One
-# command, <3 min on CPU; run before touching instrumentation, bench
-# schema, docs examples, the scaleout plane, the serving
-# engine/scheduler, the SLO/flight-recorder plane, or the memory
-# census / retrace sentinel.
+# Quick gate (ISSUE 7 + 8 + 10 + 11 + 12 + 13): metric-name/label +
+# doc lint, then the telemetry-plane, roofline-floor,
+# elastic-scaleout, serving-plane, SLO-plane, memory/compile-plane,
+# and numerics/fidelity-plane fast suites. One command, <3 min on CPU;
+# run before touching instrumentation, bench schema, docs examples,
+# the scaleout plane, the serving engine/scheduler, the
+# SLO/flight-recorder plane, the memory census / retrace sentinel, or
+# the numerics sentinel / drift audit / fidelity probes.
 #
 #   bash scripts/ci_quick.sh
 #
@@ -17,10 +18,10 @@ cd "$(dirname "$0")/.."
 echo "== metric-name + doc lint =="
 python scripts/check_metric_names.py
 
-echo "== obs + floors + scaleout-fast + serving + slo + memplane suites =="
+echo "== obs + floors + scaleout-fast + serving + slo + memplane + numerics suites =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
     tests/test_scaleout_fast.py tests/test_serving.py tests/test_slo.py \
-    tests/test_memplane.py \
+    tests/test_memplane.py tests/test_numerics.py \
     -q -m 'not slow' -p no:cacheprovider -p no:randomly
 
 echo "ci_quick: all green"

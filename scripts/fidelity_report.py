@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Render a logit-fidelity table and gate on it (ISSUE 13 tooling —
+the offline half of the ``dl4j_fidelity_*`` gauges).
+
+Accepts either input shape:
+
+- ``bench_secondary.json`` — every inference row's embedded
+  ``fidelity`` block (flash_vs_xla / bf16_vs_fp32 pairs beside the
+  floor/slo/memory evidence);
+- a JSONL stream of fidelity reports (``kind`` + max_abs_err / kl_* /
+  topk_agreement / greedy_* fields) — e.g. a flight-recorder dump
+  carrying ``kind: "fidelity"`` records, or reports written by a probe
+  sweep. Torn trailing lines are tolerated (the ``load_spans``
+  discipline).
+
+The table is the acceptance surface for ROADMAP item 3: an int8-KV or
+spec-decode candidate lands with its probe report, and the ``--max-kl``
+gate (exit 1 when any pair's kl_max exceeds the budget) makes "did we
+change the model?" a CI verdict instead of a review argument.
+
+    python scripts/fidelity_report.py bench_secondary.json
+    python scripts/fidelity_report.py reports.jsonl --max-kl 1e-3
+    python scripts/fidelity_report.py bench_secondary.json --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+_FIELDS = ("max_abs_err", "mean_abs_err", "kl_mean", "kl_max",
+           "topk_agreement", "greedy_match_frac", "greedy_prefix_len")
+
+
+def _is_report(d) -> bool:
+    return isinstance(d, dict) and "kind" in d and any(
+        f in d for f in _FIELDS)
+
+
+def load_reports(path) -> list:
+    """Fidelity reports from a bench artifact (embedded ``fidelity``
+    blocks, labeled row/pair) or a JSONL of report dicts."""
+    text = Path(path).read_text()
+    out = []
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if _is_report(doc):              # a one-line JSONL is still JSON —
+        return [doc]                 # don't mistake it for a bench doc
+    if isinstance(doc, dict):        # bench_secondary.json shape
+        for section in ("inference",):
+            for row_name, row in (doc.get(section) or {}).items():
+                blk = row.get("fidelity") if isinstance(row, dict) \
+                    else None
+                if not isinstance(blk, dict):
+                    continue
+                if "na" in blk:
+                    # a FAILED probe is a finding, not a free pass:
+                    # surfaced in the table, and --max-kl fails on it
+                    # (the gate cannot vouch for an unmeasured row)
+                    out.append({"row": row_name, "kind": "(na)",
+                                "na": str(blk["na"])})
+                    continue
+                for pair, rep in blk.items():
+                    if isinstance(rep, dict) and any(f in rep
+                                                     for f in _FIELDS):
+                        out.append({"row": row_name, "kind": pair,
+                                    **rep})
+        return out
+    for line in text.splitlines():    # JSONL shape, torn-line tolerant
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if _is_report(rec):
+            out.append(rec)
+    return out
+
+
+def _fmt(v, digits=3):
+    if v is None:
+        return "-"
+    if isinstance(v, int):
+        return str(v)
+    return f"{float(v):.{digits}g}"
+
+
+def render(reports) -> str:
+    cols = ("row", "kind", "max_abs_err", "kl_mean", "kl_max",
+            "topk_agreement", "greedy_match_frac", "greedy_prefix_len")
+    heads = ("row", "pair", "max|Δlogit|", "KL mean", "KL max",
+             "top-k agree", "greedy match", "greedy prefix")
+    rows = [[_fmt(r.get(c)) if c not in ("row", "kind")
+             else str(r.get(c, "-")) for c in cols] for r in reports]
+    widths = [max(len(h), *(len(row[i]) for row in rows)) if rows
+              else len(h) for i, h in enumerate(heads)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(heads, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in rows]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="bench_secondary.json or a fidelity-"
+                                 "report JSONL")
+    ap.add_argument("--max-kl", type=float, default=None,
+                    help="exit 1 if any pair's kl_max exceeds this "
+                         "budget (nats)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the reports as strict JSON instead of "
+                         "the table")
+    args = ap.parse_args(argv)
+    reports = load_reports(args.path)
+    if args.json:
+        print(json.dumps(reports, indent=2))
+    else:
+        if not reports:
+            print("no fidelity reports found")
+        else:
+            print(render(reports))
+    rc = 0
+    if args.max_kl is not None:
+        judged = 0
+        for r in reports:
+            if "na" in r:
+                print(f"FIDELITY GATE: {r.get('row', '?')} probe "
+                      f"FAILED ({r['na'][:120]}) — an unmeasured row "
+                      "cannot pass the gate", file=sys.stderr)
+                rc = 1
+                continue
+            kl = r.get("kl_max")
+            if kl is None:
+                continue
+            judged += 1
+            if float(kl) > args.max_kl:
+                print(f"FIDELITY GATE: {r.get('row', '?')}/"
+                      f"{r.get('kind', '?')} kl_max {float(kl):.3g} > "
+                      f"budget {args.max_kl:.3g}", file=sys.stderr)
+                rc = 1
+        if rc == 0 and judged:
+            print(f"fidelity gate: {judged} pair(s) within "
+                  f"kl_max <= {args.max_kl:.3g}")
+        elif rc == 0:
+            print("fidelity gate: no reports to judge — treating as "
+                  "pass (nothing claimed fidelity)", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -210,19 +210,30 @@ def test_bert_fused_mlm_loss_matches_naive():
                                   fused=False))
     got = float(tfm.bert_mlm_loss(params, cfg, ids, ids, weights,
                                   fused=True))
-    # tolerance is RELATIVE to the loss magnitude: the chunked path
-    # reassociates the f32 logsumexp/weighted-mean sums, so the
-    # accumulation-order error scales with the loss (~1e-4 relative on
-    # XLA:CPU; the old 2e-4 absolute bound was calibrated on a smaller
-    # loss and failed at 5.3 nats with a 5.6e-4 absolute delta)
-    assert abs(ref - got) < 2e-4 * max(1.0, abs(ref)), (ref, got)
+    # FidelityProbe-measured bounds (ISSUE 13): the tolerance is a
+    # RECORDED measurement × an explicit margin, not a magic constant.
+    # The chunked path reassociates the f32 logsumexp/weighted-mean
+    # sums, so the accumulation-order error scales with the loss.
+    from deeplearning4j_tpu.obs import fidelity
+    LOSS_BOUND = fidelity.MeasuredBound(
+        measured_abs=0.0, measured_rel=1.06e-4, margin=4,
+        source="XLA:CPU 2026-08-04, compare of fused/naive "
+               "bert_mlm_loss at 5.29 nats: |delta| 5.6e-4 = 1.06e-4 "
+               "relative (pure accumulation-order reassociation)")
+    fidelity.assert_trees_close(ref, got, LOSS_BOUND,
+                                what="fused-MLM loss")
     gr = jax.grad(lambda p: tfm.bert_mlm_loss(p, cfg, ids, ids, weights,
                                               fused=False))(params)
     gf = jax.grad(lambda p: tfm.bert_mlm_loss(p, cfg, ids, ids, weights,
                                               fused=True))(params)
-    jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-2), gr, gf)
+    GRAD_BOUND = fidelity.MeasuredBound(
+        measured_abs=3.9e-3, measured_rel=9.3e-3, margin=4,
+        source="XLA:CPU 2026-08-04, compare_trees(fused, naive) MLM "
+               "grads: max_abs_err 3.9e-3 at ref absmax 0.42 (rel "
+               "quoted at the absmax scale; near-zero elements are "
+               "covered by the abs term)")
+    fidelity.assert_trees_close(gr, gf, GRAD_BOUND,
+                                what="fused-MLM grads")
 
 
 def test_bert_remat_and_bf16_scores_equivalence():

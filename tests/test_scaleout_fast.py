@@ -296,6 +296,28 @@ def test_rejoin_ack_carries_current_mean_params():
     hub.result(timeout=5)
 
 
+def test_duplicate_worker_id_gets_distinct_assigned_identity():
+    """A live-duplicate dialer is uniquified by the hub at _register;
+    the REJOIN ack echoes the registered wid so the worker's drift
+    audit labels by hub-side identity instead of overwriting the
+    colliding worker's replica series."""
+    hub = ParamAveragingHub(n_workers=2, worker_timeout=2.0).start()
+    a = WorkerClient(hub.address, worker_id=3, timeout=5.0)
+    b = WorkerClient(hub.address, worker_id=3, timeout=5.0)
+    assert a.assigned_id == 3
+    assert b.assigned_id != 3          # uniquified, and the worker knows
+    r = {}
+    t = threading.Thread(
+        target=lambda: r.update(m=a.average(np.full(2, 1.0, np.float32))))
+    t.start()
+    mb = b.average(np.full(2, 3.0, np.float32))
+    t.join(timeout=10)
+    np.testing.assert_allclose(mb, np.full(2, 2.0))
+    for cl in (a, b):
+        cl.done()
+    hub.result(timeout=5)
+
+
 @pytest.mark.filterwarnings("ignore:scaleout. worker")
 def test_straggler_times_out_alone_round_closes_at_deadline():
     """Head-of-line fix: a healthy worker's round closes at the deadline
