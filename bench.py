@@ -948,14 +948,19 @@ def _mem_peak(pytree_total):
     return int(pytree_total), "pytree"
 
 
-def _mem_basic(params_tree, **kv_fields):
+def _mem_basic(params_tree, kv_pool_bytes=None, **kv_fields):
     """Memory block builder — the ONE place the row schema lives
     (peak/source/params_bytes core + optional kv_* fields), so the
-    decode, TTFT, and batch-1 rows can't drift apart. Never fatal."""
+    decode, TTFT, and batch-1 rows can't drift apart. For a paged pool
+    (ISSUE 14) ``kv_pool_bytes`` is the device's actual KV reservation
+    (allocated_bytes tracks MAPPED pages, which undercounts the pytree
+    footprint). Never fatal."""
     try:
         from deeplearning4j_tpu.obs import tree_bytes
         pb = tree_bytes(params_tree)
-        peak, src = _mem_peak(pb + kv_fields.get("kv_allocated_bytes", 0))
+        kv_dev = kv_pool_bytes if kv_pool_bytes is not None \
+            else kv_fields.get("kv_allocated_bytes", 0)
+        peak, src = _mem_peak(pb + (kv_dev or 0))
         return {"peak_bytes": peak, "source": src, "params_bytes": pb,
                 **kv_fields}
     except Exception as e:  # noqa: BLE001 — the row survives block-less
@@ -1031,7 +1036,7 @@ def _attach_fidelity(rec, eng):
 
 
 def _serve_blocks(eng, slots, n_requests=None, new_tokens=8,
-                  prompt_len=64):
+                  prompt_len=64, paged=False, concurrency_x=3):
     """(slo, memory) evidence from ONE real continuous-batching serve
     over the row's engine: submit a mixed-length wave through the
     scheduler with per-request ITL tracing + KV residency accounting
@@ -1039,16 +1044,32 @@ def _serve_blocks(eng, slots, n_requests=None, new_tokens=8,
     attribution (ISSUE 11 + 12). The warm-up request keeps compile time
     out of the steady-state verdict (the same discipline every timed
     row uses); prompt lengths step down across the wave so the
-    kv_waste_ratio is measured under genuinely mixed traffic — the
-    number that sizes the paged-KV PR. Never fatal — the row survives
-    block-less."""
+    kv_waste_ratio is measured under genuinely mixed traffic.
+
+    ``paged=True`` (ISSUE 14) serves the SAME wave shape through the
+    block-paged pool at the SAME KV byte budget as the dense baseline
+    (``slots × max_len`` rows re-cut into DEFAULT_PAGE_LEN pages) but
+    ``concurrency_x × slots`` decode lanes — the measured
+    ``peak_concurrent`` vs the dense slot count is the
+    concurrency-at-equal-bytes claim, and ``kv_waste_ratio`` drops from
+    the dense 0.96 to page-tail-only waste. Never fatal — the row
+    survives block-less."""
     import numpy as np
     from deeplearning4j_tpu.obs import SLOConfig, SLOTracker
-    from deeplearning4j_tpu.serving import ContinuousBatchingScheduler
+    from deeplearning4j_tpu.serving import (ContinuousBatchingScheduler,
+                                            DEFAULT_PAGE_LEN)
 
-    n_requests = n_requests or 2 * slots
+    if paged:
+        # equal KV byte budget: the dense pool's slots × max_len rows,
+        # re-cut into pages shared by concurrency_x× as many lanes
+        n_pages = slots * eng.max_len // DEFAULT_PAGE_LEN
+        sched = ContinuousBatchingScheduler(
+            eng, n_slots=slots * concurrency_x,
+            page_len=DEFAULT_PAGE_LEN, n_pages=n_pages)
+    else:
+        sched = ContinuousBatchingScheduler(eng, n_slots=slots)
+    n_requests = n_requests or 2 * sched.n_slots
     rng = np.random.default_rng(1)
-    sched = ContinuousBatchingScheduler(eng, n_slots=slots)
     warm = sched.submit(rng.integers(0, eng.cfg.vocab_size, (prompt_len,)),
                         max_new_tokens=2)
     sched.run_until_idle()
@@ -1067,12 +1088,24 @@ def _serve_blocks(eng, slots, n_requests=None, new_tokens=8,
     kv = sched.kv_report()
     mem = _mem_basic(
         eng.params,
-        kv_allocated_bytes=kv["allocated_bytes"],
+        kv_pool_bytes=kv["pool_bytes"] if paged else None,
+        kv_allocated_bytes=(kv["allocated_bytes_mean"] if paged
+                            else kv["allocated_bytes"]),
         kv_token_bytes=kv["token_bytes"],
         kv_waste_ratio=kv["waste_ratio_mean"],
         final_residency_mean=kv["final_residency_mean"],
         retraces_after_warm=sum(s["retraces_after_warm"]
                                 for s in eng.compile_report().values()))
+    if paged:
+        # the ISSUE 14 claim, measured: lanes actually served
+        # concurrently from the dense baseline's byte budget
+        mem["paged"] = {
+            **kv["paged"],
+            "pool_bytes": kv["pool_bytes"],
+            "dense_equiv_slots": slots,
+            "peak_concurrent": kv["peak_concurrent"],
+            "concurrency_x": round(kv["peak_concurrent"] / slots, 2),
+        }
     # HBM bytes the pool pays per token actually resident (mean over
     # the serve) — the serving-efficiency number paged KV and quantized
     # caches (ROADMAP items 1, 3) must push down
@@ -1082,6 +1115,131 @@ def _serve_blocks(eng, slots, n_requests=None, new_tokens=8,
         mem["bytes_per_resident_token"] = \
             round(mem["peak_bytes"] / res_tokens, 1) if res_tokens else None
     return _slo_compact(sched.slo.report()), mem
+
+
+def _chunked_admission_itl(eng, seq, dense_stall_ms=None, slots=8,
+                           baseline_sweeps=24, short_len=32,
+                           chunk_len=16):
+    """The ISSUE 14 ITL claim, measured: decode-sweep wall (= the
+    active requests' ITL) for a paged pool of ``slots`` short decoding
+    requests, with vs without a T=``seq`` prompt chunk-prefilling in.
+    Under chunked admission each step is one chunk + one sweep, so the
+    p99 must hold ≤2× the no-admission baseline — where the dense path
+    stalls every slot for the WHOLE prefill (``dense_stall_ms``: the
+    row's own TTFT median, the before number).
+
+    ``chunk_len`` is the ITL-bound side of the knob trade: one chunk's
+    cost must stay well under one decode sweep's (measured on the CPU
+    capture: a chunk has a ~0.8 s floor at ctx=4096 — the full-width
+    page gather — plus ~10 ms/token, so 128-token chunks cost ~2.5
+    2-slot sweeps → 3.5× p99; 16-token chunks ride just above the
+    floor). The TTFT-amortization side picks larger chunks — that is
+    the ``serving_prefill_chunk`` autotune record's verdict; this
+    block records both sides. ``slots`` sizes the
+    baseline pool the admission disturbs: the sweep cost scales with
+    occupancy while the chunk cost is constant, so the claim is judged
+    at a realistically busy pool (the decode row's 8 lanes), not an
+    idle one a single chunk would dominate."""
+    import numpy as np
+    from deeplearning4j_tpu.serving import (ContinuousBatchingScheduler,
+                                            DEFAULT_PAGE_LEN,
+                                            GenerationEngine)
+
+    if chunk_len != eng.chunk_len:
+        # chunk size is engine geometry (it fixes the chunk buckets):
+        # a dedicated engine over the SAME params serves the experiment
+        eng = GenerationEngine(eng.cfg, eng.params, max_len=eng.max_len,
+                               prefill_chunk=chunk_len)
+    # the admission prompt: T=seq less the decode budget that keeps it
+    # resident through the steady window (stays inside max_len)
+    long_len = min(seq, eng.max_len - baseline_sweeps - 1)
+    chunks = -(-long_len // eng.chunk_len)
+    rng = np.random.default_rng(3)
+    budget = 2 * baseline_sweeps + chunks + 12
+    # pages for the full working set: the long admission + every short
+    # request's whole prompt+budget — page PRESSURE preemptions would
+    # contaminate the ITL measurement
+    per_short = -(-(short_len + budget) // DEFAULT_PAGE_LEN)
+    n_pages = -(-seq // DEFAULT_PAGE_LEN) + slots * per_short + 4
+    sched = ContinuousBatchingScheduler(eng, n_slots=slots + 1,
+                                        page_len=DEFAULT_PAGE_LEN,
+                                        n_pages=n_pages)
+    # warm every shape this experiment touches: a chunk_len-long prompt
+    # (the long admission's bucket), a short_len prompt, decode, sample
+    for warm_len in (eng.chunk_len, short_len):
+        w = sched.submit(rng.integers(0, eng.cfg.vocab_size, (warm_len,)),
+                         max_new_tokens=2)
+        sched.run_until_idle()
+        w.result(timeout=600)
+    shorts = [sched.submit(
+        rng.integers(0, eng.cfg.vocab_size, (short_len,)),
+        max_new_tokens=budget) for _ in range(slots)]
+    for _ in range(2):
+        sched.step()                    # admit; exclude ramp-up steps
+    base = []
+    for _ in range(baseline_sweeps):
+        t0 = time.perf_counter()
+        sched.step()
+        base.append(time.perf_counter() - t0)
+    # budget > 1 keeps the long request DECODING (pages mapped) after
+    # its prefill, so the steady window below sees the same working set
+    long_fut = sched.submit(
+        rng.integers(0, eng.cfg.vocab_size, (long_len,)),
+        max_new_tokens=baseline_sweeps + 2)
+
+    def _prefilling():
+        return any(r is not None and r.pending is not None
+                   for r in sched.slots)
+
+    adm = []
+    while len(adm) < 4 * chunks + 8:
+        t0 = time.perf_counter()
+        sched.step()       # first iteration admits the long request
+        adm.append(time.perf_counter() - t0)
+        if not _prefilling():
+            break
+    # steady-state baseline at EQUAL residency: the T=seq context is
+    # resident and decoding, no admission in progress — sweeps here pay
+    # the same KV bytes the admission-window sweeps paid, so the ratio
+    # isolates the admission MECHANICS (the chunk interleave) from the
+    # permanent cost of holding seq more resident tokens, which any
+    # admission policy pays
+    steady = []
+    for _ in range(baseline_sweeps):
+        t0 = time.perf_counter()
+        sched.step()
+        steady.append(time.perf_counter() - t0)
+    sched.run_until_idle()
+    for f in shorts:
+        f.result(timeout=600)
+    long_res = long_fut.result(timeout=600)
+    p99 = lambda xs: sorted(xs)[min(len(xs) - 1,  # noqa: E731
+                                    int(round(0.99 * (len(xs) - 1))))]
+    base_p99, adm_p99, steady_p99 = p99(base), p99(adm), p99(steady)
+    ratio_resident = round(adm_p99 / steady_p99, 3) if steady_p99 else None
+    ratio_idle = round(adm_p99 / base_p99, 3) if base_p99 else None
+    return {
+        "page_len": DEFAULT_PAGE_LEN, "chunk_len": eng.chunk_len,
+        "chunks": chunks, "long_prompt_tokens": long_len,
+        "decode_slots": slots,
+        "baseline_itl_p99_ms": round(steady_p99 * 1e3, 2),
+        "pre_admission_itl_p99_ms": round(base_p99 * 1e3, 2),
+        "admission_itl_p99_ms": round(adm_p99 * 1e3, 2),
+        "admission_over_baseline": ratio_resident,
+        "admission_over_pre_admission": ratio_idle,
+        "met_2x": ratio_resident is not None and ratio_resident <= 2.0,
+        "dense_admission_stall_ms": dense_stall_ms,
+        "long_ttft_ms": round(long_res.ttft_s * 1e3, 1),
+        "note": "per-sweep wall of the decoding pool while the T="
+                f"{seq} prompt chunks in. Baseline = steady-state "
+                "sweeps at EQUAL residency (the prompt resident and "
+                "decoding, no admission running): paged KV reads "
+                "scale with resident bytes, so pre-admission sweeps "
+                "(pre_admission_itl_p99_ms) are structurally cheaper "
+                "in a way any admission policy would forfeit. Dense "
+                "admission stalls every slot for the whole prefill "
+                "(the row's TTFT median)",
+    }
 
 
 def bench_inference_decode(batch, steps):
@@ -1119,12 +1277,16 @@ def bench_inference_decode(batch, steps):
         slots=batch, prefill_tokens=64,
         note="one continuous-batching decode sweep = one token per slot; "
              "scheduler occupancy metrics: dl4j_serving_*")
-    # the SLO + memory verdicts beside the floor block (ISSUE 11 + 12):
-    # goodput at target AND kv waste from ONE real mixed-length
-    # scheduler serve — goodput is what the decode-slot sweep
-    # optimizes, kv_waste_ratio is what sizes the paged-KV PR
+    # the SLO + memory verdicts beside the floor block (ISSUE 11 + 12 +
+    # 14): goodput at target AND kv waste from ONE real mixed-length
+    # scheduler serve — now through the block-paged pool at the dense
+    # baseline's byte budget (slots × max_len re-cut into pages,
+    # concurrency_x× the lanes): memory.paged carries the measured
+    # peak_concurrent / concurrency_x, and kv_waste_ratio is page-tail
+    # waste, not the dense 0.96
     try:
-        rec["slo"], rec["memory"] = _serve_blocks(eng, slots=batch)
+        rec["slo"], rec["memory"] = _serve_blocks(eng, slots=batch,
+                                                  paged=True)
     except Exception as e:  # noqa: BLE001 — the row survives block-less
         rec["slo"] = {"na": f"slo serve failed: "
                             f"{type(e).__name__}: {e}"[:300]}
@@ -1136,11 +1298,13 @@ def bench_inference_decode(batch, steps):
     return _flag_on_chip(rec)
 
 
-def _ttft_row(seq, reps):
+def _ttft_row(seq, reps, chunked_admission=False):
     """Time-to-first-token at a `seq`-token prompt: wall-clock of one
     jitted prefill + greedy sample + host fetch (compile excluded,
     median of `reps`). This is the latency a request pays before its
-    decode slot starts streaming."""
+    decode slot starts streaming. ``chunked_admission`` additionally
+    measures the ISSUE 14 interleave claim: a paged pool's decode ITL
+    p99 while this row's prompt chunk-prefills in, vs no admission."""
     import jax.numpy as jnp
     import numpy as np
     import statistics
@@ -1190,6 +1354,16 @@ def _ttft_row(seq, reps):
     except Exception as e:  # noqa: BLE001 — the row survives SLO-less
         rec["slo"] = {"na": f"slo derivation failed: "
                             f"{type(e).__name__}: {e}"[:300]}
+    if chunked_admission:
+        # the chunked-prefill ITL verdict (ISSUE 14) rides this row's
+        # slo block: its prompt length is the admission under test
+        try:
+            rec["slo"]["chunked_admission"] = _chunked_admission_itl(
+                eng, seq, dense_stall_ms=rec["value"])
+        except Exception as e:  # noqa: BLE001 — row survives block-less
+            rec["slo"]["chunked_admission"] = {
+                "na": f"admission experiment failed: "
+                      f"{type(e).__name__}: {e}"[:300]}
     # memory attribution for the prefill path (ISSUE 12): one slot
     # filled to its prompt length — waste is the tail of max_len the
     # fixed slot preallocates past the prompt
@@ -1216,7 +1390,9 @@ def bench_inference_ttft_1024(batch, steps):
 
 
 def bench_inference_ttft_4096(batch, steps):
-    return _ttft_row(4096, reps=max(steps, 2))
+    # the T=4096 admission is the ISSUE 14 worst case: measure the
+    # chunked-prefill ITL interleave beside the raw prefill latency
+    return _ttft_row(4096, reps=max(steps, 2), chunked_admission=True)
 
 
 def _latency_sweep(pi, make_batch, iters, batches=(1, 8, 32)):

@@ -622,13 +622,25 @@ class DriftAuditor:
     def _m(self):
         # cached (the sentinel-_m discipline): record() runs once per
         # replica per round — 4 registry get-or-creates each would add
-        # up on a long scaleout job
-        if self._m_cache is not None:
-            return self._m_cache
+        # up on a long scaleout job. The cache revalidates against the
+        # registry: the process-wide auditor outlives a test's
+        # registry.reset(), and stale handles would keep publishing
+        # into gauges the registry no longer serves.
         reg = self._registry
         if reg is None:
             from . import get_registry
             reg = get_registry()
+        if self._m_cache is not None:
+            try:
+                # identity, not name: after a registry.reset() someone
+                # else may have re-registered the same NAME — publishing
+                # into our orphaned pre-reset handle would still vanish
+                # from the exporter
+                if reg.get("dl4j_replica_checksum") \
+                        is self._m_cache["checksum"]:
+                    return self._m_cache
+            except Exception:  # noqa: BLE001 — rebuild on any doubt
+                pass
         self._m_cache = {
             "checksum": reg.gauge(
                 "dl4j_replica_checksum",

@@ -25,6 +25,17 @@ and span trees), a crash :class:`~..obs.FlightRecorder` black box
 ``/debug/requests`` on the UI server), and rolling goodput/burn-rate
 accounting via ``slo=SLOConfig(...)`` (re-exported here).
 
+The paged serving plane (ISSUE 14) rides the same three layers: a
+block-paged KV pool (``init_paged_cache`` + host-side
+:class:`~.kvcache.PageTable`) that allocates MAPPED pages instead of
+``max_len`` rows per slot, chunked prefill
+(``GenerationEngine.prefill_chunk``) that the scheduler interleaves
+with decode sweeps, and page-availability-based admission
+(``ContinuousBatchingScheduler(..., page_len=16)``). Knob defaults are
+measured, not guessed: ``serving.tune`` sweeps
+page-len/prefill-chunk/decode-slots into the persistent autotune cost
+records.
+
 Quickstart: ``zoo.transformer.generate(params, cfg, ids, 32)`` for a
 one-shot, or README "Serving quickstart" for the scheduler loop.
 """
@@ -33,15 +44,18 @@ from ..obs import SLOConfig, SLOTracker  # noqa: F401  (serving SLO plane)
 from .adapter import FunctionalInferenceModel  # noqa: F401
 from .engine import (DEFAULT_PREFILL_BUCKETS, GenerationEngine,  # noqa: F401
                      sample_tokens)
-from .kvcache import (cache_len, cache_nbytes, cache_slots,  # noqa: F401
-                      init_cache, token_nbytes)
+from .kvcache import (DEFAULT_PAGE_LEN, DEFAULT_PREFILL_CHUNK,  # noqa: F401
+                      PageTable, cache_len, cache_nbytes, cache_slots,
+                      init_cache, init_paged_cache, is_paged, page_nbytes,
+                      token_nbytes)
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         GenerationResult, ServingRequest)
 
 __all__ = [
-    "ContinuousBatchingScheduler", "DEFAULT_PREFILL_BUCKETS",
+    "ContinuousBatchingScheduler", "DEFAULT_PAGE_LEN",
+    "DEFAULT_PREFILL_BUCKETS", "DEFAULT_PREFILL_CHUNK",
     "FunctionalInferenceModel", "GenerationEngine", "GenerationResult",
-    "SLOConfig", "SLOTracker", "ServingRequest", "cache_len",
-    "cache_nbytes", "cache_slots", "init_cache", "sample_tokens",
-    "token_nbytes",
+    "PageTable", "SLOConfig", "SLOTracker", "ServingRequest", "cache_len",
+    "cache_nbytes", "cache_slots", "init_cache", "init_paged_cache",
+    "is_paged", "page_nbytes", "sample_tokens", "token_nbytes",
 ]

@@ -102,7 +102,8 @@ class GenerationEngine:
     """
 
     def __init__(self, cfg, params, *, max_len: Optional[int] = None,
-                 prefill_buckets=DEFAULT_PREFILL_BUCKETS):
+                 prefill_buckets=DEFAULT_PREFILL_BUCKETS,
+                 prefill_chunk: Optional[int] = None):
         if getattr(cfg, "n_experts", 0):
             raise NotImplementedError(
                 "GenerationEngine is dense-only: MoE expert dispatch has "
@@ -122,6 +123,17 @@ class GenerationEngine:
                 f"{cfg.max_seq}: no position rows past the table")
         self.prefill_buckets = tuple(sorted(
             {min(b, self.max_len) for b in prefill_buckets} | {self.max_len}))
+        # chunked prefill (ISSUE 14): one chunk never exceeds this many
+        # prompt tokens; chunks pad to the bucket subset at or below it
+        # (≤ 1 compile per chunk bucket — the retrace contract)
+        self.chunk_len = int(min(
+            kvcache.DEFAULT_PREFILL_CHUNK if prefill_chunk is None
+            else prefill_chunk, self.max_len))
+        if self.chunk_len < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.chunk_buckets = tuple(sorted(
+            {min(b, self.chunk_len) for b in self.prefill_buckets}
+            | {self.chunk_len}))
         # jit once; cache (argnum 1 after params) donated on every path.
         # Each entry point is wrapped in a CompileSentinel (ISSUE 12):
         # compiles are counted/timed per abstract signature, and after
@@ -139,12 +151,26 @@ class GenerationEngine:
                                     donate_argnums=(1,)))
         self._sample = CompileSentinel("sample_tokens",
                                        jax.jit(sample_tokens))
+        # paged entry points (ISSUE 14): same donation discipline — the
+        # page pool is updated in place for the life of the cache
+        self._decode_paged = CompileSentinel(
+            "decode_paged", jax.jit(self._decode_paged_raw,
+                                    donate_argnums=(1,)))
+        self._prefill_chunk = CompileSentinel(
+            "prefill_chunk", jax.jit(self._prefill_chunk_raw,
+                                     donate_argnums=(1,)))
         self.sentinels = {s.name: s for s in (
-            self._decode, self._prefill, self._prefill_slot, self._sample)}
+            self._decode, self._prefill, self._prefill_slot, self._sample,
+            self._decode_paged, self._prefill_chunk)}
 
     # ------------------------------------------------------------ cache
     def init_cache(self, n_slots: int):
         return kvcache.init_cache(self.cfg, n_slots, self.max_len)
+
+    def init_paged_cache(self, n_slots: int, n_pages: int,
+                         page_len: int = kvcache.DEFAULT_PAGE_LEN):
+        return kvcache.init_paged_cache(self.cfg, n_slots, n_pages,
+                                        page_len, self.max_len)
 
     def refresh(self, params):
         """Swap in new params (e.g. after more training). Compiled fns
@@ -220,24 +246,48 @@ class GenerationEngine:
         cfg = self.cfg
         pos = cache["pos"]
         b = tokens.shape[0]
-        h_, dh = cfg.n_heads, cfg.head_dim
+        x = self._embed_rows(params, tokens, pos)
+        x, k_new, v_new = self._blocks_with_cache(
+            params, cache, x,
+            write=lambda kl, rows: kl.at[jnp.arange(b), pos].set(rows),
+            attend=lambda q, kl, vl: _cached_attention(cfg, q, kl, vl,
+                                                       pos))
+        logits = tfm.head_logits_rows(params, cfg, x)
+        return logits, {"k": k_new, "v": v_new, "pos": pos + 1}
+
+    def _embed_rows(self, params, tokens, pos):
+        """Embed one token row per sequence at its own position —
+        the shared prologue of every cached entry point."""
+        cfg = self.cfg
         x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
         x = x * math.sqrt(cfg.d_model)
         pos_rows = jnp.take(params["pos_embed"],
                             jnp.clip(pos, 0, cfg.max_seq - 1), axis=0)
-        x = x + pos_rows.astype(cfg.dtype)                     # (B, d)
+        return x + pos_rows.astype(cfg.dtype)
+
+    def _blocks_with_cache(self, params, cache, x, *, write, attend):
+        """The ONE transformer block body every cached entry point
+        (dense decode, paged decode, chunked prefill) runs — they
+        differ ONLY in how k/v rows land in the layer cache
+        (``write(layer_cache, rows) -> layer_cache``) and how the
+        rows' queries see the cache (``attend(q, kl, vl) ->
+        (rows, H, Dh)``). Keeping the norm/qkv/residual/MLP math in
+        one place is what makes the paged-vs-dense bitwise-equivalence
+        contract a structural property, not a maintenance promise.
+        Returns (block-stack output rows, new k, new v)."""
+        cfg = self.cfg
+        n = x.shape[0]
+        h_, dh = cfg.n_heads, cfg.head_dim
 
         def block(x, xs):
             blk, kl, vl = xs
             hh = tfm._rmsnorm(x, blk["ln1"])
-            qkv = hh @ blk["wqkv"].astype(hh.dtype)            # (B, 3h)
+            qkv = hh @ blk["wqkv"].astype(hh.dtype)            # (n, 3h)
             q, k, v = jnp.split(qkv, 3, axis=-1)
-            q = q.reshape(b, h_, dh)
-            kl = kl.at[jnp.arange(b), pos].set(
-                k.reshape(b, h_, dh).astype(kl.dtype))
-            vl = vl.at[jnp.arange(b), pos].set(
-                v.reshape(b, h_, dh).astype(vl.dtype))
-            a = _cached_attention(cfg, q, kl, vl, pos).reshape(b, h_ * dh)
+            q = q.reshape(n, h_, dh)
+            kl = write(kl, k.reshape(n, h_, dh).astype(kl.dtype))
+            vl = write(vl, v.reshape(n, h_, dh).astype(vl.dtype))
+            a = attend(q, kl, vl).reshape(n, h_ * dh)
             x = x + a @ blk["wo"].astype(hh.dtype)
             h2 = tfm._rmsnorm(x, blk["ln2"])
             m = jax.nn.gelu(h2 @ blk["w_in"].astype(h2.dtype)) \
@@ -247,13 +297,118 @@ class GenerationEngine:
         x, (k_new, v_new) = lax.scan(block, x,
                                      (params["blocks"], cache["k"],
                                       cache["v"]))
+        return x, k_new, v_new
+
+    def _decode_paged_raw(self, params, cache, tokens):
+        """One decode step over a block-paged pool (ISSUE 14): same
+        contract as ``_decode_raw`` — tokens (B,) → (logits (B, V) f32,
+        advanced cache) — but each slot's k/v rows live in the pages its
+        table maps. The write scatters the token's k/v into
+        (page, offset); attention gathers the slot's fixed-width table
+        row (pads to the pool sentinel, so the gather SHAPE never
+        changes — page-table growth is data, not a retrace). A slot
+        whose write position falls on an unmapped/sentinel entry drops
+        the write (scatter OOB is a no-op — same contract as the dense
+        path's past-capacity drop); keeping every position mapped is
+        the scheduler's page-accounting job."""
+        cfg = self.cfg
+        pos = cache["pos"]
+        table = cache["pages"]                       # (B, P) int32
+        b = tokens.shape[0]
+        h_, dh = cfg.n_heads, cfg.head_dim
+        npg, plen = cache["k"].shape[1], cache["k"].shape[2]
+        per_slot = table.shape[1]
+        # write coordinates: logical page -> pool page via the table;
+        # past-capacity or unmapped -> sentinel npg (scatter drops)
+        lp = pos // plen                              # (B,)
+        ent = table[jnp.arange(b), jnp.clip(lp, 0, per_slot - 1)]
+        ent = jnp.where(lp < per_slot, ent, npg)
+        off = pos % plen
+        x = self._embed_rows(params, tokens, pos)
+
+        def attend(q, kl, vl):
+            # gather each slot's pages: sentinel entries clamp to the
+            # last pool page — garbage the pos mask never exposes
+            kg = kl[table].reshape(b, per_slot * plen, h_, dh)
+            vg = vl[table].reshape(b, per_slot * plen, h_, dh)
+            return _cached_attention(cfg, q, kg, vg, pos)
+
+        x, k_new, v_new = self._blocks_with_cache(
+            params, cache, x,
+            write=lambda kl, rows: kl.at[ent, off].set(rows),
+            attend=attend)
         logits = tfm.head_logits_rows(params, cfg, x)
-        return logits, {"k": k_new, "v": v_new, "pos": pos + 1}
+        return logits, {"k": k_new, "v": v_new, "pos": pos + 1,
+                        "pages": table}
+
+    def _prefill_chunk_raw(self, params, cache, tokens, start, length,
+                           slot):
+        """One chunked-prefill dispatch (ISSUE 14): tokens (1, C_bucket)
+        — the slot's context rows ``[start, start+length)`` padded to a
+        chunk bucket — written into the slot's mapped pages, with the
+        chunk's queries attending causally against everything the slot
+        holds (earlier chunks' pages + this chunk's own rows). Returns
+        (last-valid-row logits (V,), cache); the scheduler uses the
+        logits only on the FINAL chunk (they are the TTFT sample).
+        Rows past ``length`` are padding: their writes drop (sentinel
+        page) and their outputs are garbage nothing reads."""
+        cfg = self.cfg
+        table = cache["pages"]
+        npg, plen = cache["k"].shape[1], cache["k"].shape[2]
+        per_slot = table.shape[1]
+        h_, dh = cfg.n_heads, cfg.head_dim
+        tok = tokens[0]                                  # (C,)
+        c = tok.shape[0]
+        gpos = start + jnp.arange(c, dtype=jnp.int32)    # global positions
+        valid = jnp.arange(c) < length
+        row = table[slot]                                # (P,)
+        lp = gpos // plen
+        ent = row[jnp.clip(lp, 0, per_slot - 1)]
+        ent = jnp.where(valid & (lp < per_slot), ent, npg)
+        off = gpos % plen
+        # positions via _embed_rows' clipped take, NOT a dynamic
+        # slice: a padded tail past max_seq must clamp row-wise
+        # (garbage rows) without shifting the VALID rows' positions
+        # the way a clamped dynamic_slice start would
+        x = self._embed_rows(params, tok, gpos)          # (C, d)
+        s_len = per_slot * plen
+        mask = jnp.arange(s_len)[None, :] <= gpos[:, None]   # (C, S)
+
+        def attend(q, kl, vl):
+            # the chunk's C queries attend causally over the ONE
+            # slot's gathered pages (earlier chunks + own rows) — the
+            # multi-row analogue of the decode paths' single-row
+            # _cached_attention
+            kg = kl[row].reshape(s_len, h_, dh)
+            vg = vl[row].reshape(s_len, h_, dh)
+            scale = 1.0 / math.sqrt(dh)
+            scores = jnp.einsum("qhd,shd->qhs",
+                                (q.astype(jnp.float32) * scale),
+                                kg.astype(jnp.float32))
+            scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1)
+            return jnp.einsum("qhs,shd->qhd", probs,
+                              vg.astype(jnp.float32)).astype(cfg.dtype)
+
+        x, k_new, v_new = self._blocks_with_cache(
+            params, cache, x,
+            write=lambda kl, rows: kl.at[ent, off].set(rows),
+            attend=attend)
+        x_last = x[jnp.clip(length - 1, 0, c - 1)]
+        logits = tfm.head_logits_rows(params, cfg, x_last[None])[0]
+        pos = cache["pos"].at[slot].set((start + length).astype(jnp.int32))
+        return logits, {"k": k_new, "v": v_new, "pos": pos,
+                        "pages": table}
 
     # ------------------------------------------------------- host API
     def prefill(self, cache, tokens, lengths=None):
         """Prefill the whole pool. ``tokens`` (B, T) with B == cache
         slots; ``lengths`` (B,) defaults to the full T per row."""
+        if kvcache.is_paged(cache):
+            raise ValueError(
+                "prefill is the dense-pool path; a paged cache admits "
+                "via prefill_chunk (its rows live in mapped pages, not "
+                "per-slot lanes)")
         tokens = jnp.asarray(tokens, jnp.int32)
         if tokens.ndim != 2:
             raise ValueError(f"prefill wants (B, T) token ids, got shape "
@@ -276,6 +431,11 @@ class GenerationEngine:
         """Admit one 1-D prompt into ``slot``; pads to the next prefill
         bucket so mixed lengths reuse a few compiled kernels. Returns
         (last logits (V,), cache)."""
+        if kvcache.is_paged(cache):
+            raise ValueError(
+                "prefill_slot is the dense-pool admission path; a paged "
+                "cache admits via prefill_chunk (writing by slot index "
+                "would land in an arbitrary pool page)")
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n = tokens.shape[0]
         if n < 1:
@@ -291,9 +451,42 @@ class GenerationEngine:
 
     def decode_step(self, cache, tokens):
         """One token for every slot: tokens (B,) → (logits (B, V), cache).
-        The passed cache is DONATED — keep only the returned one."""
-        return self._decode(self.params, cache,
-                            jnp.asarray(tokens, jnp.int32).reshape(-1))
+        Dispatches on the cache layout — dense slots or the block-paged
+        pool (ISSUE 14) — behind one call site; the passed cache is
+        DONATED either way, keep only the returned one."""
+        fn = self._decode_paged if kvcache.is_paged(cache) else self._decode
+        return fn(self.params, cache,
+                  jnp.asarray(tokens, jnp.int32).reshape(-1))
+
+    def prefill_chunk(self, cache, tokens, slot: int, start: int = 0):
+        """Write one chunk of a slot's context into its mapped pages
+        (paged cache only): ``tokens`` are the context rows
+        ``[start, start+len)``, at most ``prefill_chunk`` of them, and
+        every position up to ``start+len`` must already be mapped by
+        the slot's page table (the scheduler's job); ``chunk_len`` caps
+        one chunk's tokens. Pads to a chunk
+        bucket (≤ 1 compile per bucket). Returns (last logits (V,),
+        cache) — the logits matter only on the final chunk."""
+        if not kvcache.is_paged(cache):
+            raise ValueError("prefill_chunk needs a paged cache "
+                             "(init_paged_cache); dense pools admit via "
+                             "prefill_slot")
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = tokens.shape[0]
+        if n < 1:
+            raise ValueError("empty chunk")
+        if n > self.chunk_len:
+            raise ValueError(f"chunk of {n} tokens exceeds chunk_len="
+                             f"{self.chunk_len}")
+        if start + n > self.max_len:
+            raise ValueError(f"chunk ends at {start + n}, past cache "
+                             f"capacity max_len={self.max_len}")
+        bucket = next(b for b in self.chunk_buckets if b >= n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = tokens
+        return self._prefill_chunk(self.params, cache, jnp.asarray(padded),
+                                   jnp.int32(start), jnp.int32(n),
+                                   jnp.int32(slot))
 
     def sample(self, key, logits, temperature=0.0, top_k=0):
         """Next tokens from (B, V) logits; scalar knobs broadcast to the

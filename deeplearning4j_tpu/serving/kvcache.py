@@ -1,8 +1,11 @@
-"""Preallocated per-layer KV cache for the transformer serving plane.
+"""Per-layer KV cache for the transformer serving plane — dense slots
+and block-paged pages (ISSUE 14).
 
-One cache serves one fixed pool of decode SLOTS. Layout mirrors the
-model's stacked-block parameterization so a ``lax.scan`` over layers can
-consume and re-emit the cache layer-by-layer:
+**Dense layout** (the original μ-cuDNN static slotting): one cache
+serves one fixed pool of decode SLOTS, each preallocated to ``max_len``
+rows. Layout mirrors the model's stacked-block parameterization so a
+``lax.scan`` over layers can consume and re-emit the cache
+layer-by-layer:
 
     {"k":   (L, n_slots, max_len, H, Dh)   compute dtype,
      "v":   (L, n_slots, max_len, H, Dh)   compute dtype,
@@ -15,15 +18,55 @@ a plain pytree: the engine's jitted ``decode_step`` donates it, so the
 HBM buffers are updated in place across the whole decode loop and the
 allocation cost is paid once per pool, not per token.
 
-Fixed ``max_len`` by design (μ-cuDNN-style static slotting): admission
-slices variable-length traffic into fixed-capacity slots instead of
-reshaping device buffers per request — the scheduler keeps the sweep
-full, the compiler sees one shape.
+**Paged layout** (ISSUE 14 — the fix for the measured 96% waste of
+dense slotting under mixed-length traffic): the pool is a fixed set of
+fixed-size PAGES shared by every slot, plus a per-slot page table of
+device gather indices:
+
+    {"k":     (L, n_pages, page_len, H, Dh)      compute dtype,
+     "v":     (L, n_pages, page_len, H, Dh)      compute dtype,
+     "pos":   (n_slots,)                          int32,
+     "pages": (n_slots, pages_per_slot)           int32}
+
+``pages[s, j]`` is the pool page holding slot ``s``'s tokens
+``[j*page_len, (j+1)*page_len)``; unmapped entries hold the sentinel
+``n_pages`` (one past the pool) so a stray gather CLAMPS to masked
+garbage and a stray scatter DROPS — a freed lane can never corrupt a
+neighbour's live page. The page table is fixed-width
+(``pages_per_slot = ceil(max_len / page_len)``), so the attention
+gather shape is static and page-table GROWTH never retraces: mapping a
+new page is a data change, not a shape change.
+
+A short request holds ``ceil(len/page_len)`` pages instead of
+``max_len`` rows, so the byte budget buys concurrency proportional to
+*actual* token residency. The host side of the mapping lives in
+:class:`PageTable` (free list + numpy mirror of ``pages``); the device
+side rides the cache pytree through the same donated entry points as
+the dense cache.
+
+``DEFAULT_PAGE_LEN = 16`` follows the vLLM block-size precedent and the
+``serving_page_len:*`` autotune cost records (``serving/tune.py``
+re-measures it per shape/dtype/backend into the persistent autotune
+cache).
 """
 
 from __future__ import annotations
 
+from typing import List
+
+import numpy as np
+
 import jax.numpy as jnp
+
+# page size (tokens) — vLLM-style small blocks keep per-request
+# over-allocation under one page; re-derived per shape/backend by
+# serving.tune.sweep_serving_knobs into the autotune disk cache
+DEFAULT_PAGE_LEN = 16
+# prompt tokens one chunked-prefill dispatch processes (ISSUE 14):
+# small enough that one chunk costs about one decode sweep (the ITL
+# interleave contract), large enough to amortize dispatch — re-measured
+# per shape/backend by the serving_prefill_chunk autotune records
+DEFAULT_PREFILL_CHUNK = 128
 
 
 def init_cache(cfg, n_slots: int, max_len=None, dtype=None):
@@ -48,29 +91,236 @@ def init_cache(cfg, n_slots: int, max_len=None, dtype=None):
             "pos": jnp.zeros((int(n_slots),), jnp.int32)}
 
 
+def is_paged(cache) -> bool:
+    """True for the block-paged layout (ISSUE 14)."""
+    return "pages" in cache
+
+
 def cache_len(cache) -> int:
-    """Static per-slot capacity (tokens)."""
+    """Static per-slot capacity (tokens). For a paged cache this is the
+    page-table ceiling ``pages_per_slot * page_len`` — what one slot
+    could address if it mapped every entry, NOT what it has mapped."""
+    if is_paged(cache):
+        return cache["pages"].shape[1] * cache["k"].shape[2]
     return cache["k"].shape[2]
 
 
 def cache_slots(cache) -> int:
     """Number of decode slots the cache was allocated for."""
+    return cache["pos"].shape[0]
+
+
+def page_len(cache) -> int:
+    """Tokens per page (paged layout only)."""
+    return cache["k"].shape[2]
+
+
+def n_pages(cache) -> int:
+    """Pool pages (paged layout only)."""
     return cache["k"].shape[1]
+
+
+def pages_per_slot(cache) -> int:
+    """Page-table width (paged layout only)."""
+    return cache["pages"].shape[1]
 
 
 def cache_nbytes(cache) -> int:
     """Total device bytes held by the cache (capacity planning: at the
     flagship 120M config a T=1024 slot is L8·T1024·H8·Dh64 · 2 tensors
-    · 2 bytes = 16 MiB)."""
+    · 2 bytes = 16 MiB). For a paged cache this is the fixed POOL
+    footprint — what the device actually reserves, regardless of how
+    many pages are mapped."""
     return int(sum(a.size * a.dtype.itemsize for a in cache.values()))
 
 
 def token_nbytes(cache) -> int:
     """Bytes ONE resident token occupies in one slot: k + v rows across
-    every layer. ``resident tokens × token_nbytes`` vs ``cache_nbytes``
-    is the KV residency accounting (ISSUE 12) — the number that sizes
-    the paged-KV cache PR (ROADMAP item 1): waste is exactly the
-    ``(max_len - resident) × token_nbytes`` a short request pays under
-    fixed slotting."""
+    every layer (shape positions are shared by both layouts). Resident
+    tokens × token_nbytes vs the allocated bytes is the KV residency
+    accounting (ISSUE 12/14): dense waste is the ``max_len - resident``
+    tail a short request preallocates; paged waste is only the unfilled
+    remainder of the LAST mapped page."""
     layers, _, _, heads, head_dim = cache["k"].shape
     return int(2 * layers * heads * head_dim * cache["k"].dtype.itemsize)
+
+
+def page_nbytes(cache) -> int:
+    """Bytes one PAGE holds across every layer (paged layout)."""
+    return page_len(cache) * token_nbytes(cache)
+
+
+def init_paged_cache(cfg, n_slots: int, n_pages: int,
+                     page_len: int = DEFAULT_PAGE_LEN, max_len=None,
+                     dtype=None):
+    """Allocate an empty block-paged pool: ``n_pages`` shared pages of
+    ``page_len`` tokens each, a per-slot cursor, and a per-slot page
+    table sized ``ceil(max_len / page_len)`` entries (initially all the
+    ``n_pages`` sentinel = unmapped). ``max_len`` bounds what ONE slot
+    may address (defaults to ``cfg.max_seq``, same rule as the dense
+    cache); the pool itself may hold far fewer than
+    ``n_slots * max_len`` tokens — that is the point."""
+    max_len = int(cfg.max_seq if max_len is None else max_len)
+    if max_len > cfg.max_seq:
+        raise ValueError(
+            f"max_len {max_len} exceeds cfg.max_seq={cfg.max_seq}: the "
+            "position-embedding table has no rows past max_seq")
+    if page_len < 1 or n_pages < 1 or n_slots < 1 or max_len < 1:
+        raise ValueError(
+            f"need page_len/n_pages/n_slots/max_len >= 1, got "
+            f"page_len={page_len}, n_pages={n_pages}, n_slots={n_slots}, "
+            f"max_len={max_len}")
+    per_slot = -(-max_len // int(page_len))          # ceil
+    dt = cfg.dtype if dtype is None else dtype
+    shape = (cfg.n_layers, int(n_pages), int(page_len), cfg.n_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt),
+            "v": jnp.zeros(shape, dt),
+            "pos": jnp.zeros((int(n_slots),), jnp.int32),
+            "pages": jnp.full((int(n_slots), per_slot), int(n_pages),
+                              jnp.int32)}
+
+
+class PageTable:
+    """Host side of the paged mapping: the free list and the numpy
+    mirror of the device ``pages`` table. The scheduler maps pages
+    before a dispatch needs them and releases them when a request
+    finishes / is preempted / is cancelled; :meth:`device_table` hands
+    the mirror to the device only when it changed (a (n_slots, P) int32
+    transfer — never a retrace, the shape is fixed).
+
+    Invariants (``check()`` asserts them; the fuzz test hammers them):
+    a page is FREE xor mapped by exactly ONE slot, and
+    ``free + mapped == n_pages`` always.
+    """
+
+    def __init__(self, n_slots: int, n_pages: int, page_len: int,
+                 pages_per_slot: int):
+        self.n_slots = int(n_slots)
+        self.n_pages = int(n_pages)
+        self.page_len = int(page_len)
+        self.pages_per_slot = int(pages_per_slot)
+        # pop() from the end → pages hand out in increasing id order
+        self._free: List[int] = list(range(self.n_pages - 1, -1, -1))
+        self.table = np.full((self.n_slots, self.pages_per_slot),
+                             self.n_pages, np.int32)
+        self.mapped = np.zeros((self.n_slots,), np.int32)
+        self._dirty = True                    # device mirror stale?
+
+    @classmethod
+    def for_cache(cls, cache) -> "PageTable":
+        return cls(cache_slots(cache), n_pages(cache), page_len(cache),
+                   pages_per_slot(cache))
+
+    # ------------------------------------------------------- geometry
+    def pages_for(self, tokens: int) -> int:
+        """Pages required to hold ``tokens`` rows."""
+        return -(-max(0, int(tokens)) // self.page_len)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def mapped_pages(self) -> int:
+        return int(self.mapped.sum())
+
+    def slot_tokens_capacity(self, slot: int) -> int:
+        """Tokens the slot's mapped pages can hold right now."""
+        return int(self.mapped[slot]) * self.page_len
+
+    # -------------------------------------------------------- mapping
+    def can_map(self, slot: int, tokens: int) -> bool:
+        need = self.pages_for(tokens) - int(self.mapped[slot])
+        return need <= len(self._free)
+
+    def map(self, slot: int, tokens: int) -> bool:
+        """Grow ``slot``'s mapping to cover ``tokens`` rows. All-or-
+        nothing: returns False (mapping untouched) when the free list
+        cannot cover the growth — the caller preempts to make room."""
+        want = self.pages_for(tokens)
+        if want > self.pages_per_slot:
+            raise ValueError(
+                f"slot {slot} wants {want} pages "
+                f"({tokens} tokens), page table holds "
+                f"{self.pages_per_slot}")
+        have = int(self.mapped[slot])
+        need = want - have
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        for j in range(have, want):
+            self.table[slot, j] = self._free.pop()
+        self.mapped[slot] = want
+        self._dirty = True
+        return True
+
+    def release(self, slot: int) -> int:
+        """Return every page ``slot`` holds to the free list and reset
+        its table row to the sentinel (so stale device writes from the
+        freed lane DROP instead of landing in a re-issued page).
+        Returns the number of pages released."""
+        have = int(self.mapped[slot])
+        if have == 0:
+            return 0
+        for j in range(have - 1, -1, -1):     # LIFO: reuse hot pages
+            self._free.append(int(self.table[slot, j]))
+        self.table[slot, :have] = self.n_pages
+        self.mapped[slot] = 0
+        self._dirty = True
+        return have
+
+    def reset(self):
+        """Release everything (``_fail_all``)."""
+        self._free = list(range(self.n_pages - 1, -1, -1))
+        self.table[:] = self.n_pages
+        self.mapped[:] = 0
+        self._dirty = True
+
+    # --------------------------------------------------------- device
+    def sync(self, cache):
+        """Refresh the cache's device ``pages`` from the host mirror iff
+        the mapping changed since the last sync. The engine's entry
+        points DONATE the cache — including the pages buffer — so the
+        live device table always travels inside the cache pytree; this
+        uploads a fresh (n_slots, P) int32 array only on change (a tiny
+        transfer, fixed shape — page growth is data, never a
+        retrace)."""
+        if self._dirty:
+            cache = dict(cache, pages=jnp.asarray(self.table))
+            self._dirty = False
+        return cache
+
+    # ------------------------------------------------------ invariant
+    def check(self):
+        """Assert the free-xor-mapped-once invariant; raises
+        AssertionError with a diagnosis on violation (the fuzz
+        harness's oracle)."""
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate page in free list"
+        seen = {}
+        for s in range(self.n_slots):
+            m = int(self.mapped[s])
+            for j in range(self.pages_per_slot):
+                p = int(self.table[s, j])
+                if j < m:
+                    assert 0 <= p < self.n_pages, \
+                        f"slot {s} entry {j} unmapped below mapped count"
+                    assert p not in free, \
+                        f"page {p} mapped by slot {s} AND free"
+                    assert p not in seen, \
+                        f"page {p} double-mapped: slots {seen[p]}, {s}"
+                    seen[p] = s
+                else:
+                    assert p == self.n_pages, \
+                        f"slot {s} entry {j} holds {p} past mapped count"
+        assert len(seen) + len(free) == self.n_pages, \
+            f"lost pages: {self.n_pages - len(seen) - len(free)}"
+        return True
+
+    def report(self) -> dict:
+        return {"n_pages": self.n_pages, "page_len": self.page_len,
+                "pages_per_slot": self.pages_per_slot,
+                "mapped_pages": self.mapped_pages,
+                "free_pages": self.free_pages}

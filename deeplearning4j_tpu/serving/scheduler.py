@@ -116,6 +116,13 @@ class ServingRequest:
     generated: List[int] = field(default_factory=list)
     preemptions: int = 0
     trace: Optional[RequestTrace] = None
+    # chunked-prefill state (ISSUE 14, paged mode): the context being
+    # prefilled this admission and how many of its tokens are written;
+    # ``pending is None`` means the slot is decoding (or dense mode)
+    pending: Optional[np.ndarray] = None
+    done_tokens: int = 0
+    prefill_s: float = 0.0      # summed chunk wall time, this admission
+    chunks: int = 0             # chunks dispatched, this admission
 
     def context(self) -> np.ndarray:
         """Token ids to prefill on (re-)admission: the original prompt
@@ -150,13 +157,26 @@ class ContinuousBatchingScheduler:
                  recorder_snapshots: int = 512,
                  crash_dump_path: Optional[str] = None,
                  trace_spans: bool = True,
-                 sample_obs_every: int = 32):
+                 sample_obs_every: int = 32,
+                 page_len: Optional[int] = None,
+                 n_pages: Optional[int] = None):
         if n_slots < 1:
             raise ValueError("need at least one decode slot")
         self.engine = engine
         self.n_slots = int(n_slots)
         self.starvation_ms = starvation_ms
         self.replica = str(replica)
+        # paged mode (ISSUE 14): give EITHER knob and the pool becomes
+        # block-paged — n_pages shared fixed-size pages + a per-slot
+        # page table instead of n_slots × max_len dense rows. Admission
+        # turns page-availability-based, long prompts prefill in
+        # engine.chunk_len chunks interleaved with decode sweeps, and
+        # preemption/cancel/finish return pages to the free list.
+        # n_pages defaults to full per-slot capacity (no
+        # oversubscription); size it DOWN to serve at actual token
+        # residency — that is the point (the serving/tune.py sweep and
+        # bench rows pick the byte budget).
+        self.paged = page_len is not None or n_pages is not None
         # sampler observability (ISSUE 13): every Nth sampling event
         # (decode sweeps and admission first-tokens share one
         # counter), derive next-token entropy + top-k truncated mass
@@ -169,18 +189,38 @@ class ContinuousBatchingScheduler:
         # trace_overhead_seconds.
         self.sample_obs_every = max(0, int(sample_obs_every))
         self._obs_events = 0
-        self.cache = engine.init_cache(self.n_slots)
-        # memory plane (ISSUE 12): fixed-slot KV accounting — allocated
-        # bytes are static (slots × max_len), resident bytes follow the
-        # per-slot token counts the scheduler already tracks host-side
-        # (prompt + generated — no device fetch on the hot path)
+        if self.paged:
+            plen = int(page_len if page_len is not None
+                       else kvcache.DEFAULT_PAGE_LEN)
+            per_slot = -(-engine.max_len // plen)
+            np_ = int(n_pages if n_pages is not None
+                      else self.n_slots * per_slot)
+            self.cache = engine.init_paged_cache(self.n_slots, np_, plen)
+            self._pages: Optional[kvcache.PageTable] = \
+                kvcache.PageTable.for_cache(self.cache)
+            self._kv_page_bytes = kvcache.page_nbytes(self.cache)
+        else:
+            self.cache = engine.init_cache(self.n_slots)
+            self._pages = None
+            self._kv_page_bytes = 0
+        # memory plane (ISSUE 12/14): allocated bytes are static under
+        # dense slotting (slots × max_len) and MAPPED-page bytes under
+        # paging; resident bytes follow the per-slot token counts the
+        # scheduler already tracks host-side (prompt + generated — no
+        # device fetch on the hot path)
         self._kv_allocated = kvcache.cache_nbytes(self.cache)
         self._kv_token_bytes = kvcache.token_nbytes(self.cache)
         self._kv_last_resident = 0
+        self._kv_last_alloc = 0 if self.paged else self._kv_allocated
         self._kv_resident_sum = 0.0
+        self._kv_alloc_sum = 0.0
         self._kv_samples = 0
         self._final_res_sum = 0.0
         self._final_res_n = 0
+        # peak concurrent active requests over the accounting window —
+        # the ≥2×-concurrency-at-equal-bytes evidence the paged bench
+        # row reports (ISSUE 14)
+        self._peak_active = 0
         self.slots: List[Optional[ServingRequest]] = [None] * self.n_slots
         self._queue: deque = deque()
         # two locks: `_lock` guards the cheap metadata (queue, slots,
@@ -221,7 +261,7 @@ class ContinuousBatchingScheduler:
                 {"params": engine.params, "kv_cache": self.cache},
                 replica=self.replica, source="serving")
             m = self._m()
-            m["kv_alloc"].set(float(self._kv_allocated),
+            m["kv_alloc"].set(float(self._kv_last_alloc),
                               replica=self.replica)
         except Exception:  # noqa: BLE001 — census is decoration
             pass
@@ -281,13 +321,14 @@ class ContinuousBatchingScheduler:
             "latency": reg.histogram(
                 "dl4j_serving_request_latency_seconds",
                 "Time from submit to request completion"),
-            # KV residency accounting (ISSUE 12): allocated vs resident
-            # bytes of the fixed (slots, max_len) cache — the waste the
-            # paged-KV PR (ROADMAP item 1) must recover
+            # KV residency accounting (ISSUE 12/14): allocated vs
+            # resident bytes — dense slots allocate max_len per slot,
+            # the paged pool allocates only MAPPED pages
             "kv_alloc": reg.gauge(
                 "dl4j_kv_allocated_bytes",
-                "Static KV-cache allocation: slots x max_len, k+v, all "
-                "layers", labelnames=("replica",)),
+                "Allocated KV bytes: slots x max_len (dense slotting) "
+                "or mapped pages x page bytes (paged pool)",
+                labelnames=("replica",)),
             "kv_res": reg.gauge(
                 "dl4j_kv_resident_bytes",
                 "KV bytes actually holding tokens (active slots' "
@@ -295,14 +336,16 @@ class ContinuousBatchingScheduler:
                 labelnames=("replica",)),
             "kv_waste": reg.gauge(
                 "dl4j_kv_waste_ratio",
-                "1 - resident/allocated over the fixed-slot KV cache "
-                "(1.0 = idle pool; the paged-KV sizing number)",
-                labelnames=("replica",)),
+                "1 - resident/allocated (dense idle pool = 1.0; paged "
+                "counts mapped pages, so waste is only unfilled page "
+                "tails)", labelnames=("replica",)),
             "kv_final": reg.histogram(
                 "dl4j_kv_final_residency_ratio",
-                "Per-request final residency: (prompt+generated) / "
-                "max_len at completion — how much of its slot a request "
-                "ever used", buckets=tuple(i / 20 for i in range(1, 21))),
+                "Per-request final residency at completion: "
+                "(prompt+generated) / max_len under dense slotting, "
+                "/ mapped-page capacity under paging — how much of "
+                "what it reserved a request ever used",
+                buckets=tuple(i / 20 for i in range(1, 21))),
             # sampler observability (ISSUE 13): health of the model's
             # next-token distribution at the sampling sites — a
             # quantized KV cache or int8 weights (ROADMAP 3) that
@@ -342,6 +385,12 @@ class ContinuousBatchingScheduler:
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) - 1 = {total} exceeds the slot "
                 f"capacity max_len={self.engine.max_len}")
+        if self.paged and self._pages.pages_for(total) > self._pages.n_pages:
+            raise ValueError(
+                f"request needs {self._pages.pages_for(total)} pages "
+                f"({total} tokens at page_len={self._pages.page_len}) "
+                f"but the pool holds {self._pages.n_pages} — it could "
+                "never run even alone")
         now = time.perf_counter()
         fut: Future = Future()
         with self._lock:
@@ -378,8 +427,16 @@ class ContinuousBatchingScheduler:
             with self._lock:
                 did = self._maybe_preempt(m)
                 admissions = self._pop_admissions(m)
-            for slot, req in admissions:
-                self._admit_one(slot, req, m)
+            if self.paged:
+                # chunked prefill (ISSUE 14): every prefilling slot —
+                # just admitted or mid-prompt — advances ONE chunk,
+                # then the decode sweep runs; a T=4096 admission costs
+                # each sweep a chunk-sized pause, never the whole
+                # prompt
+                did = self._advance_prefills(m) or did
+            else:
+                for slot, req in admissions:
+                    self._admit_one(slot, req, m)
             did = did or bool(admissions)
             did = self._decode_sweep(m) or did
             with self._lock:
@@ -398,9 +455,18 @@ class ContinuousBatchingScheduler:
                 m["occupancy"].set(0.0, replica=self.replica)
                 m["tokens_per_s"].set(0.0, replica=self.replica)
                 m["kv_res"].set(0.0, replica=self.replica)
-                m["kv_waste"].set(1.0, replica=self.replica)
+                # dense idle = 100% waste (max_len × slots preallocated
+                # for nothing); paged idle maps NOTHING — zero
+                # allocated, zero wasted, which is the whole point
+                if self.paged:
+                    m["kv_alloc"].set(0.0, replica=self.replica)
+                    m["kv_waste"].set(0.0, replica=self.replica)
+                else:
+                    m["kv_waste"].set(1.0, replica=self.replica)
                 with self._lock:   # writers-hold-_lock invariant
                     self._kv_last_resident = 0
+                    if self.paged:
+                        self._kv_last_alloc = 0
         return did
 
     def run_until_idle(self, max_steps: int = 100000):
@@ -462,6 +528,8 @@ class ContinuousBatchingScheduler:
                 list(self._queue)
             self.slots = [None] * self.n_slots
             self._queue.clear()
+            if self.paged:      # dead pool leaks no pages
+                self._pages.reset()
         for req in doomed:
             try:
                 req.future.set_exception(exc)
@@ -493,25 +561,23 @@ class ContinuousBatchingScheduler:
     def _free_slots(self):
         return [i for i, r in enumerate(self.slots) if r is None]
 
-    def _maybe_preempt(self, m) -> bool:
-        """Starvation guard: queue head waited past the deadline with no
-        free slot → preempt the active request with the most remaining
-        budget (it blocks the pool longest). Its context re-queues at
-        the BACK; the head admits into the freed slot this same step."""
-        if self.starvation_ms is None or not self._queue:
-            return False
-        if self._free_slots():
-            return False
-        waited_ms = (time.perf_counter() - self._queue[0].queued_ts) * 1e3
-        if waited_ms <= self.starvation_ms:
-            return False
-        victim_slot = max(
-            (i for i, r in enumerate(self.slots) if r is not None),
-            key=lambda i: self.slots[i].remaining())
+    def _head_first_chunk_pages(self) -> int:
+        """Pages the queue head's FIRST prefill chunk needs (paged)."""
+        head = self._queue[0]
+        ctx_len = head.prompt.size + len(head.generated)
+        return self._pages.pages_for(min(ctx_len, self.engine.chunk_len))
+
+    def _preempt_slot(self, victim_slot: int, m) -> "ServingRequest":
+        """Preempt the request in ``victim_slot`` (caller holds
+        ``_lock``): free the lane, return its pages to the pool, reset
+        any mid-prefill progress, and re-queue its context at the BACK
+        (recompute preemption). Shared by the starvation guard and the
+        page-pressure path."""
         victim = self.slots[victim_slot]
-        if victim.remaining() <= 0 or not victim.generated:
-            return False       # nothing to save / about to finish anyway
         self.slots[victim_slot] = None
+        self._release_pages(victim_slot)
+        victim.pending = None
+        victim.done_tokens = 0
         victim.preemptions += 1
         victim.queued_ts = time.perf_counter()
         if victim.trace is not None:
@@ -521,6 +587,45 @@ class ContinuousBatchingScheduler:
             victim.trace.event("requeue", ts=victim.queued_ts)
         self._queue.append(victim)
         m["preemptions"].inc()
+        return victim
+
+    def _release_pages(self, slot: int) -> int:
+        """Paged mode: hand the slot's pages back to the free list (a
+        no-op under dense slotting). Returns pages released."""
+        return self._pages.release(slot) if self.paged else 0
+
+    def _maybe_preempt(self, m) -> bool:
+        """Starvation guard: queue head waited past the deadline and
+        cannot admit — no free slot, or (paged) not enough free pages
+        for its first chunk → preempt the active request with the most
+        remaining budget (it blocks the pool longest). Its context
+        re-queues at the BACK; the head admits into the freed
+        lane/pages this same step."""
+        if self.starvation_ms is None or not self._queue:
+            return False
+        if self._free_slots() and not (
+                self.paged
+                and self._head_first_chunk_pages() > self._pages.free_pages):
+            return False
+        waited_ms = (time.perf_counter() - self._queue[0].queued_ts) * 1e3
+        if waited_ms <= self.starvation_ms:
+            return False
+        # victims come from the DECODING slots only: a mid-chunked-
+        # prefill slot always carries the pool's max remaining budget
+        # (nothing generated yet), so including it would win every
+        # max() and then fail the nothing-to-save guard — silently
+        # disabling starvation relief for the whole multi-step
+        # admission window chunked prefill creates
+        victim_slot = max(
+            (i for i, r in enumerate(self.slots)
+             if r is not None and r.pending is None),
+            key=lambda i: self.slots[i].remaining(), default=None)
+        if victim_slot is None:
+            return False
+        victim = self.slots[victim_slot]
+        if victim.remaining() <= 0 or not victim.generated:
+            return False       # nothing to save / about to finish anyway
+        self._preempt_slot(victim_slot, m)
         return True
 
     def _pop_admissions(self, m):
@@ -528,11 +633,21 @@ class ContinuousBatchingScheduler:
         and RESERVE the slots (so occupancy readers see them) before the
         device-side prefills run lock-free. A request whose future was
         cancelled while queued is dropped here — it never costs a
-        prefill."""
+        prefill. Paged mode gates admission on PAGE availability too
+        (the head's first chunk must fit the free list) — the pool
+        admits to actual token residency, not lane count."""
         out = []
+        reserved = 0            # pages promised to this batch's heads
         for slot in self._free_slots():
+            admitted = False
             while self._queue:
-                req = self._queue.popleft()
+                req = self._queue[0]
+                if self.paged:
+                    need = self._head_first_chunk_pages()
+                    if need > self._pages.free_pages - reserved:
+                        break   # FIFO holds: nothing admits past a
+                                # head that cannot get pages
+                self._queue.popleft()
                 # fresh requests are PENDING → claim them (rejecting
                 # cancelled ones); a re-queued preemption victim is
                 # already RUNNING and must not be re-claimed
@@ -545,15 +660,25 @@ class ContinuousBatchingScheduler:
                 m["queue_wait"].observe(now - req.queued_ts)
                 if req.trace is not None:
                     req.trace.event("admit", ts=now, slot=slot)
+                if self.paged:
+                    req.pending = req.context()
+                    req.done_tokens = 0
+                    req.prefill_s = 0.0
+                    req.chunks = 0
+                    reserved += need
                 self.slots[slot] = req        # reserve
                 out.append((slot, req))
+                admitted = True
+                break
+            if not admitted:
                 break
         return out
 
     def _admit_one(self, slot, req, m):
-        """Device-side admission for one reserved slot: prefill the
-        request's context, sample its first token (TTFT). Runs outside
-        the metadata lock — `_step_lock` already serializes cache use."""
+        """Device-side admission for one reserved slot (dense mode):
+        prefill the request's whole context, sample its first token
+        (TTFT). Runs outside the metadata lock — `_step_lock` already
+        serializes cache use."""
         ctx = req.context()
         t0 = time.perf_counter()
         with span("serving.prefill",
@@ -561,7 +686,91 @@ class ContinuousBatchingScheduler:
                          "tokens": int(ctx.size)}):
             logits, self.cache = self.engine.prefill_slot(
                 self.cache, ctx, slot)
-        prefill_s = time.perf_counter() - t0
+        self._first_token(slot, req, logits, int(ctx.size),
+                          time.perf_counter() - t0, m)
+
+    def _advance_prefills(self, m) -> bool:
+        """Paged mode: advance every prefilling slot by ONE chunk (the
+        ISSUE 14 interleave — the decode sweep that follows never waits
+        out more than ``engine.chunk_len`` prompt tokens). Pages for
+        the chunk are mapped first; under page pressure the biggest-
+        remaining active neighbour is preempted, and if the pool STILL
+        cannot cover the chunk the prefilling request itself re-queues
+        (its turn comes back when pages free). The final chunk's logits
+        are the request's first token (TTFT)."""
+        with self._lock:
+            work = [(i, r) for i, r in enumerate(self.slots)
+                    if r is not None and r.pending is not None]
+        did = False
+        for slot, req in work:
+            with self._lock:
+                if self.slots[slot] is not req:   # preempted meanwhile
+                    continue
+                ctx = req.pending
+                done = req.done_tokens
+                n = min(self.engine.chunk_len, len(ctx) - done)
+                ok = self._ensure_pages(slot, req, done + n, m)
+            if not ok:
+                did = True      # a preemption shuffle IS work
+                continue
+            did = True
+            self.cache = self._pages.sync(self.cache)
+            t0 = time.perf_counter()
+            with span("serving.prefill_chunk",
+                      attrs={"request": req.id, "slot": slot,
+                             "start": int(done), "tokens": int(n)}):
+                logits, self.cache = self.engine.prefill_chunk(
+                    self.cache, ctx[done:done + n], slot, start=done)
+            with self._lock:
+                req.prefill_s += time.perf_counter() - t0
+                req.chunks += 1
+                req.done_tokens = done + n
+                final = req.done_tokens >= len(ctx)
+                if final:
+                    req.pending = None
+            if final:
+                self._first_token(slot, req, logits, len(ctx),
+                                  req.prefill_s, m, chunks=req.chunks)
+        return did
+
+    def _ensure_pages(self, slot, req, tokens: int, m) -> bool:
+        """Grow ``slot``'s mapping to cover ``tokens`` rows, preempting
+        under page pressure (caller holds ``_lock``). Victim order:
+        DECODING slots first, by most remaining budget — they block the
+        pool longest and a recompute costs them one prefill; a
+        mid-chunked-prefill slot is only sacrificed when no decoding
+        victim frees enough, least-progress first — discarding a
+        nearly-done long prefill for one page of decode growth would
+        re-pay every chunk AND invite the same squeeze on re-admission
+        (livelock by thrash). If the pool still cannot cover the
+        growth, ``req`` itself is preempted (False: the lane is free,
+        the request re-queued — never stranded, the submit-time fit
+        check guarantees it runs once pages free up)."""
+        if self._pages.map(slot, tokens):
+            return True
+        while True:
+            victim_slot = max(
+                (i for i, r in enumerate(self.slots)
+                 if r is not None and i != slot),
+                key=lambda i: (self.slots[i].pending is None,
+                               -self.slots[i].done_tokens
+                               if self.slots[i].pending is not None
+                               else self.slots[i].remaining()),
+                default=None)
+            if victim_slot is None:
+                break
+            self._preempt_slot(victim_slot, m)
+            if self._pages.map(slot, tokens):
+                return True
+        self._preempt_slot(slot, m)
+        return False
+
+    def _first_token(self, slot, req, logits, ctx_tokens: int,
+                     prefill_s: float, m, chunks: Optional[int] = None):
+        """Shared admission tail (dense prefill_slot and the final
+        prefill chunk): sample the first token — the TTFT sample —
+        record the trace events, and either park the token for the next
+        sweep or finish immediately (budget 1 / instant eos)."""
         m["prefills"].inc()
         with self._lock:
             self._key, sub = jax.random.split(self._key)
@@ -581,15 +790,18 @@ class ContinuousBatchingScheduler:
                 m["ttft"].observe(now - req.submitted_ts)
             if req.trace is not None:
                 t_ov = time.perf_counter()
+                attrs = {} if chunks is None else {"chunks": chunks}
                 req.trace.event("prefill", ts=now, slot=slot,
-                                tokens=int(ctx.size), time_s=prefill_s)
+                                tokens=ctx_tokens, time_s=prefill_s,
+                                **attrs)
                 req.trace.event("token", ts=now, i=len(req.generated))
                 self._trace_overhead += time.perf_counter() - t_ov
             req.generated.append(tok)
             m["tokens"].inc()
             if self._done(req, tok):
                 self.slots[slot] = None
-                self._finish(req, tok, m)
+                released = self._release_pages(slot)
+                self._finish(req, tok, m, mapped_pages=released)
             else:
                 self._last_tokens[slot] = tok
 
@@ -643,7 +855,20 @@ class ContinuousBatchingScheduler:
 
     def _decode_sweep(self, m) -> bool:
         with self._lock:      # snapshot; only step() (serialized) mutates
-            active = [i for i, r in enumerate(self.slots) if r is not None]
+            if self.paged:
+                # page growth BEFORE the sweep: each decoding slot's
+                # next write position must be mapped (a data update,
+                # never a retrace — the gather shape is fixed). Under
+                # pressure _ensure_pages preempts, so re-derive the
+                # active set afterwards.
+                for i in range(self.n_slots):
+                    req = self.slots[i]
+                    if req is None or req.pending is not None:
+                        continue
+                    self._ensure_pages(
+                        i, req, req.prompt.size + len(req.generated), m)
+            active = [i for i, r in enumerate(self.slots)
+                      if r is not None and r.pending is None]
             if not active:
                 return False
             temps = np.zeros((self.n_slots,), np.float32)
@@ -653,6 +878,8 @@ class ContinuousBatchingScheduler:
                 topks[i] = self.slots[i].top_k
             tokens_in = jnp.asarray(self._last_tokens)
             self._key, sub = jax.random.split(self._key)
+        if self.paged:
+            self.cache = self._pages.sync(self.cache)
         t0 = time.perf_counter()
         with span("serving.decode", attrs={"active": len(active)}):
             logits, self.cache = self.engine.decode_step(
@@ -692,8 +919,9 @@ class ContinuousBatchingScheduler:
                 req.generated.append(tok)
                 self._last_tokens[i] = tok
                 if self._done(req, tok):
-                    self._finish(req, tok, m)
                     self.slots[i] = None
+                    released = self._release_pages(i)
+                    self._finish(req, tok, m, mapped_pages=released)
         return True
 
     @staticmethod
@@ -701,19 +929,25 @@ class ContinuousBatchingScheduler:
         return (req.eos_id is not None and tok == req.eos_id) \
             or len(req.generated) >= req.max_new_tokens
 
-    def _finish(self, req: ServingRequest, last_tok: int, m):
+    def _finish(self, req: ServingRequest, last_tok: int, m,
+                mapped_pages: int = 0):
         reason = "eos" if (req.eos_id is not None
                            and last_tok == req.eos_id) else "length"
         now = time.perf_counter()
         m["completions"].inc(reason=reason)
         m["latency"].observe(now - req.submitted_ts)
         t_ov = time.perf_counter()
-        # per-request final residency (ISSUE 12): how much of its fixed
-        # slot this request EVER used — the histogram that sizes the
-        # paged-KV page count (ROADMAP item 1)
+        # per-request final residency (ISSUE 12/14): how much of what
+        # it RESERVED this request ever used — the fixed max_len slot
+        # under dense slotting, its mapped pages under paging (where
+        # the only reservable waste is the last page's tail)
         resident = min(req.prompt.size + len(req.generated),
                        self.engine.max_len)
-        ratio = resident / self.engine.max_len
+        if self.paged:
+            cap = max(1, mapped_pages) * self._pages.page_len
+            ratio = min(1.0, resident / cap)
+        else:
+            ratio = resident / self.engine.max_len
         m["kv_final"].observe(ratio)
         self._final_res_sum += ratio
         self._final_res_n += 1
@@ -763,32 +997,60 @@ class ContinuousBatchingScheduler:
             slot_ids = [None if r is None else r.id for r in self.slots]
             queued_ids = [r.id for r in self._queue]
             resident_tokens = sum(
-                min(r.prompt.size + len(r.generated), self.engine.max_len)
+                # a mid-prefill slot is resident only to the tokens its
+                # chunks have actually written
+                min(r.done_tokens if r.pending is not None
+                    else r.prompt.size + len(r.generated),
+                    self.engine.max_len)
                 for r in self.slots if r is not None)
             # accumulators update under the cheap metadata lock — the
             # lock kv_report/reset_kv_window also take — so a reader
             # never sees a sum without its count, and never waits on
             # device work to see either
             resident = resident_tokens * self._kv_token_bytes
-            waste = (1.0 - resident / self._kv_allocated) \
-                if self._kv_allocated else 0.0
+            n_active = sum(s is not None for s in slot_ids)
+            if n_active > self._peak_active:
+                self._peak_active = n_active
+            if self.paged:
+                # page granularity (ISSUE 14): allocated = MAPPED pages,
+                # not the pool — waste is unfilled page tails only. A
+                # just-sampled token is counted resident one sweep before
+                # its k/v rows are written (the next sweep's
+                # _ensure_pages maps its page first), so at an exact
+                # page boundary resident can momentarily exceed the
+                # mapping — clamp, or the waste gauge reads negative
+                alloc = self._pages.mapped_pages * self._kv_page_bytes
+                mapped = self._pages.mapped_pages
+                resident = min(resident, alloc)
+            else:
+                alloc = self._kv_allocated
+                mapped = None
+            waste = (1.0 - resident / alloc) if alloc else 0.0
             self._kv_last_resident = resident
+            self._kv_last_alloc = alloc
             self._kv_resident_sum += resident
+            self._kv_alloc_sum += alloc
             self._kv_samples += 1
         if m is None:
             m = self._m()
+        m["kv_alloc"].set(float(alloc), replica=self.replica)
         m["kv_res"].set(float(resident), replica=self.replica)
         m["kv_waste"].set(waste, replica=self.replica)
         self._steps += 1
+        paged_fields = {} if not self.paged else {
+            "kv_mapped_pages": mapped,
+            "kv_page_len": self._pages.page_len,
+            "kv_pool_bytes": self._kv_allocated,
+        }
         self.flight_recorder.record_snapshot(
             step=self._steps, slots=slot_ids, queue=queued_ids,
             queue_depth=len(queued_ids),
-            occupancy=sum(s is not None for s in slot_ids) / self.n_slots,
-            kv_allocated_bytes=self._kv_allocated,
+            occupancy=n_active / self.n_slots,
+            kv_allocated_bytes=alloc,
             kv_resident_bytes=resident,
             kv_token_bytes=self._kv_token_bytes,
             kv_waste_ratio=round(waste, 6),
-            **extra)
+            **paged_fields, **extra)
 
     def _debug_extra(self):
         """Live state merged into ``flight_recorder.debug_state()`` —
@@ -845,9 +1107,11 @@ class ContinuousBatchingScheduler:
         waits out a device dispatch."""
         with self._lock:
             self._kv_resident_sum = 0.0
+            self._kv_alloc_sum = 0.0
             self._kv_samples = 0
             self._final_res_sum = 0.0
             self._final_res_n = 0
+            self._peak_active = 0
         return self
 
     def kv_report(self) -> dict:
@@ -866,21 +1130,40 @@ class ContinuousBatchingScheduler:
             return self._kv_report_locked()
 
     def _kv_report_locked(self) -> dict:
-        alloc = self._kv_allocated
+        # allocated bytes: static pool footprint under dense slotting;
+        # MAPPED-page bytes under paging (ISSUE 14) — last snapshot and
+        # the window sum, so mean waste is resident-sum over alloc-sum
+        # (a ratio of same-window totals, not of mismatched means)
         mean_res = (self._kv_resident_sum / self._kv_samples
                     if self._kv_samples else 0.0)
-        return {
-            "allocated_bytes": alloc,
+        if self.paged:
+            alloc_last = self._kv_last_alloc
+            mean_alloc = (self._kv_alloc_sum / self._kv_samples
+                          if self._kv_samples else 0.0)
+            waste_mean = (1.0 - self._kv_resident_sum / self._kv_alloc_sum
+                          if self._kv_alloc_sum else 0.0)
+        else:
+            alloc_last = mean_alloc = self._kv_allocated
+            waste_mean = (1.0 - mean_res / self._kv_allocated
+                          if self._kv_allocated else 0.0)
+        out = {
+            "allocated_bytes": alloc_last,
+            "allocated_bytes_mean": round(mean_alloc, 1),
+            "pool_bytes": self._kv_allocated,
             "token_bytes": self._kv_token_bytes,
             "resident_bytes_last": self._kv_last_resident,
             "resident_bytes_mean": round(mean_res, 1),
             "waste_ratio_last": round(1.0 - self._kv_last_resident
-                                      / alloc, 6) if alloc else 0.0,
-            "waste_ratio_mean": round(1.0 - mean_res / alloc, 6)
-            if alloc else 0.0,
+                                      / alloc_last, 6) if alloc_last
+            else 0.0,
+            "waste_ratio_mean": round(waste_mean, 6),
             "snapshots": self._kv_samples,
+            "peak_concurrent": self._peak_active,
             "final_residency_mean": round(
                 self._final_res_sum / self._final_res_n, 6)
             if self._final_res_n else None,
             "finished_requests": self._final_res_n,
         }
+        if self.paged:
+            out["paged"] = self._pages.report()
+        return out

@@ -1,0 +1,281 @@
+"""Serving-knob sweep into the persistent autotune cache (ISSUE 14).
+
+The paged pool and chunked prefill add three tunables the dense plane
+never had — ``page_len`` (tokens per KV page), ``prefill_chunk``
+(prompt tokens per chunked-prefill dispatch), and the decode-sweep
+slot count — and each trades the same way the μ-cuDNN micro-batch
+size does: smaller buys granularity (less tail waste, shorter ITL
+pauses), larger amortizes dispatch. Which side wins is a property of
+the shape/dtype/BACKEND, so the verdict must be measured there, not
+guessed here.
+
+This module reuses the pallas block-size autotuner
+(:mod:`..kernels.autotune`) as the measurement harness: every candidate
+is timed on the real device with the marginal-chained-call discipline,
+and the winner lands in ``~/.deeplearning4j_tpu/autotune.json`` as a
+TVM-style cost record — ``{"choice": ..., "meta": {measured_at,
+best_s, measurements: [[cand, seconds], ...]}}`` — keyed by
+shape/dtype/backend. One sweep pays for every later run on the same
+chip generation, and the records are the citable provenance for the
+shipped defaults (``kvcache.DEFAULT_PAGE_LEN`` /
+``DEFAULT_PREFILL_CHUNK``): :func:`recommended_serving_knobs` reads
+the records back, choice + measurement meta together. This is the
+first concrete brick of ROADMAP item 5's unified autotune harness —
+serving knobs and pallas block sizes now share one cost-record store.
+
+Keys (backend-qualified, like the flash-attention keys):
+
+    serving_page_len:L{layers}H{heads}D{head_dim}:T{max_len}:S{slots}:{dtype}:{backend}
+    serving_prefill_chunk:L{..}H{..}D{..}:T{prompt}:{dtype}:{backend}
+    serving_decode_slots:L{..}H{..}D{..}:T{max_len}:{dtype}:{backend}
+
+Run it:
+
+    python -m deeplearning4j_tpu.serving.tune            # tiny default cfg
+    from deeplearning4j_tpu.serving.tune import sweep_serving_knobs
+    records = sweep_serving_knobs(engine)                # real engine
+
+Sweep BEFORE ``engine.mark_warm()`` (or on a scratch engine): every
+candidate is deliberately a new pool geometry / batch shape, so on a
+warm-marked engine each one trips the retrace sentinel's warning and
+counter — noise in a serve's zero-retrace gate, not a real storm.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+PAGE_LEN_CANDIDATES: Tuple[int, ...] = (8, 16, 32, 64)
+PREFILL_CHUNK_CANDIDATES: Tuple[int, ...] = (32, 64, 128, 256)
+DECODE_SLOT_CANDIDATES: Tuple[int, ...] = (2, 4, 8, 16)
+
+
+def _key(kind: str, cfg, backend: str, **dims) -> str:
+    tail = ":".join(f"{k}{v}" for k, v in dims.items())
+    dt = getattr(cfg.dtype, "__name__", str(cfg.dtype))
+    return (f"serving_{kind}:L{cfg.n_layers}H{cfg.n_heads}"
+            f"D{cfg.head_dim}:{tail}:{dt}:{backend}")
+
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def sweep_page_len(eng, *, slots: int = 4,
+                   candidates: Sequence[int] = PAGE_LEN_CANDIDATES,
+                   enabled: bool = True) -> int:
+    """Time one paged decode sweep per candidate ``page_len`` and cache
+    the winner. Each candidate's pool holds the SAME token budget
+    (``slots × max_len`` rows) so the comparison isolates the gather
+    granularity, not the byte budget."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..kernels.autotune import autotune
+    from . import kvcache
+
+    max_len = int(eng.max_len)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, eng.cfg.vocab_size, (slots,)),
+                       jnp.int32)
+
+    def make_run(cand):
+        (plen,) = cand
+        if plen > max_len:
+            return None
+        n_pages = slots * (-(-max_len // plen))
+        cache = eng.init_paged_cache(slots, n_pages, plen)
+        pt = kvcache.PageTable.for_cache(cache)
+        for s in range(slots):
+            # half-full slots (steady state), with headroom mapped so
+            # the timed steps' writes land in live pages
+            pt.map(s, min(max_len, max_len // 2 + 64))
+        cache = pt.sync(cache)
+        cache = dict(cache, pos=jnp.full((slots,), max_len // 2,
+                                         jnp.int32))
+        state = {"cache": cache}
+
+        def run():
+            logits, state["cache"] = eng.decode_step(state["cache"], toks)
+            return logits
+        return run
+
+    key = _key("page_len", eng.cfg, _backend(), T=max_len, S=slots)
+    choice = autotune(key, [(c,) for c in candidates], make_run,
+                      enabled=enabled)
+    return int(choice[0])
+
+
+def sweep_prefill_chunk(eng, *, prompt_len: int = 512,
+                        candidates: Sequence[int] = PREFILL_CHUNK_CANDIDATES,
+                        enabled: bool = True) -> int:
+    """Time a full chunked prefill of one ``prompt_len`` prompt per
+    candidate chunk size and cache the winner. The metric is the whole
+    admission's wall, so the dispatch-overhead-vs-granularity trade is
+    measured end to end (ITL interleave quality rides on the same
+    number: one chunk is one sweep's pause)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..kernels.autotune import autotune
+    from . import kvcache
+    from .engine import GenerationEngine
+
+    prompt_len = int(min(prompt_len, eng.max_len))
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, eng.cfg.vocab_size, (prompt_len,)).astype(
+        np.int32)
+    plen = kvcache.DEFAULT_PAGE_LEN
+
+    def make_run(cand):
+        (chunk,) = cand
+        if chunk > prompt_len:
+            return None
+        # chunk_len is engine geometry (it fixes the chunk buckets), so
+        # each candidate gets its own engine sharing the same params —
+        # compile cost is excluded by the harness's warmup call
+        ce = GenerationEngine(eng.cfg, eng.params, max_len=eng.max_len,
+                              prefill_buckets=eng.prefill_buckets,
+                              prefill_chunk=chunk)
+        n_pages = -(-prompt_len // plen) + 1
+        cache = ce.init_paged_cache(1, n_pages, plen)
+        pt = kvcache.PageTable.for_cache(cache)
+        pt.map(0, prompt_len)
+        state = {"cache": pt.sync(cache)}
+
+        def run():
+            logits = None
+            state["cache"] = dict(state["cache"],
+                                  pos=jnp.zeros((1,), jnp.int32))
+            for start in range(0, prompt_len, chunk):
+                n = min(chunk, prompt_len - start)
+                logits, state["cache"] = ce.prefill_chunk(
+                    state["cache"], prompt[start:start + n], 0,
+                    start=start)
+            return logits
+        return run
+
+    key = _key("prefill_chunk", eng.cfg, _backend(), T=prompt_len)
+    choice = autotune(key, [(c,) for c in candidates], make_run,
+                      enabled=enabled)
+    return int(choice[0])
+
+
+def sweep_decode_slots(eng, *, total_tokens: int = 32,
+                       candidates: Sequence[int] = DECODE_SLOT_CANDIDATES,
+                       enabled: bool = True) -> int:
+    """Time decoding the SAME total token budget at each slot count
+    (``total_tokens/slots`` sweeps of ``slots`` tokens) and cache the
+    winner — the throughput-optimal sweep width for this shape/backend
+    (what the goodput-vs-slots trade in the bench decode row sweeps)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..kernels.autotune import autotune
+
+    max_len = int(eng.max_len)
+    rng = np.random.default_rng(0)
+
+    def make_run(cand):
+        (slots,) = cand
+        if slots > total_tokens:
+            return None
+        steps = max(1, total_tokens // slots)
+        toks = jnp.asarray(rng.integers(0, eng.cfg.vocab_size, (slots,)),
+                           jnp.int32)
+        cache = eng.init_cache(slots)
+        cache = dict(cache, pos=jnp.full((slots,), max_len // 2,
+                                         jnp.int32))
+        state = {"cache": cache}
+
+        def run():
+            logits = None
+            for _ in range(steps):
+                logits, state["cache"] = eng.decode_step(state["cache"],
+                                                         toks)
+            return logits
+        return run
+
+    key = _key("decode_slots", eng.cfg, _backend(), T=max_len)
+    choice = autotune(key, [(c,) for c in candidates], make_run,
+                      enabled=enabled)
+    return int(choice[0])
+
+
+def sweep_serving_knobs(eng, *, enabled: bool = True,
+                        prompt_len: int = 512) -> Dict[str, int]:
+    """Run all three sweeps; returns the chosen knobs. Each verdict is
+    a persistent cost record — re-running is a cache hit."""
+    return {
+        "page_len": sweep_page_len(eng, enabled=enabled),
+        "prefill_chunk": sweep_prefill_chunk(eng, prompt_len=prompt_len,
+                                             enabled=enabled),
+        "decode_slots": sweep_decode_slots(eng, enabled=enabled),
+    }
+
+
+def recommended_serving_knobs(cfg=None, *, max_len: Optional[int] = None
+                              ) -> Dict[str, dict]:
+    """Read the serving cost records back: {knob: {choice, meta}} for
+    every ``serving_*`` key in the disk cache (filtered to ``cfg``'s
+    shape when given). This is how a default is CITED — the choice
+    plus the measurements that reached it, never a bare constant."""
+    from ..kernels.autotune import _disk_cache, _entry_choice
+
+    out: Dict[str, dict] = {}
+    prefix = "serving_"
+    want = None
+    if cfg is not None:
+        # field-exact match: keys are ':'-delimited, and a bare
+        # substring would let L2H4D16 claim L2H4D160's records
+        want = f"L{cfg.n_layers}H{cfg.n_heads}D{cfg.head_dim}"
+    for key, entry in _disk_cache().items():
+        if not key.startswith(prefix):
+            continue
+        fields = key.split(":")
+        if want is not None and want not in fields:
+            continue
+        if max_len is not None and f"T{int(max_len)}" not in fields:
+            continue
+        out[key] = {
+            "choice": list(_entry_choice(entry)),
+            "meta": entry.get("meta") if isinstance(entry, dict) else None,
+        }
+    return out
+
+
+def _main():
+    """CLI: sweep a tiny CPU-friendly config (or the flagship shape
+    with --flagship) and print the records."""
+    import argparse
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..zoo import transformer as tfm
+    from .engine import GenerationEngine
+
+    ap = argparse.ArgumentParser(description="serving-knob autotune sweep")
+    ap.add_argument("--flagship", action="store_true",
+                    help="sweep the 120M bench shape (slow on CPU)")
+    ap.add_argument("--prompt-len", type=int, default=512)
+    args = ap.parse_args()
+    if args.flagship:
+        cfg = tfm.TransformerConfig(vocab_size=32000, d_model=512,
+                                    n_heads=8, n_layers=8, d_ff=2048,
+                                    max_seq=1024, dtype=jnp.bfloat16,
+                                    remat=False)
+    else:
+        cfg = tfm.TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
+                                    n_layers=2, d_ff=128, max_seq=512,
+                                    dtype=jnp.float32, remat=False,
+                                    attn_scores_bf16=False)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    eng = GenerationEngine(cfg, params)
+    knobs = sweep_serving_knobs(eng, prompt_len=args.prompt_len)
+    print(json.dumps({"chosen": knobs,
+                      "records": recommended_serving_knobs(cfg)},
+                     indent=2))
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
-# Quick gate (ISSUE 7 + 8 + 10 + 11 + 12 + 13): metric-name/label +
-# doc lint, then the telemetry-plane, roofline-floor,
-# elastic-scaleout, serving-plane, SLO-plane, memory/compile-plane,
-# and numerics/fidelity-plane fast suites. One command, <3 min on CPU;
-# run before touching instrumentation, bench schema, docs examples,
-# the scaleout plane, the serving engine/scheduler, the
+# Quick gate (ISSUE 7 + 8 + 10 + 11 + 12 + 13 + 14): metric-name/label
+# + doc lint, then the telemetry-plane, roofline-floor,
+# elastic-scaleout, serving-plane, paged-KV/chunked-prefill, SLO-plane,
+# memory/compile-plane, and numerics/fidelity-plane fast suites. One
+# command, <4 min on CPU; run before touching instrumentation, bench
+# schema, docs examples, the scaleout plane, the serving
+# engine/scheduler, the paged KV pool / page table, the
 # SLO/flight-recorder plane, the memory census / retrace sentinel, or
 # the numerics sentinel / drift audit / fidelity probes.
 #
@@ -18,9 +19,10 @@ cd "$(dirname "$0")/.."
 echo "== metric-name + doc lint =="
 python scripts/check_metric_names.py
 
-echo "== obs + floors + scaleout-fast + serving + slo + memplane + numerics suites =="
+echo "== obs + floors + scaleout-fast + serving + paged-kv + slo + memplane + numerics suites =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
-    tests/test_scaleout_fast.py tests/test_serving.py tests/test_slo.py \
+    tests/test_scaleout_fast.py tests/test_serving.py \
+    tests/test_paged_kv.py tests/test_slo.py \
     tests/test_memplane.py tests/test_numerics.py \
     -q -m 'not slow' -p no:cacheprovider -p no:randomly
 

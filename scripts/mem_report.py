@@ -25,7 +25,14 @@ Torn trailing lines are tolerated (``load_spans`` discipline).
     python scripts/mem_report.py dump.jsonl --max-waste 0.9 --json
 
 Exit code: 0, or 1 when ``--max-waste`` is given and any replica's mean
-KV waste ratio exceeds it — a post-run gate, like slo_report's.
+KV waste ratio exceeds it — a post-run gate, like slo_report's. The
+mean is byte-weighted (1 - Σresident/Σallocated over the snapshot
+window) so it gates correctly on BOTH layouts: dense slotting, where
+allocated bytes are the static ``slots × max_len`` pool, and the
+block-paged pool (ISSUE 14), where allocated bytes are the MAPPED
+pages of each snapshot and the only reservable waste is unfilled page
+tails (paged snapshots carry ``kv_mapped_pages`` / ``kv_page_len`` /
+``kv_pool_bytes`` alongside).
 """
 
 from __future__ import annotations
@@ -54,7 +61,9 @@ def build_report(records) -> dict:
         return out.setdefault(str(replica), {
             "census": None, "snapshots": 0, "kv_allocated_bytes": None,
             "kv_token_bytes": None, "resident_sum": 0.0,
-            "resident_max": 0, "waste_sum": 0.0,
+            "resident_max": 0, "alloc_sum": 0.0, "alloc_max": 0,
+            "paged": False, "kv_page_len": None, "kv_pool_bytes": None,
+            "mapped_pages_max": 0,
             "final_residency": [], "requests": 0})
 
     def _better_census(old, new):
@@ -83,7 +92,18 @@ def build_report(records) -> dict:
             res = float(r.get("kv_resident_bytes", 0))
             d["resident_sum"] += res
             d["resident_max"] = max(d["resident_max"], res)
-            d["waste_sum"] += float(r.get("kv_waste_ratio", 0.0))
+            # allocated bytes are STATIC under dense slotting but track
+            # the mapped pages under paging (ISSUE 14) — accumulate per
+            # snapshot so mean waste can be byte-weighted
+            alloc = float(r.get("kv_allocated_bytes") or 0)
+            d["alloc_sum"] += alloc
+            d["alloc_max"] = max(d["alloc_max"], alloc)
+            if "kv_mapped_pages" in r:          # paged-pool snapshot
+                d["paged"] = True
+                d["kv_page_len"] = r.get("kv_page_len")
+                d["kv_pool_bytes"] = r.get("kv_pool_bytes")
+                d["mapped_pages_max"] = max(
+                    d["mapped_pages_max"], int(r["kv_mapped_pages"] or 0))
         elif kind == "reqtrace":
             d = rep(r.get("replica", "0"))
             d["requests"] += 1
@@ -98,11 +118,22 @@ def build_report(records) -> dict:
         n = d.pop("snapshots")
         resident_sum = d.pop("resident_sum")
         resident_max = d.pop("resident_max")
-        waste_sum = d.pop("waste_sum")
+        alloc_sum = d.pop("alloc_sum")
+        alloc_max = d.pop("alloc_max")
         d["n_snapshots"] = n
         d["resident_bytes_mean"] = resident_sum / n if n else None
         d["resident_bytes_max"] = resident_max if n else None
-        d["waste_ratio_mean"] = waste_sum / n if n else None
+        d["allocated_bytes_mean"] = alloc_sum / n if n else None
+        d["allocated_bytes_max"] = alloc_max if n else None
+        # mean waste is byte-weighted: 1 - Σresident/Σallocated. Under
+        # dense slotting (allocated constant) this equals the old
+        # mean-of-ratios; under paging (ISSUE 14: allocated = mapped
+        # pages, varies per snapshot) it weights each snapshot by the
+        # bytes it actually reserved — idle zero-alloc snapshots can no
+        # longer dilute (or a transient spike dominate) the --max-waste
+        # gate
+        d["waste_ratio_mean"] = (1.0 - resident_sum / alloc_sum
+                                 if alloc_sum else None) if n else None
         fr = d.pop("final_residency")
         d["final_residency_mean"] = sum(fr) / len(fr) if fr else None
         d["final_residency_n"] = len(fr)
@@ -145,9 +176,19 @@ def render(report) -> str:
         else:
             lines.append("  (no census record in dump)")
         if d.get("n_snapshots"):
+            if d.get("paged"):
+                alloc_txt = (
+                    f"allocated (mapped pages) mean "
+                    f"{_fmt_bytes(d['allocated_bytes_mean'])} / max "
+                    f"{_fmt_bytes(d['allocated_bytes_max'])} of a "
+                    f"{_fmt_bytes(d['kv_pool_bytes'])} pool "
+                    f"(page_len={d['kv_page_len']}, "
+                    f"mapped max {d['mapped_pages_max']} pages)")
+            else:
+                alloc_txt = f"allocated {_fmt_bytes(d['kv_allocated_bytes'])}"
             lines.append(
                 f"  KV residency over {d['n_snapshots']} snapshots: "
-                f"allocated {_fmt_bytes(d['kv_allocated_bytes'])}, "
+                f"{alloc_txt}, "
                 f"resident mean {_fmt_bytes(d['resident_bytes_mean'])} "
                 f"/ max {_fmt_bytes(d['resident_bytes_max'])}, "
                 f"waste mean {_fmt_pct(d['waste_ratio_mean'])}")
@@ -160,10 +201,11 @@ def render(report) -> str:
         else:
             lines.append("  (no KV residency snapshots in dump)")
         if d.get("final_residency_n"):
+            denom = "mapped pages" if d.get("paged") else "max_len"
             lines.append(
                 f"  requests: {d['requests']} traced, "
                 f"{d['final_residency_n']} finished — final residency "
-                f"mean {_fmt_pct(d['final_residency_mean'])} of max_len")
+                f"mean {_fmt_pct(d['final_residency_mean'])} of {denom}")
     return "\n".join(lines)
 
 

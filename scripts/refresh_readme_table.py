@@ -87,17 +87,33 @@ INFERENCE_LABELS = {
 }
 
 
+def waste_cell(rec):
+    """The KV-waste column (ISSUE 14): measured waste ratio plus, for a
+    block-paged serve, the concurrency bought back at the dense byte
+    budget — the before (dense 96%) / after (page tails only) of the
+    paged-KV PR, straight from the one-sha artifact."""
+    m = rec.get("memory") if isinstance(rec, dict) else None
+    if not isinstance(m, dict) or m.get("kv_waste_ratio") is None:
+        return "—"
+    cell = f"{100 * m['kv_waste_ratio']:.0f}%"
+    paged = m.get("paged")
+    if isinstance(paged, dict):
+        cell += (f" (paged; {paged['concurrency_x']}× slots "
+                 f"@ equal bytes)")
+    else:
+        cell += " (dense)"
+    return cell
+
+
 def mem_cell(rec):
-    """The serving memory column (ISSUE 12): KV waste from a real
-    mixed-length serve + bytes per resident token, or peak bytes for
-    rows without a KV cache. A record with no `memory` block predates
-    the memory plane — em-dash, the floor-column precedent."""
+    """The serving memory column (ISSUE 12): bytes per resident token
+    from a real mixed-length serve, or peak bytes for rows without a
+    KV cache. A record with no `memory` block predates the memory
+    plane — em-dash, the floor-column precedent."""
     m = rec.get("memory") if isinstance(rec, dict) else None
     if not isinstance(m, dict) or "na" in m:
         return "—"
     parts = []
-    if m.get("kv_waste_ratio") is not None:
-        parts.append(f"KV waste {100 * m['kv_waste_ratio']:.0f}%")
     if m.get("bytes_per_resident_token") is not None:
         parts.append(f"{_fmt_bytes(m['bytes_per_resident_token'])}/tok")
     if not parts and m.get("peak_bytes") is not None:
@@ -136,7 +152,7 @@ def inference_row(name, rec):
     captured = ("on-chip" if rec.get("backend") == "tpu"
                 else "⏳ CPU-derived, on-chip TODO")
     return (f"| {label} | {val} | {'; '.join(details) or '—'} "
-            f"| {mem_cell(rec)} | {captured} |")
+            f"| {waste_cell(rec)} | {mem_cell(rec)} | {captured} |")
 
 
 def inference_lines(inf):
@@ -155,8 +171,8 @@ def inference_lines(inf):
             "only against their own floor/memory evidence, not across "
             "captures:",
             "",
-            "| config | value | detail | memory | captured |",
-            "|---|---|---|---|---|"] + rows
+            "| config | value | detail | KV waste | memory | captured |",
+            "|---|---|---|---|---|---|"] + rows
 
 
 def main():

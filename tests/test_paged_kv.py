@@ -1,0 +1,686 @@
+"""Paged KV cache + chunked prefill (ISSUE 14): page-pool geometry,
+host page-table invariants (incl. fuzz), engine-level logit oracles
+(paged decode vs dense cache, chunked prefill vs the full forward),
+scheduler equivalence (greedy output bit-identical to ``generate()``),
+page release on preempt/cancel/finish/crash (the PR 10 future-liveness
+contract extended to page exhaustion), retrace pinning across
+page-table growth, paged residency accounting, the mem_report gate on
+page semantics, and the serving-knob autotune records.
+
+Fast tier-1 suite — tiny f32 configs on CPU, same oracle discipline as
+tests/test_serving.py: the paged cache is an optimization, never a
+different model.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.obs import get_registry
+from deeplearning4j_tpu.serving import (ContinuousBatchingScheduler,
+                                        GenerationEngine, PageTable,
+                                        cache_len, cache_nbytes,
+                                        cache_slots, init_paged_cache,
+                                        is_paged, page_nbytes,
+                                        token_nbytes)
+from deeplearning4j_tpu.serving import kvcache
+from deeplearning4j_tpu.zoo import transformer as tfm
+
+ATOL = 2e-4
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=61, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_seq=32, dtype=jnp.float32, remat=False,
+                attn_scores_bf16=False)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    cfg, params = model
+    # chunk_len 8 → multi-chunk prefills even at tiny prompt lengths
+    return GenerationEngine(cfg, params, prefill_chunk=8)
+
+
+def _toks(shape, vocab=61, seed=0):
+    return np.random.default_rng(seed).integers(0, vocab, shape).astype(
+        np.int32)
+
+
+def _paged_sched(engine, n_slots=2, page_len=4, n_pages=None, **kw):
+    return ContinuousBatchingScheduler(engine, n_slots=n_slots,
+                                       page_len=page_len,
+                                       n_pages=n_pages, **kw)
+
+
+# ------------------------------------------------------ pool geometry
+
+def test_paged_cache_shapes_and_accounting(model):
+    cfg, _ = model
+    cache = init_paged_cache(cfg, n_slots=3, n_pages=10, page_len=4)
+    assert is_paged(cache)
+    assert cache["k"].shape == (cfg.n_layers, 10, 4, cfg.n_heads,
+                                cfg.head_dim)
+    # page table: ceil(max_seq/page_len) entries, all the sentinel
+    assert cache["pages"].shape == (3, 8)
+    assert np.asarray(cache["pages"]).tolist() == [[10] * 8] * 3
+    assert cache_slots(cache) == 3
+    assert cache_len(cache) == 8 * 4        # addressable ceiling
+    assert kvcache.page_len(cache) == 4
+    assert kvcache.n_pages(cache) == 10
+    # token bytes match the dense layout's (shared shape positions);
+    # page bytes = page_len tokens
+    assert token_nbytes(cache) == 2 * cfg.n_layers * cfg.d_model * 4
+    assert page_nbytes(cache) == 4 * token_nbytes(cache)
+    # pool footprint is pages, NOT slots × max_len
+    expect = (2 * cfg.n_layers * 10 * 4 * cfg.d_model * 4
+              + 3 * 4 + 3 * 8 * 4)
+    assert cache_nbytes(cache) == expect
+
+
+def test_paged_cache_rejects_bad_geometry(model):
+    cfg, _ = model
+    with pytest.raises(ValueError, match="max_seq"):
+        init_paged_cache(cfg, 1, 4, max_len=cfg.max_seq + 1)
+    with pytest.raises(ValueError):
+        init_paged_cache(cfg, 0, 4)
+    with pytest.raises(ValueError):
+        init_paged_cache(cfg, 1, 0)
+    with pytest.raises(ValueError):
+        init_paged_cache(cfg, 1, 4, page_len=0)
+
+
+# ------------------------------------------------- host page table
+
+def test_page_table_map_release_invariants():
+    pt = PageTable(n_slots=2, n_pages=6, page_len=4, pages_per_slot=4)
+    assert pt.free_pages == 6 and pt.mapped_pages == 0
+    assert pt.pages_for(0) == 0 and pt.pages_for(1) == 1
+    assert pt.pages_for(4) == 1 and pt.pages_for(5) == 2
+    assert pt.map(0, 9)                      # 3 pages
+    assert pt.mapped_pages == 3 and pt.free_pages == 3
+    assert pt.slot_tokens_capacity(0) == 12
+    pt.check()
+    # growth is incremental: covering 12 tokens adds nothing
+    assert pt.map(0, 12) and pt.mapped_pages == 3
+    # all-or-nothing: slot 1 wants 4 pages, only 3 free
+    assert pt.can_map(1, 13) is False
+    assert pt.map(1, 13) is False
+    assert pt.mapped[1] == 0 and pt.free_pages == 3    # untouched
+    pt.check()
+    assert pt.map(1, 12)
+    assert pt.free_pages == 0
+    # release returns every page and resets the row to the sentinel
+    assert pt.release(0) == 3
+    assert pt.free_pages == 3
+    assert pt.table[0].tolist() == [6, 6, 6, 6]
+    pt.check()
+    # beyond the table width is a programming error, not a failure
+    with pytest.raises(ValueError, match="page table"):
+        pt.map(1, 17)
+
+
+def test_page_table_check_catches_corruption():
+    pt = PageTable(2, 4, 4, 2)
+    pt.map(0, 8)
+    pt.table[1, 0] = pt.table[0, 0]          # double-map
+    pt.mapped[1] = 1
+    with pytest.raises(AssertionError, match="double-mapped"):
+        pt.check()
+    pt2 = PageTable(2, 4, 4, 2)
+    pt2.map(0, 4)
+    pt2._free.append(int(pt2.table[0, 0]))   # free AND mapped
+    with pytest.raises(AssertionError):
+        pt2.check()
+
+
+def test_page_table_fuzz_random_map_release():
+    """Free-list fuzz: random admit/grow/release schedules never
+    double-map, never lose a page, and free+mapped == n_pages at every
+    step (the ``check()`` oracle)."""
+    rng = np.random.default_rng(7)
+    pt = PageTable(n_slots=4, n_pages=12, page_len=4, pages_per_slot=6)
+    tokens = [0] * 4
+    for _ in range(400):
+        s = int(rng.integers(0, 4))
+        if rng.random() < 0.35 and tokens[s]:
+            pt.release(s)
+            tokens[s] = 0
+        else:
+            want = int(rng.integers(1, 24))
+            if pt.map(s, want):
+                tokens[s] = max(tokens[s], want)
+        pt.check()
+    for s in range(4):
+        pt.release(s)
+    pt.check()
+    assert pt.free_pages == 12 and pt.mapped_pages == 0
+
+
+# --------------------------------------- engine-level logit oracles
+
+def test_chunked_prefill_matches_full_forward(model, engine):
+    """Chunked prefill's final-chunk logits == the full forward's last
+    position, and every chunk boundary leaves the cache able to decode
+    the NEXT token identically to the dense path (position oracle)."""
+    cfg, params = model
+    prompt = _toks((20,), seed=3)
+    full, _ = tfm.forward(params, cfg, jnp.asarray(prompt)[None])
+
+    cache = engine.init_paged_cache(1, n_pages=10, page_len=4)
+    pt = PageTable.for_cache(cache)
+    logits = None
+    start = 0
+    while start < prompt.size:
+        n = min(engine.chunk_len, prompt.size - start)
+        assert pt.map(0, start + n)
+        cache = pt.sync(cache)
+        logits, cache = engine.prefill_chunk(cache, prompt[start:start + n],
+                                             0, start=start)
+        start += n
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(full)[0, -1], atol=ATOL)
+    assert int(cache["pos"][0]) == prompt.size
+
+
+def test_paged_decode_matches_dense_decode_every_position(model, engine):
+    """After identical prefills, N paged decode steps produce the same
+    logits as the dense cache at every position — the paged gather is
+    the dense attention, re-addressed."""
+    cfg, params = model
+    prompts = [_toks((n,), seed=10 + n) for n in (5, 9, 13)]
+    b = len(prompts)
+
+    dense = engine.init_cache(b)
+    for i, p in enumerate(prompts):
+        _, dense = engine.prefill_slot(dense, p, i)
+
+    paged = engine.init_paged_cache(b, n_pages=b * 8, page_len=4)
+    pt = PageTable.for_cache(paged)
+    for i, p in enumerate(prompts):
+        start = 0
+        while start < p.size:
+            n = min(engine.chunk_len, p.size - start)
+            assert pt.map(i, start + n)
+            paged = pt.sync(paged)
+            _, paged = engine.prefill_chunk(paged, p[start:start + n], i,
+                                            start=start)
+            start += n
+
+    toks = np.asarray([int(p[-1]) for p in prompts], np.int32)
+    for step in range(6):
+        ld, dense = engine.decode_step(dense, toks)
+        for i, p in enumerate(prompts):
+            pt.map(i, p.size + step + 1)
+        paged = pt.sync(paged)
+        lp, paged = engine.decode_step(paged, toks)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(ld),
+                                   atol=ATOL)
+        assert np.asarray(jnp.argmax(lp, -1)).tolist() == \
+            np.asarray(jnp.argmax(ld, -1)).tolist()
+        toks = np.asarray(jnp.argmax(ld, -1), np.int32)
+    assert np.asarray(dense["pos"]).tolist() == \
+        np.asarray(paged["pos"]).tolist()
+
+
+def test_prefill_chunk_rejects_bad_use(model, engine):
+    cache = engine.init_paged_cache(1, 4, page_len=4)
+    dense = engine.init_cache(1)
+    with pytest.raises(ValueError, match="paged"):
+        engine.prefill_chunk(dense, _toks((4,)), 0)
+    # and the reverse: the dense admission paths refuse a paged cache
+    # (slot-indexed writes would land in an arbitrary pool page)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        engine.prefill_slot(cache, _toks((4,)), 0)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        engine.prefill(cache, _toks((1, 4)))
+    with pytest.raises(ValueError, match="chunk_len"):
+        engine.prefill_chunk(cache, _toks((engine.chunk_len + 1,)), 0)
+    with pytest.raises(ValueError, match="empty"):
+        engine.prefill_chunk(cache, np.zeros((0,), np.int32), 0)
+    with pytest.raises(ValueError, match="max_len"):
+        engine.prefill_chunk(cache, _toks((8,)), 0,
+                             start=engine.max_len - 4)
+
+
+# ------------------------------------- scheduler: paged equivalence
+
+def test_paged_scheduler_greedy_bit_identical_to_generate(model, engine):
+    """The headline transparency claim: greedy output through the paged
+    scheduler — page-gated admission, chunked prefill, paged decode
+    sweeps — is BIT-identical to engine.generate()."""
+    sched = _paged_sched(engine, n_slots=2, page_len=4, n_pages=16)
+    prompts = [_toks((n,), seed=20 + n) for n in (3, 11, 6, 17, 2)]
+    futs = [sched.submit(p, max_new_tokens=5) for p in prompts]
+    sched.run_until_idle()
+    for p, f in zip(prompts, futs):
+        assert f.result(5).tokens.tolist() == \
+            engine.generate(p, 5).tolist()
+    # the pool drained clean: every page back on the free list
+    sched._pages.check()
+    assert sched._pages.free_pages == sched._pages.n_pages
+
+
+def test_chunked_prefill_interleaves_with_decode_sweeps(model, engine):
+    """The ITL contract: while a long prompt chunks in, the already-
+    decoding slot keeps streaming — one admission never stalls the pool
+    for more than one chunk. (The dense path runs the whole prompt in
+    one dispatch; chunked admission bounds the per-sweep pause.)"""
+    sched = _paged_sched(engine, n_slots=2, page_len=4, n_pages=16)
+    short = _toks((3,), seed=31)
+    fut_s = sched.submit(short, max_new_tokens=12)
+    sched.step()                    # admit short (1 chunk), first token
+    long_p = _toks((24,), seed=32)  # 3 chunks at chunk_len=8
+    fut_l = sched.submit(long_p, max_new_tokens=2)
+    progressed = []
+    chunks_seen = []
+    for _ in range(3):              # the long admission's chunk steps
+        before = len(sched.slots[0].generated) \
+            if sched.slots[0] is not None else None
+        sched.step()
+        after = len(sched.slots[0].generated) \
+            if sched.slots[0] is not None else None
+        long_req = sched.slots[1]
+        chunks_seen.append(None if long_req is None
+                           else long_req.done_tokens)
+        if before is not None and after is not None:
+            progressed.append(after - before)
+    # every chunk step also ran a decode sweep for the short request
+    assert progressed and all(d == 1 for d in progressed)
+    # and the long prompt advanced exactly one chunk per step
+    assert chunks_seen[:2] == [8, 16]
+    sched.run_until_idle()
+    assert fut_s.result(5).tokens.tolist() == \
+        engine.generate(short, 12).tolist()
+    assert fut_l.result(5).tokens.tolist() == \
+        engine.generate(long_p, 2).tolist()
+
+
+def test_fuzz_paged_scheduler_random_schedules(model, engine):
+    """Scheduler fuzz (the ISSUE 14 invariant sweep): random mixed
+    prompt lengths, budgets and pool sizes through admit/chunk/decode/
+    preempt/finish — greedy output stays bit-identical to generate(),
+    no page is double-mapped or lost, and the drained pool is whole."""
+    for seed in (0, 1):
+        rng = np.random.default_rng(seed)
+        n_pages = int(rng.integers(10, 20))
+        sched = _paged_sched(engine, n_slots=int(rng.integers(1, 4)),
+                             page_len=int(rng.choice([2, 4, 8])),
+                             n_pages=n_pages,
+                             starvation_ms=0.0 if seed % 2 else None)
+        prompts, futs, budgets = [], [], []
+        for _ in range(int(rng.integers(3, 8))):
+            p = _toks((int(rng.integers(1, 20)),),
+                      seed=int(rng.integers(0, 1 << 16)))
+            mnt = int(rng.integers(1, 6))
+            total = p.size + mnt - 1
+            if sched._pages.pages_for(total) > n_pages:
+                continue            # would be rejected at submit
+            prompts.append(p)
+            budgets.append(mnt)
+            futs.append(sched.submit(p, max_new_tokens=mnt))
+            if rng.random() < 0.5:
+                sched.step()
+                sched._pages.check()
+        guard = 0
+        while sched.step():
+            sched._pages.check()
+            guard += 1
+            assert guard < 2000, "scheduler failed to drain"
+        for p, mnt, f in zip(prompts, budgets, futs):
+            assert f.result(5).tokens.tolist() == \
+                engine.generate(p, mnt).tolist()
+        sched._pages.check()
+        assert sched._pages.free_pages == sched._pages.n_pages
+        assert sched._pages.mapped_pages == 0
+
+
+# ------------------------- page release: preempt / cancel / exhaust
+
+def test_page_exhausted_pool_recovers_and_futures_complete(model, engine):
+    """Liveness under page pressure (PR 10 contract extended): a pool
+    too small for the offered load must preempt/requeue its way
+    through — every future completes with the right tokens, no page
+    leaks, nothing hangs."""
+    reg = get_registry()
+    reg.reset()
+    # 8 pages of 4 tokens: ~2 mid-size requests' working set
+    sched = _paged_sched(engine, n_slots=3, page_len=4, n_pages=8)
+    prompts = [_toks((n,), seed=40 + n) for n in (10, 14, 9, 12)]
+    futs = [sched.submit(p, max_new_tokens=6) for p in prompts]
+    guard = 0
+    while sched.step():
+        guard += 1
+        assert guard < 2000, "page-exhausted pool failed to drain"
+    for p, f in zip(prompts, futs):
+        assert f.result(10).tokens.tolist() == \
+            engine.generate(p, 6).tolist()
+    sched._pages.check()
+    assert sched._pages.free_pages == 8
+    # pressure was real: at least one preemption released pages
+    assert reg.get("dl4j_serving_preemptions_total").value() >= 1
+
+
+def test_preempted_request_releases_pages(model, engine):
+    """Starvation preemption hands the victim's pages straight back:
+    after the preempt step the victim maps nothing and the free list
+    grew; on re-admission it completes bit-identically."""
+    sched = _paged_sched(engine, n_slots=1, page_len=4, n_pages=16,
+                         starvation_ms=0.0)
+    long_p = _toks((5,), seed=41)
+    f_long = sched.submit(long_p, max_new_tokens=10)
+    sched.step()                      # admit + first token
+    mapped_before = sched._pages.mapped_pages
+    assert mapped_before > 0
+    import time as _t
+    _t.sleep(0.002)
+    short = _toks((3,), seed=42)
+    f_short = sched.submit(short, max_new_tokens=2)
+    _t.sleep(0.002)
+    sched.step()                      # starvation guard preempts long
+    assert sched._pages.mapped_pages < mapped_before + \
+        sched._pages.pages_for(3)     # victim's pages were returned
+    sched._pages.check()
+    sched.run_until_idle()
+    assert f_long.result(5).preemptions >= 1
+    assert f_long.result(5).tokens.tolist() == \
+        engine.generate(long_p, 10).tolist()
+    assert f_short.result(5).tokens.tolist() == \
+        engine.generate(short, 2).tolist()
+    assert sched._pages.free_pages == 16
+
+
+def test_starvation_guard_fires_during_chunked_prefill(model, engine):
+    """Regression: the starvation guard must keep working while a slot
+    is mid-chunked-prefill. The prefilling request carries the pool's
+    max remaining budget (nothing generated), so a naive global max()
+    would select it every step, fail the nothing-to-save guard, and
+    starve the queue head for the whole admission window — the guard
+    must pick among DECODING slots instead."""
+    import time as _t
+    sched = _paged_sched(engine, n_slots=2, page_len=4, n_pages=24,
+                         starvation_ms=0.0)
+    decoding = _toks((3,), seed=55)
+    fut_d = sched.submit(decoding, max_new_tokens=12)
+    sched.step()                      # slot 0 decodes
+    long_p = _toks((24,), seed=56)    # 3 chunks at chunk_len=8
+    fut_l = sched.submit(long_p, max_new_tokens=2)
+    sched.step()                      # slot 1 starts chunking
+    assert sched.slots[1] is not None and sched.slots[1].pending is not None
+    _t.sleep(0.002)
+    head = _toks((2,), seed=57)
+    fut_h = sched.submit(head, max_new_tokens=2)
+    _t.sleep(0.002)
+    sched.step()   # guard must preempt the DECODING slot, not bail
+    sched.run_until_idle()
+    assert fut_d.result(5).preemptions >= 1
+    assert fut_d.result(5).tokens.tolist() == \
+        engine.generate(decoding, 12).tolist()
+    assert fut_l.result(5).tokens.tolist() == \
+        engine.generate(long_p, 2).tolist()
+    assert fut_h.result(5).tokens.tolist() == \
+        engine.generate(head, 2).tolist()
+    sched._pages.check()
+    assert sched._pages.free_pages == 24
+
+
+def test_cancelled_queued_request_never_holds_pages(model, engine):
+    sched = _paged_sched(engine, n_slots=1, page_len=4, n_pages=8)
+    p1 = _toks((4,), seed=51)
+    p2 = _toks((4,), seed=52)
+    f_run = sched.submit(p1, max_new_tokens=2)
+    f_cancel = sched.submit(p2, max_new_tokens=2)
+    assert f_cancel.cancel()
+    sched.run_until_idle()
+    assert f_cancel.cancelled()
+    assert f_run.result(5).tokens.tolist() == \
+        engine.generate(p1, 2).tolist()
+    sched._pages.check()
+    assert sched._pages.free_pages == 8
+
+
+def test_submit_rejects_request_larger_than_pool(model, engine):
+    sched = _paged_sched(engine, n_slots=1, page_len=4, n_pages=3)
+    with pytest.raises(ValueError, match="pool holds"):
+        sched.submit(_toks((14,), seed=1), max_new_tokens=4)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_crash_resets_page_pool(model, engine, monkeypatch):
+    """_fail_all under paging: the dead pool leaks no pages — a
+    restarted serve loop starts from a whole free list."""
+    sched = _paged_sched(engine, n_slots=1, page_len=4, n_pages=8)
+    fut = sched.submit(_toks((4,), seed=61), max_new_tokens=6)
+    sched.step()                      # admit; pages mapped
+    assert sched._pages.mapped_pages > 0
+
+    def boom(cache, tokens):
+        raise RuntimeError("injected paged decode crash")
+    monkeypatch.setattr(sched.engine, "decode_step", boom)
+    sched.start(poll_s=0.001)
+    with pytest.raises(RuntimeError, match="injected paged decode"):
+        fut.result(timeout=30)
+    sched._thread.join(timeout=30)    # _fail_all ran before the re-raise
+    sched._pages.check()
+    assert sched._pages.free_pages == 8 and sched._pages.mapped_pages == 0
+
+
+# ------------------------------------------- retrace pinning (ISSUE 12)
+
+def test_zero_retraces_across_page_growth_and_chunks(model):
+    """CompileSentinel contract: after warmup, page-table growth is a
+    DATA change (fixed gather shape — zero retraces across arbitrarily
+    many admissions), and chunked prefill compiles at most once per
+    chunk bucket."""
+    cfg, params = model
+    eng = GenerationEngine(cfg, params, prefill_chunk=8)
+    sched = _paged_sched(eng, n_slots=2, page_len=4, n_pages=16)
+    warm = sched.submit(_toks((9,), seed=70), max_new_tokens=3)
+    sched.run_until_idle()
+    warm.result(5)
+    eng.mark_warm()
+    prompts = [_toks((n,), seed=71 + n) for n in (2, 7, 15, 20, 11)]
+    futs = [sched.submit(p, max_new_tokens=4) for p in prompts]
+    sched.run_until_idle()
+    for f in futs:
+        f.result(5)
+    rep = eng.compile_report()
+    assert sum(s["retraces_after_warm"] for s in rep.values()) == 0
+    assert rep["prefill_chunk"]["compiles"] <= len(eng.chunk_buckets)
+    assert rep["decode_paged"]["compiles"] == 1
+
+
+# -------------------------------------- residency accounting (paged)
+
+def test_paged_kv_report_counts_mapped_pages(model, engine):
+    reg = get_registry()
+    reg.reset()
+    sched = _paged_sched(engine, n_slots=2, page_len=4, n_pages=16)
+    prompts = [_toks((6,), seed=81), _toks((13,), seed=82)]
+    futs = [sched.submit(p, max_new_tokens=3) for p in prompts]
+    sched.step()
+    mapped = sched._pages.mapped_pages
+    assert mapped > 0
+    rep = sched.kv_report()
+    # allocated = mapped pages × page bytes — not the pool footprint
+    assert rep["allocated_bytes"] == mapped * page_nbytes(sched.cache)
+    assert rep["pool_bytes"] == cache_nbytes(sched.cache)
+    assert rep["paged"]["mapped_pages"] == mapped
+    assert rep["paged"]["page_len"] == 4
+    # waste is bounded by the last-page tails of the active slots: with
+    # page_len=4 a slot wastes < 1 page, so waste < n_active/(mapped)
+    assert 0.0 <= rep["waste_ratio_last"] < 1.0
+    sched.run_until_idle()
+    for f in futs:
+        f.result(5)
+    # gauges follow the mapped-page semantics
+    assert reg.get("dl4j_kv_allocated_bytes").value(replica="0") == \
+        sched._pages.mapped_pages * page_nbytes(sched.cache)
+    assert sched.step() is False              # idle: zero alloc, zero waste
+    assert reg.get("dl4j_kv_allocated_bytes").value(replica="0") == 0.0
+    assert reg.get("dl4j_kv_waste_ratio").value(replica="0") == 0.0
+    rep = sched.kv_report()
+    assert rep["peak_concurrent"] == 2
+    assert rep["finished_requests"] == 2
+    # paged waste over the busy window stays far below the dense 0.96:
+    # only unfilled page tails can be reserved-but-empty
+    assert rep["waste_ratio_mean"] < 0.5
+
+
+def test_waste_gauge_never_negative_at_page_boundary(model, engine):
+    """Regression: a just-sampled token is counted resident one sweep
+    before its page is mapped, so at an exact page boundary (prompt a
+    multiple of page_len) resident could exceed the mapping and the
+    waste gauge read negative — the snapshot clamps."""
+    reg = get_registry()
+    reg.reset()
+    sched = _paged_sched(engine, n_slots=1, page_len=4, n_pages=8)
+    fut = sched.submit(_toks((4,), seed=99), max_new_tokens=4)
+    waste = reg.get("dl4j_kv_waste_ratio")
+    while sched.step():
+        assert waste.value(replica="0") >= 0.0
+    fut.result(5)
+    rep = sched.kv_report()
+    assert rep["waste_ratio_mean"] >= 0.0
+
+
+def test_mem_report_gates_on_paged_semantics(model, engine, tmp_path):
+    """The offline half: a paged serve's flight-recorder dump renders
+    paged allocation (mapped pages of a pool) and the byte-weighted
+    waste mean feeds --max-waste — passing at the paged bound that
+    dense traffic (0.96 measured) could never meet."""
+    import subprocess
+    import sys
+    from pathlib import Path
+    sched = _paged_sched(engine, n_slots=2, page_len=4, n_pages=16)
+    prompts = [_toks((n,), seed=90 + n) for n in (5, 12, 8)]
+    futs = [sched.submit(p, max_new_tokens=4) for p in prompts]
+    sched.run_until_idle()
+    for f in futs:
+        f.result(5)
+    dump = tmp_path / "paged_serve.jsonl"
+    sched.flight_recorder.dump(str(dump))
+
+    script = Path(__file__).resolve().parent.parent / "scripts" / \
+        "mem_report.py"
+    proc = subprocess.run(
+        [sys.executable, str(script), str(dump), "--json",
+         "--max-waste", "0.5"], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    rep = json.loads(proc.stdout)["0"]
+    assert rep["paged"] is True
+    assert rep["kv_page_len"] == 4
+    assert rep["kv_pool_bytes"] == cache_nbytes(sched.cache)
+    assert rep["mapped_pages_max"] >= 1
+    assert rep["waste_ratio_mean"] < 0.5
+    # the rendered table names the paged pool
+    proc2 = subprocess.run([sys.executable, str(script), str(dump)],
+                           capture_output=True, text=True)
+    assert proc2.returncode == 0
+    assert "mapped pages" in proc2.stdout
+
+
+# ------------------------------------------------ bench-block schema
+
+@pytest.mark.slow
+def test_bench_serve_blocks_paged_tiny_engine():
+    """The decode row's paged serve blocks at CI scale: equal-byte
+    paged pool (dense slots × max_len re-cut into pages), measured
+    peak_concurrent ≥ 2× the dense slot count, page-tail-only waste,
+    zero retraces — the ISSUE 14 acceptance schema end to end.
+    (slow-marked: the captured bench artifact carries the same schema;
+    the tier-1 wall budget is tight.)"""
+    import bench
+
+    cfg = tiny_cfg(max_seq=64)
+    eng = GenerationEngine(cfg, tfm.init_params(jax.random.PRNGKey(0),
+                                                cfg), prefill_chunk=8)
+    slo, mem = bench._serve_blocks(eng, slots=2, paged=True,
+                                   new_tokens=3, prompt_len=6)
+    paged = mem["paged"]
+    assert paged["dense_equiv_slots"] == 2
+    # equal byte budget: the pool holds exactly the dense slots' rows
+    assert paged["n_pages"] * paged["page_len"] == 2 * eng.max_len
+    assert paged["peak_concurrent"] >= 4          # ≥2× dense slots
+    assert paged["concurrency_x"] >= 2.0
+    # page-tail waste is coarse at toy scale (~10-token requests on
+    # 16-token pages ≈ 0.6) but still beats the dense layout's, whose
+    # 64-token slots would idle ≥0.84 here (the real row: 0.108)
+    assert mem["kv_waste_ratio"] < 0.8
+    assert mem["retraces_after_warm"] == 0
+    assert slo["requests"] == 2 * 6               # 2× the paged lanes
+
+
+@pytest.mark.slow
+def test_bench_chunked_admission_itl_schema():
+    """The ttft row's slo.chunked_admission block at CI scale: both
+    p99s measured, the ratio recorded, the dense stall cited. (The
+    ≤2× verdict itself is scale-dependent — the real row records it;
+    at toy scale a chunk out-costs the tiny sweep. slow-marked like
+    the serve-blocks schema test above.)"""
+    import bench
+
+    cfg = tiny_cfg(max_seq=64)
+    eng = GenerationEngine(cfg, tfm.init_params(jax.random.PRNGKey(0),
+                                                cfg))
+    blk = bench._chunked_admission_itl(eng, 48, dense_stall_ms=123.4,
+                                       slots=2, baseline_sweeps=4,
+                                       short_len=8, chunk_len=16)
+    assert blk["chunks"] == 3 and blk["chunk_len"] == 16
+    assert blk["baseline_itl_p99_ms"] > 0
+    assert blk["admission_itl_p99_ms"] > 0
+    assert blk["admission_over_baseline"] > 0
+    assert isinstance(blk["met_2x"], bool)
+    assert blk["dense_admission_stall_ms"] == 123.4
+    assert blk["long_ttft_ms"] > 0
+
+
+# -------------------------------------------- autotune cost records
+
+def test_serving_knob_sweep_writes_cost_records(model, monkeypatch,
+                                                tmp_path):
+    """The knob sweep lands TVM-style cost records in the shared
+    autotune disk cache — choice + per-candidate measurements, keyed by
+    shape/dtype/backend — and recommended_serving_knobs() reads them
+    back as citable provenance."""
+    from deeplearning4j_tpu.kernels import autotune as at
+    from deeplearning4j_tpu.serving import tune
+
+    monkeypatch.setattr(at, "_CACHE_PATH", tmp_path / "autotune.json")
+    at._memory_cache.clear()
+    cfg, params = model
+    eng = GenerationEngine(cfg, params, prefill_chunk=8)
+    knobs = tune.sweep_serving_knobs(
+        eng, prompt_len=32)
+    assert knobs["page_len"] in tune.PAGE_LEN_CANDIDATES
+    assert knobs["prefill_chunk"] in tune.PREFILL_CHUNK_CANDIDATES
+    assert knobs["decode_slots"] in tune.DECODE_SLOT_CANDIDATES
+
+    recs = tune.recommended_serving_knobs(cfg)
+    kinds = {k.split(":")[0] for k in recs}
+    assert kinds == {"serving_page_len", "serving_prefill_chunk",
+                     "serving_decode_slots"}
+    for key, rec in recs.items():
+        assert rec["meta"] is not None, key
+        assert rec["meta"]["best_s"] > 0
+        timed = [m for m in rec["meta"]["measurements"]
+                 if m[1] is not None]
+        assert timed, key                     # real measurements behind it
+        assert [rec["choice"]] == [list(min(
+            timed, key=lambda m: m[1])[0])]   # choice == fastest measured
+    at._memory_cache.clear()
