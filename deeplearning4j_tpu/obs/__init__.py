@@ -45,6 +45,15 @@ Instrumented surfaces (all under the ``dl4j_`` namespace —
   comparisons (``dl4j_fidelity_*{kind}``, the spec-decode /
   quantized-KV acceptance oracle). Forensics: ``GET /debug/numerics``,
   ``scripts/fidelity_report.py``.
+- ``obs.trend`` — the perf regression & trend plane (ISSUE 15): the
+  longitudinal layer the other planes feed. Append-only bench ledger
+  (``runs/perf_ledger.jsonl``) every ``bench.py`` capture appends a
+  keyed record to, noise-aware change detection with bands from the
+  *measured* IQR, two-cluster bimodality verdicts (the T=4096
+  best-XLA debt), regression attribution (floor diff / retraces /
+  layer spans → suspects), ``dl4j_trend_*{row, backend, verdict}``
+  gauges. Forensics: ``GET /debug/trend``, ``scripts/perf_gate.py``
+  (trend table + CI regression gate vs a pinned baseline).
 """
 
 from .registry import (Counter, DEFAULT_BUCKETS, Gauge,  # noqa: F401
@@ -73,6 +82,7 @@ from .reqtrace import (FlightRecorder, RequestTrace,  # noqa: E402,F401
 from .slo import SLOConfig, SLOTracker  # noqa: E402,F401
 from . import numerics  # noqa: E402,F401  (numerics plane, ISSUE 13)
 from . import fidelity  # noqa: E402,F401  (fidelity probes, ISSUE 13)
+from . import trend  # noqa: E402,F401  (perf trend plane, ISSUE 15)
 from .numerics import (DriftAuditor, NumericsSentinel,  # noqa: E402,F401
                        audit_params, drift_report, emit_stats,
                        summarize)

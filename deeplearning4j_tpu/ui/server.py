@@ -38,6 +38,12 @@ Endpoints:
                         live sentinel's trip log, the cross-replica
                         drift-audit summary, and the latest
                         fidelity-probe reports
+  GET /debug/trend      perf regression & trend plane (ISSUE 15): the
+                        bench ledger replayed into per-row trend
+                        verdicts (stable/improved/regressed/unstable/
+                        bimodal with cluster medians), verdict counts,
+                        pct vs baseline — mirrored as dl4j_trend_*
+                        gauges on /metrics
 """
 
 from __future__ import annotations
@@ -195,6 +201,12 @@ class _Handler(BaseHTTPRequestHandler):
             # sentinel trip logs, drift audits, fidelity reports
             from ..obs import numerics as obs_numerics
             body = json.dumps(obs_numerics.debug_state()).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/debug/trend"):
+            # perf trend plane (ISSUE 15): ledger replay — verdicts
+            # per (row, backend), cluster medians on bimodal rows
+            from ..obs import trend as obs_trend
+            body = json.dumps(obs_trend.debug_state()).encode()
             ctype = "application/json"
         elif self.path.startswith("/debug/requests"):
             from ..obs import live_flight_recorders

@@ -63,8 +63,9 @@ NAMESPACE = "dl4j_"
 # Every label NAME any instrumentation site registers. Extending this
 # is a deliberate act: each new label multiplies time series, and an
 # unbounded one (request id, trace id) melts the registry.
-ALLOWED_LABELS = {"component", "config", "direction", "kind", "layer",
-                  "level", "reason", "replica", "stat", "unit"}
+ALLOWED_LABELS = {"backend", "component", "config", "direction", "kind",
+                  "layer", "level", "reason", "replica", "row", "stat",
+                  "unit", "verdict"}
 # per-prefix restriction (ISSUE 12/13): each observability plane may
 # label ONLY from its own small fixed vocabulary — component names,
 # stat kinds and probe-pair kinds are bounded sets, never per-request
@@ -79,6 +80,11 @@ PLANE_LABELS = {
     "dl4j_num_": {"kind", "layer", "replica"},
     "dl4j_fidelity_": {"kind", "layer", "replica"},
     "dl4j_replica_": {"replica"},
+    # perf trend plane (ISSUE 15): the ledger key (row, backend) plus
+    # the verdict enum — bench row names are a small fixed set; never
+    # a sha, host fingerprint or capture id (those live in the ledger
+    # records themselves)
+    "dl4j_trend_": {"backend", "row", "verdict"},
 }
 # label names that smell like per-request/per-trace identity — never
 # allowed even if someone adds them to the allowlist above by mistake

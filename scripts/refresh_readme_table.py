@@ -6,7 +6,10 @@ hand-edited. Also enforces the floor-or-lever discipline (ISSUE 7):
 every rendered row must carry a ``floor`` block (or explicitly lack one,
 ``floor: {"na": ...}`` — the dpoverhead delta row); a record with NO
 floor key predates the floor engine and is flagged as stale so the next
-capture re-derives it. Run after a bench capture:
+capture re-derives it. The trend column (ISSUE 15) renders ▲/▼/≈ with
+% vs the previous same-backend capture from ``runs/perf_ledger.jsonl``,
+tolerant of a missing or partial ledger (em-dash). Run after a bench
+capture:
     python scripts/refresh_readme_table.py
 """
 
@@ -26,10 +29,29 @@ _spec = importlib.util.spec_from_file_location(
 _mem = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(_mem)
 _fmt_bytes = _mem.format_bytes
+# trend cells (ISSUE 15) come from the perf ledger via obs/trend.py —
+# same standalone-by-file-path discipline, tolerant of a missing ledger
+_tspec = importlib.util.spec_from_file_location(
+    "_dl4j_obs_trend_standalone",
+    REPO / "deeplearning4j_tpu" / "obs" / "trend.py")
+_trend = importlib.util.module_from_spec(_tspec)
+_tspec.loader.exec_module(_trend)
 BEGIN = "<!-- BENCH-TABLE BEGIN (scripts/refresh_readme_table.py) -->"
 END = "<!-- BENCH-TABLE END -->"
 
 _floor_warnings = []
+
+# the ledger is read once; every cell filters it (missing/partial
+# ledger → every cell is an em-dash, the table still renders)
+_LEDGER = _trend.load_ledger()
+
+
+def trend_col(name, rec):
+    """▲/▼/≈ with % vs the previous same-backend capture of this row
+    (ISSUE 15). Em-dash when the ledger is missing or holds fewer than
+    two comparable captures."""
+    backend = rec.get("backend") if isinstance(rec, dict) else None
+    return _trend.trend_cell(name, backend, _LEDGER)
 
 
 def floor_cell(label, rec):
@@ -67,15 +89,19 @@ def fmt_value(rec):
     return f"{v:,.0f} {unit}"
 
 
-def row(label, rec, extra=""):
+def row(label, rec, extra="", name=None):
     if not isinstance(rec, dict) or rec.get("value") is None:
         return None
     mfu = rec.get("mfu")
     mfu_s = f"{mfu:.2f}" if isinstance(mfu, (int, float)) else "—"
     if rec.get("unstable"):
         extra += f" *(unstable: median of {rec.get('median_of_k')})*"
+    if rec.get("bimodal") and rec.get("cluster_medians_ms"):
+        lo, hi = rec["cluster_medians_ms"]
+        extra += f" *(bimodal: {lo}/{hi} ms modes)*"
     return (f"| {label} | {fmt_value(rec)}{extra} | {mfu_s} "
-            f"| {floor_cell(label, rec)} |")
+            f"| {floor_cell(label, rec)} "
+            f"| {trend_col(name, rec) if name else '—'} |")
 
 
 INFERENCE_LABELS = {
@@ -152,7 +178,8 @@ def inference_row(name, rec):
     captured = ("on-chip" if rec.get("backend") == "tpu"
                 else "⏳ CPU-derived, on-chip TODO")
     return (f"| {label} | {val} | {'; '.join(details) or '—'} "
-            f"| {waste_cell(rec)} | {mem_cell(rec)} | {captured} |")
+            f"| {waste_cell(rec)} | {mem_cell(rec)} "
+            f"| {trend_col(name, rec)} | {captured} |")
 
 
 def inference_lines(inf):
@@ -171,8 +198,9 @@ def inference_lines(inf):
             "only against their own floor/memory evidence, not across "
             "captures:",
             "",
-            "| config | value | detail | KV waste | memory | captured |",
-            "|---|---|---|---|---|---|"] + rows
+            "| config | value | detail | KV waste | memory | trend "
+            "| captured |",
+            "|---|---|---|---|---|---|---|"] + rows
 
 
 def main():
@@ -198,36 +226,39 @@ def main():
              "(each record carries `captured_at` + `git_sha` + "
              "`backend: tpu`):",
              "",
-             "| config | throughput | MFU | % of floor |",
-             "|---|---|---|---|"]
+             "| config | throughput | MFU | % of floor | trend |",
+             "|---|---|---|---|---|"]
     vsb = head.get("vs_baseline")
     rows = [
         row("ResNet-50 **real `fit(DataSetIterator)`**, bf16, batch 128",
             head, extra=f" ({vsb}× the 360 img/s V100 baseline)"
-            if vsb else ""),
+            if vsb else "", name="resnet50"),
         row("ResNet-50 `fit_scanned` (one dispatch/epoch)",
-            sec.get("resnet50_fitscan")),
-        row("ResNet-50 raw train step", sec.get("resnet50_rawstep")),
-        row("BERT-base fine-tune, T=128", sec.get("bert")),
+            sec.get("resnet50_fitscan"), name="resnet50_fitscan"),
+        row("ResNet-50 raw train step", sec.get("resnet50_rawstep"),
+            name="resnet50_rawstep"),
+        row("BERT-base fine-tune, T=128", sec.get("bert"), name="bert"),
         row("Transformer-LM 120M, T=1024 (flash + save-attn remat, b32)",
-            sec.get("transformer")),
+            sec.get("transformer"), name="transformer"),
         row("Transformer-LM long context, T=4096 (flash attention)",
-            sec.get("transformer_long")),
+            sec.get("transformer_long"), name="transformer_long"),
         row("Transformer-LM extra-long context, T=8192 (flash, remat-off)",
-            sec.get("transformer_xlong")),
-        row("GravesLSTM char-RNN, bf16", sec.get("charnn")),
+            sec.get("transformer_xlong"), name="transformer_xlong"),
+        row("GravesLSTM char-RNN, bf16", sec.get("charnn"),
+            name="charnn"),
         row("GravesLSTM char-RNN, f32 (delta record)",
-            sec.get("charnn_f32")),
-        row("LeNet MNIST, bf16", sec.get("lenet")),
+            sec.get("charnn_f32"), name="charnn_f32"),
+        row("LeNet MNIST, bf16", sec.get("lenet"), name="lenet"),
         row("LeNet MNIST, `fit_scanned` (scan-dispatch)",
-            sec.get("lenet_scan")),
+            sec.get("lenet_scan"), name="lenet_scan"),
     ]
     lines += [r for r in rows if r]
     dp = sec.get("dpoverhead", {})
     if isinstance(dp, dict) and dp.get("value") is not None:
         lines.append(f"| dp-8 ParallelWrapper overhead (virtual CPU mesh) "
                      f"| +{dp['value']:.1f} ms/step at equal global batch "
-                     f"| — | {floor_cell('dpoverhead', dp)} |")
+                     f"| — | {floor_cell('dpoverhead', dp)} "
+                     f"| {trend_col('dpoverhead', dp)} |")
     lines += inference_lines(art.get("inference", {}))
     if _floor_warnings:
         lines.append("")
