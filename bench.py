@@ -1423,6 +1423,127 @@ def bench_inference_ttft_4096(batch, steps):
     return _ttft_row(4096, reps=max(steps, 2), chunked_admission=True)
 
 
+def bench_inference_prefix_shared(batch, steps):
+    """CoW prefix cache row (ISSUE 16): `batch` requests share a
+    1024-token common prefix (the system-prompt shape) with mixed
+    random tails. Three phases against the same page budget:
+
+    - sharing ON, sequential: a cold leader pays the full prefill,
+      then every follower admits against the cached prefix and
+      chunk-prefills only its tail — warm TTFT median is the row value;
+    - sharing ON, concurrent: `slots` requests decode together while
+      the page table is sampled — tokens-resident-per-user with the
+      prefix counted ONCE (used pages) vs per-slot (mapped pages, what
+      a no-sharing pool holds);
+    - sharing OFF, same prompts: measured cold TTFT AND a greedy
+      bit-equivalence check against the sharing-on outputs.
+    """
+    import numpy as np
+    import statistics
+    from deeplearning4j_tpu.serving import (ContinuousBatchingScheduler,
+                                            DEFAULT_PAGE_LEN)
+
+    prefix_len, slots = 1024, 8
+    n_req = max(batch, 2)
+    new_tokens = max(steps, 2)
+    eng, cfg = _serving_engine(prefix_len + 128)
+    pages_per_slot = -(-cfg.max_seq // DEFAULT_PAGE_LEN)
+    n_pages = slots * pages_per_slot     # the dense-equivalent budget
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, cfg.vocab_size, (prefix_len,)).astype(
+        np.int32)
+    prompts = [np.concatenate([prefix, rng.integers(
+        0, cfg.vocab_size, (int(rng.integers(8, 65)),)).astype(np.int32)])
+        for _ in range(n_req)]
+
+    sched = ContinuousBatchingScheduler(eng, n_slots=slots,
+                                        page_len=DEFAULT_PAGE_LEN,
+                                        n_pages=n_pages,
+                                        prefix_cache=True)
+    # cold leader: full-prefix prefill, pages cached at retirement
+    leader = sched.submit(prompts[0], max_new_tokens=new_tokens)
+    sched.run_until_idle()
+    ttft_cold = leader.result(timeout=1200).ttft_s
+    # warm followers, sequential (queue-free TTFT): tail-only prefill
+    warm_samples, on_tokens = [], {}
+    for i, p in enumerate(prompts[1:], start=1):
+        f = sched.submit(p, max_new_tokens=new_tokens)
+        sched.run_until_idle()
+        res = f.result(timeout=1200)
+        warm_samples.append(res.ttft_s)
+        on_tokens[i] = res.tokens.tolist()
+    warm_med = float(statistics.median(warm_samples))
+    # concurrent wave: residency per user while `slots` decode
+    # together. Generation long enough to span several sweeps — the
+    # page table is sampled AFTER each step, and a too-short wave
+    # retires inside the first one, leaving nothing to observe
+    wave = [sched.submit(p, max_new_tokens=max(new_tokens, 8))
+            for p in prompts[:slots]]
+    best = (0, 0, 0, 0)                 # (active, used, mapped, shared)
+    while sched.step():
+        with sched._lock:
+            active = sum(1 for s in sched.slots if s is not None)
+            if active >= best[0]:
+                best = (active, sched._pages.used_pages,
+                        sched._pages.mapped_pages,
+                        sched._pages.shared_pages)
+    for f in wave:
+        f.result(timeout=1200)
+    assert sched.check_pages()
+    prefix_rep = sched.kv_report()["prefix"]
+    active, used, mapped, shared = best
+    per_user_shared = (used * DEFAULT_PAGE_LEN / active) if active else None
+    per_user_dense = (mapped * DEFAULT_PAGE_LEN / active) if active else None
+
+    # sharing OFF: measured cold TTFT over a subset of the SAME
+    # prompts + greedy bit-equivalence vs the sharing-on outputs
+    sched_off = ContinuousBatchingScheduler(eng, n_slots=slots,
+                                            page_len=DEFAULT_PAGE_LEN,
+                                            n_pages=n_pages)
+    off_samples, mismatches = [], 0
+    n_off = min(4, n_req - 1)
+    for i in range(1, 1 + n_off):
+        f = sched_off.submit(prompts[i], max_new_tokens=new_tokens)
+        sched_off.run_until_idle()
+        res = f.result(timeout=1200)
+        off_samples.append(res.ttft_s)
+        if res.tokens.tolist() != on_tokens[i]:
+            mismatches += 1
+    off_med = float(statistics.median(off_samples))
+
+    rec = {
+        "metric": f"Serving TTFT under a shared {prefix_len}-token "
+                  f"prefix, {n_req} requests, CoW prefix cache "
+                  "(Transformer-LM 120M)",
+        "value": round(warm_med * 1e3, 1), "unit": "ms",
+        "requests": n_req, "prefix_tokens": prefix_len,
+        "decode_slots": slots, "n_pages": n_pages,
+        "ttft_ms_samples": [round(s * 1e3, 1) for s in warm_samples],
+        "ttft_cold_ms": round(ttft_cold * 1e3, 1),
+        "ttft_no_sharing_ms": round(off_med * 1e3, 1),
+        "ttft_speedup_x": round(off_med / warm_med, 2) if warm_med else None,
+        "tokens_resident_per_user_shared": round(per_user_shared, 1)
+        if per_user_shared else None,
+        "tokens_resident_per_user_dense": round(per_user_dense, 1)
+        if per_user_dense else None,
+        "residency_sample_active_users": active,
+        "shared_pages_sampled": shared,
+        "prefix_hits": prefix_rep["prefix_hits"],
+        "prefix_hit_tokens": prefix_rep["prefix_hit_tokens"],
+        "cow_copies": prefix_rep["cow_copies"],
+        "greedy_bitmatch_vs_no_sharing": mismatches == 0,
+        "no_sharing_reps": n_off,
+        "timing": "wall submit→first-token through the scheduler, "
+                  "sequential (queue-free); value = warm (prefix-hit) "
+                  "median, vs measured no-sharing cold median over "
+                  f"{n_off} of the same prompts",
+    }
+    assert mismatches == 0, (
+        f"{mismatches}/{n_off} prompts decoded differently with the "
+        "prefix cache on — sharing broke greedy bit-equivalence")
+    return _flag_on_chip(_stamp(rec))
+
+
 def _latency_sweep(pi, make_batch, iters, batches=(1, 8, 32)):
     """batch-1 p50/p99 + best-batch throughput through a LIVE
     ParallelInference (jit dispatch, padding, host round-trip included —
@@ -1515,8 +1636,8 @@ def bench_inference_bert_b1(batch, steps):
 
 
 INFERENCE_ROWS = ("inference_decode", "inference_ttft_1024",
-                  "inference_ttft_4096", "inference_resnet_b1",
-                  "inference_bert_b1")
+                  "inference_ttft_4096", "inference_prefix_shared",
+                  "inference_resnet_b1", "inference_bert_b1")
 
 CONFIGS = {
     "resnet50": bench_resnet50_fit,   # headline: the REAL fit() entry point
@@ -1534,6 +1655,7 @@ CONFIGS = {
     "inference_decode": bench_inference_decode,
     "inference_ttft_1024": bench_inference_ttft_1024,
     "inference_ttft_4096": bench_inference_ttft_4096,
+    "inference_prefix_shared": bench_inference_prefix_shared,
     "inference_resnet_b1": bench_inference_resnet_b1,
     "inference_bert_b1": bench_inference_bert_b1,
 }
@@ -1564,6 +1686,9 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     "inference_decode": (8, 25),
     "inference_ttft_1024": (1, 3),
     "inference_ttft_4096": (1, 2),   # T=4096 prefill is minutes on CPU
+    # prefix row: batch = requests sharing the 1024-token prefix, steps
+    # = decode tokens per request; one cold prefill + batch-1 warm tails
+    "inference_prefix_shared": (64, 4),
     "inference_resnet_b1": (1, 15),
     "inference_bert_b1": (1, 12),
 }

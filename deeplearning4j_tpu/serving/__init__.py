@@ -45,9 +45,9 @@ from .adapter import FunctionalInferenceModel  # noqa: F401
 from .engine import (DEFAULT_PREFILL_BUCKETS, GenerationEngine,  # noqa: F401
                      sample_tokens)
 from .kvcache import (DEFAULT_PAGE_LEN, DEFAULT_PREFILL_CHUNK,  # noqa: F401
-                      PageTable, cache_len, cache_nbytes, cache_slots,
-                      init_cache, init_paged_cache, is_paged, page_nbytes,
-                      token_nbytes)
+                      PageTable, PrefixCache, cache_len, cache_nbytes,
+                      cache_slots, init_cache, init_paged_cache, is_paged,
+                      page_nbytes, token_nbytes)
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         GenerationResult, ServingRequest)
 
@@ -55,7 +55,8 @@ __all__ = [
     "ContinuousBatchingScheduler", "DEFAULT_PAGE_LEN",
     "DEFAULT_PREFILL_BUCKETS", "DEFAULT_PREFILL_CHUNK",
     "FunctionalInferenceModel", "GenerationEngine", "GenerationResult",
-    "PageTable", "SLOConfig", "SLOTracker", "ServingRequest", "cache_len",
-    "cache_nbytes", "cache_slots", "init_cache", "init_paged_cache",
-    "is_paged", "page_nbytes", "sample_tokens", "token_nbytes",
+    "PageTable", "PrefixCache", "SLOConfig", "SLOTracker",
+    "ServingRequest", "cache_len", "cache_nbytes", "cache_slots",
+    "init_cache", "init_paged_cache", "is_paged", "page_nbytes",
+    "sample_tokens", "token_nbytes",
 ]

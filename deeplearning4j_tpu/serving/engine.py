@@ -159,9 +159,12 @@ class GenerationEngine:
         self._prefill_chunk = CompileSentinel(
             "prefill_chunk", jax.jit(self._prefill_chunk_raw,
                                      donate_argnums=(1,)))
+        self._copy_page = CompileSentinel(
+            "copy_page", jax.jit(self._copy_page_raw,
+                                 donate_argnums=(0,)))
         self.sentinels = {s.name: s for s in (
             self._decode, self._prefill, self._prefill_slot, self._sample,
-            self._decode_paged, self._prefill_chunk)}
+            self._decode_paged, self._prefill_chunk, self._copy_page)}
 
     # ------------------------------------------------------------ cache
     def init_cache(self, n_slots: int):
@@ -400,7 +403,35 @@ class GenerationEngine:
         return logits, {"k": k_new, "v": v_new, "pos": pos,
                         "pages": table}
 
+    @staticmethod
+    def _copy_page_raw(cache, src, dst):
+        """Copy-on-write page split (ISSUE 16): duplicate pool page
+        ``src``'s k/v rows (every layer) into page ``dst``. Scalar
+        src/dst are traced operands, so ONE compile covers every split;
+        the cache is donated — the copy lands in place in the pool."""
+        k = cache["k"]
+        v = cache["v"]
+        row_k = jax.lax.dynamic_slice_in_dim(k, src, 1, axis=1)
+        row_v = jax.lax.dynamic_slice_in_dim(v, src, 1, axis=1)
+        return dict(cache,
+                    k=jax.lax.dynamic_update_slice_in_dim(
+                        k, row_k, dst, axis=1),
+                    v=jax.lax.dynamic_update_slice_in_dim(
+                        v, row_v, dst, axis=1))
+
     # ------------------------------------------------------- host API
+    def copy_page(self, cache, src: int, dst: int):
+        """Duplicate pool page ``src`` into ``dst`` (paged cache only) —
+        the device half of a CoW split, after ``PageTable.cow`` remapped
+        the table entry. The cache is DONATED; keep only the return."""
+        if not kvcache.is_paged(cache):
+            raise ValueError("copy_page needs a paged cache")
+        npg = kvcache.n_pages(cache)
+        if not (0 <= int(src) < npg and 0 <= int(dst) < npg):
+            raise ValueError(f"page copy {src}->{dst} outside the "
+                             f"{npg}-page pool")
+        return self._copy_page(cache, jnp.int32(src), jnp.int32(dst))
+
     def prefill(self, cache, tokens, lengths=None):
         """Prefill the whole pool. ``tokens`` (B, T) with B == cache
         slots; ``lengths`` (B,) defaults to the full T per row."""

@@ -44,6 +44,20 @@ A short request holds ``ceil(len/page_len)`` pages instead of
 side rides the cache pytree through the same donated entry points as
 the dense cache.
 
+**Copy-on-write prefix sharing** (ISSUE 16): pages are REF-COUNTED, so
+one pool page may back the same token prefix in many slots at once —
+the gather attention reads arbitrary page sets, so sharing needs zero
+jitted-code changes. :class:`PrefixCache` keeps a radix-style index
+over resident pages (each page-aligned token block hashed chained on
+its predecessor's hash) plus per-session retention entries; admission
+matches an incoming prompt against it, maps the shared prefix via
+:meth:`PageTable.map_shared`, and chunk-prefills only the unmatched
+tail. A slot about to scatter into a page with other holders first
+splits it (:meth:`PageTable.cow` + one device page copy). Pages whose
+only holders are cache entries ("cached" state) are LRU-evicted under
+page pressure, before the scheduler's preemption path. The page
+lifecycle: free → mapped → shared → cow-split → cached → evicted.
+
 ``DEFAULT_PAGE_LEN = 16`` follows the vLLM block-size precedent and the
 ``serving_page_len:*`` autotune cost records (``serving/tune.py``
 re-measures it per shape/dtype/backend into the persistent autotune
@@ -52,7 +66,9 @@ cache).
 
 from __future__ import annotations
 
-from typing import List
+import hashlib
+
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -182,16 +198,22 @@ def init_paged_cache(cfg, n_slots: int, n_pages: int,
 
 
 class PageTable:
-    """Host side of the paged mapping: the free list and the numpy
-    mirror of the device ``pages`` table. The scheduler maps pages
-    before a dispatch needs them and releases them when a request
-    finishes / is preempted / is cancelled; :meth:`device_table` hands
-    the mirror to the device only when it changed (a (n_slots, P) int32
-    transfer — never a retrace, the shape is fixed).
+    """Host side of the paged mapping: the free list, per-page
+    refcounts, and the numpy mirror of the device ``pages`` table. The
+    scheduler maps pages before a dispatch needs them and releases its
+    holds when a request finishes / is preempted / is cancelled;
+    :meth:`sync` hands the mirror to the device only when it changed
+    (a (n_slots, P) int32 transfer — never a retrace, the shape is
+    fixed).
 
-    Invariants (``check()`` asserts them; the fuzz test hammers them):
-    a page is FREE xor mapped by exactly ONE slot, and
-    ``free + mapped == n_pages`` always.
+    Pages are ref-counted (ISSUE 16): a slot mapping a page holds one
+    ref, a :class:`PrefixCache` entry or session retaining it holds
+    another, and the page returns to the free list only at refcount
+    zero. Invariants (``check()`` asserts them; the fuzz tests hammer
+    them): a page is FREE xor ref-counted (the ISSUE 14
+    free-xor-mapped-once invariant generalized), slot mappings never
+    exceed a page's refcount, and — given the cache's hold census —
+    slot maps + cache holds equal the refcount exactly.
     """
 
     def __init__(self, n_slots: int, n_pages: int, page_len: int,
@@ -205,6 +227,10 @@ class PageTable:
         self.table = np.full((self.n_slots, self.pages_per_slot),
                              self.n_pages, np.int32)
         self.mapped = np.zeros((self.n_slots,), np.int32)
+        # holders per pool page (slots + cache entries), and the token
+        # fill census behind shared-counted-once residency accounting
+        self.refcount = np.zeros((self.n_pages,), np.int32)
+        self.fill = np.zeros((self.n_pages,), np.int32)
         self._dirty = True                    # device mirror stale?
 
     @classmethod
@@ -223,7 +249,28 @@ class PageTable:
 
     @property
     def mapped_pages(self) -> int:
+        """Per-slot mapping count summed — a SHARED page counts once
+        per slot mapping it (per-slot capacity math). Residency
+        accounting wants :attr:`used_pages` instead."""
         return int(self.mapped.sum())
+
+    @property
+    def used_pages(self) -> int:
+        """Pool pages with at least one holder, each counted ONCE
+        regardless of how many slots share it (ISSUE 16: the truthful
+        allocated-bytes base)."""
+        return self.n_pages - len(self._free)
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages with more than one holder (slot maps + cache holds)."""
+        return int((self.refcount > 1).sum())
+
+    @property
+    def resident_tokens(self) -> int:
+        """Tokens held across all resident pages, shared counted once
+        (the :meth:`note_fill` census)."""
+        return int(self.fill.sum())
 
     def slot_tokens_capacity(self, slot: int) -> int:
         """Tokens the slot's mapped pages can hold right now."""
@@ -234,10 +281,18 @@ class PageTable:
         need = self.pages_for(tokens) - int(self.mapped[slot])
         return need <= len(self._free)
 
+    def _alloc(self) -> int:
+        """Pop a fresh page off the free list: refcount 1, empty."""
+        p = self._free.pop()
+        self.refcount[p] = 1
+        self.fill[p] = 0
+        return p
+
     def map(self, slot: int, tokens: int) -> bool:
-        """Grow ``slot``'s mapping to cover ``tokens`` rows. All-or-
-        nothing: returns False (mapping untouched) when the free list
-        cannot cover the growth — the caller preempts to make room."""
+        """Grow ``slot``'s mapping to cover ``tokens`` rows with FRESH
+        pages. All-or-nothing: returns False (mapping untouched) when
+        the free list cannot cover the growth — the caller evicts
+        cached prefix pages and/or preempts to make room."""
         want = self.pages_for(tokens)
         if want > self.pages_per_slot:
             raise ValueError(
@@ -251,31 +306,116 @@ class PageTable:
         if need > len(self._free):
             return False
         for j in range(have, want):
-            self.table[slot, j] = self._free.pop()
+            self.table[slot, j] = self._alloc()
         self.mapped[slot] = want
         self._dirty = True
         return True
 
+    def map_shared(self, slot: int, pages) -> None:
+        """Map an admission's matched prefix (ISSUE 16): the already-
+        resident ``pages`` become ``slot``'s logical pages
+        ``0..len-1``, each gaining one ref. The slot must map nothing
+        yet (admission-time only); growth past the prefix goes through
+        :meth:`map` as usual."""
+        if int(self.mapped[slot]):
+            raise ValueError(f"slot {slot} already maps "
+                             f"{int(self.mapped[slot])} pages")
+        pages = [int(p) for p in pages]
+        if len(pages) > self.pages_per_slot:
+            raise ValueError(f"{len(pages)} shared pages exceed the "
+                             f"{self.pages_per_slot}-entry page table")
+        for p in pages:
+            if not (0 <= p < self.n_pages) or self.refcount[p] < 1:
+                raise ValueError(f"page {p} is not resident")
+        for j, p in enumerate(pages):
+            self.table[slot, j] = p
+            self.refcount[p] += 1
+        if pages:
+            self.mapped[slot] = len(pages)
+            self._dirty = True
+
+    def incref(self, page: int):
+        """Add a cache hold on a RESIDENT page (PrefixCache entries and
+        session retention — the holds that keep a finished request's
+        pages shareable)."""
+        if not (0 <= int(page) < self.n_pages) or self.refcount[page] < 1:
+            raise ValueError(f"page {page} is not resident")
+        self.refcount[page] += 1
+
+    def decref(self, page: int) -> int:
+        """Drop one ref; a page reaching zero refs returns to the free
+        list (free-XOR-refcounted). Returns 1 if the page freed, else
+        0."""
+        r = int(self.refcount[page]) - 1
+        if r < 0:
+            raise ValueError(f"page {page} is already free")
+        self.refcount[page] = r
+        if r == 0:
+            self.fill[page] = 0
+            self._free.append(int(page))
+            return 1
+        return 0
+
+    def cow(self, slot: int, j: int):
+        """Copy-on-write split of ``slot``'s logical page ``j`` — which
+        must have other holders — before the slot scatters into it:
+        remap the entry to a fresh page, drop one ref on the old one,
+        and return ``(src, dst)`` pool ids for the caller's device page
+        copy. Returns None when no free page exists (the caller evicts
+        / preempts and retries)."""
+        if not (0 <= j < int(self.mapped[slot])):
+            raise ValueError(f"slot {slot} logical page {j} is unmapped")
+        old = int(self.table[slot, j])
+        if int(self.refcount[old]) <= 1:
+            raise ValueError(
+                f"page {old} is exclusively owned — no split needed")
+        if not self._free:
+            return None
+        new = self._alloc()
+        self.fill[new] = int(self.fill[old])
+        self.table[slot, j] = new
+        self.refcount[old] -= 1
+        self._dirty = True
+        return old, new
+
+    def note_fill(self, slot: int, tokens: int):
+        """Record the tokens ``slot``'s mapping holds into the per-page
+        fill census (shared pages counted once via the per-page max):
+        logical page ``j`` holds ``min(page_len, tokens - j*page_len)``
+        rows, clamped to the mapped range."""
+        t = max(0, int(tokens))
+        for j in range(min(self.pages_for(t), int(self.mapped[slot]))):
+            p = int(self.table[slot, j])
+            f = min(self.page_len, t - j * self.page_len)
+            if f > self.fill[p]:
+                self.fill[p] = f
+
     def release(self, slot: int) -> int:
-        """Return every page ``slot`` holds to the free list and reset
-        its table row to the sentinel (so stale device writes from the
-        freed lane DROP instead of landing in a re-issued page).
-        Returns the number of pages released."""
+        """Drop ``slot``'s hold on every page it maps and reset its
+        table row to the sentinel (so stale device writes from the
+        freed lane DROP instead of landing in a re-issued page). Pages
+        with remaining holders — shared prefixes, cached entries —
+        stay resident; the rest return to the free list. Returns the
+        number of mappings removed (NOT necessarily pages freed)."""
         have = int(self.mapped[slot])
         if have == 0:
             return 0
         for j in range(have - 1, -1, -1):     # LIFO: reuse hot pages
-            self._free.append(int(self.table[slot, j]))
+            self.decref(int(self.table[slot, j]))
         self.table[slot, :have] = self.n_pages
         self.mapped[slot] = 0
         self._dirty = True
         return have
 
     def reset(self):
-        """Release everything (``_fail_all``)."""
+        """Release everything (``_fail_all``). A PrefixCache layered on
+        this table must ``forget()`` its holds in the same breath — the
+        refcounts they backed are gone."""
         self._free = list(range(self.n_pages - 1, -1, -1))
         self.table[:] = self.n_pages
         self.mapped[:] = 0
+        self.refcount[:] = 0
+        self.fill[:] = 0
         self._dirty = True
 
     # --------------------------------------------------------- device
@@ -293,13 +433,27 @@ class PageTable:
         return cache
 
     # ------------------------------------------------------ invariant
-    def check(self):
-        """Assert the free-xor-mapped-once invariant; raises
+    def check(self, external=None):
+        """Assert the free-XOR-refcounted invariant; raises
         AssertionError with a diagnosis on violation (the fuzz
-        harness's oracle)."""
+        harness's oracle).
+
+        ``external`` maps page id -> hold count owed by layers above
+        the table (PrefixCache entries + session retention). Every
+        page's refcount must equal its slot mappings plus its external
+        holds EXACTLY — a leaked or double-dropped ref is caught here,
+        not as an eventual use-after-free. With no external holds this
+        degenerates to the PR 14 free-xor-mapped-once check (shared
+        mappings excepted, which only arise via ``map_shared``)."""
+        ext = dict(external or {})
         free = set(self._free)
         assert len(free) == len(self._free), "duplicate page in free list"
-        seen = {}
+        for p in free:
+            assert self.refcount[p] == 0, \
+                f"page {p} free with refcount {int(self.refcount[p])}"
+            assert self.fill[p] == 0, \
+                f"page {p} free with fill {int(self.fill[p])}"
+        slot_refs = np.zeros((self.n_pages,), np.int64)
         for s in range(self.n_slots):
             m = int(self.mapped[s])
             for j in range(self.pages_per_slot):
@@ -309,18 +463,345 @@ class PageTable:
                         f"slot {s} entry {j} unmapped below mapped count"
                     assert p not in free, \
                         f"page {p} mapped by slot {s} AND free"
-                    assert p not in seen, \
-                        f"page {p} double-mapped: slots {seen[p]}, {s}"
-                    seen[p] = s
+                    slot_refs[p] += 1
                 else:
                     assert p == self.n_pages, \
                         f"slot {s} entry {j} holds {p} past mapped count"
-        assert len(seen) + len(free) == self.n_pages, \
-            f"lost pages: {self.n_pages - len(seen) - len(free)}"
+        for p in range(self.n_pages):
+            assert int(slot_refs[p]) <= int(self.refcount[p]), (
+                f"page {p} double-mapped: {int(slot_refs[p])} slot maps "
+                f"exceed refcount {int(self.refcount[p])}")
+            want = int(slot_refs[p]) + int(ext.get(p, 0))
+            assert int(self.refcount[p]) == want, (
+                f"page {p} refcount {int(self.refcount[p])} != "
+                f"{int(slot_refs[p])} slot maps + {int(ext.get(p, 0))} "
+                f"external holds")
+        held = int((self.refcount > 0).sum())
+        assert held + len(free) == self.n_pages, \
+            f"lost pages: {self.n_pages - held - len(free)}"
         return True
 
     def report(self) -> dict:
         return {"n_pages": self.n_pages, "page_len": self.page_len,
                 "pages_per_slot": self.pages_per_slot,
                 "mapped_pages": self.mapped_pages,
+                "used_pages": self.used_pages,
+                "shared_pages": self.shared_pages,
                 "free_pages": self.free_pages}
+
+
+# --------------------------------------------------------------------------
+# Prefix index over resident pages (ISSUE 16)
+# --------------------------------------------------------------------------
+
+#: hash-chain root: the parent digest of a prompt's first block
+_ROOT = b"dl4j-prefix-root"
+
+
+def _chain_hash(parent: bytes, block: np.ndarray) -> bytes:
+    """Digest of one page-aligned token block chained on its
+    predecessor's digest — radix-style, so a block's key encodes its
+    entire prefix, and two prompts share an entry iff they share every
+    token up to and including that block."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent)
+    h.update(np.ascontiguousarray(block, dtype=np.int32).tobytes())
+    return h.digest()
+
+
+class _PrefixEntry:
+    """One FULL page of tokens resident in the pool, keyed by its
+    chained block hash. Holds one table ref on its page for as long as
+    it lives in the index."""
+
+    __slots__ = ("page", "tokens", "parent", "children", "last_used")
+
+    def __init__(self, page: int, tokens: np.ndarray,
+                 parent: Optional[bytes], last_used: int):
+        self.page = int(page)
+        self.tokens = np.array(tokens, dtype=np.int32)  # defensive copy
+        self.parent = parent          # predecessor's digest (chain walk)
+        self.children = 0             # resident entries chained on us
+        self.last_used = last_used
+
+
+class _SessionEntry:
+    """A finished request's written context retained verbatim so the
+    session's next turn resumes append-only. Holds one table ref per
+    page (the final partial page included — unlike the block index,
+    which only keeps full pages)."""
+
+    __slots__ = ("tokens", "pages", "last_used")
+
+    def __init__(self, tokens: np.ndarray, pages: List[int],
+                 last_used: int):
+        self.tokens = np.array(tokens, dtype=np.int32)
+        self.pages = [int(p) for p in pages]
+        self.last_used = last_used
+
+
+class PrefixCache:
+    """Longest-prefix index + session retention over a :class:`PageTable`
+    (ISSUE 16 tentpole part b/d).
+
+    Pure host-side bookkeeping: entries key page-aligned token blocks by
+    their chained hash and pin the backing pool page with one table ref
+    (``incref``). Admission walks the chain over the incoming prompt's
+    full blocks (:meth:`match`), maps whatever matched straight into
+    the new slot's page table (``map_shared``) and prefills only the
+    tail — the gather attention kernel reads arbitrary page sets, so
+    sharing needs zero jitted-code changes. Sessions
+    (:meth:`retain_session`) keep a finished request's ENTIRE written
+    context, partial tail page included, so a follow-up turn resumes
+    append-only (the boundary page copy-on-writes if appended into).
+
+    Under page pressure the scheduler calls :meth:`evict`: zero-slot-ref
+    cached pages drop LRU, leaves first (an inner chain entry never
+    outlives its children — a dangling parent digest would match
+    prompts whose earlier blocks are gone). Eviction runs BEFORE the
+    preemption path — cold cache beats killing live requests.
+
+    Collision paranoia: a digest match alone never shares a page;
+    every hit re-verifies token equality against the entry's stored
+    block before the page is mapped.
+    """
+
+    def __init__(self, table: PageTable):
+        self.table = table
+        self.entries: Dict[bytes, _PrefixEntry] = {}
+        self.sessions: Dict[str, _SessionEntry] = {}
+        self._holds: Dict[int, int] = {}   # page -> cache hold count
+        self._clock = 0                    # LRU tick, monotonic
+        self.hits = 0                      # admissions with >0 shared pages
+        self.hit_tokens = 0                # prefill tokens skipped
+        self.cow_copies = 0                # device page copies performed
+        self.evictions = 0                 # pages freed by evict()
+
+    # ------------------------------------------------------------ refs
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _hold(self, page: int):
+        self.table.incref(page)
+        self._holds[page] = self._holds.get(page, 0) + 1
+
+    def _unhold(self, page: int) -> int:
+        n = self._holds[page] - 1
+        if n:
+            self._holds[page] = n
+        else:
+            del self._holds[page]
+        return self.table.decref(page)
+
+    def holds(self) -> Dict[int, int]:
+        """Page -> hold count owed by this cache — feed straight into
+        ``PageTable.check(external=...)``."""
+        return dict(self._holds)
+
+    # ----------------------------------------------------------- match
+    def match(self, tokens: np.ndarray) -> List[int]:
+        """Longest resident prefix of ``tokens``: walk the chain over
+        its full page-aligned blocks, verifying token equality at each
+        hop, and return the matched pages in logical order. Bumps LRU
+        on every entry touched."""
+        tokens = np.asarray(tokens, dtype=np.int32)
+        plen = self.table.page_len
+        pages: List[int] = []
+        parent = _ROOT
+        now = self._tick()
+        for j in range(len(tokens) // plen):
+            block = tokens[j * plen:(j + 1) * plen]
+            h = _chain_hash(parent, block)
+            e = self.entries.get(h)
+            if e is None or not np.array_equal(e.tokens, block):
+                break
+            e.last_used = now
+            pages.append(e.page)
+            parent = h
+        return pages
+
+    def insert(self, tokens: np.ndarray, pages: List[int]) -> int:
+        """Register ``tokens``' full page-aligned blocks, backed by the
+        slot's ``pages`` (logical order), into the index. Idempotent:
+        blocks already resident keep their FIRST page (the latecomer's
+        copy stays slot-owned and frees on release); new blocks gain a
+        cache hold on theirs. Returns the number of new entries."""
+        tokens = np.asarray(tokens, dtype=np.int32)
+        plen = self.table.page_len
+        parent = _ROOT
+        now = self._tick()
+        added = 0
+        prev: Optional[_PrefixEntry] = None
+        for j in range(min(len(tokens) // plen, len(pages))):
+            block = tokens[j * plen:(j + 1) * plen]
+            h = _chain_hash(parent, block)
+            e = self.entries.get(h)
+            if e is None or not np.array_equal(e.tokens, block):
+                if e is not None:       # true digest collision: keep old
+                    break
+                e = _PrefixEntry(pages[j], block,
+                                 None if parent is _ROOT else parent, now)
+                self._hold(e.page)
+                self.entries[h] = e
+                if prev is not None:
+                    prev.children += 1
+                added += 1
+            else:
+                e.last_used = now
+            parent = h
+            prev = e
+        return added
+
+    def note_hit(self, tokens_matched: int):
+        """Account one admission that skipped ``tokens_matched`` prefill
+        tokens via the index or a session."""
+        self.hits += 1
+        self.hit_tokens += int(tokens_matched)
+
+    # -------------------------------------------------------- sessions
+    def session_match(self, session_id: str,
+                      tokens: np.ndarray) -> Optional[Tuple[int, List[int]]]:
+        """If ``session_id``'s retained context is a strict prefix of
+        ``tokens``, return ``(n_retained_tokens, pages)`` — the whole
+        retained mapping, partial tail page included. Returns None on
+        unknown session or divergence (caller falls back to the block
+        index)."""
+        s = self.sessions.get(session_id)
+        if s is None:
+            return None
+        n = len(s.tokens)
+        tokens = np.asarray(tokens, dtype=np.int32)
+        if n > len(tokens) or not np.array_equal(s.tokens, tokens[:n]):
+            return None
+        s.last_used = self._tick()
+        return n, list(s.pages)
+
+    def retain_session(self, session_id: str, tokens: np.ndarray,
+                       pages: List[int]):
+        """Pin a finished request's written context under its session id
+        (one hold per page). Replaces any previous retention for the
+        id — each turn's retention supersedes the last."""
+        self.drop_session(session_id)
+        s = _SessionEntry(np.asarray(tokens, dtype=np.int32), pages,
+                          self._tick())
+        for p in s.pages:
+            self._hold(p)
+        self.sessions[session_id] = s
+
+    def drop_session(self, session_id: str) -> bool:
+        """Release a session's holds (explicit end-of-conversation, or
+        supersession by the next turn)."""
+        s = self.sessions.pop(session_id, None)
+        if s is None:
+            return False
+        for p in reversed(s.pages):
+            self._unhold(p)
+        return True
+
+    # -------------------------------------------------------- eviction
+    def _slot_free(self, page: int) -> bool:
+        """True when only this cache holds the page — no slot maps it,
+        so dropping our hold(s) frees it."""
+        return int(self.table.refcount[page]) == self._holds.get(page, 0)
+
+    @property
+    def cached_pages(self) -> int:
+        """Pages resident ONLY because this cache holds them — the
+        evictable reclaim headroom ``_ensure_pages`` taps before
+        preempting."""
+        return sum(1 for p in self._holds if self._slot_free(p))
+
+    def _drop_entry(self, h: bytes) -> int:
+        e = self.entries.pop(h)
+        if e.parent is not None:
+            parent = self.entries.get(e.parent)
+            if parent is not None:
+                parent.children -= 1
+        return self._unhold(e.page)
+
+    def evict(self, need: int, protect=frozenset()) -> int:
+        """Free up to ``need`` pages by dropping cold cache state, LRU
+        first: leaf index entries whose page no slot maps, then (and
+        interleaved by age) whole sessions whose every page is
+        slot-free. ``protect`` pins pages the caller just matched but
+        has not yet mapped — eviction must never reclaim the prefix an
+        admission is about to share. Returns pages actually freed."""
+        freed = 0
+        while freed < need:
+            # candidate leaves: evictable index entries (no children —
+            # inner nodes wait for their subtree) and whole sessions
+            cand = []
+            for h, e in self.entries.items():
+                if (e.children == 0 and e.page not in protect
+                        and self._slot_free(e.page)):
+                    cand.append((e.last_used, 0, h))
+            for sid, s in self.sessions.items():
+                if s.pages and all(p not in protect and self._slot_free(p)
+                                   for p in s.pages):
+                    cand.append((s.last_used, 1, sid))
+                elif not s.pages:
+                    cand.append((s.last_used, 1, sid))
+            if not cand:
+                break
+            cand.sort(key=lambda c: (c[0], c[1]))
+            _, kind, key = cand[0]
+            if kind == 0:
+                freed += self._drop_entry(key)
+            else:
+                s = self.sessions.pop(key)
+                for p in reversed(s.pages):
+                    freed += self._unhold(p)
+        self.evictions += freed
+        return freed
+
+    def release_page_holds(self, page: int) -> int:
+        """Ownership-transfer escape hatch for CoW starvation: drop
+        EVERY index entry and session touching ``page`` so the one slot
+        still mapping it becomes the sole owner and can scatter in
+        place — no copy, no free page needed. Entries chained below a
+        dropped one are dropped too (their prefix is gone). Returns the
+        holds removed from ``page``."""
+        before = self._holds.get(page, 0)
+        if not before:
+            return 0
+        # drop the subtree rooted at every entry on this page: child
+        # entries' parent digests would dangle otherwise
+        doomed = {h for h, e in self.entries.items() if e.page == page}
+        while True:
+            grew = {h for h, e in self.entries.items()
+                    if e.parent in doomed and h not in doomed}
+            if not grew:
+                break
+            doomed |= grew
+        for h in doomed:
+            self._drop_entry(h)
+        for sid in [sid for sid, s in self.sessions.items()
+                    if page in s.pages]:
+            self.drop_session(sid)
+        return before - self._holds.get(page, 0)
+
+    # ------------------------------------------------------------ misc
+    @property
+    def n_entries(self) -> int:
+        return len(self.entries)
+
+    @property
+    def n_sessions(self) -> int:
+        return len(self.sessions)
+
+    def forget(self):
+        """Drop all bookkeeping WITHOUT touching table refcounts — the
+        ``_fail_all`` companion to ``PageTable.reset()``, which already
+        zeroed them."""
+        self.entries.clear()
+        self.sessions.clear()
+        self._holds.clear()
+
+    def report(self) -> dict:
+        return {"entries": self.n_entries, "sessions": self.n_sessions,
+                "cached_pages": self.cached_pages,
+                "prefix_hits": self.hits,
+                "prefix_hit_tokens": self.hit_tokens,
+                "cow_copies": self.cow_copies,
+                "evictions": self.evictions}

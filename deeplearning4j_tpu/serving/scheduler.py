@@ -123,6 +123,11 @@ class ServingRequest:
     done_tokens: int = 0
     prefill_s: float = 0.0      # summed chunk wall time, this admission
     chunks: int = 0             # chunks dispatched, this admission
+    # prefix sharing (ISSUE 16): the session this request extends (its
+    # finish retains pages under the same id), and the tokens the last
+    # admission skipped via shared resident pages
+    session_id: Optional[str] = None
+    prefix_matched: int = 0
 
     def context(self) -> np.ndarray:
         """Token ids to prefill on (re-)admission: the original prompt
@@ -159,9 +164,13 @@ class ContinuousBatchingScheduler:
                  trace_spans: bool = True,
                  sample_obs_every: int = 32,
                  page_len: Optional[int] = None,
-                 n_pages: Optional[int] = None):
+                 n_pages: Optional[int] = None,
+                 prefix_cache: bool = False):
         if n_slots < 1:
             raise ValueError("need at least one decode slot")
+        if prefix_cache and page_len is None and n_pages is None:
+            raise ValueError("prefix_cache rides the paged pool: give "
+                             "page_len and/or n_pages")
         self.engine = engine
         self.n_slots = int(n_slots)
         self.starvation_ms = starvation_ms
@@ -203,6 +212,19 @@ class ContinuousBatchingScheduler:
             self.cache = engine.init_cache(self.n_slots)
             self._pages = None
             self._kv_page_bytes = 0
+        # copy-on-write prefix sharing (ISSUE 16, opt-in): a radix-style
+        # index + session retention over the page pool. Admission maps
+        # matched prefixes into the new slot's table (zero jitted
+        # changes — the gather reads arbitrary page sets) and prefills
+        # only the tail; a slot about to scatter into a shared page
+        # splits it first via engine.copy_page.
+        self._prefix: Optional[kvcache.PrefixCache] = \
+            kvcache.PrefixCache(self._pages) if prefix_cache else None
+        if self._prefix is not None and hasattr(engine, "copy_page"):
+            # warm the CoW page-copy kernel NOW (a src==dst self-copy is
+            # a semantic no-op): the first real split may land after
+            # mark_warm(), and it must not count as a retrace
+            self.cache = engine.copy_page(self.cache, 0, 0)
         # memory plane (ISSUE 12/14): allocated bytes are static under
         # dense slotting (slots × max_len) and MAPPED-page bytes under
         # paging; resident bytes follow the per-slot token counts the
@@ -339,6 +361,34 @@ class ContinuousBatchingScheduler:
                 "1 - resident/allocated (dense idle pool = 1.0; paged "
                 "counts mapped pages, so waste is only unfilled page "
                 "tails)", labelnames=("replica",)),
+            # CoW prefix sharing census (ISSUE 16) — shared pages count
+            # ONCE in kv_alloc above; these expose the sharing itself
+            "kv_shared": reg.gauge(
+                "dl4j_kv_shared_pages",
+                "Pool pages with more than one holder (slot mappings + "
+                "prefix-cache/session holds) at the last snapshot",
+                labelnames=("replica",)),
+            "kv_cached": reg.gauge(
+                "dl4j_kv_cached_pages",
+                "Pool pages resident only because the prefix cache "
+                "holds them — the LRU-evictable reclaim headroom",
+                labelnames=("replica",)),
+            "kv_cow": reg.counter(
+                "dl4j_kv_cow_copies_total",
+                "Copy-on-write page splits (device page copies) before "
+                "a slot scattered into a shared page"),
+            "kv_prefix_hits": reg.counter(
+                "dl4j_kv_prefix_hits_total",
+                "Admissions that mapped a shared resident prefix "
+                "instead of re-prefilling it"),
+            "kv_prefix_hit_tokens": reg.counter(
+                "dl4j_kv_prefix_hit_tokens_total",
+                "Prompt tokens skipped at prefill because their pages "
+                "were already resident (prefix/session hits)"),
+            "kv_prefix_evictions": reg.counter(
+                "dl4j_kv_prefix_evictions_total",
+                "Cached prefix pages freed by LRU eviction under page "
+                "pressure (before the preemption path)"),
             "kv_final": reg.histogram(
                 "dl4j_kv_final_residency_ratio",
                 "Per-request final residency at completion: "
@@ -369,11 +419,23 @@ class ContinuousBatchingScheduler:
     # -------------------------------------------------------- submit
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                temperature: float = 0.0, top_k: int = 0,
-               eos_id: Optional[int] = None) -> Future:
+               eos_id: Optional[int] = None,
+               session_id: Optional[str] = None) -> Future:
         """Queue a generation request; returns a Future resolving to a
         :class:`GenerationResult`. Rejects requests that could never fit
         a slot (prompt + budget beyond the cache's ``max_len``) up
-        front — admission never has to partially honour a request."""
+        front — admission never has to partially honour a request.
+
+        ``session_id`` (ISSUE 16, needs ``prefix_cache=True``) threads a
+        multi-turn conversation: at finish the request's written pages
+        are RETAINED under the id, and the next ``submit`` whose prompt
+        extends the retained context maps those pages instead of
+        re-prefilling the history — the new turn's delta becomes
+        append-only. Each turn's retention supersedes the last;
+        :meth:`drop_session` releases it explicitly."""
+        if session_id is not None and self._prefix is None:
+            raise ValueError("session_id needs prefix_cache=True (and "
+                             "the paged pool)")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -398,7 +460,8 @@ class ContinuousBatchingScheduler:
                 id=self._next_id, prompt=prompt,
                 max_new_tokens=int(max_new_tokens),
                 temperature=float(temperature), top_k=int(top_k),
-                eos_id=eos_id, future=fut, submitted_ts=now, queued_ts=now)
+                eos_id=eos_id, future=fut, submitted_ts=now,
+                queued_ts=now, session_id=session_id)
             req.trace = RequestTrace(request_id=req.id,
                                      replica=self.replica)
             req.trace.event("submit", ts=now,
@@ -454,19 +517,43 @@ class ContinuousBatchingScheduler:
                 # drains with it: an idle fixed pool is 100% waste.
                 m["occupancy"].set(0.0, replica=self.replica)
                 m["tokens_per_s"].set(0.0, replica=self.replica)
-                m["kv_res"].set(0.0, replica=self.replica)
                 # dense idle = 100% waste (max_len × slots preallocated
                 # for nothing); paged idle maps NOTHING — zero
-                # allocated, zero wasted, which is the whole point
-                if self.paged:
-                    m["kv_alloc"].set(0.0, replica=self.replica)
-                    m["kv_waste"].set(0.0, replica=self.replica)
+                # allocated, zero wasted, which is the whole point.
+                # With the prefix cache, idle residency is whatever the
+                # cache still HOLDS (ISSUE 16): cached pages occupy
+                # real pool bytes until evicted, and the gauges must
+                # say so.
+                if self.paged and self._prefix is not None:
+                    with self._lock:
+                        alloc = self._pages.used_pages \
+                            * self._kv_page_bytes
+                        resident = min(
+                            alloc, self._pages.resident_tokens
+                            * self._kv_token_bytes)
+                        self._kv_last_resident = resident
+                        self._kv_last_alloc = alloc
+                    m["kv_alloc"].set(float(alloc), replica=self.replica)
+                    m["kv_res"].set(float(resident),
+                                    replica=self.replica)
+                    m["kv_waste"].set(
+                        (1.0 - resident / alloc) if alloc else 0.0,
+                        replica=self.replica)
+                    m["kv_cached"].set(float(self._prefix.cached_pages),
+                                       replica=self.replica)
+                    m["kv_shared"].set(float(self._pages.shared_pages),
+                                       replica=self.replica)
                 else:
-                    m["kv_waste"].set(1.0, replica=self.replica)
-                with self._lock:   # writers-hold-_lock invariant
-                    self._kv_last_resident = 0
+                    m["kv_res"].set(0.0, replica=self.replica)
                     if self.paged:
-                        self._kv_last_alloc = 0
+                        m["kv_alloc"].set(0.0, replica=self.replica)
+                        m["kv_waste"].set(0.0, replica=self.replica)
+                    else:
+                        m["kv_waste"].set(1.0, replica=self.replica)
+                    with self._lock:   # writers-hold-_lock invariant
+                        self._kv_last_resident = 0
+                        if self.paged:
+                            self._kv_last_alloc = 0
         return did
 
     def run_until_idle(self, max_steps: int = 100000):
@@ -530,6 +617,10 @@ class ContinuousBatchingScheduler:
             self._queue.clear()
             if self.paged:      # dead pool leaks no pages
                 self._pages.reset()
+                if self._prefix is not None:
+                    # reset() zeroed the refcounts the cache's holds
+                    # backed — drop the bookkeeping without decref
+                    self._prefix.forget()
         for req in doomed:
             try:
                 req.future.set_exception(exc)
@@ -561,11 +652,44 @@ class ContinuousBatchingScheduler:
     def _free_slots(self):
         return [i for i, r in enumerate(self.slots) if r is None]
 
+    def _admission_plan(self, req):
+        """Paged-admission plan for ``req`` (caller holds ``_lock``):
+        ``(shared_pages, matched_tokens, need)`` — the resident pages
+        its prompt prefix already has (ISSUE 16: session retention
+        first, then the block index), the prompt tokens those cover,
+        and the FREE pages its first prefill chunk still needs. The
+        match is capped at ``ctx_len - 1`` so at least one token always
+        prefills — the final chunk's logits are the first-token sample.
+        Without the prefix cache this degenerates to the PR 14
+        first-chunk page count."""
+        ctx_len = req.prompt.size + len(req.generated)
+        if self._prefix is None:
+            return [], 0, self._pages.pages_for(
+                min(ctx_len, self.engine.chunk_len))
+        ctx = req.context()
+        cap = ctx_len - 1
+        shared: List[int] = []
+        matched = 0
+        if req.session_id is not None:
+            sm = self._prefix.session_match(req.session_id, ctx)
+            if sm is not None:
+                n, shared = sm
+                # identical resubmit: keep the pages (CoW rewrites the
+                # tail position) but leave one token to prefill
+                matched = min(n, cap)
+        if not shared:
+            shared = self._prefix.match(ctx)
+            while shared and len(shared) * self._pages.page_len > cap:
+                shared.pop()
+            matched = len(shared) * self._pages.page_len
+        first_end = min(ctx_len, matched + self.engine.chunk_len)
+        need = max(0, self._pages.pages_for(first_end) - len(shared))
+        return shared, matched, need
+
     def _head_first_chunk_pages(self) -> int:
-        """Pages the queue head's FIRST prefill chunk needs (paged)."""
-        head = self._queue[0]
-        ctx_len = head.prompt.size + len(head.generated)
-        return self._pages.pages_for(min(ctx_len, self.engine.chunk_len))
+        """FREE pages the queue head's first prefill chunk needs, net
+        of any resident shared prefix (paged)."""
+        return self._admission_plan(self._queue[0])[2]
 
     def _preempt_slot(self, victim_slot: int, m) -> "ServingRequest":
         """Preempt the request in ``victim_slot`` (caller holds
@@ -590,9 +714,42 @@ class ContinuousBatchingScheduler:
         return victim
 
     def _release_pages(self, slot: int) -> int:
-        """Paged mode: hand the slot's pages back to the free list (a
-        no-op under dense slotting). Returns pages released."""
+        """Paged mode: drop the slot's page holds (a no-op under dense
+        slotting). Returns mappings removed; pages the prefix cache
+        still holds stay resident (cached) rather than freeing."""
         return self._pages.release(slot) if self.paged else 0
+
+    def _slot_pages(self, slot: int) -> List[int]:
+        """The slot's mapped pool pages in logical order (paged mode,
+        caller holds ``_lock``)."""
+        return [int(self._pages.table[slot, j])
+                for j in range(int(self._pages.mapped[slot]))]
+
+    def _retire_slot(self, slot: int, req: "ServingRequest") -> int:
+        """Finish-path page retirement (caller holds ``_lock``): with
+        the prefix cache, REGISTER the request's written context before
+        dropping the slot's holds — full blocks into the block index
+        (cross-request sharing), and, for a ``session_id`` request, the
+        whole written mapping (partial tail page included) under the
+        session so the next turn resumes append-only. The last sampled
+        token's k/v was never written, so the retained context stops
+        one short. Preemption does NOT register (its whole point is to
+        actually free pages — registration there would livelock the
+        page-pressure path). Returns mappings removed."""
+        if not self.paged:
+            return 0
+        if self._prefix is not None:
+            ctx = req.context()
+            written = int(ctx.size) - 1
+            pages = self._slot_pages(slot)
+            if written > 0 and pages:
+                self._pages.note_fill(slot, written)
+                self._prefix.insert(ctx[:written], pages)
+                if req.session_id is not None:
+                    keep = self._pages.pages_for(written)
+                    self._prefix.retain_session(
+                        req.session_id, ctx[:written], pages[:keep])
+        return self._pages.release(slot)
 
     def _maybe_preempt(self, m) -> bool:
         """Starvation guard: queue head waited past the deadline and
@@ -643,10 +800,21 @@ class ContinuousBatchingScheduler:
             while self._queue:
                 req = self._queue[0]
                 if self.paged:
-                    need = self._head_first_chunk_pages()
+                    shared, matched, need = self._admission_plan(req)
                     if need > self._pages.free_pages - reserved:
-                        break   # FIFO holds: nothing admits past a
-                                # head that cannot get pages
+                        # LRU-evict cold cached prefix pages BEFORE
+                        # refusing admission (ISSUE 16) — the pages the
+                        # head just matched are protected until mapped
+                        if self._prefix is not None:
+                            freed = self._prefix.evict(
+                                need - (self._pages.free_pages
+                                        - reserved),
+                                protect=frozenset(shared))
+                            if freed:
+                                m["kv_prefix_evictions"].inc(freed)
+                        if need > self._pages.free_pages - reserved:
+                            break   # FIFO holds: nothing admits past a
+                                    # head that cannot get pages
                 self._queue.popleft()
                 # fresh requests are PENDING → claim them (rejecting
                 # cancelled ones); a re-queued preemption victim is
@@ -665,6 +833,24 @@ class ContinuousBatchingScheduler:
                     req.done_tokens = 0
                     req.prefill_s = 0.0
                     req.chunks = 0
+                    req.prefix_matched = 0
+                    if shared:
+                        # map the matched prefix NOW (same lock hold as
+                        # the plan — eviction cannot slip between):
+                        # those tokens never prefill, the tail chunks
+                        # start past them
+                        self._pages.map_shared(slot, shared)
+                        self._pages.note_fill(slot, matched)
+                        req.done_tokens = matched
+                        req.prefix_matched = matched
+                        self._prefix.note_hit(matched)
+                        m["kv_prefix_hits"].inc()
+                        m["kv_prefix_hit_tokens"].inc(matched)
+                        if req.trace is not None:
+                            req.trace.event(
+                                "prefix_hit", ts=now,
+                                matched_tokens=int(matched),
+                                shared_pages=len(shared))
                     reserved += need
                 self.slots[slot] = req        # reserve
                 out.append((slot, req))
@@ -710,10 +896,17 @@ class ContinuousBatchingScheduler:
                 done = req.done_tokens
                 n = min(self.engine.chunk_len, len(ctx) - done)
                 ok = self._ensure_pages(slot, req, done + n, m)
+                # CoW (ISSUE 16): pages this chunk writes into that
+                # have other holders split first — planned under the
+                # lock, copied on device outside it
+                cows = self._plan_cow(slot, done, done + n, m) \
+                    if ok and self.slots[slot] is req else []
             if not ok:
                 did = True      # a preemption shuffle IS work
                 continue
             did = True
+            for src, dst in cows:
+                self.cache = self.engine.copy_page(self.cache, src, dst)
             self.cache = self._pages.sync(self.cache)
             t0 = time.perf_counter()
             with span("serving.prefill_chunk",
@@ -745,8 +938,13 @@ class ContinuousBatchingScheduler:
         (livelock by thrash). If the pool still cannot cover the
         growth, ``req`` itself is preempted (False: the lane is free,
         the request re-queued — never stranded, the submit-time fit
-        check guarantees it runs once pages free up)."""
-        if self._pages.map(slot, tokens):
+        check guarantees it runs once pages free up).
+
+        With the prefix cache (ISSUE 16), LRU eviction of cold cached
+        pages runs BEFORE the preemption cascade and again after each
+        preemption (a victim's release may leave its registered pages
+        cached rather than free)."""
+        if self._try_map(slot, tokens, m):
             return True
         while True:
             victim_slot = max(
@@ -760,10 +958,76 @@ class ContinuousBatchingScheduler:
             if victim_slot is None:
                 break
             self._preempt_slot(victim_slot, m)
-            if self._pages.map(slot, tokens):
+            if self._try_map(slot, tokens, m):
                 return True
         self._preempt_slot(slot, m)
         return False
+
+    def _try_map(self, slot, req_or_slot_tokens, m=None) -> bool:
+        """``PageTable.map`` with the ISSUE 16 eviction step: when the
+        free list cannot cover the growth, LRU-evict cached prefix
+        pages (cold cache beats preempting live requests) and retry
+        once. Caller holds ``_lock``."""
+        tokens = int(req_or_slot_tokens)
+        if self._pages.map(slot, tokens):
+            return True
+        if self._prefix is not None:
+            short = (self._pages.pages_for(tokens)
+                     - int(self._pages.mapped[slot])
+                     - self._pages.free_pages)
+            if short > 0:
+                freed = self._prefix.evict(short)
+                if freed and m is not None:
+                    m["kv_prefix_evictions"].inc(freed)
+                if freed and self._pages.map(slot, tokens):
+                    return True
+        return False
+
+    def _plan_cow(self, slot, start: int, end: int, m) -> list:
+        """Split every page ``slot`` is about to write (context rows
+        ``[start, end)``) that has other holders (ISSUE 16 CoW). Caller
+        holds ``_lock``; returns the ``(src, dst)`` pool-page copies
+        the caller must run on device (``engine.copy_page``) BEFORE the
+        write dispatch — device work never runs under the lock.
+
+        Starvation ladder when no free page exists for the split:
+        evict cold cache, then transfer sole ownership (drop the cache
+        holds on the contested page — the write is then private, no
+        copy needed), then preempt the other slot mapping it."""
+        if self._prefix is None or end <= start:
+            return []
+        plen = self._pages.page_len
+        copies = []
+        for j in range(start // plen, (end - 1) // plen + 1):
+            if j >= int(self._pages.mapped[slot]):
+                break
+            while True:
+                p = int(self._pages.table[slot, j])
+                if int(self._pages.refcount[p]) <= 1:
+                    break                      # private: write in place
+                split = self._pages.cow(slot, j)
+                if split is not None:
+                    copies.append(split)
+                    self._prefix.cow_copies += 1
+                    m["kv_cow"].inc()
+                    break
+                # no free page for the copy: reclaim, cheapest first
+                freed = self._prefix.evict(1)
+                if freed:
+                    m["kv_prefix_evictions"].inc(freed)
+                    continue
+                if self._prefix.release_page_holds(p):
+                    continue                   # may now be private
+                other = next(
+                    (i for i in range(self.n_slots)
+                     if i != slot and self.slots[i] is not None
+                     and p in self._pages.table[
+                         i, :int(self._pages.mapped[i])]),
+                    None)
+                if other is None:              # cannot happen: refs
+                    break                      # must come from somewhere
+                self._preempt_slot(other, m)
+        return copies
 
     def _first_token(self, slot, req, logits, ctx_tokens: int,
                      prefill_s: float, m, chunks: Optional[int] = None):
@@ -796,11 +1060,20 @@ class ContinuousBatchingScheduler:
                                 **attrs)
                 req.trace.event("token", ts=now, i=len(req.generated))
                 self._trace_overhead += time.perf_counter() - t_ov
+            if self.paged and self._prefix is not None:
+                # register the just-prefilled context's full blocks so
+                # CONCURRENT requests with the same prompt share them
+                # from their own admission onward (finish re-registers
+                # the generated extension)
+                ctx_now = req.context()
+                self._pages.note_fill(slot, ctx_now.size)
+                self._prefix.insert(
+                    ctx_now, self._slot_pages(slot))
             req.generated.append(tok)
             m["tokens"].inc()
             if self._done(req, tok):
                 self.slots[slot] = None
-                released = self._release_pages(slot)
+                released = self._retire_slot(slot, req)
                 self._finish(req, tok, m, mapped_pages=released)
             else:
                 self._last_tokens[slot] = tok
@@ -861,12 +1134,20 @@ class ContinuousBatchingScheduler:
                 # never a retrace — the gather shape is fixed). Under
                 # pressure _ensure_pages preempts, so re-derive the
                 # active set afterwards.
+                cows = []
                 for i in range(self.n_slots):
                     req = self.slots[i]
                     if req is None or req.pending is not None:
                         continue
-                    self._ensure_pages(
-                        i, req, req.prompt.size + len(req.generated), m)
+                    w = req.prompt.size + len(req.generated)
+                    ok = self._ensure_pages(i, req, w, m)
+                    if ok and self.slots[i] is req:
+                        # the sweep writes this slot's row w-1: split
+                        # it first if shared (ISSUE 16 — e.g. a session
+                        # append into the retained partial tail page)
+                        cows.extend(self._plan_cow(i, w - 1, w, m))
+            else:
+                cows = []
             active = [i for i, r in enumerate(self.slots)
                       if r is not None and r.pending is None]
             if not active:
@@ -879,6 +1160,8 @@ class ContinuousBatchingScheduler:
             tokens_in = jnp.asarray(self._last_tokens)
             self._key, sub = jax.random.split(self._key)
         if self.paged:
+            for src, dst in cows:
+                self.cache = self.engine.copy_page(self.cache, src, dst)
             self.cache = self._pages.sync(self.cache)
         t0 = time.perf_counter()
         with span("serving.decode", attrs={"active": len(active)}):
@@ -920,7 +1203,7 @@ class ContinuousBatchingScheduler:
                 self._last_tokens[i] = tok
                 if self._done(req, tok):
                     self.slots[i] = None
-                    released = self._release_pages(i)
+                    released = self._retire_slot(i, req)
                     self._finish(req, tok, m, mapped_pages=released)
         return True
 
@@ -1011,7 +1294,23 @@ class ContinuousBatchingScheduler:
             n_active = sum(s is not None for s in slot_ids)
             if n_active > self._peak_active:
                 self._peak_active = n_active
-            if self.paged:
+            if self.paged and self._prefix is not None:
+                # CoW sharing (ISSUE 16): a shared page must count ONCE
+                # — per-slot token sums would bill the same bytes to
+                # every slot mapping them. Allocated = pool pages with
+                # ≥1 holder (slots OR cache); resident = the per-page
+                # fill census, refreshed here for the active slots
+                # (cached pages keep the fill they retired with).
+                for i, r in enumerate(self.slots):
+                    if r is not None:
+                        self._pages.note_fill(
+                            i, r.done_tokens if r.pending is not None
+                            else r.prompt.size + len(r.generated) - 1)
+                alloc = self._pages.used_pages * self._kv_page_bytes
+                mapped = self._pages.mapped_pages
+                resident = min(self._pages.resident_tokens
+                               * self._kv_token_bytes, alloc)
+            elif self.paged:
                 # page granularity (ISSUE 14): allocated = MAPPED pages,
                 # not the pool — waste is unfilled page tails only. A
                 # just-sampled token is counted resident one sweep before
@@ -1042,6 +1341,21 @@ class ContinuousBatchingScheduler:
             "kv_page_len": self._pages.page_len,
             "kv_pool_bytes": self._kv_allocated,
         }
+        if self._prefix is not None:
+            # sharing census (ISSUE 16) on every snapshot — the flight
+            # recorder doubles as the prefix-cache timeline
+            shared = self._pages.shared_pages
+            cached = self._prefix.cached_pages
+            paged_fields.update(
+                kv_used_pages=self._pages.used_pages,
+                kv_shared_pages=shared,
+                kv_cached_pages=cached,
+                kv_cow_copies_total=self._prefix.cow_copies,
+                kv_prefix_hits_total=self._prefix.hits,
+                kv_prefix_hit_tokens_total=self._prefix.hit_tokens,
+            )
+            m["kv_shared"].set(float(shared), replica=self.replica)
+            m["kv_cached"].set(float(cached), replica=self.replica)
         self.flight_recorder.record_snapshot(
             step=self._steps, slots=slot_ids, queue=queued_ids,
             queue_depth=len(queued_ids),
@@ -1166,4 +1480,28 @@ class ContinuousBatchingScheduler:
         }
         if self.paged:
             out["paged"] = self._pages.report()
+        if self._prefix is not None:
+            # sharing evidence (ISSUE 16): hits, tokens the pool did
+            # NOT re-prefill or re-store, CoW splits, evictions
+            out["prefix"] = self._prefix.report()
         return out
+
+    def drop_session(self, session_id: str) -> bool:
+        """Release a session's retained pages (end of conversation) —
+        they become plain cached prefix pages if the block index still
+        holds them, else free. Returns True if the session existed."""
+        with self._lock:
+            if self._prefix is None:
+                return False
+            return self._prefix.drop_session(session_id)
+
+    def check_pages(self) -> bool:
+        """Assert the free-XOR-refcounted page invariant, feeding the
+        prefix cache's hold census in as the external refs (the fuzz
+        tests' oracle). True for dense pools."""
+        with self._lock:
+            if not self.paged:
+                return True
+            return self._pages.check(
+                self._prefix.holds() if self._prefix is not None
+                else None)

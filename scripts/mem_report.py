@@ -64,6 +64,8 @@ def build_report(records) -> dict:
             "resident_max": 0, "alloc_sum": 0.0, "alloc_max": 0,
             "paged": False, "kv_page_len": None, "kv_pool_bytes": None,
             "mapped_pages_max": 0,
+            "prefix": False, "shared_pages_max": 0, "cached_pages_max": 0,
+            "prefix_hits": 0, "prefix_hit_tokens": 0, "cow_copies": 0,
             "final_residency": [], "requests": 0})
 
     def _better_census(old, new):
@@ -104,6 +106,23 @@ def build_report(records) -> dict:
                 d["kv_pool_bytes"] = r.get("kv_pool_bytes")
                 d["mapped_pages_max"] = max(
                     d["mapped_pages_max"], int(r["kv_mapped_pages"] or 0))
+            if "kv_shared_pages" in r:      # CoW prefix census (ISSUE 16)
+                d["prefix"] = True
+                d["shared_pages_max"] = max(
+                    d["shared_pages_max"], int(r["kv_shared_pages"] or 0))
+                d["cached_pages_max"] = max(
+                    d["cached_pages_max"], int(r["kv_cached_pages"] or 0))
+                # counters are monotonic; max() tolerates out-of-order
+                # dump lines the same way the torn-tail discipline does
+                d["prefix_hits"] = max(
+                    d["prefix_hits"], int(r.get("kv_prefix_hits_total")
+                                          or 0))
+                d["prefix_hit_tokens"] = max(
+                    d["prefix_hit_tokens"],
+                    int(r.get("kv_prefix_hit_tokens_total") or 0))
+                d["cow_copies"] = max(
+                    d["cow_copies"], int(r.get("kv_cow_copies_total")
+                                         or 0))
         elif kind == "reqtrace":
             d = rep(r.get("replica", "0"))
             d["requests"] += 1
@@ -192,6 +211,14 @@ def render(report) -> str:
                 f"resident mean {_fmt_bytes(d['resident_bytes_mean'])} "
                 f"/ max {_fmt_bytes(d['resident_bytes_max'])}, "
                 f"waste mean {_fmt_pct(d['waste_ratio_mean'])}")
+            if d.get("prefix"):
+                lines.append(
+                    f"  prefix sharing: shared max "
+                    f"{d['shared_pages_max']} / cached max "
+                    f"{d['cached_pages_max']} pages, "
+                    f"{d['prefix_hits']} hits "
+                    f"({d['prefix_hit_tokens']} prompt tokens skipped), "
+                    f"{d['cow_copies']} CoW copies")
             if d.get("bytes_per_resident_token"):
                 lines.append(
                     f"  bytes per resident token: "

@@ -108,6 +108,8 @@ INFERENCE_LABELS = {
     "inference_decode": "Transformer-LM decode (KV-cache, 8 slots, T=1024)",
     "inference_ttft_1024": "Time-to-first-token, T=1024 prefill",
     "inference_ttft_4096": "Time-to-first-token, T=4096 prefill",
+    "inference_prefix_shared": "Warm TTFT, 64 req × shared 1024-token "
+                               "prefix (CoW cache)",
     "inference_resnet_b1": "ResNet-50 batch-1 latency (ParallelInference)",
     "inference_bert_b1": "BERT-base batch-1 latency (ParallelInference)",
 }
@@ -175,6 +177,17 @@ def inference_row(name, rec):
                        f"{rec['best_batch_throughput']:,.1f} samples/s")
     if rec.get("slots") is not None:
         details.append(f"{rec['slots']} decode slots")
+    if rec.get("ttft_speedup_x") is not None:
+        # the CoW prefix-cache row (ISSUE 16): warm-vs-cold TTFT and
+        # tokens each user actually keeps resident when the prefix is
+        # counted once
+        details.append(f"{rec['ttft_speedup_x']}× vs no sharing "
+                       f"(cold {rec['ttft_no_sharing_ms']:,.0f} ms)")
+        if rec.get("tokens_resident_per_user_shared") is not None:
+            details.append(
+                f"{rec['tokens_resident_per_user_shared']:,.0f} "
+                f"tok/user resident vs "
+                f"{rec['tokens_resident_per_user_dense']:,.0f} unshared")
     captured = ("on-chip" if rec.get("backend") == "tpu"
                 else "⏳ CPU-derived, on-chip TODO")
     return (f"| {label} | {val} | {'; '.join(details) or '—'} "

@@ -1,0 +1,452 @@
+"""Copy-on-write prefix cache tests (ISSUE 16).
+
+The transparency contract extends PR 14's: greedy output through the
+prefix-sharing scheduler — admission matched against resident pages,
+shared prefixes mapped instead of re-prefilled, CoW splits before any
+write into a shared page, session retention across turns — stays
+BIT-identical to ``engine.generate()`` cold prefill. On top: the
+free-XOR-refcounted invariant under fuzzed schedules (the
+``check(external=)`` oracle), CoW isolation, LRU eviction before
+preemption, and zero post-warmup retraces with sharing enabled.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.obs import get_registry
+from deeplearning4j_tpu.serving import (ContinuousBatchingScheduler,
+                                        GenerationEngine, PageTable,
+                                        PrefixCache)
+from deeplearning4j_tpu.zoo import transformer as tfm
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=61, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_seq=32, dtype=jnp.float32, remat=False,
+                attn_scores_bf16=False)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    cfg, params = model
+    return GenerationEngine(cfg, params, prefill_chunk=8)
+
+
+def _toks(shape, vocab=61, seed=0):
+    return np.random.default_rng(seed).integers(0, vocab, shape).astype(
+        np.int32)
+
+
+def _sched(engine, n_slots=2, page_len=4, n_pages=16, **kw):
+    return ContinuousBatchingScheduler(engine, n_slots=n_slots,
+                                       page_len=page_len, n_pages=n_pages,
+                                       prefix_cache=True, **kw)
+
+
+# --------------------------------------------- PageTable refcount unit
+
+def test_page_table_refcount_and_map_shared():
+    pt = PageTable(n_slots=3, n_pages=8, page_len=4, pages_per_slot=4)
+    assert pt.map(0, 10)                        # 3 fresh pages, 1 ref each
+    assert pt.used_pages == 3 and pt.shared_pages == 0
+    pages = [int(pt.table[0, j]) for j in range(3)]
+    pt.map_shared(1, pages[:2])                 # slot 1 shares 2 of them
+    assert pt.shared_pages == 2
+    assert pt.mapped_pages == 5                 # per-slot view double counts
+    assert pt.used_pages == 3                   # residency view does not
+    pt.check()
+    # a shared slot releasing keeps the pages resident for the other
+    assert pt.release(1) == 2
+    assert pt.used_pages == 3 and pt.free_pages == 5
+    pt.check()
+    # errors: sharing into a mapped slot, sharing a free page
+    pt.map_shared(1, pages[:1])
+    with pytest.raises(ValueError, match="already maps"):
+        pt.map_shared(1, pages[:1])
+    pt.release(1)
+    pt.release(0)
+    with pytest.raises(ValueError, match="not resident"):
+        pt.map_shared(2, pages[:1])
+    assert pt.free_pages == 8
+
+
+def test_page_table_cow_and_fill_census():
+    pt = PageTable(n_slots=2, n_pages=6, page_len=4, pages_per_slot=3)
+    pt.map(0, 8)
+    pt.note_fill(0, 8)
+    pages = [int(pt.table[0, j]) for j in range(2)]
+    pt.map_shared(1, pages)
+    assert pt.resident_tokens == 8              # shared counted once
+    # CoW needs other holders: an exclusive page refuses the split
+    pt2_page = pt.map(0, 12) and int(pt.table[0, 2])
+    with pytest.raises(ValueError, match="exclusively owned"):
+        pt.cow(0, 2)
+    src, dst = pt.cow(1, 1)
+    assert src == pages[1] and dst not in pages
+    assert int(pt.fill[dst]) == int(pt.fill[src])   # census rides along
+    assert int(pt.refcount[src]) == 1
+    pt.check()
+    # exhaust the free list: cow returns None instead of raising
+    while pt._free:
+        pt._free.pop()
+    pt.map_shared  # (no-op attr touch keeps linters quiet)
+    pt.table[1, 0] = pages[0]  # restore state is unnecessary; check cow
+    assert pt.cow(1, 0) is None
+    del pt2_page
+
+
+def test_check_external_catches_leaked_hold():
+    pt = PageTable(n_slots=1, n_pages=4, page_len=4, pages_per_slot=2)
+    pc = PrefixCache(pt)
+    pt.map(0, 4)
+    page = int(pt.table[0, 0])
+    pc._hold(page)
+    pt.check(pc.holds())                        # balanced: passes
+    with pytest.raises(AssertionError, match="external holds"):
+        pt.check()                              # census withheld: leak
+    pt.incref(page)                             # phantom ref, no holder
+    with pytest.raises(AssertionError, match="external holds"):
+        pt.check(pc.holds())
+
+
+def test_prefix_cache_index_match_insert_evict():
+    pt = PageTable(n_slots=2, n_pages=8, page_len=4, pages_per_slot=4)
+    pc = PrefixCache(pt)
+    toks = _toks((12,), seed=5)
+    pt.map(0, 12)
+    pages = [int(pt.table[0, j]) for j in range(3)]
+    assert pc.insert(toks, pages) == 3
+    assert pc.insert(toks, pages) == 0          # idempotent
+    # longest-prefix walk stops at the first non-matching block
+    probe = toks.copy()
+    probe[5] = (probe[5] + 1) % 61
+    assert pc.match(probe) == pages[:1]
+    assert pc.match(toks) == pages
+    pt.release(0)
+    pt.check(pc.holds())
+    assert pc.cached_pages == 3
+    # LRU eviction drops leaves first, never a held parent before its
+    # children, and frees exactly what it reclaims
+    freed = pc.evict(1)
+    assert freed == 1 and pc.n_entries == 2
+    assert pc.evict(100) == 2 and pc.n_entries == 0
+    pt.check(pc.holds())
+    assert pt.free_pages == 8
+
+
+# ----------------------------------------- bit-equivalence vs generate
+
+def test_prefix_hit_and_miss_bit_identical(model, engine):
+    """Hit (identical full-block prefix), miss (disjoint prompt), and
+    partial-page divergence all reproduce cold prefill exactly."""
+    sched = _sched(engine, n_slots=2, page_len=4, n_pages=24)
+    prefix = _toks((12,), seed=1)               # 3 full pages
+    first = np.concatenate([prefix, _toks((3,), seed=2)])
+    f0 = sched.submit(first, max_new_tokens=5)
+    sched.run_until_idle()
+    assert f0.result(5).tokens.tolist() == \
+        engine.generate(first, 5).tolist()
+    assert sched.kv_report()["prefix"]["entries"] > 0
+
+    cases = [
+        np.concatenate([prefix, _toks((6,), seed=3)]),   # hit: 3 pages
+        _toks((9,), seed=4),                             # miss
+        np.concatenate([prefix[:10], _toks((5,), seed=5)]),  # partial page
+    ]
+    futs = [sched.submit(p, max_new_tokens=5) for p in cases]
+    sched.run_until_idle()
+    for p, f in zip(cases, futs):
+        assert f.result(5).tokens.tolist() == \
+            engine.generate(p, 5).tolist()
+    rep = sched.kv_report()["prefix"]
+    # the full-prefix case hit all 3 blocks; the partial-page case can
+    # only match the 2 full blocks below its divergence point
+    assert rep["prefix_hits"] >= 2
+    assert rep["prefix_hit_tokens"] >= 12 + 8
+    sched.check_pages()
+
+
+def test_divergent_page_cow_isolation(model, engine):
+    """Two live requests share prefix pages; the one that diverges and
+    keeps writing must never corrupt what the other still reads —
+    every output stays cold-prefill-identical."""
+    sched = _sched(engine, n_slots=3, page_len=4, n_pages=24)
+    prefix = _toks((8,), seed=11)
+    seed_req = np.concatenate([prefix, _toks((2,), seed=12)])
+    f_seed = sched.submit(seed_req, max_new_tokens=3)
+    sched.run_until_idle()
+
+    # both admit against the same cached prefix, then generate long
+    # enough to append into (and CoW-split) their shared tail pages
+    a = np.concatenate([prefix, _toks((1,), seed=13)])
+    b = np.concatenate([prefix, _toks((1,), seed=14)])
+    fa = sched.submit(a, max_new_tokens=10)
+    fb = sched.submit(b, max_new_tokens=10)
+    sched.run_until_idle()
+    assert f_seed.result(5).tokens.tolist() == \
+        engine.generate(seed_req, 3).tolist()
+    assert fa.result(5).tokens.tolist() == engine.generate(a, 10).tolist()
+    assert fb.result(5).tokens.tolist() == engine.generate(b, 10).tolist()
+    rep = sched.kv_report()
+    assert rep["prefix"]["prefix_hits"] >= 2
+    sched.check_pages()
+
+
+def test_session_multi_turn_append_only_equivalence(model, engine):
+    """The session API: each turn's prompt extends the retained context,
+    maps it wholesale (partial tail page via CoW), and produces tokens
+    bit-identical to cold-prefilling the whole conversation."""
+    sched = _sched(engine, n_slots=2, page_len=4, n_pages=24)
+    convo = _toks((5,), seed=21)
+    # 5 prompt + 5 generated -> written context of 9 tokens ends
+    # mid-page, so turn 2's append must CoW-split the retained tail
+    f1 = sched.submit(convo, max_new_tokens=5, session_id="s")
+    sched.run_until_idle()
+    r1 = f1.result(5)
+    assert r1.tokens.tolist() == engine.generate(convo, 5).tolist()
+    assert sched.kv_report()["prefix"]["sessions"] == 1
+
+    turn2 = np.concatenate([convo, r1.tokens, _toks((3,), seed=22)])
+    f2 = sched.submit(turn2, max_new_tokens=4, session_id="s")
+    sched.run_until_idle()
+    r2 = f2.result(5)
+    assert r2.tokens.tolist() == engine.generate(turn2, 4).tolist()
+    rep = sched.kv_report()["prefix"]
+    # the whole first turn (written context = turn1 minus the last
+    # sampled token) was mapped, not re-prefilled — more than the
+    # block-aligned index could offer for a 5+4-token history
+    assert rep["prefix_hit_tokens"] >= convo.size + r1.tokens.size - 1
+    assert rep["cow_copies"] >= 1        # append into the partial page
+
+    turn3 = np.concatenate([turn2, r2.tokens, _toks((2,), seed=23)])
+    f3 = sched.submit(turn3, max_new_tokens=3, session_id="s")
+    sched.run_until_idle()
+    assert f3.result(5).tokens.tolist() == \
+        engine.generate(turn3, 3).tolist()
+    sched.check_pages()
+    # dropping the session releases its holds; the index may keep full
+    # blocks, so drain the cache and expect a whole pool
+    assert sched.drop_session("s") is True
+    assert sched.drop_session("s") is False
+    sched._prefix.evict(10 ** 6)
+    sched.check_pages()
+    assert sched._pages.free_pages == sched._pages.n_pages
+
+
+def test_identical_resubmit_same_session_cows_last_page(model, engine):
+    """Resubmitting the retained context verbatim still prefills ≥1
+    token (the first-token logits): the capped match leaves the tail
+    token, whose rewrite lands in a CoW split of the shared page."""
+    sched = _sched(engine, n_slots=1, page_len=4, n_pages=16)
+    p = _toks((6,), seed=31)
+    f1 = sched.submit(p, max_new_tokens=3, session_id="rs")
+    sched.run_until_idle()
+    r1 = f1.result(5)
+    # turn 2 = EXACTLY the retained context (turn1 written tokens)
+    retained = np.concatenate([p, r1.tokens])[:-1]
+    f2 = sched.submit(retained, max_new_tokens=3, session_id="rs")
+    sched.run_until_idle()
+    assert f2.result(5).tokens.tolist() == \
+        engine.generate(retained, 3).tolist()
+    sched.check_pages()
+
+
+# ------------------------------------------------- pressure + eviction
+
+def test_lru_eviction_under_page_pressure(model, engine):
+    """Cached (zero-slot-ref) prefix pages are reclaimed LRU under page
+    pressure BEFORE any live request is preempted."""
+    reg = get_registry()
+    reg.reset()
+    sched = _sched(engine, n_slots=2, page_len=4, n_pages=10)
+    # park two finished requests' pages in the cache
+    for s in (41, 42):
+        f = sched.submit(_toks((9,), seed=s), max_new_tokens=2)
+        sched.run_until_idle()
+        f.result(5)
+    cached_before = sched._prefix.cached_pages
+    assert cached_before >= 4
+    # a request needing more than the free list forces eviction:
+    # 24 prompt + 4 generated = 7 pages against 6 free
+    big = _toks((24,), seed=43)
+    f = sched.submit(big, max_new_tokens=4)
+    sched.run_until_idle()
+    assert f.result(5).tokens.tolist() == \
+        engine.generate(big, 4).tolist()
+    assert sched._prefix.evictions >= 1
+    assert reg.get("dl4j_kv_prefix_evictions_total").value() >= 1
+    # cold cache paid; no live request did
+    assert reg.get("dl4j_serving_preemptions_total").value() == 0
+    sched.check_pages()
+
+
+def test_shared_pages_counted_once_in_accounting(model, engine):
+    """Residency truthfulness (the ISSUE 16 satellite): with N slots
+    sharing one prefix, allocated bytes follow UNIQUE pages, while the
+    per-slot mapping view keeps double counting (capacity math)."""
+    reg = get_registry()
+    reg.reset()
+    sched = _sched(engine, n_slots=3, page_len=4, n_pages=24)
+    prefix = _toks((12,), seed=51)
+    f0 = sched.submit(np.concatenate([prefix, _toks((2,), seed=52)]),
+                      max_new_tokens=2)
+    sched.run_until_idle()
+    f0.result(5)
+    tails = [np.concatenate([prefix, _toks((2,), seed=53 + i)])
+             for i in range(3)]
+    futs = [sched.submit(t, max_new_tokens=8) for t in tails]
+    # drive a few steps so all three decode concurrently on the shared
+    # prefix, then read the gauges mid-flight
+    for _ in range(4):
+        sched.step()
+    with sched._lock:
+        shared = sched._pages.shared_pages
+        used = sched._pages.used_pages
+        mapped = sched._pages.mapped_pages
+    if shared:      # all three admitted and still active
+        assert mapped > used      # per-slot view double counts
+        alloc_gauge = reg.get("dl4j_kv_allocated_bytes").value(
+            replica="0")
+        import deeplearning4j_tpu.serving.kvcache as kv
+        assert alloc_gauge == used * kv.page_nbytes(sched.cache)
+        assert reg.get("dl4j_kv_shared_pages").value(replica="0") >= 1
+    sched.run_until_idle()
+    for t, f in zip(tails, futs):
+        assert f.result(5).tokens.tolist() == \
+            engine.generate(t, 8).tolist()
+    rep = sched.kv_report()
+    assert rep["waste_ratio_mean"] >= 0.0
+    assert rep["paged"]["used_pages"] <= rep["paged"]["n_pages"]
+    sched.check_pages()
+
+
+# ------------------------------------------------------------- fuzzing
+
+def test_fuzz_refcount_invariant_random_schedules(model, engine):
+    """Free-XOR-refcounted fuzz: random prompts (seeded to collide on
+    prefixes), sessions, cancels, and starvation preemption through
+    admit/chunk/decode/finish — ``check(external=holds)`` passes at
+    every step, outputs stay cold-prefill-identical, and after a full
+    cache drain the pool is whole."""
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        n_pages = int(rng.integers(12, 24))
+        sched = _sched(engine, n_slots=int(rng.integers(1, 4)),
+                       page_len=int(rng.choice([2, 4])),
+                       n_pages=n_pages,
+                       starvation_ms=0.0 if seed % 2 else None)
+        bases = [_toks((int(rng.integers(4, 10)),), seed=100 + seed),
+                 _toks((int(rng.integers(4, 10)),), seed=200 + seed)]
+        prompts, futs, budgets = [], [], []
+        for i in range(int(rng.integers(4, 9))):
+            base = bases[int(rng.integers(0, 2))]
+            tail = _toks((int(rng.integers(1, 6)),),
+                         seed=int(rng.integers(0, 1 << 16)))
+            p = np.concatenate([base, tail])
+            mnt = int(rng.integers(1, 5))
+            if sched._pages.pages_for(p.size + mnt - 1) > n_pages:
+                continue
+            sid = f"s{i % 2}" if rng.random() < 0.3 else None
+            fut = sched.submit(p, max_new_tokens=mnt, session_id=sid)
+            if rng.random() < 0.15:
+                fut.cancel()
+            else:
+                prompts.append(p)
+                budgets.append(mnt)
+                futs.append(fut)
+            if rng.random() < 0.5:
+                sched.step()
+                sched.check_pages()
+        guard = 0
+        while sched.step():
+            sched.check_pages()
+            guard += 1
+            assert guard < 2000, "prefix scheduler failed to drain"
+        for p, mnt, f in zip(prompts, budgets, futs):
+            assert f.result(5).tokens.tolist() == \
+                engine.generate(p, mnt).tolist()
+        sched.check_pages()
+        # drain the cache: sessions + index released -> whole pool
+        with sched._lock:
+            for sid in list(sched._prefix.sessions):
+                sched._prefix.drop_session(sid)
+            sched._prefix.evict(10 ** 6)
+        sched.check_pages()
+        assert sched._pages.free_pages == n_pages
+        assert sched._pages.mapped_pages == 0
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_crash_forgets_prefix_holds(model, engine, monkeypatch):
+    """_fail_all with the prefix cache: the pool reset zeroes refcounts,
+    and the cache forgets its holds in the same breath — a restarted
+    loop starts from a whole free list and an empty index."""
+    sched = _sched(engine, n_slots=1, page_len=4, n_pages=12)
+    warm = sched.submit(_toks((6,), seed=61), max_new_tokens=2,
+                        session_id="crash")
+    sched.run_until_idle()
+    warm.result(5)
+    assert sched._prefix.n_sessions == 1 and sched._prefix.n_entries > 0
+    fut = sched.submit(_toks((6,), seed=62), max_new_tokens=6)
+    sched.step()
+
+    def boom(cache, tokens):
+        raise RuntimeError("injected prefix-cache crash")
+    monkeypatch.setattr(sched.engine, "decode_step", boom)
+    sched.start(poll_s=0.001)
+    with pytest.raises(RuntimeError, match="injected prefix-cache"):
+        fut.result(timeout=30)
+    sched._thread.join(timeout=30)
+    sched.check_pages()
+    assert sched._pages.free_pages == 12
+    assert sched._prefix.n_entries == 0
+    assert sched._prefix.n_sessions == 0
+
+
+# ---------------------------------------------------- retrace pinning
+
+def test_zero_retraces_with_prefix_cache_enabled(model):
+    """The ISSUE 16 acceptance bar: with sharing on — hits, session
+    turns, CoW splits, evictions — post-warmup traffic triggers ZERO
+    retraces. copy_page is pre-warmed at construction (src==dst
+    self-copy), so even a first-ever split after mark_warm is a cache
+    hit."""
+    cfg, params = model
+    eng = GenerationEngine(cfg, params, prefill_chunk=8)
+    sched = _sched(eng, n_slots=2, page_len=4, n_pages=20)
+    # warmup covers every entry point incl. a session turn (CoW)
+    w1 = sched.submit(_toks((9,), seed=71), max_new_tokens=3,
+                      session_id="warm")
+    sched.run_until_idle()
+    t2 = np.concatenate([_toks((9,), seed=71), w1.result(5).tokens,
+                         _toks((2,), seed=72)])
+    w2 = sched.submit(t2, max_new_tokens=3, session_id="warm")
+    sched.run_until_idle()
+    w2.result(5)
+    eng.mark_warm()
+
+    base = _toks((11,), seed=73)
+    futs = [sched.submit(np.concatenate([base, _toks((k,), seed=74 + k)]),
+                         max_new_tokens=4) for k in (1, 3, 5)]
+    t3 = np.concatenate([t2, w2.result(5).tokens, _toks((2,), seed=79)])
+    futs.append(sched.submit(t3, max_new_tokens=3, session_id="warm"))
+    sched.run_until_idle()
+    for f in futs:
+        f.result(5)
+    rep = eng.compile_report()
+    retraces = {k: v["retraces_after_warm"] for k, v in rep.items()}
+    assert all(v == 0 for v in retraces.values()), retraces
+    assert rep["copy_page"]["compiles"] == 1
+    sched.check_pages()
