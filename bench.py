@@ -1063,6 +1063,50 @@ def _attach_fidelity(rec, eng):
     return rec
 
 
+def _paged_kernel_ab(eng, slots=4, floor_ms=None):
+    """Kernel-vs-XLA A/B for the decode row (ISSUE 17): run the
+    fidelity-gated promotion race over probe paged caches of the row's
+    own geometry (dense byte budget re-cut into DEFAULT_PAGE_LEN
+    pages) and report both arms — tokens/s, pct_of_floor against the
+    row's roofline floor, the fidelity kl_max that gated promotion,
+    and the verdict that landed as a sha-stamped cost record. Off-TPU
+    the kernel arm runs in pallas interpret mode, so its timing is a
+    plumbing check (the verdict records ``fallback_slower`` — the
+    baseline is NOT re-pinned on it); on-chip the same block is the
+    promotion's citable evidence."""
+    from deeplearning4j_tpu.kernels.paged_attention import race
+    from deeplearning4j_tpu.serving import kvcache
+
+    plen = kvcache.DEFAULT_PAGE_LEN
+    n_pages = slots * (-(-eng.max_len // plen))
+    cache = eng.init_paged_cache(slots, n_pages, plen)
+    res = race(eng, cache)
+
+    def arm(step_s):
+        if step_s is None:
+            return None
+        return {"step_time_ms": round(step_s * 1e3, 3),
+                "tokens_per_s": round(slots / step_s, 2),
+                "pct_of_floor": (None if not floor_ms or step_s <= 0
+                                 else round(floor_ms / (step_s * 1e3), 4))}
+
+    rep = eng.compile_report()
+    return {
+        "slots": slots, "page_len": plen, "n_pages": n_pages,
+        "verdict": res["verdict"],
+        "promoted": res["choice"] == "kernel",
+        "gather": arm(res["gather_s"]),
+        "kernel": arm(res["kernel_s"]),
+        "speedup_kernel_over_gather": res["speedup"],
+        "fidelity_kl_max": res["fidelity"]["kl_max"],
+        "greedy_match_frac": res["fidelity"]["greedy_match_frac"],
+        "cost_record": res["key"],
+        # one compile per arm, both pre-warmed by the race itself — the
+        # dispatch decision never costs the serve loop a retrace
+        "kernel_compiles": rep["decode_paged_kernel"]["compiles"],
+    }
+
+
 def _serve_blocks(eng, slots, n_requests=None, new_tokens=8,
                   prompt_len=64, paged=False, concurrency_x=3):
     """(slo, memory) evidence from ONE real continuous-batching serve
@@ -1323,6 +1367,25 @@ def bench_inference_decode(batch, steps):
     # error over the row's own engine — the measured numerics envelope
     # the quantized-KV / spec-decode rows must stay inside
     _attach_fidelity(rec, eng)
+    # paged-decode kernel-vs-XLA A/B (ISSUE 17): the promotion race's
+    # verdict + both arms' tokens/s beside the row, and the race's own
+    # fidelity probe joins the fidelity block so fidelity_report.py
+    # gates the kernel capture like every other pair
+    try:
+        floor_ms = (rec.get("floor") or {}).get("floor_ms")
+        rec["paged_kernel_ab"] = _paged_kernel_ab(eng, slots=4,
+                                                  floor_ms=floor_ms)
+        if isinstance(rec.get("fidelity"), dict) \
+                and "na" not in rec["fidelity"]:
+            from deeplearning4j_tpu.kernels import autotune as _at
+            meta = _at.measurement_meta(
+                rec["paged_kernel_ab"]["cost_record"]) or {}
+            fid = meta.get("fidelity")
+            if fid:
+                rec["fidelity"]["paged_kernel_vs_xla"] = _fid_compact(fid)
+    except Exception as e:  # noqa: BLE001 — the row survives block-less
+        rec["paged_kernel_ab"] = {"na": f"kernel A/B failed: "
+                                        f"{type(e).__name__}: {e}"[:300]}
     return _flag_on_chip(rec)
 
 

@@ -1,3 +1,4 @@
 """deeplearning4j_tpu.kernels — pallas TPU kernels for the hot ops."""
 
 from .flash_attention import flash_attention, mha_reference
+from .paged_attention import paged_attention, paged_attention_reference

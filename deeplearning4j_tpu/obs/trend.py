@@ -196,6 +196,23 @@ def ledger_record(row: str, rec: Dict[str, Any],
             entry["memory"] = compact
     if isinstance(rec.get("layers"), dict):
         entry["layers"] = rec["layers"]
+    # paged-attention kernel-vs-XLA A/B (ISSUE 17): both arms' rates,
+    # the promotion verdict and the fidelity bound ride in the ledger so
+    # the trend plane can watch the kernel's margin across captures
+    ab = rec.get("paged_kernel_ab")
+    if isinstance(ab, dict) and "na" not in ab:
+        compact = {k: ab[k] for k in
+                   ("verdict", "promoted", "speedup_kernel_over_gather",
+                    "fidelity_kl_max", "greedy_match_frac", "cost_record")
+                   if ab.get(k) is not None}
+        for arm in ("gather", "kernel"):
+            a = ab.get(arm)
+            if isinstance(a, dict):
+                compact[arm] = {k: a[k] for k in
+                                ("step_time_ms", "tokens_per_s",
+                                 "pct_of_floor") if a.get(k) is not None}
+        if compact:
+            entry["paged_kernel_ab"] = compact
     return entry
 
 

@@ -31,6 +31,7 @@ pallas kernel exactly like the training forward.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -103,7 +104,8 @@ class GenerationEngine:
 
     def __init__(self, cfg, params, *, max_len: Optional[int] = None,
                  prefill_buckets=DEFAULT_PREFILL_BUCKETS,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 paged_kernel: Optional[str] = None):
         if getattr(cfg, "n_experts", 0):
             raise NotImplementedError(
                 "GenerationEngine is dense-only: MoE expert dispatch has "
@@ -156,6 +158,20 @@ class GenerationEngine:
         self._decode_paged = CompileSentinel(
             "decode_paged", jax.jit(self._decode_paged_raw,
                                     donate_argnums=(1,)))
+        # pallas paged-attention variant (ISSUE 17): same signature and
+        # donation, attention fused in-kernel instead of gathered.
+        # Which one decode_step dispatches is a per-geometry verdict
+        # from the fidelity-gated promotion race (_paged_kernel_choice)
+        self._decode_paged_kernel = CompileSentinel(
+            "decode_paged_kernel",
+            jax.jit(functools.partial(self._decode_paged_raw,
+                                      use_kernel=True),
+                    donate_argnums=(1,)))
+        # paged_kernel pins the dispatch mode (off|on|auto|race); None
+        # defers to $DL4J_PAGED_KERNEL, default "auto" (race on TPU,
+        # gather elsewhere — see kernels.paged_attention.decide)
+        self.paged_kernel_mode = paged_kernel
+        self._paged_plan = {}            # geometry key -> kernel|gather
         self._prefill_chunk = CompileSentinel(
             "prefill_chunk", jax.jit(self._prefill_chunk_raw,
                                      donate_argnums=(1,)))
@@ -164,7 +180,8 @@ class GenerationEngine:
                                  donate_argnums=(0,)))
         self.sentinels = {s.name: s for s in (
             self._decode, self._prefill, self._prefill_slot, self._sample,
-            self._decode_paged, self._prefill_chunk, self._copy_page)}
+            self._decode_paged, self._decode_paged_kernel,
+            self._prefill_chunk, self._copy_page)}
 
     # ------------------------------------------------------------ cache
     def init_cache(self, n_slots: int):
@@ -302,7 +319,7 @@ class GenerationEngine:
                                       cache["v"]))
         return x, k_new, v_new
 
-    def _decode_paged_raw(self, params, cache, tokens):
+    def _decode_paged_raw(self, params, cache, tokens, use_kernel=False):
         """One decode step over a block-paged pool (ISSUE 14): same
         contract as ``_decode_raw`` — tokens (B,) → (logits (B, V) f32,
         advanced cache) — but each slot's k/v rows live in the pages its
@@ -313,7 +330,14 @@ class GenerationEngine:
         whose write position falls on an unmapped/sentinel entry drops
         the write (scatter OOB is a no-op — same contract as the dense
         path's past-capacity drop); keeping every position mapped is
-        the scheduler's page-accounting job."""
+        the scheduler's page-accounting job.
+
+        ``use_kernel=True`` (the ``decode_paged_kernel`` entry point,
+        ISSUE 17) swaps ONLY the attend closure for the fused pallas
+        paged-attention kernel — page-table indirection via scalar
+        prefetch, no materialized gather; writes, block math and logits
+        are byte-identical to the gather path by construction
+        (``_blocks_with_cache`` is shared)."""
         cfg = self.cfg
         pos = cache["pos"]
         table = cache["pages"]                       # (B, P) int32
@@ -329,12 +353,19 @@ class GenerationEngine:
         off = pos % plen
         x = self._embed_rows(params, tokens, pos)
 
-        def attend(q, kl, vl):
-            # gather each slot's pages: sentinel entries clamp to the
-            # last pool page — garbage the pos mask never exposes
-            kg = kl[table].reshape(b, per_slot * plen, h_, dh)
-            vg = vl[table].reshape(b, per_slot * plen, h_, dh)
-            return _cached_attention(cfg, q, kg, vg, pos)
+        if use_kernel:
+            from ..kernels.paged_attention import paged_attention as _pa
+
+            def attend(q, kl, vl):
+                return _pa(q, kl, vl, table, pos)
+        else:
+            def attend(q, kl, vl):
+                # gather each slot's pages: sentinel entries clamp to
+                # the last pool page — garbage the pos mask never
+                # exposes
+                kg = kl[table].reshape(b, per_slot * plen, h_, dh)
+                vg = vl[table].reshape(b, per_slot * plen, h_, dh)
+                return _cached_attention(cfg, q, kg, vg, pos)
 
         x, k_new, v_new = self._blocks_with_cache(
             params, cache, x,
@@ -480,12 +511,35 @@ class GenerationEngine:
         return self._prefill_slot(self.params, cache, jnp.asarray(padded),
                                   jnp.int32(n), jnp.int32(slot))
 
+    def _paged_kernel_choice(self, cache) -> str:
+        """``"kernel"`` or ``"gather"`` for this cache geometry —
+        resolved ONCE per (pool shape, dtype, table shape) via the
+        fidelity-gated promotion race (``kernels.paged_attention``) and
+        memoized, so the decode hot loop never re-decides. The race's
+        probe caches share the live cache's abstract shapes, so losing
+        a race never costs the serve loop a retrace."""
+        key = (cache["k"].shape, str(jnp.dtype(cache["k"].dtype)),
+               cache["pages"].shape)
+        got = self._paged_plan.get(key)
+        if got is None:
+            from ..kernels.paged_attention import decide
+            got = decide(self, cache)
+            self._paged_plan[key] = got
+        return got
+
     def decode_step(self, cache, tokens):
         """One token for every slot: tokens (B,) → (logits (B, V), cache).
-        Dispatches on the cache layout — dense slots or the block-paged
-        pool (ISSUE 14) — behind one call site; the passed cache is
-        DONATED either way, keep only the returned one."""
-        fn = self._decode_paged if kvcache.is_paged(cache) else self._decode
+        Dispatches on the cache layout — dense slots, or the block-paged
+        pool (ISSUE 14) via either the XLA gather path or the promoted
+        pallas kernel (ISSUE 17, ``_paged_kernel_choice``) — behind one
+        call site; the passed cache is DONATED either way, keep only
+        the returned one."""
+        if kvcache.is_paged(cache):
+            fn = (self._decode_paged_kernel
+                  if self._paged_kernel_choice(cache) == "kernel"
+                  else self._decode_paged)
+        else:
+            fn = self._decode
         return fn(self.params, cache,
                   jnp.asarray(tokens, jnp.int32).reshape(-1))
 

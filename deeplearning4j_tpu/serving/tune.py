@@ -215,30 +215,27 @@ def sweep_serving_knobs(eng, *, enabled: bool = True,
 def recommended_serving_knobs(cfg=None, *, max_len: Optional[int] = None
                               ) -> Dict[str, dict]:
     """Read the serving cost records back: {knob: {choice, meta}} for
-    every ``serving_*`` key in the disk cache (filtered to ``cfg``'s
+    every ``serving_*`` key in the unified store (filtered to ``cfg``'s
     shape when given). This is how a default is CITED — the choice
-    plus the measurements that reached it, never a bare constant."""
-    from ..kernels.autotune import _disk_cache, _entry_choice
+    plus the measurements that reached it, never a bare constant.
+    Reads through the public harness API (``records(kind=...)``,
+    ISSUE 17) — the kind filter prefix-matches every ``serving_*``
+    family in one call."""
+    from ..kernels.autotune import records
 
     out: Dict[str, dict] = {}
-    prefix = "serving_"
     want = None
     if cfg is not None:
         # field-exact match: keys are ':'-delimited, and a bare
         # substring would let L2H4D16 claim L2H4D160's records
         want = f"L{cfg.n_layers}H{cfg.n_heads}D{cfg.head_dim}"
-    for key, entry in _disk_cache().items():
-        if not key.startswith(prefix):
-            continue
+    for key, rec in records(kind="serving").items():
         fields = key.split(":")
         if want is not None and want not in fields:
             continue
         if max_len is not None and f"T{int(max_len)}" not in fields:
             continue
-        out[key] = {
-            "choice": list(_entry_choice(entry)),
-            "meta": entry.get("meta") if isinstance(entry, dict) else None,
-        }
+        out[key] = {"choice": rec["choice"], "meta": rec["meta"]}
     return out
 
 

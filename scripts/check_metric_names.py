@@ -63,9 +63,9 @@ NAMESPACE = "dl4j_"
 # Every label NAME any instrumentation site registers. Extending this
 # is a deliberate act: each new label multiplies time series, and an
 # unbounded one (request id, trace id) melts the registry.
-ALLOWED_LABELS = {"backend", "component", "config", "direction", "kind",
-                  "layer", "level", "reason", "replica", "row", "stat",
-                  "unit", "verdict"}
+ALLOWED_LABELS = {"backend", "component", "config", "direction", "kernel",
+                  "kind", "layer", "level", "reason", "replica", "row",
+                  "stat", "unit", "verdict"}
 # per-prefix restriction (ISSUE 12/13): each observability plane may
 # label ONLY from its own small fixed vocabulary — component names,
 # stat kinds and probe-pair kinds are bounded sets, never per-request
@@ -80,6 +80,10 @@ PLANE_LABELS = {
     "dl4j_num_": {"kind", "layer", "replica"},
     "dl4j_fidelity_": {"kind", "layer", "replica"},
     "dl4j_replica_": {"replica"},
+    # autotune harness (ISSUE 17): cache level, kernel kind, promotion
+    # verdict, invalidation reason — all small fixed enums; the shape
+    # bucket and sha stay in the cost-record key, never in a label
+    "dl4j_autotune_": {"kernel", "level", "reason", "verdict"},
     # perf trend plane (ISSUE 15): the ledger key (row, backend) plus
     # the verdict enum — bench row names are a small fixed set; never
     # a sha, host fingerprint or capture id (those live in the ledger

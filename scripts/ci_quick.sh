@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Quick gate (ISSUE 7 + 8 + 10 + 11 + 12 + 13 + 14 + 15 + 16):
+# Quick gate (ISSUE 7 + 8 + 10 + 11 + 12 + 13 + 14 + 15 + 16 + 17):
 # metric-name/label + doc lint, the offline perf-regression gate over
 # the bench ledger, then the telemetry-plane, roofline-floor,
 # elastic-scaleout, serving-plane, paged-KV/chunked-prefill,
@@ -29,9 +29,37 @@ echo "== obs + floors + scaleout-fast + serving + paged-kv + prefix-cache + slo 
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
     tests/test_scaleout_fast.py tests/test_serving.py \
     tests/test_paged_kv.py tests/test_prefix_cache.py \
+    tests/test_paged_attention.py \
     tests/test_slo.py \
     tests/test_memplane.py tests/test_numerics.py \
     tests/test_trend.py \
     -q -m 'not slow' -p no:cacheprovider -p no:randomly
+
+echo "== autotune harness round-trip (record -> sha-bump -> invalidate + re-measure) =="
+JAX_PLATFORMS=cpu python - <<'EOF'
+import tempfile
+from pathlib import Path
+import jax.numpy as jnp
+from deeplearning4j_tpu.kernels import autotune as at
+
+at._CACHE_PATH = Path(tempfile.mkdtemp()) / "autotune.json"
+at._memory_cache.clear()
+at.put("roundtrip:check", (1,), meta={"best_s": 1.0}, sha="aaaa")
+assert at.choice("roundtrip:check", sha="aaaa") == (1,)
+# sha bump: stale record dropped, lookup misses
+assert at.lookup("roundtrip:check", sha="bbbb") is None
+assert at.records(kind="roundtrip") == {}
+# and the autotune() path re-measures instead of serving the old verdict
+timed = []
+def make_run(cand):
+    def run():
+        timed.append(cand)
+        return jnp.zeros((1,))
+    return run
+got = at.autotune("roundtrip:check", [(1,), (2,)], make_run, sha="bbbb")
+assert timed, "re-measure path not taken after sha bump"
+assert at.records()["roundtrip:check"]["sha"] == "bbbb"
+print("autotune harness round-trip OK")
+EOF
 
 echo "ci_quick: all green"

@@ -177,6 +177,14 @@ def inference_row(name, rec):
                        f"{rec['best_batch_throughput']:,.1f} samples/s")
     if rec.get("slots") is not None:
         details.append(f"{rec['slots']} decode slots")
+    ab = rec.get("paged_kernel_ab")
+    if isinstance(ab, dict) and ab.get("verdict"):
+        # the paged-attention kernel-vs-gather race (ISSUE 17): the
+        # verdict and measured ratio, so the README never implies the
+        # kernel is live where the fidelity gate said otherwise
+        sp = ab.get("speedup_kernel_over_gather")
+        details.append(f"pallas paged-attn A/B: {ab['verdict']}"
+                       + (f" ({sp}× vs gather)" if sp else ""))
     if rec.get("ttft_speedup_x") is not None:
         # the CoW prefix-cache row (ISSUE 16): warm-vs-cold TTFT and
         # tokens each user actually keeps resident when the prefix is

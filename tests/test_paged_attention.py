@@ -1,0 +1,417 @@
+"""Pallas paged-attention decode kernel + unified autotune harness
+(ISSUE 17): interpret-mode kernel-vs-gather oracles at every position
+across mapped/sentinel/partial-fill pages, CoW-split pages through the
+kernel, scheduler-level greedy bit-equivalence with the kernel forced
+on, the zero-retrace pin across page-table growth, the fidelity-gated
+promotion lifecycle (race → sha-stamped cost record → counter), the
+sha-bump invalidation + re-race round trip, and the public cost-record
+API (``records``/``choice``/``lookup``/``put``/``invalidate``) with
+its deprecation shims.
+
+Fast tier-1 suite — tiny f32 configs, pallas interpret mode on CPU
+(the same kernel code path the TPU compiles, minus the Mosaic
+lowering)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import importlib
+
+from deeplearning4j_tpu.kernels import autotune as at
+# the package re-exports the paged_attention FUNCTION under the same
+# name; import_module resolves the module itself for monkeypatching
+pa_mod = importlib.import_module(
+    "deeplearning4j_tpu.kernels.paged_attention")
+from deeplearning4j_tpu.kernels.paged_attention import (
+    PROMOTION_MAX_KL, bucket_key, kernel_sha, paged_attention,
+    paged_attention_reference)
+from deeplearning4j_tpu.obs import get_registry
+from deeplearning4j_tpu.serving import (ContinuousBatchingScheduler,
+                                        GenerationEngine, PageTable)
+from deeplearning4j_tpu.serving import kvcache
+from deeplearning4j_tpu.zoo import transformer as tfm
+
+ATOL = 2e-4          # engine-level logit tolerance (tests/test_paged_kv)
+KERNEL_ATOL = 1e-5   # direct-array f32 kernel vs reference
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=61, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_seq=32, dtype=jnp.float32, remat=False,
+                attn_scores_bf16=False)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _toks(shape, vocab=61, seed=0):
+    return np.random.default_rng(seed).integers(0, vocab, shape).astype(
+        np.int32)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_store(tmp_path, monkeypatch):
+    """Every test gets its own autotune store — promotion races must
+    never read a verdict another test (or the developer's home dir)
+    measured."""
+    monkeypatch.setattr(at, "_CACHE_PATH", tmp_path / "autotune.json")
+    at._memory_cache.clear()
+    yield
+    at._memory_cache.clear()
+
+
+# ------------------------------------------------ direct-array oracle
+
+def test_kernel_matches_reference_at_every_position():
+    """The wall-to-wall oracle: for EVERY decode position of a slot —
+    so every mapped/partial-fill/sentinel page-table configuration a
+    scheduler can produce — the interpret-mode kernel equals the XLA
+    gather reference."""
+    rng = np.random.default_rng(0)
+    h, dh, npg, plen, per_slot = 2, 16, 12, 4, 4
+    q = jnp.asarray(rng.standard_normal((1, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((npg, plen, h, dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((npg, plen, h, dh)), jnp.float32)
+    # non-contiguous page ids: the indirection must go through the table
+    ids = rng.permutation(npg)[:per_slot]
+    for pos in range(per_slot * plen):
+        mapped = -(-(pos + 1) // plen)
+        table = np.full((1, per_slot), npg, np.int32)
+        table[0, :mapped] = ids[:mapped]
+        out = paged_attention(q, k, v, jnp.asarray(table),
+                              jnp.asarray([pos], jnp.int32),
+                              interpret=True)
+        ref = paged_attention_reference(q, k, v, jnp.asarray(table),
+                                        jnp.asarray([pos], jnp.int32))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=KERNEL_ATOL,
+                                   err_msg=f"pos={pos} mapped={mapped}")
+
+
+def test_kernel_matches_reference_mixed_slots():
+    """A batch mixing full slots, partial fills, a single-page slot —
+    the per-slot online-softmax state must not bleed across the grid's
+    batch dimension."""
+    rng = np.random.default_rng(1)
+    b, h, dh, npg, plen, per_slot = 4, 2, 8, 16, 4, 5
+    q = jnp.asarray(rng.standard_normal((b, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((npg, plen, h, dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((npg, plen, h, dh)), jnp.float32)
+    table = np.full((b, per_slot), npg, np.int32)
+    table[0, :3] = [2, 7, 4]      # partial fill of page 3
+    table[1, :5] = [0, 1, 3, 5, 6]  # full table row
+    table[2, :1] = [8]            # first token only
+    table[3, :2] = [9, 10]        # exact page boundary (pos on last row)
+    pos = jnp.asarray([9, 19, 0, 7], jnp.int32)
+    out = paged_attention(q, k, v, jnp.asarray(table), pos, interpret=True)
+    ref = paged_attention_reference(q, k, v, jnp.asarray(table), pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=KERNEL_ATOL)
+
+
+# --------------------------------------------- engine + CoW-split pages
+
+def _paged_engines(model, **kw):
+    cfg, params = model
+    gather = GenerationEngine(cfg, params, prefill_chunk=8,
+                              paged_kernel="off", **kw)
+    kernel = GenerationEngine(cfg, params, prefill_chunk=8,
+                              paged_kernel="on", **kw)
+    return gather, kernel
+
+
+def test_cow_split_pages_kernel_matches_gather(model):
+    """CoW scenario (ISSUE 16) through the kernel: a shared partial
+    page is split (PageTable.cow + engine.copy_page), then both slots
+    decode over their now-diverged pages — kernel and gather paths stay
+    logit-identical at every step."""
+    eng_g, eng_k = _paged_engines(model)
+    prompt = _toks((6,), seed=5)          # 2 pages, second half-full
+
+    def build(eng):
+        cache = eng.init_paged_cache(2, 16, 4)
+        pt = PageTable.for_cache(cache)
+        assert pt.map(0, prompt.size)
+        cache = pt.sync(cache)
+        _, cache = eng.prefill_chunk(cache, prompt, 0, start=0)
+        # slot 1 admits on the shared prefix: same pages, one ref each
+        pt.map_shared(1, [int(pt.table[0, 0]), int(pt.table[0, 1])])
+        cache = pt.sync(cache)
+        cache = dict(cache, pos=cache["pos"].at[1].set(prompt.size))
+        # slot 1 will scatter into shared logical page 1 → split first
+        src, dst = pt.cow(1, 1)
+        cache = eng.copy_page(pt.sync(cache), src, dst)
+        # headroom for the decoded tokens (fresh pages, both slots)
+        assert pt.map(0, prompt.size + 4) and pt.map(1, prompt.size + 4)
+        return pt.sync(cache), pt
+
+    cg, _ = build(eng_g)
+    ck, _ = build(eng_k)
+    toks = jnp.asarray([3, 9], jnp.int32)
+    for step in range(4):
+        lg, cg = eng_g.decode_step(cg, toks)
+        lk, ck = eng_k.decode_step(ck, toks)
+        np.testing.assert_allclose(np.asarray(lk), np.asarray(lg),
+                                   atol=ATOL, err_msg=f"step {step}")
+        assert np.asarray(jnp.argmax(lk, -1)).tolist() == \
+            np.asarray(jnp.argmax(lg, -1)).tolist()
+        toks = jnp.argmax(lg, -1).astype(jnp.int32)
+
+
+def test_scheduler_greedy_bit_identical_with_kernel(model):
+    """Scheduler-level token-space equivalence (the acceptance bar):
+    greedy output through the paged scheduler with the pallas kernel
+    FORCED on is bit-identical to engine.generate()'s dense path."""
+    cfg, params = model
+    eng = GenerationEngine(cfg, params, prefill_chunk=8,
+                           paged_kernel="on")
+    sched = ContinuousBatchingScheduler(eng, n_slots=2, page_len=4,
+                                        n_pages=16)
+    prompts = [_toks((n,), seed=20 + n) for n in (3, 11, 6, 17, 2)]
+    futs = [sched.submit(p, max_new_tokens=5) for p in prompts]
+    sched.run_until_idle()
+    for p, f in zip(prompts, futs):
+        assert f.result(5).tokens.tolist() == \
+            eng.generate(p, 5).tolist()
+    sched._pages.check()
+    assert sched._pages.free_pages == sched._pages.n_pages
+
+
+def test_zero_retraces_with_kernel_across_page_growth(model):
+    """The ISSUE 14 retrace pin holds with the kernel dispatched: the
+    page table rides as DATA through the scalar-prefetch operand, so
+    page growth across admissions never recompiles — one compile for
+    the kernel decode entry point, zero retraces after warm."""
+    cfg, params = model
+    eng = GenerationEngine(cfg, params, prefill_chunk=8,
+                           paged_kernel="on")
+    sched = ContinuousBatchingScheduler(eng, n_slots=2, page_len=4,
+                                        n_pages=16)
+    warm = sched.submit(_toks((9,), seed=70), max_new_tokens=3)
+    sched.run_until_idle()
+    warm.result(5)
+    eng.mark_warm()
+    prompts = [_toks((n,), seed=71 + n) for n in (2, 7, 15, 20, 11)]
+    futs = [sched.submit(p, max_new_tokens=4) for p in prompts]
+    sched.run_until_idle()
+    for f in futs:
+        f.result(5)
+    rep = eng.compile_report()
+    assert sum(s["retraces_after_warm"] for s in rep.values()) == 0
+    assert rep["decode_paged_kernel"]["compiles"] == 1
+    assert rep["decode_paged"]["compiles"] == 0   # gather never dispatched
+
+
+# ------------------------------------------------ promotion lifecycle
+
+def _race_engine(model, mode="race"):
+    cfg, params = model
+    return GenerationEngine(cfg, params, prefill_chunk=8,
+                            paged_kernel=mode)
+
+
+def test_promotion_race_records_sha_stamped_verdict(model):
+    """One decode over a fresh geometry in race mode runs the
+    fidelity-gated race: the verdict lands as a ``paged_decode:*`` cost
+    record stamped with the kernel sha, fidelity held the KL budget
+    with bit-identical greedy tokens, and the promotions counter
+    carries the verdict label."""
+    reg = get_registry()
+    reg.reset()
+    eng = _race_engine(model)
+    cache = eng.init_paged_cache(2, 16, 4)
+    pt = PageTable.for_cache(cache)
+    assert pt.map(0, 8) and pt.map(1, 8)
+    cache = pt.sync(cache)
+    cache = dict(cache, pos=jnp.asarray([5, 3], jnp.int32))
+    _, cache = eng.decode_step(cache, jnp.asarray([1, 2], jnp.int32))
+
+    recs = at.records(kind="paged_decode")
+    assert len(recs) == 1
+    key, rec = next(iter(recs.items()))
+    assert key == bucket_key(eng.cfg, cache)
+    assert rec["sha"] == kernel_sha()
+    assert rec["choice"][0] in ("kernel", "gather")
+    meta = rec["meta"]
+    assert meta["verdict"] in ("promoted", "fallback_slower")
+    # fidelity held: that's why the verdict is a TIMING verdict, not
+    # fallback_fidelity
+    assert meta["fidelity"]["kl_max"] <= PROMOTION_MAX_KL
+    assert meta["fidelity"]["greedy_match_frac"] == 1.0
+    assert meta["gather_s"] > 0 and meta["kernel_s"] > 0
+    assert reg.get("dl4j_autotune_promotions_total").value(
+        kernel="paged_decode", verdict=meta["verdict"]) == 1
+    # the verdict is memoized per engine geometry — no re-race
+    _, cache = eng.decode_step(cache, jnp.asarray([1, 2], jnp.int32))
+    assert reg.get("dl4j_autotune_promotions_total").value(
+        kernel="paged_decode", verdict=meta["verdict"]) == 1
+
+
+def test_sha_bump_invalidates_record_and_reraces(model, monkeypatch):
+    """The harness round trip (acceptance criterion): a cost record
+    written under one kernel sha is DROPPED when the kernel source
+    changes — the invalidation counter bumps with reason=sha and the
+    race runs again, leaving a record under the new sha."""
+    reg = get_registry()
+    reg.reset()
+    eng = _race_engine(model)
+    cache = eng.init_paged_cache(2, 16, 4)
+    pt = PageTable.for_cache(cache)
+    assert pt.map(0, 8) and pt.map(1, 8)
+    cache = pt.sync(cache)
+    cache = dict(cache, pos=jnp.asarray([5, 3], jnp.int32))
+    _, cache = eng.decode_step(cache, jnp.asarray([1, 2], jnp.int32))
+    old_sha = kernel_sha()
+    key = bucket_key(eng.cfg, cache)
+    assert at.records(kind="paged_decode")[key]["sha"] == old_sha
+    races_before = sum(
+        reg.get("dl4j_autotune_promotions_total").value(
+            kernel="paged_decode", verdict=v)
+        for v in ("promoted", "fallback_slower", "fallback_fidelity"))
+    assert races_before == 1
+
+    # simulate an edit to the kernel source: decide() now presents a
+    # different sha, so the stored verdict is stale
+    monkeypatch.setattr(pa_mod, "kernel_sha", lambda: "deadbeef00000000")
+    eng2 = _race_engine(model)          # fresh engine: no memoized plan
+    cache2 = eng2.init_paged_cache(2, 16, 4)
+    pt2 = PageTable.for_cache(cache2)
+    assert pt2.map(0, 8) and pt2.map(1, 8)
+    cache2 = pt2.sync(cache2)
+    cache2 = dict(cache2, pos=jnp.asarray([5, 3], jnp.int32))
+    _, cache2 = eng2.decode_step(cache2, jnp.asarray([1, 2], jnp.int32))
+
+    assert reg.get("dl4j_autotune_invalidations_total").value(
+        kernel="paged_decode", reason="sha") == 1
+    races_after = sum(
+        reg.get("dl4j_autotune_promotions_total").value(
+            kernel="paged_decode", verdict=v)
+        for v in ("promoted", "fallback_slower", "fallback_fidelity"))
+    assert races_after == 2             # the re-measure path ran
+    assert at.records(kind="paged_decode")[key]["sha"] == \
+        "deadbeef00000000"
+
+
+def test_auto_mode_off_tpu_dispatches_gather_without_racing(model):
+    """``auto`` (the default) never races off-TPU: the interpret-mode
+    kernel is a CI oracle, not a speed path — CPU serving keeps the
+    gather dispatch and writes no cost record."""
+    cfg, params = model
+    eng = GenerationEngine(cfg, params, prefill_chunk=8)   # mode=auto
+    cache = eng.init_paged_cache(1, 8, 4)
+    pt = PageTable.for_cache(cache)
+    assert pt.map(0, 4)
+    cache = pt.sync(cache)
+    cache = dict(cache, pos=jnp.asarray([3], jnp.int32))
+    _, cache = eng.decode_step(cache, jnp.asarray([1], jnp.int32))
+    assert list(eng._paged_plan.values()) == ["gather"]
+    assert at.records(kind="paged_decode") == {}
+    rep = eng.compile_report()
+    assert rep["decode_paged_kernel"]["compiles"] == 0
+
+
+def test_fidelity_report_gate_passes_on_kernel_capture(model, tmp_path):
+    """The ``fidelity_report.py --max-kl`` acceptance bar on an
+    interpret-mode CPU capture: the paged_kernel_vs_xla probe report
+    passes the same KL budget promotion uses."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from deeplearning4j_tpu.obs.fidelity import FidelityProbe
+
+    eng_g, eng_k = _paged_engines(model)
+
+    def build(eng):
+        cache = eng.init_paged_cache(2, 16, 4)
+        pt = PageTable.for_cache(cache)
+        assert pt.map(0, 12) and pt.map(1, 8)
+        cache = pt.sync(cache)
+        return dict(cache, pos=jnp.asarray([9, 5], jnp.int32))
+
+    prompt = _toks((8,), seed=9)
+    caches = []
+    for eng in (eng_g, eng_k):
+        cache = build(eng)
+        _, cache = eng.prefill_chunk(cache, prompt, 0, start=0)
+        caches.append(cache)
+    toks = jnp.asarray([4, 2], jnp.int32)
+    lg, _ = eng_g.decode_step(caches[0], toks)
+    lk, _ = eng_k.decode_step(caches[1], toks)
+    rep = FidelityProbe("paged_kernel_vs_xla").compare(
+        np.asarray(lg, np.float32), np.asarray(lk, np.float32),
+        observe=False)
+    capture = tmp_path / "paged_kernel_fidelity.jsonl"
+    capture.write_text(json.dumps(rep) + "\n")
+
+    script = Path(__file__).resolve().parent.parent / "scripts" / \
+        "fidelity_report.py"
+    proc = subprocess.run(
+        [sys.executable, str(script), str(capture), "--max-kl", "1e-3"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "within" in proc.stdout
+
+
+# -------------------------------------------- public cost-record API
+
+def test_records_choice_lookup_public_api():
+    at.put("flash5:cpu:1x2x3x4:f32:True", (128, 256),
+           meta={"best_s": 1e-3})
+    at.put("serving_page_len:L2H2D16:T32:S4:float32:cpu", (16,))
+    at.put("paged_decode:L2H2D16:PL4:P8:NP16:S2:float32:cpu",
+           ("kernel",), sha="abc")
+    # kind filter prefix-matches the kind segment only
+    assert set(at.records(kind="serving")) == \
+        {"serving_page_len:L2H2D16:T32:S4:float32:cpu"}
+    assert len(at.records()) == 3
+    assert at.choice("flash5:cpu:1x2x3x4:f32:True") == (128, 256)
+    rec = at.lookup("paged_decode:L2H2D16:PL4:P8:NP16:S2:float32:cpu",
+                    sha="abc")
+    assert rec["choice"] == ["kernel"] and rec["sha"] == "abc"
+    # wrong sha: record invalidated, None returned
+    assert at.lookup("paged_decode:L2H2D16:PL4:P8:NP16:S2:float32:cpu",
+                     sha="xyz") is None
+    assert "paged_decode:L2H2D16:PL4:P8:NP16:S2:float32:cpu" \
+        not in at.records()
+    # records without a sha never sha-invalidate (the measured code is
+    # the caller itself)
+    assert at.choice("serving_page_len:L2H2D16:T32:S4:float32:cpu",
+                     sha="whatever") == (16,)
+    # explicit invalidate reports whether anything existed
+    assert at.invalidate("flash5:cpu:1x2x3x4:f32:True") is True
+    assert at.invalidate("flash5:cpu:1x2x3x4:f32:True") is False
+
+
+def test_deprecated_shims_still_serve_old_callers():
+    at.put("serving_decode_slots:L2H2D16:T32:float32:cpu", (8,),
+           meta={"best_s": 2e-3})
+    store = at._disk_cache()
+    assert "serving_decode_slots:L2H2D16:T32:float32:cpu" in store
+    entry = store["serving_decode_slots:L2H2D16:T32:float32:cpu"]
+    assert at._entry_choice(entry) == (8,)
+    # legacy bare-list entries normalize too
+    assert at._entry_choice([4, 2]) == (4, 2)
+
+
+def test_source_sha_changes_with_source():
+    def f():
+        return 1
+
+    def g():
+        return 2
+
+    assert at.source_sha(f) != at.source_sha(g)
+    assert at.source_sha(f) == at.source_sha(f)
+    assert len(at.source_sha(f)) == 16
