@@ -167,6 +167,15 @@ class SLOTracker:
             "span_s": rows[-1][0] - rows[0][0],
         }
 
+    @property
+    def latest_ts(self) -> float:
+        """Timestamp of the newest observation (0.0 before any). The
+        window prunes by THIS, not wall clock — a consumer comparing
+        against wall time (the fleet router's staleness guard) can tell
+        a fresh verdict from one frozen since traffic moved away."""
+        with self._lock:
+            return self._latest_ts
+
     def goodput(self) -> Optional[float]:
         st = self._stats()
         return None if st is None else st["good"] / st["n"]

@@ -23,7 +23,7 @@ from .tp import (ChannelShardedConvolution, ColumnParallelDense,
 from .ring_attention import (ring_attention, ring_attention_inner,
                              ring_attention_sharded)
 from .param_avg import ParameterAveragingTrainer
-from .leases import LeaseTable
+from .leases import LeaseTable, RequestLeaseTable
 from .scaleout import (MasterDiedError, ParamAveragingHub,
                        ParameterAveragingTrainingMaster,
                        SparkComputationGraph, SparkDl4jMultiLayer,
@@ -50,6 +50,6 @@ __all__ = [
     "SocketGradientTransport",
     "TrainingMaster", "ParameterAveragingTrainingMaster",
     "SparkDl4jMultiLayer", "SparkComputationGraph", "ParamAveragingHub",
-    "WorkerClient", "worker_main", "LeaseTable", "MasterDiedError",
-    "read_resume_state",
+    "WorkerClient", "worker_main", "LeaseTable", "RequestLeaseTable",
+    "MasterDiedError", "read_resume_state",
 ]

@@ -201,3 +201,96 @@ class LeaseTable:
                               completed=[int(i) for i in d["completed"]])
         except (ValueError, KeyError, TypeError):
             return None
+
+
+class RequestLeaseTable:
+    """Lease table over an UNBOUNDED request stream — the serving-fleet
+    sibling of :class:`LeaseTable` (ISSUE 18).
+
+    The training table's geometry is fixed at construction (``n_shards *
+    epochs`` items, affinity by slot arithmetic); a serving fleet sees an
+    open-ended arrival stream and routes by burn-rate/session affinity
+    *outside* the table. What carries over unchanged is the completion
+    contract: every item is completed **exactly once** no matter how many
+    replicas die holding it, stale completions from a replica whose lease
+    was released-and-re-granted are ignored, and ``release_replica``
+    returns the dead replica's in-flight items so the router can re-lease
+    them on survivors. Same state constants, same leaf-lock discipline.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: Dict[int, int] = {}
+        self._owner: Dict[int, Optional[int]] = {}
+        self._prev: Dict[int, Optional[int]] = {}
+        self._next = 0
+        self.reassigned = 0      # leases granted after a release
+
+    def add(self) -> int:
+        """Register a new work item; returns its id (monotonic)."""
+        with self._lock:
+            item = self._next
+            self._next += 1
+            self._state[item] = AVAILABLE
+            self._owner[item] = None
+            self._prev[item] = None
+            return item
+
+    def lease(self, item: int, replica: int) -> bool:
+        """Grant ``item`` to ``replica``. False if unknown / already
+        leased or done (the router must release before re-leasing)."""
+        with self._lock:
+            if self._state.get(item) != AVAILABLE:
+                return False
+            self._state[item] = LEASED
+            if self._prev[item] is not None:
+                self.reassigned += 1
+            self._owner[item] = replica
+            return True
+
+    def owner_of(self, item: int) -> Optional[int]:
+        with self._lock:
+            return self._owner.get(item)
+
+    def complete(self, replica: int, item: int) -> bool:
+        """Exactly-once completion: True iff ``replica`` currently holds
+        the lease (or held it when the item was released and no one has
+        re-leased it since — the late-DONE-from-a-ghost case). A result
+        arriving from a presumed-dead replica AFTER the item was re-leased
+        elsewhere returns False and must be dropped by the caller."""
+        with self._lock:
+            st = self._state.get(item)
+            if st == LEASED and self._owner[item] == replica:
+                self._state[item] = DONE
+                self._owner[item] = None
+                return True
+            if st == AVAILABLE and self._prev[item] == replica:
+                self._state[item] = DONE
+                return True
+            return False
+
+    def release_replica(self, replica: int) -> List[int]:
+        """Return all of ``replica``'s unfinished leases to the pool (in
+        item order) so they can be re-leased on survivors."""
+        out = []
+        with self._lock:
+            for item in sorted(self._state):
+                if self._state[item] == LEASED and \
+                        self._owner[item] == replica:
+                    self._state[item] = AVAILABLE
+                    self._owner[item] = None
+                    self._prev[item] = replica
+                    out.append(item)
+        return out
+
+    def all_done(self) -> bool:
+        with self._lock:
+            return all(st == DONE for st in self._state.values())
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            vals = list(self._state.values())
+            return {"available": vals.count(AVAILABLE),
+                    "leased": vals.count(LEASED),
+                    "done": vals.count(DONE),
+                    "reassigned": self.reassigned}

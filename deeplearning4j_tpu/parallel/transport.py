@@ -83,6 +83,82 @@ KIND_LEASE = 6      # hub -> worker: uint8 grant status (leases.GRANT_*),
 KIND_LEASE_DONE = 7  # worker -> hub: uint32 item id completed (no ack —
 #                     a completion lost with the connection is re-run,
 #                     the at-least-once half of the lease contract)
+KIND_FLEET_SUBMIT = 8   # router -> replica: one leased generation request
+#                     (see pack_fleet_submit for the payload layout)
+KIND_FLEET_RESULT = 9   # replica -> router: the finished request — lease
+#                     item id + generated tokens + finish reason; the
+#                     router's RequestLeaseTable.complete() decides
+#                     whether this result wins (exactly-once) or is a
+#                     ghost from a presumed-dead replica (dropped)
+
+
+# ---------------------------------------------------------------------------
+# Fleet frame payloads (ISSUE 18). The FleetRouter's in-process replica
+# handles round-trip every submit/result through these packers, so the
+# byte layout is exercised in tier-1 today and a socket-backed replica
+# host can slot in behind the same boundary later. Little-endian:
+#
+#   FLEET_SUBMIT: uint32 item, uint32 max_new_tokens, float32 temperature,
+#                 int32 top_k (0 = off), int32 eos_id (-1 = none),
+#                 uint16 session byte length, session bytes (utf-8),
+#                 uint32 prompt length, int32[] prompt token ids
+#   FLEET_RESULT: uint32 item, uint8 reason byte length, reason (utf-8),
+#                 uint32 token count, int32[] generated token ids
+# ---------------------------------------------------------------------------
+
+_FLEET_SUBMIT_HDR = struct.Struct("<IIfii")
+_FLEET_RESULT_HDR = struct.Struct("<IB")
+
+
+def pack_fleet_submit(item: int, prompt_ids, max_new_tokens: int,
+                      temperature: float = 0.0, top_k: int = 0,
+                      eos_id: Optional[int] = None,
+                      session_id: Optional[str] = None) -> bytes:
+    sess = (session_id or "").encode()
+    if len(sess) > 0xFFFF:
+        raise ValueError("session_id too long for wire format")
+    ids = np.ascontiguousarray(np.asarray(prompt_ids, np.int32))
+    return (_FLEET_SUBMIT_HDR.pack(item, max_new_tokens, float(temperature),
+                                   int(top_k or 0),
+                                   -1 if eos_id is None else int(eos_id))
+            + struct.pack("<H", len(sess)) + sess
+            + struct.pack("<I", ids.size) + ids.tobytes())
+
+
+def unpack_fleet_submit(payload: bytes) -> dict:
+    item, max_new, temp, top_k, eos = _FLEET_SUBMIT_HDR.unpack_from(payload)
+    off = _FLEET_SUBMIT_HDR.size
+    (slen,) = struct.unpack_from("<H", payload, off)
+    off += 2
+    sess = payload[off:off + slen].decode()
+    off += slen
+    (n,) = struct.unpack_from("<I", payload, off)
+    off += 4
+    ids = np.frombuffer(payload, np.int32, count=n, offset=off).copy()
+    return {"item": item, "prompt_ids": ids, "max_new_tokens": max_new,
+            "temperature": temp, "top_k": top_k or None,
+            "eos_id": None if eos == -1 else eos,
+            "session_id": sess or None}
+
+
+def pack_fleet_result(item: int, token_ids, reason: str) -> bytes:
+    rb = reason.encode()
+    if len(rb) > 0xFF:
+        raise ValueError("finish reason too long for wire format")
+    ids = np.ascontiguousarray(np.asarray(token_ids, np.int32))
+    return (_FLEET_RESULT_HDR.pack(item, len(rb)) + rb
+            + struct.pack("<I", ids.size) + ids.tobytes())
+
+
+def unpack_fleet_result(payload: bytes) -> dict:
+    item, rlen = _FLEET_RESULT_HDR.unpack_from(payload)
+    off = _FLEET_RESULT_HDR.size
+    reason = payload[off:off + rlen].decode()
+    off += rlen
+    (n,) = struct.unpack_from("<I", payload, off)
+    off += 4
+    ids = np.frombuffer(payload, np.int32, count=n, offset=off).copy()
+    return {"item": item, "token_ids": ids, "reason": reason}
 
 
 def send_frame(conn: socket.socket, kind: int, payload: bytes = b""):

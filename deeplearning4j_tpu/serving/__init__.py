@@ -36,27 +36,44 @@ measured, not guessed: ``serving.tune`` sweeps
 page-len/prefill-chunk/decode-slots into the persistent autotune cost
 records.
 
+The fleet fabric (ISSUE 18) is the tier above: :class:`FleetRouter`
+fronts N scheduler-wrapped replicas behind the ``parallel/transport.py``
+fleet frames, leases every request on a ``RequestLeaseTable``
+(exactly-once completion, death → re-prefill on a survivor), routes by
+session/prefix affinity then least burn-rate, and the
+:class:`Autoscaler` spawns/retires replicas on sustained ``dl4j_slo_*``
+burn. :mod:`traffic` generates the open-loop Poisson episodes that
+exercise it (``run_episode`` → ``slo_report.py --fleet``).
+
 Quickstart: ``zoo.transformer.generate(params, cfg, ids, 32)`` for a
-one-shot, or README "Serving quickstart" for the scheduler loop.
+one-shot, or README "Serving quickstart" for the scheduler loop and
+"Fleet quickstart" for the router.
 """
 
 from ..obs import SLOConfig, SLOTracker  # noqa: F401  (serving SLO plane)
 from .adapter import FunctionalInferenceModel  # noqa: F401
 from .engine import (DEFAULT_PREFILL_BUCKETS, GenerationEngine,  # noqa: F401
                      sample_tokens)
+from .fleet import (Autoscaler, AutoscalerConfig, FleetResult,  # noqa: F401
+                    FleetRouter, InProcessReplica)
 from .kvcache import (DEFAULT_PAGE_LEN, DEFAULT_PREFILL_CHUNK,  # noqa: F401
                       PageTable, PrefixCache, cache_len, cache_nbytes,
                       cache_slots, init_cache, init_paged_cache, is_paged,
                       page_nbytes, token_nbytes)
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         GenerationResult, ServingRequest)
+from .traffic import (Arrival, EpisodeReport, TrafficConfig,  # noqa: F401
+                      poisson_arrivals, run_episode)
 
 __all__ = [
+    "Arrival", "Autoscaler", "AutoscalerConfig",
     "ContinuousBatchingScheduler", "DEFAULT_PAGE_LEN",
-    "DEFAULT_PREFILL_BUCKETS", "DEFAULT_PREFILL_CHUNK",
-    "FunctionalInferenceModel", "GenerationEngine", "GenerationResult",
+    "DEFAULT_PREFILL_BUCKETS", "DEFAULT_PREFILL_CHUNK", "EpisodeReport",
+    "FleetResult", "FleetRouter", "FunctionalInferenceModel",
+    "GenerationEngine", "GenerationResult", "InProcessReplica",
     "PageTable", "PrefixCache", "SLOConfig", "SLOTracker",
-    "ServingRequest", "cache_len", "cache_nbytes", "cache_slots",
-    "init_cache", "init_paged_cache", "is_paged", "page_nbytes",
-    "sample_tokens", "token_nbytes",
+    "ServingRequest", "TrafficConfig", "cache_len", "cache_nbytes",
+    "cache_slots", "init_cache", "init_paged_cache", "is_paged",
+    "page_nbytes", "poisson_arrivals", "run_episode", "sample_tokens",
+    "token_nbytes",
 ]

@@ -245,6 +245,7 @@ class ContinuousBatchingScheduler:
         self._peak_active = 0
         self.slots: List[Optional[ServingRequest]] = [None] * self.n_slots
         self._queue: deque = deque()
+        self._draining = False      # drain(): admission gate (ISSUE 18)
         # two locks: `_lock` guards the cheap metadata (queue, slots,
         # key, last_tokens) so submit()/inspection never wait on device
         # work; `_step_lock` serializes whole step() iterations — the
@@ -456,6 +457,9 @@ class ContinuousBatchingScheduler:
         now = time.perf_counter()
         fut: Future = Future()
         with self._lock:
+            if self._draining:
+                raise RuntimeError("scheduler is draining — submit to "
+                                   "another replica")
             req = ServingRequest(
                 id=self._next_id, prompt=prompt,
                 max_new_tokens=int(max_new_tokens),
@@ -648,6 +652,45 @@ class ContinuousBatchingScheduler:
         self._thread.join(timeout=30)
         self._thread = None
 
+    def drain(self, max_steps: int = 100000) -> List["ServingRequest"]:
+        """Graceful retire (ISSUE 18): stop admission, FINISH every
+        request already occupying a slot (their futures resolve
+        normally), then hand back the still-unstarted queue entries
+        instead of failing them — the fleet router re-routes those to a
+        surviving replica. Contrast ``_fail_all``, the crash path.
+
+        Returned entries may include recompute-preemption victims whose
+        futures are already RUNNING and whose ``generated`` is partial;
+        re-running the ORIGINAL prompt elsewhere reproduces the same
+        greedy output (prefill recomputes exactly the logits the
+        interrupted decode would have seen), so the router resubmits
+        ``req.prompt`` and resolves the caller from the fresh run.
+
+        Safe to call while the background serve loop runs — the flag
+        stops its admissions too and ``step()`` is ``_step_lock``-
+        serialized; the scheduler accepts submits again after drain
+        returns (the router usually discards it instead)."""
+        with self._lock:
+            self._draining = True
+        try:
+            for _ in range(max_steps):
+                with self._lock:
+                    busy = any(self.slots)
+                if not busy:
+                    break
+                self.step()
+            else:
+                raise RuntimeError(
+                    f"drain: pool not empty after {max_steps} steps")
+            with self._lock:
+                leftover = list(self._queue)
+                self._queue.clear()
+                self._m()["queue_depth"].set(0, replica=self.replica)
+            return leftover
+        finally:
+            with self._lock:
+                self._draining = False
+
     # ------------------------------------------------------ internals
     def _free_slots(self):
         return [i for i, r in enumerate(self.slots) if r is None]
@@ -758,7 +801,7 @@ class ContinuousBatchingScheduler:
         remaining budget (it blocks the pool longest). Its context
         re-queues at the BACK; the head admits into the freed
         lane/pages this same step."""
-        if self.starvation_ms is None or not self._queue:
+        if self.starvation_ms is None or not self._queue or self._draining:
             return False
         if self._free_slots() and not (
                 self.paged
@@ -794,6 +837,8 @@ class ContinuousBatchingScheduler:
         (the head's first chunk must fit the free list) — the pool
         admits to actual token residency, not lane count."""
         out = []
+        if self._draining:      # drain(): queued entries stay queued —
+            return out          # they are handed back, not admitted
         reserved = 0            # pages promised to this batch's heads
         for slot in self._free_slots():
             admitted = False

@@ -89,6 +89,11 @@ PLANE_LABELS = {
     # a sha, host fingerprint or capture id (those live in the ledger
     # records themselves)
     "dl4j_trend_": {"backend", "row", "verdict"},
+    # fleet fabric (ISSUE 18): routing reason and scale direction are
+    # tiny fixed enums; replica ids (r0, r1, ...) stay out of fleet
+    # metric labels — per-replica series already exist on the
+    # dl4j_serving_*/dl4j_slo_* planes under {replica=}
+    "dl4j_fleet_": {"direction", "reason"},
 }
 # label names that smell like per-request/per-trace identity — never
 # allowed even if someone adds them to the allowlist above by mistake

@@ -110,6 +110,8 @@ INFERENCE_LABELS = {
     "inference_ttft_4096": "Time-to-first-token, T=4096 prefill",
     "inference_prefix_shared": "Warm TTFT, 64 req × shared 1024-token "
                                "prefix (CoW cache)",
+    "inference_fleet": "Fleet goodput, Poisson burst, autoscaled "
+                       "replicas",
     "inference_resnet_b1": "ResNet-50 batch-1 latency (ParallelInference)",
     "inference_bert_b1": "BERT-base batch-1 latency (ParallelInference)",
 }
@@ -167,6 +169,8 @@ def inference_row(name, rec):
     unit = rec.get("unit", "")
     if "tokens" in unit:
         val = f"{rec['value']:,.1f} tokens/s"
+    elif "goodput" in unit:
+        val = f"{rec['value']:,.1f}% goodput"
     else:
         val = f"{rec['value']:,.1f} ms"
     details = []
@@ -185,6 +189,17 @@ def inference_row(name, rec):
         sp = ab.get("speedup_kernel_over_gather")
         details.append(f"pallas paged-attn A/B: {ab['verdict']}"
                        + (f" ({sp}× vs gather)" if sp else ""))
+    if rec.get("replicas_max") is not None:
+        # the fleet row (ISSUE 18): p99s at target + the autoscaler's
+        # replica span under the burst, straight from the episode dump
+        slo = rec.get("slo") or {}
+        if slo.get("ttft_p99_ms") is not None:
+            details.append(f"p99 TTFT {slo['ttft_p99_ms']:,.0f} ms / "
+                           f"ITL {slo.get('itl_p99_ms', 0):,.1f} ms")
+        details.append(f"replicas {rec.get('replicas_min')}→"
+                       f"{rec['replicas_max']} "
+                       f"({rec.get('scale_ups', 0)} up, "
+                       f"{rec.get('scale_downs', 0)} down)")
     if rec.get("ttft_speedup_x") is not None:
         # the CoW prefix-cache row (ISSUE 16): warm-vs-cold TTFT and
         # tokens each user actually keeps resident when the prefix is

@@ -46,10 +46,17 @@ def _fmt_pct(v):
     return "-" if v is None else f"{100 * v:.1f}%"
 
 
-def build_reports(records, cfg: SLOConfig):
+def build_reports(records, cfg: SLOConfig, fleet: bool = False):
     """Replica -> SLOTracker report for every reqtrace record. The
     window is the whole dump (offline replay: window_s=inf) so a
-    postmortem judges everything the black box kept."""
+    postmortem judges everything the black box kept.
+
+    ``fleet`` (ISSUE 18): additionally replay EVERY deduped record
+    into one ``FLEET`` tracker — the all-replica total row. The dedupe
+    key already carries the replica label, so one request served by
+    one replica counts once; a lease re-prefilled onto a survivor after
+    replica death appears under the replica that COMPLETED it (the dead
+    replica never closed a trace for it)."""
     offline = SLOConfig(ttft_s=cfg.ttft_s, itl_s=cfg.itl_s,
                         quantile=cfg.quantile,
                         max_error_rate=cfg.max_error_rate,
@@ -68,6 +75,8 @@ def build_reports(records, cfg: SLOConfig):
         replica = str(rec.get("replica", "0"))
         latest[(replica, rec.get("request_id"),
                 rec.get("t0_epoch"))] = rec
+    fleet_tr = SLOTracker(offline, replica="FLEET", registry=False) \
+        if fleet else None
     for (replica, _, _), rec in sorted(latest.items(),
                                        key=lambda kv: kv[0][1] or 0):
         tr = trackers.setdefault(
@@ -75,7 +84,30 @@ def build_reports(records, cfg: SLOConfig):
         summary = rec.get("summary") or {}
         ts = rec.get("t0_epoch")
         tr.observe_summary(summary, ts=ts)
-    return {replica: tr.report() for replica, tr in trackers.items()}
+        if fleet_tr is not None:
+            fleet_tr.observe_summary(summary, ts=ts)
+    out = {replica: tr.report() for replica, tr in trackers.items()}
+    if fleet_tr is not None:
+        out["FLEET"] = fleet_tr.report()
+    return out
+
+
+def scale_events(records):
+    """The autoscaler timeline a fleet dump carries: the fleet-replica
+    snapshots with a ``scale_event`` direction, in dump order."""
+    return [rec for rec in records
+            if rec.get("kind") == "snapshot"
+            and rec.get("replica") == "fleet"
+            and rec.get("scale_event")]
+
+
+def replica_range(records):
+    """(min, max) of replicas_live over the fleet snapshots, or None."""
+    live = [rec["replicas_live"] for rec in records
+            if rec.get("kind") == "snapshot"
+            and rec.get("replica") == "fleet"
+            and rec.get("replicas_live") is not None]
+    return (min(live), max(live)) if live else None
 
 
 def render(reports, crash_headers) -> str:
@@ -92,7 +124,15 @@ def render(reports, crash_headers) -> str:
            f"{'itl p99':>10} {'err':>6} {'burn':>6}  verdict")
     lines.append(hdr)
     lines.append("-" * len(hdr))
-    for replica, rep in sorted(reports.items()):
+    # the FLEET total renders LAST, under a rule — it aggregates the
+    # per-replica rows above it
+    order = sorted(r for r in reports if r != "FLEET")
+    if "FLEET" in reports:
+        order.append("FLEET")
+    for replica in order:
+        rep = reports[replica]
+        if replica == "FLEET":
+            lines.append("-" * len(hdr))
         w = rep.get("window", {})
         if not w.get("requests"):
             lines.append(f"{replica:>8} {'0':>5}  (no eligible requests)")
@@ -126,6 +166,10 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the raw report dicts as JSON instead of "
                          "the table")
+    ap.add_argument("--fleet", action="store_true",
+                    help="aggregate a multi-replica fleet dump: add the "
+                         "FLEET total row and print the autoscaler's "
+                         "scale-event timeline (ISSUE 18)")
     args = ap.parse_args(argv)
 
     records = load_flight_records(args.dump)
@@ -135,7 +179,7 @@ def main(argv=None) -> int:
         return 1
     cfg = SLOConfig(ttft_s=args.ttft, itl_s=args.itl,
                     quantile=args.quantile)
-    reports = build_reports(records, cfg)
+    reports = build_reports(records, cfg, fleet=args.fleet)
     crash_headers = [r for r in records if r.get("kind") == "flightrec"
                      and r.get("reason") == "fail_all"]
     if args.json:
@@ -150,11 +194,26 @@ def main(argv=None) -> int:
             if isinstance(o, list):
                 return [_finite(v) for v in o]
             return o
-        print(json.dumps(_finite({"reports": reports,
-                                  "crash_dumps": len(crash_headers)}),
-                         indent=2))
+        payload = {"reports": reports, "crash_dumps": len(crash_headers)}
+        if args.fleet:
+            payload["scale_events"] = scale_events(records)
+            payload["replica_range"] = replica_range(records)
+        print(json.dumps(_finite(payload), indent=2))
     else:
         print(render(reports, crash_headers))
+        if args.fleet:
+            evs = scale_events(records)
+            ups = sum(1 for e in evs if e["scale_event"] == "up")
+            downs = sum(1 for e in evs if e["scale_event"] == "down")
+            rng = replica_range(records)
+            span = f", replicas {rng[0]}→{rng[1]}" if rng else ""
+            print(f"\nscale events: {ups} up, {downs} down{span}")
+            for e in evs:
+                burn = e.get("burn")
+                print(f"  {e['scale_event']:>4} rid={e.get('rid')} "
+                      f"burn={'-' if burn is None else round(burn, 2)} "
+                      f"queue/replica={e.get('queue_per_replica')} "
+                      f"live={e.get('replicas_live')}")
     return 1 if any(rep.get("met") is False
                     for rep in reports.values()) else 0
 
