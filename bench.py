@@ -1730,6 +1730,149 @@ def bench_inference_fleet(batch, steps):
     return _flag_on_chip(_stamp(rec))
 
 
+def bench_inference_quant_kv(batch, steps):
+    """Quantized-KV row (ISSUE 19): run the fidelity-gated int8-vs-bf16
+    promotion races (``quant.race_kv`` over one paged-pool geometry,
+    ``quant.race_weights`` over the block stack) and report both arms —
+    decode tokens/s, the KV bytes-per-resident-token each pool pays,
+    the kl_max that gated promotion, and the verdicts that landed as
+    sha-stamped cost records. The row VALUE is the byte-shrink factor
+    (bf16 / int8 KV bytes per token) — the claim that holds on any
+    backend; the speed verdict is the chip's to make (CPU dequant
+    overhead records ``fallback_slower`` without re-pinning anything,
+    exactly the paged-kernel A/B discipline). The races' own fidelity
+    probes land in the ``fidelity`` block so ``fidelity_report.py
+    --max-kl`` gates this capture like every other pair.
+
+    ``batch`` = probe decode slots, ``steps`` unused (the race times
+    marginal chained sweeps itself)."""
+    from deeplearning4j_tpu.serving import kvcache
+    from deeplearning4j_tpu.serving.quant import race_kv, race_weights
+
+    slots = max(batch, 2)
+    eng, cfg = _serving_engine(512)
+    plen = kvcache.DEFAULT_PAGE_LEN
+    n_pages = slots * (-(-eng.max_len // plen))
+    kv = race_kv(eng, slots, n_pages, plen)
+    bpt = kv["bytes_per_token"]
+
+    def arm(step_s):
+        if step_s is None:
+            return None
+        return {"step_time_ms": round(step_s * 1e3, 3),
+                "tokens_per_s": round(slots / step_s, 2)}
+
+    rec = {
+        "metric": "KV-cache bytes/token shrink from int8 page storage, "
+                  "fidelity-gated (Transformer-LM 120M, paged pool)",
+        "value": round(bpt["bf16"] / bpt["int8"], 2), "unit": "x fewer "
+                 "KV bytes/token (int8+scales vs bf16)",
+        "slots": slots, "page_len": plen, "n_pages": n_pages,
+        "kv_bytes_per_token": bpt,
+        "verdict": kv["verdict"],
+        "promoted": kv["choice"] == "int8",
+        "bf16": arm(kv["bf16_s"]), "int8": arm(kv["int8_s"]),
+        "speedup_int8_over_bf16": kv["speedup"],
+        "fidelity_kl_max": kv["fidelity"]["kl_max"],
+        "cost_record": kv["key"],
+        "timing": "marginal chained decode sweeps per arm (the race's "
+                  "own autotune timing); identical probe content both "
+                  "pools — the fidelity diff is quantization error and "
+                  "nothing else",
+    }
+    rec["fidelity"] = {"quant_kv_vs_bf16": kv["fidelity"]}
+    # int8 weights ride along: same race shape over the decode matvecs
+    try:
+        w = race_weights(eng)
+        rec["weights"] = {
+            "verdict": w["verdict"], "promoted": w["choice"] == "int8",
+            "bf16_s": w["bf16_s"], "int8_s": w["int8_s"],
+            "speedup": w["speedup"], "cost_record": w["key"]}
+        rec["fidelity"]["quant_w_vs_bf16"] = w["fidelity"]
+    except Exception as e:  # noqa: BLE001 — the row survives block-less
+        rec["weights"] = {"na": f"weight race failed: "
+                                f"{type(e).__name__}: {e}"[:300]}
+    return _flag_on_chip(_stamp(rec))
+
+
+def bench_inference_spec_decode(batch, steps):
+    """Speculative-decoding row (ISSUE 19): race draft arms (prompt-
+    lookup ``NgramDraft`` + self-draft ``EngineDraft``) against the
+    plain paged greedy decode on one prompt via ``spec.race_spec``.
+    The row VALUE is the best arm's tokens/s with the baseline riding
+    along; ``accepted_per_step`` (tokens per verify dispatch — the
+    ``fidelity_report.py --min-accept`` gate input) and the per-arm
+    bit-identity + promotion verdicts land beside it. An arm that
+    loses falls back silently (counted in
+    ``dl4j_autotune_promotions_total``) — the row still captures, the
+    verdict is the evidence.
+
+    ``batch`` = draft window k, ``steps`` = decode tokens per rep."""
+    import numpy as np
+    from deeplearning4j_tpu.serving import EngineDraft, NgramDraft
+    from deeplearning4j_tpu.serving.spec import SpeculativeDecoder, \
+        race_spec
+
+    k = max(batch, 2)
+    new_tokens = max(steps, 16)
+    eng, cfg = _serving_engine(256)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (32,)).astype(np.int32)
+    # warm every jitted shape the race will hit OUTSIDE its timed reps:
+    # plain decode + chunked prefill, the verify chunk, and the engine
+    # draft's dense prefill_slot/decode_step
+    warm = SpeculativeDecoder(eng, NgramDraft(), k=k)
+    warm.generate(prompt, k + 2)
+    warm.release()
+    d = EngineDraft(eng)
+    d.propose([int(t) for t in prompt] + [0], 2)
+    d.reset()
+
+    res = race_spec(eng, {"ngram": NgramDraft(), "engine": EngineDraft(eng)},
+                    prompt, new_tokens, k=k)
+    base_tps = res["tokens"] / res["base_s"] if res["base_s"] else None
+    # best arm by wall time whether or not it promoted — the row trends
+    # the measured number; the verdict says what dispatches
+    best_name = min(res["arms"], key=lambda n: res["arms"][n]["spec_s"])
+    best = res["arms"][best_name]
+
+    rec = {
+        "metric": f"Speculative decode tokens/s, draft-verify k={k} "
+                  "vs plain greedy (Transformer-LM 120M, paged pool)",
+        "value": round(res["tokens"] / best["spec_s"], 2)
+        if best["spec_s"] else None,
+        "unit": "tokens/sec (best draft arm)",
+        "k": k, "decode_tokens": res["tokens"],
+        "baseline_tokens_per_s": round(base_tps, 2) if base_tps else None,
+        "choice": res["choice"],
+        "best_arm": best_name,
+        "speedup_vs_plain": best["speedup"],
+        "arms": {
+            name: {kk: a[kk] for kk in ("verdict", "spec_s", "speedup",
+                                        "accepted_per_step",
+                                        "bit_identical")}
+            for name, a in res["arms"].items()},
+        "spec": {                       # the --min-accept gate's input
+            "accepted_per_step": best["accepted_per_step"],
+            "bit_identical": best["bit_identical"],
+            "rounds": (best["stats"] or {}).get("rounds"),
+            "rollback_pages": (best["stats"] or {}).get("rollback_pages"),
+        },
+        # greedy bit-identity IS the fidelity evidence here (token
+        # space, not logits) — the pair rides the fidelity block so the
+        # report renders it beside the kl pairs
+        "fidelity": {"spec_vs_plain": {
+            "greedy_match_frac": 1.0 if best["bit_identical"] else 0.0,
+            "greedy_prefix_len": res["tokens"]
+            if best["bit_identical"] else 0}},
+        "timing": "median wall of full generates per arm (prefill + "
+                  "rounds), identical prompt and token budget; baseline "
+                  "= plain chunked-prefill + per-token decode over an "
+                  "identical private paged pool",
+    }
+    return _flag_on_chip(_stamp(rec))
+
+
 def _latency_sweep(pi, make_batch, iters, batches=(1, 8, 32)):
     """batch-1 p50/p99 + best-batch throughput through a LIVE
     ParallelInference (jit dispatch, padding, host round-trip included —
@@ -1823,7 +1966,8 @@ def bench_inference_bert_b1(batch, steps):
 
 INFERENCE_ROWS = ("inference_decode", "inference_ttft_1024",
                   "inference_ttft_4096", "inference_prefix_shared",
-                  "inference_fleet",
+                  "inference_fleet", "inference_quant_kv",
+                  "inference_spec_decode",
                   "inference_resnet_b1", "inference_bert_b1")
 
 CONFIGS = {
@@ -1844,6 +1988,8 @@ CONFIGS = {
     "inference_ttft_4096": bench_inference_ttft_4096,
     "inference_prefix_shared": bench_inference_prefix_shared,
     "inference_fleet": bench_inference_fleet,
+    "inference_quant_kv": bench_inference_quant_kv,
+    "inference_spec_decode": bench_inference_spec_decode,
     "inference_resnet_b1": bench_inference_resnet_b1,
     "inference_bert_b1": bench_inference_bert_b1,
 }
@@ -1880,6 +2026,10 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     # fleet row: batch = decode slots per replica, steps = decode tokens
     # per request; the burst trace + autoscaler window are fixed in-row
     "inference_fleet": (4, 6),
+    # quant row: batch = probe decode slots; spec row: batch = draft
+    # window k, steps = decode tokens per rep
+    "inference_quant_kv": (4, 8),
+    "inference_spec_decode": (8, 48),
     "inference_resnet_b1": (1, 15),
     "inference_bert_b1": (1, 12),
 }

@@ -45,6 +45,19 @@ session/prefix affinity then least burn-rate, and the
 burn. :mod:`traffic` generates the open-loop Poisson episodes that
 exercise it (``run_episode`` → ``slo_report.py --fleet``).
 
+The quantization & speculation plane (ISSUE 19) shrinks the bytes the
+decode sweep moves, behind the fidelity gate: :mod:`quant` quantizes
+KV pages (int8 rows + per-row-per-head scales riding the page axis)
+and the decode matvec weights (int8, bf16 compute), each promoted
+per-shape-bucket only when the race holds ``kl_max`` under the
+``fidelity_report.py --max-kl`` bound AND measures faster; :mod:`spec`
+adds draft-verify speculative decoding (:class:`SpeculativeDecoder` —
+``EngineDraft``/``NgramDraft`` propose, the target's ``verify_chunk``
+judges all k in one dispatch, rejected pages roll back via
+``PageTable.trim``) whose greedy output is bit-identical to plain
+decode. Losers fall back silently, counted in
+``dl4j_autotune_promotions_total``.
+
 Quickstart: ``zoo.transformer.generate(params, cfg, ids, 32)`` for a
 one-shot, or README "Serving quickstart" for the scheduler loop and
 "Fleet quickstart" for the router.
@@ -59,21 +72,27 @@ from .fleet import (Autoscaler, AutoscalerConfig, FleetResult,  # noqa: F401
 from .kvcache import (DEFAULT_PAGE_LEN, DEFAULT_PREFILL_CHUNK,  # noqa: F401
                       PageTable, PrefixCache, cache_len, cache_nbytes,
                       cache_slots, init_cache, init_paged_cache, is_paged,
-                      page_nbytes, token_nbytes)
+                      is_quantized, page_nbytes, token_nbytes)
+from .quant import (decide_kv, decide_weights, quantize_rows,  # noqa: F401
+                    quantized_params, race_kv, race_weights)
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         GenerationResult, ServingRequest)
+from .spec import (EngineDraft, NgramDraft,  # noqa: F401
+                   SpeculativeDecoder, race_spec)
 from .traffic import (Arrival, EpisodeReport, TrafficConfig,  # noqa: F401
                       poisson_arrivals, run_episode)
 
 __all__ = [
     "Arrival", "Autoscaler", "AutoscalerConfig",
     "ContinuousBatchingScheduler", "DEFAULT_PAGE_LEN",
-    "DEFAULT_PREFILL_BUCKETS", "DEFAULT_PREFILL_CHUNK", "EpisodeReport",
-    "FleetResult", "FleetRouter", "FunctionalInferenceModel",
-    "GenerationEngine", "GenerationResult", "InProcessReplica",
-    "PageTable", "PrefixCache", "SLOConfig", "SLOTracker",
-    "ServingRequest", "TrafficConfig", "cache_len", "cache_nbytes",
-    "cache_slots", "init_cache", "init_paged_cache", "is_paged",
-    "page_nbytes", "poisson_arrivals", "run_episode", "sample_tokens",
-    "token_nbytes",
+    "DEFAULT_PREFILL_BUCKETS", "DEFAULT_PREFILL_CHUNK", "EngineDraft",
+    "EpisodeReport", "FleetResult", "FleetRouter",
+    "FunctionalInferenceModel", "GenerationEngine", "GenerationResult",
+    "InProcessReplica", "NgramDraft", "PageTable", "PrefixCache",
+    "SLOConfig", "SLOTracker", "ServingRequest", "SpeculativeDecoder",
+    "TrafficConfig", "cache_len", "cache_nbytes", "cache_slots",
+    "decide_kv", "decide_weights", "init_cache", "init_paged_cache",
+    "is_paged", "is_quantized", "page_nbytes", "poisson_arrivals",
+    "quantize_rows", "quantized_params", "race_kv", "race_spec",
+    "race_weights", "run_episode", "sample_tokens", "token_nbytes",
 ]

@@ -74,6 +74,19 @@ def sample_tokens(key, logits, temperature, top_k):
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+def _wload(blk, name, dt):
+    """One layer weight in compute dtype. A quantized block stack
+    (ISSUE 19, ``serving.quant.quantized_params``) stores int8 values
+    plus a per-output-channel scale under ``name + "_scale"``; the
+    dequant happens here, on the fly, so storage is int8 and the
+    matvec math stays bf16 — identical call sites either way."""
+    w = blk[name]
+    s = blk.get(name + "_scale")
+    if s is None:
+        return w.astype(dt)
+    return (w.astype(jnp.float32) * s.astype(jnp.float32)).astype(dt)
+
+
 def _cached_attention(cfg, q, k, v, pos):
     """Single-token attention against the cache: q (B, H, Dh) vs
     k/v (B, S, H, Dh), each slot masked to its own length (positions
@@ -105,7 +118,9 @@ class GenerationEngine:
     def __init__(self, cfg, params, *, max_len: Optional[int] = None,
                  prefill_buckets=DEFAULT_PREFILL_BUCKETS,
                  prefill_chunk: Optional[int] = None,
-                 paged_kernel: Optional[str] = None):
+                 paged_kernel: Optional[str] = None,
+                 quant_kv: Optional[str] = None,
+                 quant_weights: Optional[str] = None):
         if getattr(cfg, "n_experts", 0):
             raise NotImplementedError(
                 "GenerationEngine is dense-only: MoE expert dispatch has "
@@ -172,31 +187,78 @@ class GenerationEngine:
         # gather elsewhere — see kernels.paged_attention.decide)
         self.paged_kernel_mode = paged_kernel
         self._paged_plan = {}            # geometry key -> kernel|gather
+        # quantization plane (ISSUE 19): per-mode dispatch verdicts —
+        # quant_kv/quant_weights pin the mode (off|on|auto|race); None
+        # defers to $DL4J_QUANT_KV / $DL4J_QUANT_W, default "auto"
+        # (race on TPU, bf16 elsewhere — serving.quant.decide_*). The
+        # int8 block stack is built lazily on the first decode that
+        # wants it, never at construction.
+        self.quant_kv_mode = quant_kv
+        self.quant_weights_mode = quant_weights
+        self._wchoice: Optional[str] = None   # "int8" | "bf16"
+        self._qparams = None
         self._prefill_chunk = CompileSentinel(
             "prefill_chunk", jax.jit(self._prefill_chunk_raw,
                                      donate_argnums=(1,)))
+        # speculative-decode verify (ISSUE 19): the SAME chunked-prefill
+        # body, but the head runs over EVERY row — the draft's k
+        # proposals are judged from one dispatch's (C, V) logits
+        self._verify_chunk = CompileSentinel(
+            "verify_chunk",
+            jax.jit(functools.partial(self._prefill_chunk_raw,
+                                      all_logits=True),
+                    donate_argnums=(1,)))
         self._copy_page = CompileSentinel(
             "copy_page", jax.jit(self._copy_page_raw,
                                  donate_argnums=(0,)))
         self.sentinels = {s.name: s for s in (
             self._decode, self._prefill, self._prefill_slot, self._sample,
             self._decode_paged, self._decode_paged_kernel,
-            self._prefill_chunk, self._copy_page)}
+            self._prefill_chunk, self._verify_chunk, self._copy_page)}
 
     # ------------------------------------------------------------ cache
     def init_cache(self, n_slots: int):
         return kvcache.init_cache(self.cfg, n_slots, self.max_len)
 
     def init_paged_cache(self, n_slots: int, n_pages: int,
-                         page_len: int = kvcache.DEFAULT_PAGE_LEN):
+                         page_len: int = kvcache.DEFAULT_PAGE_LEN,
+                         quantized: Optional[bool] = None):
+        """Allocate the paged pool. ``quantized=None`` lets the
+        fidelity-gated quant_kv promotion decide per geometry (ISSUE
+        19, ``serving.quant.decide_kv``) — off everywhere the race
+        does not run or win, so callers that never opt in keep the
+        bf16 pool byte-for-byte."""
+        if quantized is None:
+            from . import quant
+            quantized = quant.decide_kv(self, n_slots, n_pages,
+                                        page_len) == "int8"
         return kvcache.init_paged_cache(self.cfg, n_slots, n_pages,
-                                        page_len, self.max_len)
+                                        page_len, self.max_len,
+                                        quantized=bool(quantized))
 
     def refresh(self, params):
         """Swap in new params (e.g. after more training). Compiled fns
-        are shape-keyed, so no retrace as long as shapes match."""
+        are shape-keyed, so no retrace as long as shapes match. The
+        quantized block stack (ISSUE 19) is derived state: drop it so
+        the next decode re-quantizes the fresh values."""
         self.params = params
+        self._qparams = None
         return self
+
+    def _decode_params(self):
+        """Params the decode matvecs run with: the int8 block stack
+        when the quant_w promotion picked it (ISSUE 19), else the full
+        ones. Resolved lazily ONCE per engine — the race itself needs
+        the jitted decode, so this cannot happen at construction."""
+        if self._wchoice is None:
+            from . import quant
+            self._wchoice = quant.decide_weights(self)
+        if self._wchoice == "int8":
+            if self._qparams is None:
+                from . import quant
+                self._qparams = quant.quantized_params(self.params)
+            return self._qparams
+        return self.params
 
     # -------------------------------------------------- compile plane
     def mark_warm(self):
@@ -267,13 +329,14 @@ class GenerationEngine:
         pos = cache["pos"]
         b = tokens.shape[0]
         x = self._embed_rows(params, tokens, pos)
-        x, k_new, v_new = self._blocks_with_cache(
+        x, kv = self._blocks_with_cache(
             params, cache, x,
-            write=lambda kl, rows: kl.at[jnp.arange(b), pos].set(rows),
+            write=lambda kl, rows: kl.at[jnp.arange(b), pos].set(
+                rows.astype(kl.dtype)),
             attend=lambda q, kl, vl: _cached_attention(cfg, q, kl, vl,
                                                        pos))
         logits = tfm.head_logits_rows(params, cfg, x)
-        return logits, {"k": k_new, "v": v_new, "pos": pos + 1}
+        return logits, dict(kv, pos=pos + 1)
 
     def _embed_rows(self, params, tokens, pos):
         """Embed one token row per sequence at its own position —
@@ -294,30 +357,50 @@ class GenerationEngine:
         (rows, H, Dh)``). Keeping the norm/qkv/residual/MLP math in
         one place is what makes the paged-vs-dense bitwise-equivalence
         contract a structural property, not a maintenance promise.
-        Returns (block-stack output rows, new k, new v)."""
+
+        A quantized pool (ISSUE 19) threads its per-row scale arrays
+        through the same scan: each layer's cache then travels as a
+        ``(rows, scales)`` pair through ``write``/``attend``, and the
+        closures own the quantize-on-append / dequantize-on-gather.
+        Raw compute-dtype rows go INTO ``write`` on every path — the
+        storage cast lives in the closure beside the scatter it feeds.
+        Returns (block-stack output rows, cache k/v update dict)."""
         cfg = self.cfg
         n = x.shape[0]
         h_, dh = cfg.n_heads, cfg.head_dim
+        quant = kvcache.is_quantized(cache)
 
         def block(x, xs):
-            blk, kl, vl = xs
+            if quant:
+                blk, kl, vl, ks, vs = xs
+                kc, vc = (kl, ks), (vl, vs)
+            else:
+                blk, kc, vc = xs
             hh = tfm._rmsnorm(x, blk["ln1"])
-            qkv = hh @ blk["wqkv"].astype(hh.dtype)            # (n, 3h)
+            qkv = hh @ _wload(blk, "wqkv", hh.dtype)           # (n, 3h)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(n, h_, dh)
-            kl = write(kl, k.reshape(n, h_, dh).astype(kl.dtype))
-            vl = write(vl, v.reshape(n, h_, dh).astype(vl.dtype))
-            a = attend(q, kl, vl).reshape(n, h_ * dh)
-            x = x + a @ blk["wo"].astype(hh.dtype)
+            kc = write(kc, k.reshape(n, h_, dh))
+            vc = write(vc, v.reshape(n, h_, dh))
+            a = attend(q, kc, vc).reshape(n, h_ * dh)
+            x = x + a @ _wload(blk, "wo", hh.dtype)
             h2 = tfm._rmsnorm(x, blk["ln2"])
-            m = jax.nn.gelu(h2 @ blk["w_in"].astype(h2.dtype)) \
-                @ blk["w_out"].astype(h2.dtype)
-            return x + m, (kl, vl)
+            m = jax.nn.gelu(h2 @ _wload(blk, "w_in", h2.dtype)) \
+                @ _wload(blk, "w_out", h2.dtype)
+            if quant:
+                return x + m, (kc[0], vc[0], kc[1], vc[1])
+            return x + m, (kc, vc)
 
+        if quant:
+            x, (k_new, v_new, ks_new, vs_new) = lax.scan(
+                block, x, (params["blocks"], cache["k"], cache["v"],
+                           cache["k_scale"], cache["v_scale"]))
+            return x, {"k": k_new, "v": v_new,
+                       "k_scale": ks_new, "v_scale": vs_new}
         x, (k_new, v_new) = lax.scan(block, x,
                                      (params["blocks"], cache["k"],
                                       cache["v"]))
-        return x, k_new, v_new
+        return x, {"k": k_new, "v": v_new}
 
     def _decode_paged_raw(self, params, cache, tokens, use_kernel=False):
         """One decode step over a block-paged pool (ISSUE 14): same
@@ -352,12 +435,32 @@ class GenerationEngine:
         ent = jnp.where(lp < per_slot, ent, npg)
         off = pos % plen
         x = self._embed_rows(params, tokens, pos)
+        quant = kvcache.is_quantized(cache)
 
         if use_kernel:
+            if quant:
+                raise NotImplementedError(
+                    "the pallas paged-attention kernel reads bf16 pages; "
+                    "a quantized pool decodes via the gather path "
+                    "(decode_step routes it there automatically)")
             from ..kernels.paged_attention import paged_attention as _pa
 
             def attend(q, kl, vl):
                 return _pa(q, kl, vl, table, pos)
+        elif quant:
+            from . import quant as quantmod
+
+            def attend(q, kc, vc):
+                # dequantize at gather: int8 pages × per-row-per-head
+                # scales → f32 rows, same clamp-the-sentinel contract
+                kl, ks = kc
+                vl, vs = vc
+                s = per_slot * plen
+                kg = kl[table].reshape(b, s, h_, dh).astype(jnp.float32) \
+                    * ks[table].reshape(b, s, h_)[..., None]
+                vg = vl[table].reshape(b, s, h_, dh).astype(jnp.float32) \
+                    * vs[table].reshape(b, s, h_)[..., None]
+                return _cached_attention(cfg, q, kg, vg, pos)
         else:
             def attend(q, kl, vl):
                 # gather each slot's pages: sentinel entries clamp to
@@ -367,16 +470,25 @@ class GenerationEngine:
                 vg = vl[table].reshape(b, per_slot * plen, h_, dh)
                 return _cached_attention(cfg, q, kg, vg, pos)
 
-        x, k_new, v_new = self._blocks_with_cache(
-            params, cache, x,
-            write=lambda kl, rows: kl.at[ent, off].set(rows),
-            attend=attend)
+        if quant:
+            def write(kc, rows):
+                # quantize at append (ISSUE 19): the scale scatters to
+                # the same (page, offset) the int8 row does
+                arr, sc = kc
+                qr, s = quantmod.quantize_rows(rows)
+                return (arr.at[ent, off].set(qr),
+                        sc.at[ent, off].set(s))
+        else:
+            def write(kl, rows):
+                return kl.at[ent, off].set(rows.astype(kl.dtype))
+
+        x, kv = self._blocks_with_cache(params, cache, x,
+                                        write=write, attend=attend)
         logits = tfm.head_logits_rows(params, cfg, x)
-        return logits, {"k": k_new, "v": v_new, "pos": pos + 1,
-                        "pages": table}
+        return logits, dict(kv, pos=pos + 1, pages=table)
 
     def _prefill_chunk_raw(self, params, cache, tokens, start, length,
-                           slot):
+                           slot, all_logits=False):
         """One chunked-prefill dispatch (ISSUE 14): tokens (1, C_bucket)
         — the slot's context rows ``[start, start+length)`` padded to a
         chunk bucket — written into the slot's mapped pages, with the
@@ -385,7 +497,13 @@ class GenerationEngine:
         (last-valid-row logits (V,), cache); the scheduler uses the
         logits only on the FINAL chunk (they are the TTFT sample).
         Rows past ``length`` are padding: their writes drop (sentinel
-        page) and their outputs are garbage nothing reads."""
+        page) and their outputs are garbage nothing reads.
+
+        ``all_logits=True`` is the speculative-decode verify variant
+        (ISSUE 19, the ``verify_chunk`` entry point): the head runs
+        over EVERY row — (C_bucket, V) — so one dispatch judges all k
+        draft proposals; rows past ``length`` are garbage the caller
+        slices off."""
         cfg = self.cfg
         table = cache["pages"]
         npg, plen = cache["k"].shape[1], cache["k"].shape[2]
@@ -407,14 +525,13 @@ class GenerationEngine:
         x = self._embed_rows(params, tok, gpos)          # (C, d)
         s_len = per_slot * plen
         mask = jnp.arange(s_len)[None, :] <= gpos[:, None]   # (C, S)
+        quant = kvcache.is_quantized(cache)
 
-        def attend(q, kl, vl):
+        def _chunk_attention(q, kg, vg):
             # the chunk's C queries attend causally over the ONE
             # slot's gathered pages (earlier chunks + own rows) — the
             # multi-row analogue of the decode paths' single-row
             # _cached_attention
-            kg = kl[row].reshape(s_len, h_, dh)
-            vg = vl[row].reshape(s_len, h_, dh)
             scale = 1.0 / math.sqrt(dh)
             scores = jnp.einsum("qhd,shd->qhs",
                                 (q.astype(jnp.float32) * scale),
@@ -424,31 +541,59 @@ class GenerationEngine:
             return jnp.einsum("qhs,shd->qhd", probs,
                               vg.astype(jnp.float32)).astype(cfg.dtype)
 
-        x, k_new, v_new = self._blocks_with_cache(
-            params, cache, x,
-            write=lambda kl, rows: kl.at[ent, off].set(rows),
-            attend=attend)
-        x_last = x[jnp.clip(length - 1, 0, c - 1)]
-        logits = tfm.head_logits_rows(params, cfg, x_last[None])[0]
+        if quant:
+            from . import quant as quantmod
+
+            def attend(q, kc, vc):
+                kl, ks = kc
+                vl, vs = vc
+                kg = kl[row].reshape(s_len, h_, dh).astype(jnp.float32) \
+                    * ks[row].reshape(s_len, h_)[..., None]
+                vg = vl[row].reshape(s_len, h_, dh).astype(jnp.float32) \
+                    * vs[row].reshape(s_len, h_)[..., None]
+                return _chunk_attention(q, kg, vg)
+
+            def write(kc, rows):
+                arr, sc = kc
+                qr, s = quantmod.quantize_rows(rows)
+                return (arr.at[ent, off].set(qr),
+                        sc.at[ent, off].set(s))
+        else:
+            def attend(q, kl, vl):
+                return _chunk_attention(q, kl[row].reshape(s_len, h_, dh),
+                                        vl[row].reshape(s_len, h_, dh))
+
+            def write(kl, rows):
+                return kl.at[ent, off].set(rows.astype(kl.dtype))
+
+        x, kv = self._blocks_with_cache(params, cache, x,
+                                        write=write, attend=attend)
+        if all_logits:
+            logits = tfm.head_logits_rows(params, cfg, x)    # (C, V)
+        else:
+            x_last = x[jnp.clip(length - 1, 0, c - 1)]
+            logits = tfm.head_logits_rows(params, cfg, x_last[None])[0]
         pos = cache["pos"].at[slot].set((start + length).astype(jnp.int32))
-        return logits, {"k": k_new, "v": v_new, "pos": pos,
-                        "pages": table}
+        return logits, dict(kv, pos=pos, pages=table)
 
     @staticmethod
     def _copy_page_raw(cache, src, dst):
         """Copy-on-write page split (ISSUE 16): duplicate pool page
         ``src``'s k/v rows (every layer) into page ``dst``. Scalar
         src/dst are traced operands, so ONE compile covers every split;
-        the cache is donated — the copy lands in place in the pool."""
-        k = cache["k"]
-        v = cache["v"]
-        row_k = jax.lax.dynamic_slice_in_dim(k, src, 1, axis=1)
-        row_v = jax.lax.dynamic_slice_in_dim(v, src, 1, axis=1)
-        return dict(cache,
-                    k=jax.lax.dynamic_update_slice_in_dim(
-                        k, row_k, dst, axis=1),
-                    v=jax.lax.dynamic_update_slice_in_dim(
-                        v, row_v, dst, axis=1))
+        the cache is donated — the copy lands in place in the pool. A
+        quantized pool's scale arrays share the page axis, so the same
+        two-slice move carries them and CoW splits stay exact (ISSUE
+        19: scales ride sharing untouched)."""
+        out = dict(cache)
+        for name in ("k", "v", "k_scale", "v_scale"):
+            a = cache.get(name)
+            if a is None:
+                continue
+            page = jax.lax.dynamic_slice_in_dim(a, src, 1, axis=1)
+            out[name] = jax.lax.dynamic_update_slice_in_dim(
+                a, page, dst, axis=1)
+        return out
 
     # ------------------------------------------------------- host API
     def copy_page(self, cache, src: int, dst: int):
@@ -533,14 +678,20 @@ class GenerationEngine:
         pool (ISSUE 14) via either the XLA gather path or the promoted
         pallas kernel (ISSUE 17, ``_paged_kernel_choice``) — behind one
         call site; the passed cache is DONATED either way, keep only
-        the returned one."""
+        the returned one. A quantized pool (ISSUE 19) always takes the
+        gather path — dequant lives in its attend closure, which the
+        pallas kernel has no analogue for — and the weights the matvecs
+        load come from ``_decode_params`` (int8 when promoted)."""
         if kvcache.is_paged(cache):
-            fn = (self._decode_paged_kernel
-                  if self._paged_kernel_choice(cache) == "kernel"
-                  else self._decode_paged)
+            if kvcache.is_quantized(cache):
+                fn = self._decode_paged
+            else:
+                fn = (self._decode_paged_kernel
+                      if self._paged_kernel_choice(cache) == "kernel"
+                      else self._decode_paged)
         else:
             fn = self._decode
-        return fn(self.params, cache,
+        return fn(self._decode_params(), cache,
                   jnp.asarray(tokens, jnp.int32).reshape(-1))
 
     def prefill_chunk(self, cache, tokens, slot: int, start: int = 0):
@@ -572,6 +723,38 @@ class GenerationEngine:
         return self._prefill_chunk(self.params, cache, jnp.asarray(padded),
                                    jnp.int32(start), jnp.int32(n),
                                    jnp.int32(slot))
+
+    def verify_chunk(self, cache, tokens, slot: int, start: int):
+        """Speculative-decode verify (ISSUE 19): run ``tokens`` — the
+        last accepted token followed by the draft's proposals — through
+        the chunked-prefill body at positions ``[start, start+len)``
+        and return ALL row logits ``((C_bucket, V) f32, cache)``; row i
+        is the next-token distribution after ``tokens[:i+1]``, so one
+        dispatch judges every proposal. Rows are WRITTEN into the
+        slot's mapped pages as they go — the caller rolls back the
+        rejected tail (``PageTable.trim`` + a pos rewind). Runs with
+        ``_decode_params`` — the verify logits must be the ones
+        ``decode_step`` would have produced, or greedy spec decode
+        loses bit-identity with ``generate()``."""
+        if not kvcache.is_paged(cache):
+            raise ValueError("verify_chunk needs a paged cache: rollback "
+                             "is a page-table operation")
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = tokens.shape[0]
+        if n < 1:
+            raise ValueError("empty verify chunk")
+        if n > self.chunk_len:
+            raise ValueError(f"verify chunk of {n} tokens exceeds "
+                             f"chunk_len={self.chunk_len}")
+        if start + n > self.max_len:
+            raise ValueError(f"verify chunk ends at {start + n}, past "
+                             f"cache capacity max_len={self.max_len}")
+        bucket = next(b for b in self.chunk_buckets if b >= n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = tokens
+        return self._verify_chunk(self._decode_params(), cache,
+                                  jnp.asarray(padded), jnp.int32(start),
+                                  jnp.int32(n), jnp.int32(slot))
 
     def sample(self, key, logits, temperature=0.0, top_k=0):
         """Next tokens from (B, V) logits; scalar knobs broadcast to the

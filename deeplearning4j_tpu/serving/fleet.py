@@ -241,7 +241,8 @@ class FleetRouter:
                  affinity_prefix_len: int = 16,
                  autoscale_every: int = 8,
                  snapshot_every: int = 16,
-                 recorder_snapshots: int = 1024):
+                 recorder_snapshots: int = 1024,
+                 quant_kv: Optional[str] = None):
         if n_replicas < 1:
             raise ValueError("need at least one replica")
         if isinstance(engine, GenerationEngine):
@@ -251,6 +252,12 @@ class FleetRouter:
         self.n_slots = int(n_slots)
         self.slo = slo
         self._scheduler_kwargs = dict(scheduler_kwargs or {})
+        # quant plumbing (ISSUE 19): the fleet-level mode reaches every
+        # replica's scheduler — scale-out and scale-up replicas get the
+        # same quantized pool (re-prefill after preemption re-quantizes
+        # at append, so migration across replicas stays mode-blind)
+        if quant_kv is not None:
+            self._scheduler_kwargs["quant_kv"] = quant_kv
         self.affinity_prefix_len = int(affinity_prefix_len)
         self.autoscale_every = max(1, int(autoscale_every))
         self.snapshot_every = max(1, int(snapshot_every))

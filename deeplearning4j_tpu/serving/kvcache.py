@@ -112,6 +112,14 @@ def is_paged(cache) -> bool:
     return "pages" in cache
 
 
+def is_quantized(cache) -> bool:
+    """True when the pool stores int8 rows + per-row-per-head scales
+    (ISSUE 19). The scale arrays share the page axis, so every page
+    operation (CoW copy, prefix sharing, release, trim) moves scales
+    and rows as one unit."""
+    return "k_scale" in cache
+
+
 def cache_len(cache) -> int:
     """Static per-slot capacity (tokens). For a paged cache this is the
     page-table ceiling ``pages_per_slot * page_len`` — what one slot
@@ -156,9 +164,14 @@ def token_nbytes(cache) -> int:
     tokens × token_nbytes vs the allocated bytes is the KV residency
     accounting (ISSUE 12/14): dense waste is the ``max_len - resident``
     tail a short request preallocates; paged waste is only the unfilled
-    remainder of the LAST mapped page."""
+    remainder of the LAST mapped page. A quantized pool adds the two
+    per-row-per-head scale entries (ISSUE 19) — at the flagship shape
+    that is 8-bit rows + 4-byte scales ≈ 53% of the bf16 row."""
     layers, _, _, heads, head_dim = cache["k"].shape
-    return int(2 * layers * heads * head_dim * cache["k"].dtype.itemsize)
+    n = 2 * layers * heads * head_dim * cache["k"].dtype.itemsize
+    if is_quantized(cache):
+        n += 2 * layers * heads * cache["k_scale"].dtype.itemsize
+    return int(n)
 
 
 def page_nbytes(cache) -> int:
@@ -168,7 +181,7 @@ def page_nbytes(cache) -> int:
 
 def init_paged_cache(cfg, n_slots: int, n_pages: int,
                      page_len: int = DEFAULT_PAGE_LEN, max_len=None,
-                     dtype=None):
+                     dtype=None, quantized: bool = False):
     """Allocate an empty block-paged pool: ``n_pages`` shared pages of
     ``page_len`` tokens each, a per-slot cursor, and a per-slot page
     table sized ``ceil(max_len / page_len)`` entries (initially all the
@@ -190,6 +203,19 @@ def init_paged_cache(cfg, n_slots: int, n_pages: int,
     dt = cfg.dtype if dtype is None else dtype
     shape = (cfg.n_layers, int(n_pages), int(page_len), cfg.n_heads,
              cfg.head_dim)
+    if quantized:
+        # int8 rows + f32 per-row-per-head scales riding the same page
+        # axis (ISSUE 19): the gather/scatter/CoW paths address scales
+        # with the page table entries they already compute, so sharing
+        # and splits need zero extra bookkeeping
+        sshape = shape[:-1]
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32),
+                "pos": jnp.zeros((int(n_slots),), jnp.int32),
+                "pages": jnp.full((int(n_slots), per_slot), int(n_pages),
+                                  jnp.int32)}
     return {"k": jnp.zeros(shape, dt),
             "v": jnp.zeros(shape, dt),
             "pos": jnp.zeros((int(n_slots),), jnp.int32),
@@ -406,6 +432,27 @@ class PageTable:
         self.mapped[slot] = 0
         self._dirty = True
         return have
+
+    def trim(self, slot: int, tokens: int) -> int:
+        """Shrink ``slot``'s mapping to cover exactly ``tokens`` rows —
+        the speculative-decode rollback primitive (ISSUE 19). Pages past
+        the kept range lose this slot's hold LIFO and their entries go
+        back to the sentinel; shared pages survive through their other
+        holders, exclusively-held ones return to the free list. Stale
+        rows inside the LAST kept page (rejected draft tokens) are left
+        in place — the attention mask never reads past ``pos`` and the
+        next append overwrites them in order, the same contract release
+        + remap already relies on. Returns mappings removed."""
+        keep = self.pages_for(tokens)
+        have = int(self.mapped[slot])
+        if keep >= have:
+            return 0
+        for j in range(have - 1, keep - 1, -1):   # LIFO: reuse hot pages
+            self.decref(int(self.table[slot, j]))
+        self.table[slot, keep:have] = self.n_pages
+        self.mapped[slot] = keep
+        self._dirty = True
+        return have - keep
 
     def reset(self):
         """Release everything (``_fail_all``). A PrefixCache layered on

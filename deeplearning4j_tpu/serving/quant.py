@@ -1,0 +1,384 @@
+"""Quantization plane (ISSUE 19): int8 KV-cache storage and int8 weight
+storage behind the fidelity gate.
+
+Decode is memory-bound — the floor plane has said so since PR 7 — so
+the decode path gets faster only by moving fewer bytes per token. This
+module shrinks the two byte streams the decode sweep actually reads:
+
+- **int8 KV pages** — rows quantize at page append (symmetric,
+  per-row-per-head ``amax/127`` scales) and dequantize inside the
+  gather/attention closure. The scale arrays share the pool's page
+  axis, so every page-table operation the serving stack already has —
+  CoW splits, prefix sharing, release, spec-decode trim, fleet
+  re-prefill — carries scales and rows as one unit with zero new
+  bookkeeping. Per-row scales (not per-page) are deliberate: pages
+  fill incrementally, and a page-wide running amax would requantize
+  resident rows on every growth, compounding error.
+- **int8 weights, bf16 compute** — the block-stack matvec weights
+  (wqkv/wo/w_in/w_out) quantize ONCE per engine with per-output-channel
+  scales and dequantize on the fly inside ``_blocks_with_cache``'s
+  ``_wload``; embeddings, norms and the head stay full precision, and
+  the prefill trunk never sees quantized weights (prompt fidelity is
+  not where the bytes are).
+
+Neither mode is dispatched on faith. Promotion is per-mode and
+per-shape-bucket through the unified autotune harness
+(``kernels/autotune.py``), exactly the ISSUE 17 paged-kernel contract:
+``race_*`` runs the quantized arm against the bf16 arm on identical
+probe content, gates on the FidelityProbe's ``kl_max`` under
+:data:`PROMOTION_MAX_KL` (the ``fidelity_report.py --max-kl`` bound),
+requires a measured speed-or-bytes win, persists the verdict as a
+sha-stamped ``quant_kv:*`` / ``quant_w:*`` cost record, and bumps
+``dl4j_autotune_promotions_total{kernel,verdict}``. Losers fall back
+silently — the caller gets bf16 and never knows a race happened.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels import autotune
+from ..kernels.paged_attention import PROMOTION_MAX_KL
+from . import kvcache
+
+#: symmetric int8 range: scales are amax/127, values clip to ±127
+QMAX = 127.0
+
+#: env knobs for the two dispatch modes when the engine doesn't pin
+#: one: auto (race on TPU, bf16 elsewhere) | race | on | off
+_KV_MODE_ENV = "DL4J_QUANT_KV"
+_W_MODE_ENV = "DL4J_QUANT_W"
+
+_OFF = ("off", "0", "bf16", "none")
+_ON = ("on", "1", "int8")
+
+
+# --------------------------------------------------------- primitives --
+
+def quantize_rows(rows):
+    """Symmetric int8 quantization of k/v rows ``(..., H, Dh)`` in one
+    shot: per-row-per-head scale ``amax(|row|)/127`` (f32), values
+    rounded and clipped to ±127. Returns ``(int8 rows (..., H, Dh),
+    f32 scales (..., H))`` — the shapes the quantized pool's page
+    scatter writes side by side."""
+    r = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(r), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / QMAX
+    q = jnp.clip(jnp.round(r / scale[..., None]), -QMAX, QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_rows(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_rows` (the gather-side dequant)."""
+    return (q.astype(jnp.float32)
+            * scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
+#: the block-stack matvec weights the int8 weight path stores quantized
+_W_NAMES = ("wqkv", "wo", "w_in", "w_out")
+
+
+def quantize_block_weights(blocks) -> Dict:
+    """Quantize the stacked block matvec weights ``(L, in, out)`` to
+    int8 with per-output-channel scales ``(L, 1, out)`` stored under
+    ``name + "_scale"`` — the layout ``engine._wload`` dequantizes on
+    the fly (the lax.scan layer slice broadcasts ``(1, out)`` against
+    ``(in, out)``). Norm weights stay full precision."""
+    out = dict(blocks)
+    for name in _W_NAMES:
+        w = jnp.asarray(blocks[name], jnp.float32)
+        amax = jnp.max(jnp.abs(w), axis=1, keepdims=True)   # (L, 1, out)
+        scale = jnp.maximum(amax, 1e-12) / QMAX
+        out[name] = jnp.clip(jnp.round(w / scale), -QMAX, QMAX) \
+            .astype(jnp.int8)
+        out[name + "_scale"] = scale.astype(jnp.float32)
+    return out
+
+
+def quantized_params(params) -> Dict:
+    """Params with ONLY the block stack replaced by its int8 form —
+    embed/pos_embed/ln_f/head are shared arrays, not copies, so the
+    int8 engine holds one extra copy of the (shrunken) blocks and
+    nothing else."""
+    return dict(params, blocks=quantize_block_weights(params["blocks"]))
+
+
+def quant_sha() -> str:
+    """Source fingerprint stamped on every ``quant_kv:*``/``quant_w:*``
+    cost record — editing the quantization math auto-invalidates stale
+    promotion verdicts on next lookup (kernels/autotune.py)."""
+    return autotune.source_sha(quantize_rows, quantize_block_weights)
+
+
+# ---------------------------------------------------------- promotion --
+
+def kv_bucket_key(cfg, n_slots: int, n_pages: int, page_len: int,
+                  backend: Optional[str] = None) -> str:
+    """Shape-bucket cost-record key for one paged-pool geometry."""
+    if backend is None:
+        backend = jax.default_backend()
+    return (f"quant_kv:L{cfg.n_layers}H{cfg.n_heads}D{cfg.head_dim}"
+            f":PL{int(page_len)}:NP{int(n_pages)}:S{int(n_slots)}"
+            f":{jnp.dtype(cfg.dtype).name}:{backend}")
+
+
+def w_bucket_key(cfg, backend: Optional[str] = None) -> str:
+    """Shape-bucket cost-record key for one block-stack geometry."""
+    if backend is None:
+        backend = jax.default_backend()
+    return (f"quant_w:L{cfg.n_layers}H{cfg.n_heads}D{cfg.head_dim}"
+            f"F{cfg.d_ff}:{jnp.dtype(cfg.dtype).name}:{backend}")
+
+
+def _fid_compact(rep: Dict) -> Dict:
+    keep = ("max_abs_err", "mean_abs_err", "kl_mean", "kl_max",
+            "topk_agreement", "greedy_match_frac", "greedy_prefix_len",
+            "positions")
+    return {k: rep[k] for k in keep if k in rep}
+
+
+def _probe_paged(cfg, n_slots: int, n_pages: int, page_len: int,
+                 max_len: int, quantized: bool, rng):
+    """A probe pool of the live geometry: random k/v content, every
+    slot mapped to ~3/4 of its table width, cursors mid-page — the
+    paged-kernel race's probe recipe (its signatures ARE the live
+    sweep's). The quantized probe holds the SAME content, pushed
+    through :func:`quantize_rows`, so the fidelity diff measures
+    quantization error and nothing else. Returns (cache, tokens)."""
+    base = kvcache.init_paged_cache(cfg, n_slots, n_pages, page_len,
+                                    max_len)
+    kshape = base["k"].shape
+    per_slot = base["pages"].shape[1]
+    table = np.full((n_slots, per_slot), n_pages, np.int32)
+    nxt = 0
+    pos = np.zeros((n_slots,), np.int32)
+    for s in range(n_slots):
+        want = max(1, (3 * per_slot) // 4)
+        got = min(want, n_pages - nxt)
+        if got < 1:
+            continue
+        table[s, :got] = np.arange(nxt, nxt + got)
+        nxt += got
+        pos[s] = (got - 1) * page_len + page_len // 2
+    k = rng.standard_normal(kshape).astype(np.float32)
+    v = rng.standard_normal(kshape).astype(np.float32)
+    cache = {"pos": jnp.asarray(pos), "pages": jnp.asarray(table)}
+    if quantized:
+        qk, sk = quantize_rows(jnp.asarray(k))
+        qv, sv = quantize_rows(jnp.asarray(v))
+        cache.update(k=qk, v=qv, k_scale=sk, v_scale=sv)
+    else:
+        cache.update(k=jnp.asarray(k, base["k"].dtype),
+                     v=jnp.asarray(v, base["v"].dtype))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (n_slots,)),
+                       jnp.int32)
+    return cache, toks
+
+
+def _promote(key: str, kernel: str, arms: Dict[str, float],
+             cand: str, ref: str, fid: Dict, fidelity_ok: bool,
+             max_kl: float, extra: Optional[Dict] = None) -> Dict:
+    """Shared verdict/record/counter tail of every race here: pick the
+    candidate only when fidelity holds AND it measured faster, persist
+    the sha-stamped record, bump the promotions counter."""
+    from ..obs import get_registry
+
+    if fidelity_ok:
+        chosen = cand if arms[cand] < arms[ref] else ref
+        verdict = "promoted" if chosen == cand else "fallback_slower"
+    else:
+        chosen, verdict = ref, "fallback_fidelity"
+    meta = {
+        "verdict": verdict,
+        f"{ref}_s": arms.get(ref),
+        f"{cand}_s": arms.get(cand),
+        "speedup": (round(arms[ref] / arms[cand], 3)
+                    if arms.get(cand) else None),
+        "max_kl": max_kl,
+        "fidelity": _fid_compact(fid),
+        "backend": jax.default_backend(),
+    }
+    if extra:
+        meta.update(extra)
+    autotune.put(key, (chosen,), meta=meta, sha=quant_sha())
+    get_registry().counter(
+        "dl4j_autotune_promotions_total",
+        "Fidelity-gated kernel-vs-XLA promotion races, by verdict",
+        labelnames=("kernel", "verdict")).inc(
+            kernel=kernel, verdict=verdict)
+    return dict(meta, choice=chosen, key=key)
+
+
+def race_kv(engine, n_slots: int, n_pages: int,
+            page_len: int = kvcache.DEFAULT_PAGE_LEN, *,
+            max_kl: float = PROMOTION_MAX_KL) -> Dict:
+    """Race the int8 pool against the bf16 pool on identical probe
+    content at one geometry; gate on ``kl_max``; persist the verdict.
+
+    Verdicts: ``promoted`` (fidelity holds, int8 decode measured
+    faster), ``fallback_slower``, ``fallback_fidelity`` — the latter
+    two leave the bf16 pool dispatched silently."""
+    from ..obs.fidelity import FidelityProbe
+
+    cfg = engine.cfg
+    key = kv_bucket_key(cfg, n_slots, n_pages, page_len)
+    rng = np.random.default_rng(0)
+
+    ref_probe, toks = _probe_paged(cfg, n_slots, n_pages, page_len,
+                                   engine.max_len, False, rng)
+    rng = np.random.default_rng(0)          # same draw -> same content
+    cand_probe, _ = _probe_paged(cfg, n_slots, n_pages, page_len,
+                                 engine.max_len, True, rng)
+    params = engine._decode_params()
+    ref_logits, _ = engine._decode_paged(params, ref_probe, toks)
+    cand_logits, _ = engine._decode_paged(params, cand_probe, toks)
+    fid = FidelityProbe("quant_kv_vs_bf16").compare(
+        np.asarray(ref_logits, np.float32),
+        np.asarray(cand_logits, np.float32))
+    fidelity_ok = fid["kl_max"] <= max_kl
+
+    arms: Dict[str, float] = {}
+    for name, quantized in (("bf16", False), ("int8", True)):
+        state: Dict = {}
+        rng = np.random.default_rng(0)
+        state["cache"], state["toks"] = _probe_paged(
+            cfg, n_slots, n_pages, page_len, engine.max_len, quantized,
+            rng)
+
+        def run():
+            logits, state["cache"] = engine._decode_paged(
+                params, state["cache"], state["toks"])
+            return logits
+
+        arms[name] = autotune._time_once(run)
+    bpt = {name: kvcache.token_nbytes(
+        kvcache.init_paged_cache(cfg, 1, 1, page_len, engine.max_len,
+                                 quantized=(name == "int8")))
+        for name in ("bf16", "int8")}
+    return _promote(key, "quant_kv", arms, "int8", "bf16", fid,
+                    fidelity_ok, max_kl,
+                    extra={"bytes_per_token": bpt})
+
+
+def race_weights(engine, *, max_kl: float = PROMOTION_MAX_KL) -> Dict:
+    """Race int8-weight decode against bf16-weight decode on one dense
+    probe cache; gate on ``kl_max``; persist the verdict (same
+    vocabulary as :func:`race_kv`)."""
+    from ..obs.fidelity import FidelityProbe
+
+    cfg = engine.cfg
+    key = w_bucket_key(cfg)
+    qparams = quantized_params(engine.params)
+    rng = np.random.default_rng(0)
+    probe_len = min(engine.max_len, 256)
+
+    def probe():
+        r = np.random.default_rng(0)
+        shape = (cfg.n_layers, 2, probe_len, cfg.n_heads, cfg.head_dim)
+        cache = {"k": jnp.asarray(r.standard_normal(shape), cfg.dtype),
+                 "v": jnp.asarray(r.standard_normal(shape), cfg.dtype),
+                 "pos": jnp.full((2,), probe_len // 2, jnp.int32)}
+        toks = jnp.asarray(r.integers(0, cfg.vocab_size, (2,)), jnp.int32)
+        return cache, toks
+
+    del rng
+    cache_a, toks = probe()
+    cache_b, _ = probe()
+    ref_logits, _ = engine._decode(engine.params, cache_a, toks)
+    cand_logits, _ = engine._decode(qparams, cache_b, toks)
+    fid = FidelityProbe("quant_w_vs_bf16").compare(
+        np.asarray(ref_logits, np.float32),
+        np.asarray(cand_logits, np.float32))
+    fidelity_ok = fid["kl_max"] <= max_kl
+
+    arms: Dict[str, float] = {}
+    for name, p in (("bf16", engine.params), ("int8", qparams)):
+        state: Dict = {}
+        state["cache"], state["toks"] = probe()
+
+        def run():
+            logits, state["cache"] = engine._decode(p, state["cache"],
+                                                    state["toks"])
+            return logits
+
+        arms[name] = autotune._time_once(run)
+    return _promote(key, "quant_w", arms, "int8", "bf16", fid,
+                    fidelity_ok, max_kl)
+
+
+# ----------------------------------------------------------- dispatch --
+
+def _resolve_mode(pinned: Optional[str], env: str) -> str:
+    mode = pinned if pinned is not None else os.environ.get(env, "auto")
+    return str(mode).lower()
+
+
+def decide_kv(engine, n_slots: int, n_pages: int,
+              page_len: int = kvcache.DEFAULT_PAGE_LEN,
+              mode: Optional[str] = None) -> str:
+    """``"int8"`` or ``"bf16"`` for one pool geometry. Resolution:
+    ``mode`` (or the engine's pinned ``quant_kv_mode``, or
+    ``$DL4J_QUANT_KV``): ``off`` → bf16, ``on`` → int8 (no race);
+    ``auto`` off-TPU → bf16; ``race``/auto-on-TPU → the cached
+    sha-stamped verdict, else :func:`race_kv`. Every resolution bumps
+    ``dl4j_quant_pool_total{kernel,mode}`` — the allocation census the
+    quant bench row and /debug pages read."""
+    if mode is None:
+        mode = _resolve_mode(getattr(engine, "quant_kv_mode", None),
+                             _KV_MODE_ENV)
+    mode = str(mode).lower()
+    if mode in _OFF:
+        choice = "bf16"
+    elif mode in _ON:
+        choice = "int8"
+    elif mode == "auto" and jax.default_backend() != "tpu":
+        choice = "bf16"
+    else:
+        rec = autotune.lookup(
+            kv_bucket_key(engine.cfg, n_slots, n_pages, page_len),
+            sha=quant_sha())
+        if rec is not None and rec["choice"]:
+            choice = str(rec["choice"][0])
+        else:
+            choice = str(race_kv(engine, n_slots, n_pages,
+                                 page_len)["choice"])
+    from ..obs import get_registry
+    get_registry().counter(
+        "dl4j_quant_pool_total",
+        "KV pools allocated, by resolved storage mode",
+        labelnames=("kernel", "mode")).inc(kernel="quant_kv", mode=choice)
+    return choice
+
+
+def decide_weights(engine, mode: Optional[str] = None) -> str:
+    """``"int8"`` or ``"bf16"`` for the engine's decode weights — same
+    resolution ladder as :func:`decide_kv` over ``quant_weights_mode``
+    / ``$DL4J_QUANT_W``, with the verdict cached per block-stack shape
+    bucket. Bumps ``dl4j_quant_weights_total{kernel,mode}``."""
+    if mode is None:
+        mode = _resolve_mode(getattr(engine, "quant_weights_mode", None),
+                             _W_MODE_ENV)
+    mode = str(mode).lower()
+    if mode in _OFF:
+        choice = "bf16"
+    elif mode in _ON:
+        choice = "int8"
+    elif mode == "auto" and jax.default_backend() != "tpu":
+        choice = "bf16"
+    else:
+        rec = autotune.lookup(w_bucket_key(engine.cfg), sha=quant_sha())
+        if rec is not None and rec["choice"]:
+            choice = str(rec["choice"][0])
+        else:
+            choice = str(race_weights(engine)["choice"])
+    from ..obs import get_registry
+    get_registry().counter(
+        "dl4j_quant_weights_total",
+        "Engine decode-weight resolutions, by storage mode",
+        labelnames=("kernel", "mode")).inc(kernel="quant_w", mode=choice)
+    return choice

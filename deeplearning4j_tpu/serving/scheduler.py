@@ -165,11 +165,15 @@ class ContinuousBatchingScheduler:
                  sample_obs_every: int = 32,
                  page_len: Optional[int] = None,
                  n_pages: Optional[int] = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 quant_kv: Optional[str] = None):
         if n_slots < 1:
             raise ValueError("need at least one decode slot")
         if prefix_cache and page_len is None and n_pages is None:
             raise ValueError("prefix_cache rides the paged pool: give "
+                             "page_len and/or n_pages")
+        if quant_kv is not None and page_len is None and n_pages is None:
+            raise ValueError("quant_kv quantizes the paged pool: give "
                              "page_len and/or n_pages")
         self.engine = engine
         self.n_slots = int(n_slots)
@@ -204,7 +208,21 @@ class ContinuousBatchingScheduler:
             per_slot = -(-engine.max_len // plen)
             np_ = int(n_pages if n_pages is not None
                       else self.n_slots * per_slot)
-            self.cache = engine.init_paged_cache(self.n_slots, np_, plen)
+            # int8 KV storage (ISSUE 19): quant_kv pins the mode
+            # (off|on|auto|race); None defers to the engine / env
+            # ladder inside serving.quant.decide_kv, whose verdict is
+            # the fidelity-gated promotion race. Every path below —
+            # CoW splits, prefix sharing, re-prefill, preemption —
+            # is mode-blind: scales ride the page axis.
+            if quant_kv is not None:
+                from . import quant
+                qz = quant.decide_kv(engine, self.n_slots, np_, plen,
+                                     mode=quant_kv) == "int8"
+                self.cache = engine.init_paged_cache(
+                    self.n_slots, np_, plen, quantized=qz)
+            else:
+                self.cache = engine.init_paged_cache(self.n_slots, np_,
+                                                     plen)
             self._pages: Optional[kvcache.PageTable] = \
                 kvcache.PageTable.for_cache(self.cache)
             self._kv_page_bytes = kvcache.page_nbytes(self.cache)
@@ -1525,6 +1543,10 @@ class ContinuousBatchingScheduler:
         }
         if self.paged:
             out["paged"] = self._pages.report()
+            out["kv_dtype"] = ("int8"
+                               if kvcache.is_quantized(self.cache)
+                               else str(jnp.dtype(
+                                   self.cache["k"].dtype).name))
         if self._prefix is not None:
             # sharing evidence (ISSUE 16): hits, tokens the pool did
             # NOT re-prefill or re-store, CoW splits, evictions

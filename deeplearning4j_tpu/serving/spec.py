@@ -1,0 +1,457 @@
+"""Speculative decoding (ISSUE 19): draft-verify generation over the
+paged pool, with page-exact rollback.
+
+The scheme is the classic two-model split: a cheap **draft** proposes
+``k`` greedy tokens, and the target model verifies ALL of them in ONE
+forward through the existing chunked-prefill body (``verify_chunk`` —
+the same block math, head over every row). Row ``i`` of the verify
+logits is the target's next-token distribution after proposal ``i``,
+so the longest prefix of proposals matching the target's own argmax is
+accepted wholesale, and on the first mismatch the target's argmax IS
+the correction token — every round emits ``accepted + 1`` tokens for
+one target dispatch (``accepted`` when the whole window matched). In
+greedy token space the output is therefore BIT-IDENTICAL to the
+non-speculative decode by construction; the promotion race pins it
+anyway (fp reduction order could bite) along with the speed gate.
+
+Rollback is the page-table operation the paged pool already prepared
+for: verify wrote the whole window's k/v into the slot's mapped pages,
+so rejecting a tail is ``PageTable.trim`` (drop the holds on pages
+past the accepted length — shared pages survive via their other
+holders) plus a host-side ``pos`` rewind. Stale rows inside the kept
+page sit beyond ``pos``, where the attention mask never reads and the
+next append overwrites in order — the same contract preemption/remap
+has always relied on. ``PageTable.check()`` stays green after every
+round (the fuzz tests hammer it).
+
+Two draft implementations ship:
+
+- :class:`EngineDraft` — a (smaller) zoo model with its own dense
+  cache (``zoo.transformer.draft_params`` builds a layer-truncated one
+  sharing embeddings/head with the target). Its cache rewinds the same
+  way the target's does: accepted proposals are exactly the tokens the
+  draft itself processed, so a rollback is just a cursor rewind.
+- :class:`NgramDraft` — prompt-lookup speculation (the vLLM/HF
+  "prompt lookup decoding" trick): propose the continuation of the
+  longest recent suffix match in the generated-so-far ids. Free to
+  propose, surprisingly strong on self-repeating output.
+
+Promotion (:func:`race_spec`) is per-draft-arm and per-shape-bucket
+through ``kernels/autotune.py``: an arm promotes only when its greedy
+tokens are bit-identical to the plain decode's, accepted-tokens/step
+beats 1, AND its median tokens/s wins; otherwise the verdict is a
+silent fallback counted in ``dl4j_autotune_promotions_total``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels import autotune
+from . import kvcache
+
+__all__ = ["EngineDraft", "NgramDraft", "SpeculativeDecoder",
+           "race_spec", "spec_bucket_key"]
+
+
+def _registry():
+    from ..obs import get_registry
+    return get_registry()
+
+
+# ------------------------------------------------------------- drafts --
+
+class EngineDraft:
+    """Draft tokens from a (smaller) zoo model with its own dense
+    1-slot cache. ``propose`` decodes greedily from the shared context;
+    after the target accepts/rejects, the next ``propose`` observes the
+    shorter context and rewinds its cursor — rows for accepted tokens
+    were written by the draft's own decode of those very tokens, so
+    they are already correct, and rejected rows sit beyond the cursor
+    where the mask never reads."""
+
+    name = "engine"
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.cache = None
+        self._pos = 0
+
+    def reset(self):
+        self.cache = None
+        self._pos = 0
+
+    def propose(self, ids: Sequence[int], k: int) -> List[int]:
+        eng = self.engine
+        if self.cache is None:
+            self.cache = eng.init_cache(1)
+            prompt = np.asarray(ids[:-1], np.int32)
+            _, self.cache = eng.prefill_slot(self.cache, prompt, 0)
+            self._pos = len(ids) - 1
+        want = len(ids) - 1
+        if want != self._pos:
+            if want > self._pos:
+                raise ValueError(
+                    f"draft cursor {self._pos} behind context {want}: "
+                    "propose() must see every accepted token")
+            # rollback: rewind the cursor; accepted rows match what the
+            # draft wrote, rejected ones are masked garbage
+            self.cache = dict(self.cache,
+                              pos=jnp.full((1,), want, jnp.int32))
+            self._pos = want
+        out: List[int] = []
+        last = int(ids[-1])
+        for _ in range(k):
+            logits, self.cache = eng.decode_step(
+                self.cache, np.asarray([last], np.int32))
+            last = int(np.argmax(np.asarray(logits, np.float32)[0]))
+            out.append(last)
+        self._pos += k
+        return out
+
+
+class NgramDraft:
+    """Prompt-lookup speculation: find the longest suffix of the
+    context (up to ``n`` tokens) that recurred earlier, and propose
+    whatever followed it last time. Stateless — rollback costs
+    nothing."""
+
+    name = "ngram"
+
+    def __init__(self, n: int = 3):
+        self.n = max(1, int(n))
+
+    def reset(self):
+        pass
+
+    def propose(self, ids: Sequence[int], k: int) -> List[int]:
+        ids = list(ids)
+        t = len(ids)
+        for n in range(min(self.n, t - 1), 0, -1):
+            suffix = ids[t - n:]
+            # most recent earlier occurrence wins
+            for i in range(t - n - 1, -1, -1):
+                if ids[i:i + n] == suffix and i + n < t:
+                    cont = ids[i + n:i + n + k]
+                    if cont:
+                        return (cont + [ids[-1]] * (k - len(cont)))[:k]
+        return [ids[-1]] * k
+
+
+# ------------------------------------------------------------ decoder --
+
+class SpeculativeDecoder:
+    """Greedy draft-verify generation for ONE request over a private
+    paged pool. The target engine's ``verify_chunk`` judges ``k``
+    proposals per round; rejected tails roll back via
+    ``PageTable.trim`` + a pos rewind, refcount-exactly (``check()``
+    holds after every round — the fuzz harness pins it).
+
+    ``preempt()`` releases every page mid-flight (the scheduler fault
+    the rollback contract must survive); ``resume()`` re-admits the
+    accepted context through chunked prefill and generation continues
+    bit-identically. ``cancel()`` is preempt without the comeback."""
+
+    def __init__(self, engine, draft, *, k: int = 4,
+                 page_len: int = kvcache.DEFAULT_PAGE_LEN,
+                 n_pages: Optional[int] = None,
+                 quantized: Optional[bool] = None):
+        if k < 1:
+            raise ValueError("need k >= 1 draft proposals per round")
+        if k >= engine.chunk_len:
+            raise ValueError(f"k={k} proposals need a verify chunk of "
+                             f"k rows <= chunk_len={engine.chunk_len}")
+        self.engine = engine
+        self.draft = draft
+        self.k = int(k)
+        per_slot = -(-engine.max_len // int(page_len))
+        self.n_pages = int(per_slot if n_pages is None else n_pages)
+        self.page_len = int(page_len)
+        self.cache = engine.init_paged_cache(1, self.n_pages, page_len,
+                                             quantized=quantized)
+        self.table = kvcache.PageTable.for_cache(self.cache)
+        # round accounting (the bench row + dl4j_spec_* metrics)
+        self.rounds = 0
+        self.proposed = 0
+        self.accepted = 0
+        self.rollback_pages = 0
+        self._ids: List[int] = []
+        self._emitted: List[int] = []
+
+    # ------------------------------------------------------- plumbing
+    def _set_pos(self, pos: int):
+        self.cache = dict(self.cache,
+                          pos=jnp.full((1,), int(pos), jnp.int32))
+
+    def _map_to(self, tokens: int):
+        if not self.table.map(0, tokens):
+            raise RuntimeError(
+                f"speculation pool exhausted: {tokens} tokens need "
+                f"{self.table.pages_for(tokens)} pages, "
+                f"{self.table.free_pages} free")
+        self.cache = self.table.sync(self.cache)
+
+    def _prefill(self, ids: Sequence[int]):
+        """Chunked prefill of ``ids`` into slot 0 (admission and the
+        post-preemption re-prefill share this). Returns last logits."""
+        eng = self.engine
+        n = len(ids)
+        self._map_to(n)
+        logits = None
+        for start in range(0, n, eng.chunk_len):
+            chunk = np.asarray(ids[start:start + eng.chunk_len], np.int32)
+            logits, self.cache = eng.prefill_chunk(self.cache, chunk, 0,
+                                                   start)
+        self.table.note_fill(0, n)
+        return logits
+
+    # ------------------------------------------------------ lifecycle
+    def release(self):
+        """Drop every page hold (finish/cancel/preempt tail)."""
+        self.table.release(0)
+        self.cache = self.table.sync(self.cache)
+        self._set_pos(0)
+
+    def cancel(self):
+        """Abandon the request: pages back to the free list, state
+        cleared. ``check()`` must hold right after — no leaked refs."""
+        self.release()
+        self._ids = []
+        self._emitted = []
+        if hasattr(self.draft, "reset"):
+            self.draft.reset()
+
+    def preempt(self):
+        """Scheduler-fault simulation: lose every page mid-generation
+        (accepted context survives host-side in ``self._ids``)."""
+        self.release()
+
+    def resume(self):
+        """Re-admit after :meth:`preempt`: chunked re-prefill of the
+        accepted context (all ids but the unwritten last), exactly the
+        scheduler's resumable-re-prefill path."""
+        if not self._ids:
+            raise RuntimeError("nothing to resume: no accepted context")
+        self._prefill(self._ids[:-1])
+
+    # ----------------------------------------------------- generation
+    def stats(self) -> Dict:
+        emitted = len(self._emitted)
+        return {
+            "rounds": self.rounds,
+            "proposed": self.proposed,
+            "accepted": self.accepted,
+            "rollback_pages": self.rollback_pages,
+            # tokens per VERIFY dispatch (the first token is the
+            # prefill's, not a round's) — the ISSUE 19 gate is > 1
+            "accepted_per_step": ((emitted - 1) / self.rounds
+                                  if self.rounds else 0.0),
+        }
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32, *,
+                 eos_id: Optional[int] = None,
+                 fault_hook=None) -> np.ndarray:
+        """Greedy speculative generation; returns generated ids
+        (prompt excluded), bit-identical in token space to the plain
+        greedy decode. ``fault_hook(round, decoder)`` — test-only —
+        runs before each verify round and may preempt/cancel."""
+        eng = self.engine
+        prompt = [int(t) for t in np.asarray(prompt_ids, np.int32)
+                  .reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens - 1 > eng.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) - 1 exceeds max_len={eng.max_len}")
+        if hasattr(self.draft, "reset"):
+            self.draft.reset()
+        reg = _registry()
+        c_rounds = reg.counter(
+            "dl4j_spec_rounds_total",
+            "Speculative verify rounds, by draft mode",
+            labelnames=("mode",))
+        c_proposed = reg.counter(
+            "dl4j_spec_proposed_total",
+            "Draft tokens proposed, by draft mode", labelnames=("mode",))
+        c_accepted = reg.counter(
+            "dl4j_spec_accepted_total",
+            "Draft tokens the target accepted, by draft mode",
+            labelnames=("mode",))
+        c_rollback = reg.counter(
+            "dl4j_spec_rollback_pages_total",
+            "Page mappings rolled back on rejected speculation",
+            labelnames=("mode",))
+        mode = getattr(self.draft, "name", "draft")
+
+        logits = self._prefill(prompt)
+        t0 = int(np.argmax(np.asarray(logits, np.float32)))
+        ids = prompt + [t0]
+        emitted = [t0]
+        self._ids, self._emitted = ids, emitted
+        rnd = 0
+        while len(emitted) < max_new_tokens and \
+                (eos_id is None or emitted[-1] != eos_id):
+            if fault_hook is not None:
+                fault_hook(rnd, self)
+                if not self._ids:          # hook cancelled us
+                    break
+            rnd += 1
+            pos = len(ids) - 1             # resident rows
+            r = min(self.k, max_new_tokens - len(emitted))
+            drafts = [int(t) for t in self.draft.propose(ids, r)]
+            self.proposed += r
+            rows = [ids[-1]] + drafts[:r - 1]
+            self._map_to(pos + r)
+            logits_all, self.cache = eng.verify_chunk(self.cache, rows,
+                                                      0, pos)
+            g = np.argmax(np.asarray(logits_all, np.float32)[:r],
+                          axis=-1)
+            m = 0
+            while m < r and drafts[m] == int(g[m]):
+                m += 1
+            new = drafts[:r] if m == r else drafts[:m] + [int(g[m])]
+            self.accepted += m
+            ids.extend(new)
+            emitted.extend(new)
+            # rollback the rejected tail: resident rows are everything
+            # but the (never-written) newest token
+            new_pos = len(ids) - 1
+            freed = self.table.trim(0, new_pos)
+            self.rollback_pages += freed
+            self.cache = self.table.sync(self.cache)
+            self._set_pos(new_pos)
+            self.table.note_fill(0, new_pos)
+            self.rounds += 1
+            c_rounds.inc(mode=mode)
+            c_proposed.inc(r, mode=mode)
+            c_accepted.inc(m, mode=mode)
+            if freed:
+                c_rollback.inc(freed, mode=mode)
+        if eos_id is not None and eos_id in emitted:
+            emitted = emitted[:emitted.index(eos_id) + 1]
+        self._emitted = emitted
+        return np.asarray(emitted, np.int32)
+
+
+# ---------------------------------------------------------- promotion --
+
+def spec_bucket_key(cfg, draft_name: str, k: int,
+                    backend: Optional[str] = None) -> str:
+    import jax
+    if backend is None:
+        backend = jax.default_backend()
+    return (f"spec_decode:L{cfg.n_layers}H{cfg.n_heads}D{cfg.head_dim}"
+            f":{draft_name}:K{int(k)}:{backend}")
+
+
+def spec_sha() -> str:
+    """Source fingerprint for ``spec_decode:*`` cost records."""
+    return autotune.source_sha(SpeculativeDecoder, EngineDraft,
+                               NgramDraft)
+
+
+def plain_generate(engine, prompt_ids, max_new_tokens: int, *,
+                   page_len: int = kvcache.DEFAULT_PAGE_LEN):
+    """The non-speculative baseline the race (and the bench row)
+    compares against: greedy decode of one request over an identical
+    private paged pool — chunked prefill + one decode_step per token.
+    Returns (generated ids, seconds)."""
+    prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+    per_slot = -(-engine.max_len // int(page_len))
+    cache = engine.init_paged_cache(1, per_slot, page_len)
+    table = kvcache.PageTable.for_cache(cache)
+    start = time.perf_counter()
+    n = len(prompt)
+    table.map(0, n + max_new_tokens - 1)
+    cache = table.sync(cache)
+    logits = None
+    for s in range(0, n, engine.chunk_len):
+        chunk = prompt[s:s + engine.chunk_len]
+        logits, cache = engine.prefill_chunk(cache, chunk, 0, s)
+    out = [int(np.argmax(np.asarray(logits, np.float32)))]
+    while len(out) < max_new_tokens:
+        logits, cache = engine.decode_step(cache,
+                                           np.asarray([out[-1]], np.int32))
+        out.append(int(np.argmax(np.asarray(logits, np.float32)[0])))
+    elapsed = time.perf_counter() - start
+    table.release(0)
+    return np.asarray(out, np.int32), elapsed
+
+
+def race_spec(engine, drafts: Dict[str, object], prompt_ids,
+              max_new_tokens: int = 64, *, k: int = 4,
+              reps: int = 3) -> Dict:
+    """Race each draft arm against the plain greedy decode on one
+    prompt. An arm promotes only when its tokens are BIT-IDENTICAL to
+    the baseline's, accepted-tokens/step > 1, and its median wall time
+    wins; first promoted arm (best speedup) is the record's choice,
+    otherwise the baseline, with the usual silent-fallback verdicts
+    counted per arm in ``dl4j_autotune_promotions_total``."""
+    import jax
+
+    cfg = engine.cfg
+    base_times = []
+    base_tokens = None
+    for _ in range(max(1, reps)):
+        base_tokens, dt = plain_generate(engine, prompt_ids,
+                                         max_new_tokens)
+        base_times.append(dt)
+    base_s = float(np.median(base_times))
+
+    arms: Dict[str, Dict] = {}
+    for name, draft in drafts.items():
+        times = []
+        toks = None
+        stats = None
+        dec = SpeculativeDecoder(engine, draft, k=k)
+        for _ in range(max(1, reps)):
+            dec.rounds = dec.proposed = dec.accepted = 0
+            dec.rollback_pages = 0
+            t0 = time.perf_counter()
+            toks = dec.generate(prompt_ids, max_new_tokens)
+            times.append(time.perf_counter() - t0)
+            stats = dec.stats()
+            dec.release()
+        arm_s = float(np.median(times))
+        identical = (toks is not None and base_tokens is not None
+                     and len(toks) == len(base_tokens)
+                     and bool(np.array_equal(toks, base_tokens)))
+        accept = float(stats["accepted_per_step"]) if stats else 0.0
+        if not identical:
+            verdict = "fallback_fidelity"
+        elif accept <= 1.0 or arm_s >= base_s:
+            verdict = "fallback_slower"
+        else:
+            verdict = "promoted"
+        arms[name] = {
+            "verdict": verdict, "spec_s": arm_s, "base_s": base_s,
+            "speedup": round(base_s / arm_s, 3) if arm_s > 0 else None,
+            "accepted_per_step": round(accept, 3),
+            "bit_identical": identical,
+            "stats": stats,
+        }
+        key = spec_bucket_key(cfg, name, k)
+        chosen = name if verdict == "promoted" else "plain"
+        autotune.put(key, (chosen,),
+                     meta=dict(arms[name], backend=jax.default_backend()),
+                     sha=spec_sha())
+        _registry().counter(
+            "dl4j_autotune_promotions_total",
+            "Fidelity-gated kernel-vs-XLA promotion races, by verdict",
+            labelnames=("kernel", "verdict")).inc(
+                kernel="spec_decode", verdict=verdict)
+    best = None
+    for name, a in arms.items():
+        if a["verdict"] == "promoted" and \
+                (best is None or a["speedup"] > arms[best]["speedup"]):
+            best = name
+    return {"choice": best or "plain", "base_s": base_s,
+            "tokens": int(len(base_tokens)), "arms": arms,
+            "backend": jax.default_backend()}

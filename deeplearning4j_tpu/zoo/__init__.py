@@ -9,6 +9,7 @@ from .nasnet import NASNet
 from .resnet import ResNet50
 from .unet import UNet
 from .transformer import (BertConfig, TransformerConfig, bert_forward,
-                          bert_init, forward as transformer_forward,
+                          bert_init, draft_config, draft_params,
+                          forward as transformer_forward,
                           generate as transformer_generate,
                           init_params as transformer_init)

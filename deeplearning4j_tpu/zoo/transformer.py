@@ -20,6 +20,7 @@ TPU-native redesign is a pure-functional GPT-style LM engineered for SPMD:
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from dataclasses import dataclass
 from typing import Any
@@ -125,6 +126,34 @@ def init_params(key, cfg: TransformerConfig):
     if not cfg.tie_embeddings:
         params["head"] = norm(k[9], (d, cfg.vocab_size), d)
     return params
+
+
+def draft_config(cfg: TransformerConfig,
+                 n_layers: int = 2) -> TransformerConfig:
+    """Config for a layer-truncated draft model (ISSUE 19 speculative
+    decoding): the target's shape with only the first ``n_layers``
+    blocks — everything else (vocab, widths, max_seq, dtypes) must
+    match so the draft can share embeddings/head and propose in the
+    target's token space."""
+    n = int(n_layers)
+    if not (1 <= n <= cfg.n_layers):
+        raise ValueError(f"draft n_layers={n} outside 1..{cfg.n_layers}")
+    return dataclasses.replace(cfg, n_layers=n)
+
+
+def draft_params(params, cfg: TransformerConfig, n_layers: int = 2):
+    """Params for :func:`draft_config`'s truncated draft: the FIRST
+    ``n_layers`` slices of the target's stacked block tensors, with
+    embed/pos_embed/ln_f/head SHARED (same arrays, no copy) — a free
+    draft, no training run needed. Returns ``(draft_cfg,
+    draft_params)``. Acceptance depends entirely on how much of the
+    target's next-token behaviour the early layers carry; the spec
+    promotion race measures it rather than assuming it."""
+    dcfg = draft_config(cfg, n_layers)
+    blocks = {name: w[:dcfg.n_layers]
+              for name, w in params["blocks"].items()}
+    out = dict(params, blocks=blocks)
+    return dcfg, out
 
 
 def param_pspecs(cfg: TransformerConfig):

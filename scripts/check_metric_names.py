@@ -64,8 +64,8 @@ NAMESPACE = "dl4j_"
 # is a deliberate act: each new label multiplies time series, and an
 # unbounded one (request id, trace id) melts the registry.
 ALLOWED_LABELS = {"backend", "component", "config", "direction", "kernel",
-                  "kind", "layer", "level", "reason", "replica", "row",
-                  "stat", "unit", "verdict"}
+                  "kind", "layer", "level", "mode", "reason", "replica",
+                  "row", "stat", "unit", "verdict"}
 # per-prefix restriction (ISSUE 12/13): each observability plane may
 # label ONLY from its own small fixed vocabulary — component names,
 # stat kinds and probe-pair kinds are bounded sets, never per-request
@@ -94,6 +94,11 @@ PLANE_LABELS = {
     # metric labels — per-replica series already exist on the
     # dl4j_serving_*/dl4j_slo_* planes under {replica=}
     "dl4j_fleet_": {"direction", "reason"},
+    # quantization & speculation plane (ISSUE 19): storage/draft mode,
+    # kernel kind and promotion verdict — all tiny fixed enums; shape
+    # buckets and shas live in the autotune cost-record keys
+    "dl4j_quant_": {"kernel", "mode", "verdict"},
+    "dl4j_spec_": {"kernel", "mode", "verdict"},
 }
 # label names that smell like per-request/per-trace identity — never
 # allowed even if someone adds them to the allowlist above by mistake

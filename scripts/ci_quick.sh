@@ -1,17 +1,18 @@
 #!/usr/bin/env bash
-# Quick gate (ISSUE 7 + 8 + 10 + 11 + 12 + 13 + 14 + 15 + 16 + 17 + 18):
-# metric-name/label + doc lint, the offline perf-regression gate over
-# the bench ledger, then the telemetry-plane, roofline-floor,
+# Quick gate (ISSUE 7 + 8 + 10 + 11 + 12 + 13 + 14 + 15 + 16 + 17 +
+# 18 + 19): metric-name/label + doc lint, the offline perf-regression
+# gate over the bench ledger, then the telemetry-plane, roofline-floor,
 # elastic-scaleout, serving-plane, paged-KV/chunked-prefill,
 # prefix-cache/CoW, SLO-plane, memory/compile-plane,
-# numerics/fidelity-plane, perf-trend, and fleet-fabric fast suites.
+# numerics/fidelity-plane, perf-trend, fleet-fabric, quantization, and
+# speculative-decoding fast suites.
 # One command, <4 min on CPU; run before touching instrumentation,
 # bench schema, docs examples, the scaleout plane, the serving
 # engine/scheduler, the paged KV pool / page table, the prefix cache /
 # session API, the SLO/flight-recorder plane, the memory census /
 # retrace sentinel, the numerics sentinel / drift audit / fidelity
-# probes, the perf ledger / trend verdicts, or the fleet
-# router/autoscaler.
+# probes, the perf ledger / trend verdicts, the fleet
+# router/autoscaler, or the quant/spec plane.
 #
 #   bash scripts/ci_quick.sh
 #
@@ -26,7 +27,7 @@ python scripts/check_metric_names.py
 echo "== perf regression gate (offline replay of runs/perf_ledger.jsonl) =="
 python scripts/perf_gate.py --offline
 
-echo "== obs + floors + scaleout-fast + serving + paged-kv + prefix-cache + slo + memplane + numerics + trend + fleet suites =="
+echo "== obs + floors + scaleout-fast + serving + paged-kv + prefix-cache + slo + memplane + numerics + trend + fleet + quant + spec suites =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
     tests/test_scaleout_fast.py tests/test_serving.py \
     tests/test_paged_kv.py tests/test_prefix_cache.py \
@@ -34,6 +35,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
     tests/test_slo.py \
     tests/test_memplane.py tests/test_numerics.py \
     tests/test_trend.py tests/test_fleet_fast.py \
+    tests/test_quant.py tests/test_spec_decode.py \
     -q -m 'not slow' -p no:cacheprovider -p no:randomly
 
 echo "== autotune harness round-trip (record -> sha-bump -> invalidate + re-measure) =="

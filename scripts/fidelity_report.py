@@ -18,8 +18,17 @@ spec-decode candidate lands with its probe report, and the ``--max-kl``
 gate (exit 1 when any pair's kl_max exceeds the budget) makes "did we
 change the model?" a CI verdict instead of a review argument.
 
+ISSUE 19 landed that candidate plane: the ``inference_quant_kv`` row
+embeds its ``quant_kv_vs_bf16`` probe pair (and ``quant_w_vs_bf16``
+when the weight race ran), and ``inference_spec_decode`` embeds a
+``spec_vs_plain`` pair plus a ``spec`` block whose
+``accepted_per_step`` the ``--min-accept`` gate pins — the speculation
+WIN, not just its fidelity (exit 1 when any spec report accepts fewer
+tokens per verify step than the floor).
+
     python scripts/fidelity_report.py bench_secondary.json
     python scripts/fidelity_report.py reports.jsonl --max-kl 1e-3
+    python scripts/fidelity_report.py bench_secondary.json --min-accept 1.0
     python scripts/fidelity_report.py bench_secondary.json --json
 """
 
@@ -31,7 +40,8 @@ import sys
 from pathlib import Path
 
 _FIELDS = ("max_abs_err", "mean_abs_err", "kl_mean", "kl_max",
-           "topk_agreement", "greedy_match_frac", "greedy_prefix_len")
+           "topk_agreement", "greedy_match_frac", "greedy_prefix_len",
+           "accepted_per_step")
 
 
 def _is_report(d) -> bool:
@@ -69,6 +79,19 @@ def load_reports(path) -> list:
                                                      for f in _FIELDS):
                         out.append({"row": row_name, "kind": pair,
                                     **rep})
+                # speculation evidence (ISSUE 19): the spec block's
+                # accepted-tokens/step rides into the table and the
+                # --min-accept gate beside the row's fidelity pairs
+                spec = row.get("spec") if isinstance(row, dict) else None
+                if isinstance(spec, dict) and \
+                        spec.get("accepted_per_step") is not None:
+                    out.append({
+                        "row": row_name, "kind": "spec_decode",
+                        "accepted_per_step": spec["accepted_per_step"],
+                        "greedy_match_frac":
+                            (1.0 if spec.get("bit_identical") else 0.0)
+                            if "bit_identical" in spec else None,
+                    })
         return out
     for line in text.splitlines():    # JSONL shape, torn-line tolerant
         line = line.strip()
@@ -93,9 +116,11 @@ def _fmt(v, digits=3):
 
 def render(reports) -> str:
     cols = ("row", "kind", "max_abs_err", "kl_mean", "kl_max",
-            "topk_agreement", "greedy_match_frac", "greedy_prefix_len")
+            "topk_agreement", "greedy_match_frac", "greedy_prefix_len",
+            "accepted_per_step")
     heads = ("row", "pair", "max|Δlogit|", "KL mean", "KL max",
-             "top-k agree", "greedy match", "greedy prefix")
+             "top-k agree", "greedy match", "greedy prefix",
+             "accept/step")
     rows = [[_fmt(r.get(c)) if c not in ("row", "kind")
              else str(r.get(c, "-")) for c in cols] for r in reports]
     widths = [max(len(h), *(len(row[i]) for row in rows)) if rows
@@ -114,6 +139,9 @@ def main(argv=None) -> int:
     ap.add_argument("--max-kl", type=float, default=None,
                     help="exit 1 if any pair's kl_max exceeds this "
                          "budget (nats)")
+    ap.add_argument("--min-accept", type=float, default=None,
+                    help="exit 1 if any spec report accepts fewer "
+                         "tokens per verify step than this floor")
     ap.add_argument("--json", action="store_true",
                     help="emit the reports as strict JSON instead of "
                          "the table")
@@ -151,6 +179,27 @@ def main(argv=None) -> int:
         elif rc == 0:
             print("fidelity gate: no reports to judge — treating as "
                   "pass (nothing claimed fidelity)", file=sys.stderr)
+    if args.min_accept is not None:
+        judged = 0
+        for r in reports:
+            v = r.get("accepted_per_step")
+            if v is None:
+                continue
+            judged += 1
+            if float(v) < args.min_accept:
+                print(f"SPEC GATE: {r.get('row', '?')}/"
+                      f"{r.get('kind', '?')} accepted/step "
+                      f"{float(v):.3g} < floor {args.min_accept:.3g}",
+                      file=sys.stderr)
+                rc = 1
+        if judged and all(float(r["accepted_per_step"]) >=
+                          args.min_accept for r in reports
+                          if r.get("accepted_per_step") is not None):
+            print(f"spec gate: {judged} report(s) at "
+                  f"accepted/step >= {args.min_accept:.3g}")
+        elif not judged:
+            print("spec gate: no accepted/step reports — treating as "
+                  "pass (nothing claimed speculation)", file=sys.stderr)
     return rc
 
 

@@ -112,6 +112,10 @@ INFERENCE_LABELS = {
                                "prefix (CoW cache)",
     "inference_fleet": "Fleet goodput, Poisson burst, autoscaled "
                        "replicas",
+    "inference_quant_kv": "int8 KV pages vs bf16, fidelity-gated "
+                          "promotion race",
+    "inference_spec_decode": "Speculative decode (draft-verify) vs "
+                             "plain greedy",
     "inference_resnet_b1": "ResNet-50 batch-1 latency (ParallelInference)",
     "inference_bert_b1": "BERT-base batch-1 latency (ParallelInference)",
 }
@@ -167,7 +171,9 @@ def inference_row(name, rec):
         return None
     label = INFERENCE_LABELS.get(name, name)
     unit = rec.get("unit", "")
-    if "tokens" in unit:
+    if "bytes/token" in unit:
+        val = f"{rec['value']:,.2f}× fewer KV bytes/token"
+    elif "tokens" in unit:
         val = f"{rec['value']:,.1f} tokens/s"
     elif "goodput" in unit:
         val = f"{rec['value']:,.1f}% goodput"
@@ -200,6 +206,30 @@ def inference_row(name, rec):
                        f"{rec['replicas_max']} "
                        f"({rec.get('scale_ups', 0)} up, "
                        f"{rec.get('scale_downs', 0)} down)")
+    if rec.get("kv_bytes_per_token") is not None:
+        # the quant row (ISSUE 19): what each pool pays per token plus
+        # the race's speed verdict — a CPU fallback_slower is recorded,
+        # not hidden
+        bpt = rec["kv_bytes_per_token"]
+        details.append(f"{bpt['int8']} vs {bpt['bf16']} B/tok")
+        details.append(f"int8 race: {rec.get('verdict')}"
+                       + (f" ({rec['speedup_int8_over_bf16']}× vs bf16)"
+                          if rec.get("speedup_int8_over_bf16") else ""))
+        w = rec.get("weights")
+        if isinstance(w, dict) and w.get("verdict"):
+            details.append(f"int8 weights: {w['verdict']}")
+    spec = rec.get("spec")
+    if isinstance(spec, dict) and spec.get("accepted_per_step") is not None:
+        # the spec-decode row (ISSUE 19): tokens per verify dispatch +
+        # bit-identity, the --min-accept gate's own numbers
+        details.append(f"{spec['accepted_per_step']:.2f} accepted/step "
+                       f"(k={rec.get('k')})")
+        if rec.get("speedup_vs_plain"):
+            details.append(f"{rec['speedup_vs_plain']}× vs plain "
+                           f"({rec.get('best_arm')} draft)")
+        details.append("greedy bit-identical"
+                       if spec.get("bit_identical")
+                       else "⚠ greedy divergence")
     if rec.get("ttft_speedup_x") is not None:
         # the CoW prefix-cache row (ISSUE 16): warm-vs-cold TTFT and
         # tokens each user actually keeps resident when the prefix is

@@ -1,0 +1,331 @@
+"""Speculative decoding (ISSUE 19): draft-verify generation with
+page-exact rollback.
+
+The anchor is the tests/test_serving.py logit-equivalence discipline
+carried into token space: greedy speculative output must be
+BIT-IDENTICAL to ``engine.generate()`` for every draft — the target's
+own verify logits decide every token, the draft only proposes. The
+rollback contract is fuzzed: adversarial drafts force rejections every
+round and ``PageTable.check()`` must hold after each one, through
+preemption, resume, and cancel. The promotion race (bit-identity AND
+accepted/step > 1 AND faster median, else silent fallback) lands
+sha-stamped ``spec_decode:*`` records and
+``dl4j_autotune_promotions_total`` bumps.
+
+Fast tier-1 suite — tiny f32 configs on CPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.kernels import autotune as at
+from deeplearning4j_tpu.obs import get_registry
+from deeplearning4j_tpu.serving import (EngineDraft, GenerationEngine,
+                                        NgramDraft, PageTable,
+                                        SpeculativeDecoder)
+from deeplearning4j_tpu.serving import spec
+from deeplearning4j_tpu.zoo import transformer as tfm
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=61, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_seq=64, dtype=jnp.float32, remat=False,
+                attn_scores_bf16=False)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    cfg, params = model
+    return GenerationEngine(cfg, params, prefill_chunk=8)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_store(tmp_path, monkeypatch):
+    monkeypatch.setattr(at, "_CACHE_PATH", tmp_path / "autotune.json")
+    at._memory_cache.clear()
+    yield
+    at._memory_cache.clear()
+
+
+def _toks(shape, vocab=61, seed=0):
+    return np.random.default_rng(seed).integers(0, vocab, shape).astype(
+        np.int32)
+
+
+class RandomDraft:
+    """Adversarial draft: proposes uniform noise — near-total rejection
+    every round, the rollback path's worst case."""
+
+    name = "random"
+
+    def __init__(self, seed=0):
+        self.rng = np.random.default_rng(seed)
+
+    def reset(self):
+        pass
+
+    def propose(self, ids, k):
+        return [int(t) for t in self.rng.integers(0, 61, (k,))]
+
+
+# --------------------------------------------- PageTable.trim (unit)
+
+def test_trim_frees_exclusive_pages_lifo():
+    pt = PageTable(n_slots=1, n_pages=6, page_len=4, pages_per_slot=6)
+    assert pt.map(0, 20)                    # 5 pages
+    pt.note_fill(0, 20)
+    pt.check()
+    freed = pt.trim(0, 9)                   # keep 3 pages
+    assert freed == 2
+    assert int(pt.mapped[0]) == 3 and pt.free_pages == 3
+    assert pt.table[0, 3:].tolist() == [6, 6, 6]   # sentinel restored
+    pt.check()
+    # no-op trims: already-covered lengths don't touch the mapping
+    assert pt.trim(0, 9) == 0 and pt.trim(0, 12) == 0
+    # freed pages hand back out
+    assert pt.map(0, 20)
+    pt.check()
+
+
+def test_trim_shared_pages_survive():
+    """Rollback under prefix sharing: a trimmed page with another
+    holder stays resident (its cache hold), only this slot's mapping
+    drops."""
+    pt = PageTable(n_slots=2, n_pages=6, page_len=4, pages_per_slot=4)
+    assert pt.map(0, 12)                    # pages 0,1,2
+    shared = [int(p) for p in pt.table[0, :3]]
+    for p in shared:
+        pt.incref(p)                        # cache holds (PrefixCache)
+    holds = {p: 1 for p in shared}
+    pt.check(external=holds)
+    freed = pt.trim(0, 4)                   # drop slot holds on 2 pages
+    assert freed == 2
+    # nothing actually freed: the cache holds keep them resident
+    assert pt.free_pages == 3
+    assert all(int(pt.refcount[p]) == (2 if p == shared[0] else 1)
+               for p in shared)
+    pt.check(external=holds)
+
+
+# -------------------------------------------------- bit-identity
+
+@pytest.mark.parametrize("mkdraft", [
+    lambda eng: EngineDraft(eng),          # self-draft: all accepted
+    lambda eng: NgramDraft(3),             # prompt-lookup
+    lambda eng: RandomDraft(),             # adversarial: all rejected
+], ids=["engine", "ngram", "random"])
+def test_spec_greedy_bit_identical(engine, mkdraft):
+    """The acceptance criterion: greedy speculative output ==
+    engine.generate() for EVERY draft quality."""
+    prompt = _toks((12,))
+    want = [int(t) for t in engine.generate(prompt, 24)]
+    dec = SpeculativeDecoder(engine, mkdraft(engine), k=4)
+    got = [int(t) for t in dec.generate(prompt, 24)]
+    assert got == want
+    st = dec.stats()
+    assert st["rounds"] >= 1
+    assert st["accepted_per_step"] == pytest.approx(
+        (len(got) - 1) / st["rounds"])
+    dec.release()
+    dec.table.check()
+    assert dec.table.free_pages == dec.table.n_pages
+
+
+def test_self_draft_accepts_everything(engine):
+    """Draft == target: every proposal matches the verify argmax, so
+    each round emits the full window and accepted/step == k."""
+    prompt = _toks((10,), seed=2)
+    dec = SpeculativeDecoder(engine, EngineDraft(engine), k=4)
+    out = dec.generate(prompt, 21)          # 1 prefill token + 5 rounds
+    st = dec.stats()
+    assert len(out) == 21
+    assert st["rounds"] == 5 and st["accepted"] == 20
+    assert st["accepted_per_step"] == 4.0 > 1.0
+    assert st["rollback_pages"] == 0
+    dec.release()
+
+
+def test_eos_truncation(engine):
+    prompt = _toks((8,), seed=1)
+    want = [int(t) for t in engine.generate(prompt, 24)]
+    eos = want[7]
+    dec = SpeculativeDecoder(engine, EngineDraft(engine), k=4)
+    got = [int(t) for t in dec.generate(prompt, 24, eos_id=eos)]
+    assert got == want[:want.index(eos) + 1]
+    dec.release()
+
+
+# ------------------------------------------------- rollback fuzz
+
+def test_rollback_fuzz_refcounts_hold(engine):
+    """Adversarial drafts force a rejection (and page rollback) nearly
+    every round; the table invariants must hold after each one."""
+    prompt = _toks((9,), seed=5)
+    want = [int(t) for t in engine.generate(prompt, 28)]
+
+    def audit(rnd, dec):
+        dec.table.check()
+
+    for seed in range(3):
+        dec = SpeculativeDecoder(engine, RandomDraft(seed), k=5)
+        got = [int(t) for t in dec.generate(prompt, 28,
+                                            fault_hook=audit)]
+        assert got == want
+        st = dec.stats()
+        # near-total rejection: a round emits ~1 token, so the verify
+        # window's tail pages rolled back over and over
+        assert st["rounds"] >= 20
+        dec.table.check()
+        dec.release()
+        dec.table.check()
+        assert dec.table.free_pages == dec.table.n_pages
+
+
+def test_metrics_census(engine):
+    reg = get_registry()
+    reg.reset()
+    prompt = _toks((9,), seed=5)
+    dec = SpeculativeDecoder(engine, RandomDraft(), k=4)
+    dec.generate(prompt, 16)
+    st = dec.stats()
+    dec.release()
+    assert reg.get("dl4j_spec_rounds_total").value(
+        mode="random") == st["rounds"]
+    assert reg.get("dl4j_spec_proposed_total").value(
+        mode="random") == st["proposed"]
+    assert reg.get("dl4j_spec_accepted_total").value(
+        mode="random") == st["accepted"]
+    assert reg.get("dl4j_spec_rollback_pages_total").value(
+        mode="random") == st["rollback_pages"]
+
+
+# -------------------------------------- preemption / cancel safety
+
+def test_preempt_resume_mid_generation_bit_identical(engine):
+    """Lose every page mid-flight, re-prefill the accepted context,
+    and the stream continues bit-identically — the fleet re-prefill
+    contract extended to speculation."""
+    prompt = _toks((11,), seed=6)
+    want = [int(t) for t in engine.generate(prompt, 24)]
+
+    def fault(rnd, dec):
+        if rnd == 2:
+            dec.preempt()
+            assert dec.table.free_pages == dec.table.n_pages
+            dec.table.check()
+            dec.resume()
+
+    dec = SpeculativeDecoder(engine, NgramDraft(3), k=4)
+    got = [int(t) for t in dec.generate(prompt, 24, fault_hook=fault)]
+    assert got == want
+    dec.release()
+    dec.table.check()
+
+
+def test_cancel_releases_everything(engine):
+    prompt = _toks((11,), seed=6)
+
+    def fault(rnd, dec):
+        if rnd == 1:
+            dec.cancel()
+
+    dec = SpeculativeDecoder(engine, NgramDraft(3), k=4)
+    out = dec.generate(prompt, 24, fault_hook=fault)
+    assert 1 <= len(out) < 24               # stopped early
+    dec.table.check()
+    assert dec.table.free_pages == dec.table.n_pages
+
+
+def test_pool_exhaustion_raises(engine):
+    dec = SpeculativeDecoder(engine, NgramDraft(3), k=4, n_pages=2,
+                             page_len=4)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        dec.generate(_toks((12,)), 8)
+    dec.release()
+    dec.table.check()
+
+
+def test_decoder_rejects_bad_k(engine):
+    with pytest.raises(ValueError):
+        SpeculativeDecoder(engine, NgramDraft(), k=0)
+    with pytest.raises(ValueError):
+        SpeculativeDecoder(engine, NgramDraft(), k=engine.chunk_len)
+
+
+# ------------------------------------------------------ draft zoo
+
+def test_engine_draft_from_truncated_zoo_model(model):
+    """zoo.transformer.draft_params: a layer-truncated draft sharing
+    embeddings/head with the target is a valid (if weak) proposer —
+    the output stays bit-identical regardless of its quality."""
+    cfg, params = model
+    dcfg, dparams = tfm.draft_params(params, cfg, n_layers=1)
+    assert dcfg.n_layers == 1
+    assert dparams["embed"] is params["embed"]
+    target = GenerationEngine(cfg, params, prefill_chunk=8)
+    draft = EngineDraft(GenerationEngine(dcfg, dparams, prefill_chunk=8))
+    prompt = _toks((10,), seed=8)
+    want = [int(t) for t in target.generate(prompt, 16)]
+    dec = SpeculativeDecoder(target, draft, k=3)
+    assert [int(t) for t in dec.generate(prompt, 16)] == want
+    dec.release()
+    dec.table.check()
+
+
+def test_ngram_draft_proposals():
+    d = NgramDraft(3)
+    # the continuation of the repeated suffix is proposed verbatim
+    ids = [5, 1, 2, 3, 9, 1, 2, 3]
+    assert d.propose(ids, 2) == [9, 1]
+    # no recurrence: pad with the last token
+    assert d.propose([1, 2, 3], 3) == [3, 3, 3]
+
+
+# -------------------------------------------------- promotion race
+
+def test_race_spec_verdicts_records_counters(engine):
+    reg = get_registry()
+    reg.reset()
+    prompt = _toks((10,), seed=4)
+    res = spec.race_spec(engine,
+                         {"engine": EngineDraft(engine),
+                          "random": RandomDraft()},
+                         prompt, max_new_tokens=20, k=4, reps=1)
+    assert res["choice"] in ("plain", "engine", "random")
+    arms = res["arms"]
+    # both arms bit-identical by construction; the random arm's
+    # accepted/step can't beat 1, so it can never promote
+    assert arms["engine"]["bit_identical"]
+    assert arms["random"]["bit_identical"]
+    assert arms["engine"]["accepted_per_step"] > 1.0
+    assert arms["random"]["verdict"] == "fallback_slower"
+    for name, a in arms.items():
+        assert a["verdict"] in ("promoted", "fallback_slower",
+                                "fallback_fidelity")
+        rec = at.lookup(spec.spec_bucket_key(engine.cfg, name, 4),
+                        sha=spec.spec_sha())
+        assert rec is not None
+        want_choice = name if a["verdict"] == "promoted" else "plain"
+        assert rec["choice"][0] == want_choice
+        assert reg.get("dl4j_autotune_promotions_total").value(
+            kernel="spec_decode", verdict=a["verdict"]) >= 1
+
+
+def test_plain_generate_matches_engine_generate(engine):
+    prompt = _toks((10,), seed=4)
+    want = [int(t) for t in engine.generate(prompt, 20)]
+    toks, dt = spec.plain_generate(engine, prompt, 20)
+    assert [int(t) for t in toks] == want and dt > 0
