@@ -1607,6 +1607,145 @@ def bench_inference_prefix_shared(batch, steps):
     return _flag_on_chip(_stamp(rec))
 
 
+def bench_inference_scoring(batch, steps):
+    """SCORE workload row (ISSUE 20): prefill-only per-token logprob
+    scoring through the scheduler — `batch` prompts of ~512 tokens
+    each, `steps` timed waves. A SCORE request retires at its final
+    prefill chunk (no decode sweeps), so the row measures the chunked
+    prefill pipeline's SCORING throughput: prompt tokens scored per
+    second. Each wave's perplexities are cross-checked for finiteness
+    and the first wave's logprob count must be exactly prompt-1 per
+    request (the oracle contract tests pin the values on CPU)."""
+    import time as _time
+    import numpy as np
+    from deeplearning4j_tpu.serving import (ContinuousBatchingScheduler,
+                                            DEFAULT_PAGE_LEN)
+
+    n_req = max(batch, 1)
+    reps = max(steps, 1)
+    prompt_len, slots = 512, 8
+    eng, cfg = _serving_engine(prompt_len + 16)
+    pages_per_slot = -(-cfg.max_seq // DEFAULT_PAGE_LEN)
+    sched = ContinuousBatchingScheduler(eng, n_slots=slots,
+                                        page_len=DEFAULT_PAGE_LEN,
+                                        n_pages=slots * pages_per_slot)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).astype(
+        np.int32) for _ in range(n_req)]
+    # warm the chunk buckets once (compile excluded from timing)
+    sched.submit(prompts[0], kind="score")
+    sched.run_until_idle()
+    wave_tps, ppl0 = [], None
+    for _ in range(reps):
+        futs = [sched.submit(p, kind="score") for p in prompts]
+        t0 = _time.perf_counter()
+        sched.run_until_idle()
+        dt = _time.perf_counter() - t0
+        results = [f.result(timeout=1200) for f in futs]
+        assert all(np.isfinite(r.perplexity) for r in results)
+        assert all(len(r.logprobs) == prompt_len - 1 for r in results)
+        if ppl0 is None:
+            ppl0 = [round(float(r.perplexity), 2) for r in results[:4]]
+        wave_tps.append(n_req * prompt_len / dt)
+    tps = max(wave_tps)
+    rec = {"metric": "Serving SCORE throughput: prefill-only per-token "
+                     f"logprobs, {n_req} x {prompt_len}-token prompts "
+                     "(Transformer-LM 120M)",
+           "value": round(tps, 1), "unit": "tokens/sec/chip",
+           "requests": n_req, "prompt_tokens": prompt_len,
+           "decode_slots": slots, "reps": reps,
+           "wave_tokens_per_s": [round(t, 1) for t in wave_tps],
+           "perplexity_head": ppl0,
+           "timing": "wall submit→all-retired per wave through the "
+                     "scheduler, warm buckets (compile excluded); "
+                     "value = best wave"}
+    return _flag_on_chip(_stamp(rec))
+
+
+def bench_inference_beam(batch, steps):
+    """BEAM workload row (ISSUE 20): width-`batch` beam search through
+    the scheduler's paged pool, `steps` new tokens. The beams
+    ``map_shared`` the prompt's pages and CoW-split only where they
+    diverge, so the row reports BOTH the lane throughput (beams advance
+    in one decode sweep — width-k costs one sweep, not k) and the page
+    census (shared vs mapped) that proves the sharing, plus the search
+    quality signal: beam gain = best beam total logprob − greedy total
+    logprob over the same horizon (greedy continuation re-scored
+    through a SCORE request; ≥ 0 up to fp tolerance by construction,
+    fidelity_report.py --min-beam-gain gates it)."""
+    import time as _time
+    import statistics
+    import numpy as np
+    from deeplearning4j_tpu.serving import (ContinuousBatchingScheduler,
+                                            DEFAULT_PAGE_LEN)
+
+    width = max(batch, 2)
+    new_tokens = max(steps, 4)
+    prompt_len = 256
+    slots = max(width, 8)
+    eng, cfg = _serving_engine(prompt_len + new_tokens + 16)
+    pages_per_slot = -(-cfg.max_seq // DEFAULT_PAGE_LEN)
+    sched = ContinuousBatchingScheduler(eng, n_slots=slots,
+                                        page_len=DEFAULT_PAGE_LEN,
+                                        n_pages=slots * pages_per_slot)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).astype(
+        np.int32) for _ in range(3)]
+    # warm: one narrow beam + one greedy + one score (compile excluded)
+    sched.submit(prompts[0], max_new_tokens=2, kind="beam",
+                 beam_width=width)
+    sched.submit(prompts[0], max_new_tokens=2)
+    sched.submit(prompts[0], kind="score")
+    sched.run_until_idle()
+
+    gains, lane_tps, census = [], [], (0, 0, 0)
+    for p in prompts:
+        fb = sched.submit(p, max_new_tokens=new_tokens, kind="beam",
+                          beam_width=width)
+        t0 = _time.perf_counter()
+        while sched.step():
+            with sched._lock:
+                active = sum(1 for s in sched.slots if s is not None)
+                if active >= census[0]:
+                    census = (active, sched._pages.shared_pages,
+                              sched._pages.mapped_pages)
+        dt = _time.perf_counter() - t0
+        br = fb.result(timeout=1200)
+        assert sched.check_pages()
+        lane_tps.append(len(br.sequences[0]) * width / dt)
+        # greedy baseline over the same horizon, scored exactly
+        fg = sched.submit(p, max_new_tokens=new_tokens)
+        sched.run_until_idle()
+        greedy = fg.result(timeout=1200).tokens
+        fs = sched.submit(np.concatenate([p, greedy]), kind="score")
+        sched.run_until_idle()
+        lps = fs.result(timeout=1200).logprobs
+        greedy_lp = float(np.sum(lps[p.size - 1:]))
+        gains.append(br.best_logprob - greedy_lp)
+    gain_med = float(statistics.median(gains))
+    active, shared, mapped = census
+    rec = {"metric": f"Serving width-{width} beam search, "
+                     f"{prompt_len}-token prompt + {new_tokens} new "
+                     "tokens, CoW page-shared beams "
+                     "(Transformer-LM 120M)",
+           "value": round(float(statistics.median(lane_tps)), 1),
+           "unit": "tokens/sec/chip",
+           "beam_width": width, "new_tokens": new_tokens,
+           "prompt_tokens": prompt_len, "n_prompts": len(prompts),
+           "beam_gain_nats": round(gain_med, 4),
+           "beam_gain_samples": [round(g, 4) for g in gains],
+           "census_active_lanes": active,
+           "census_shared_pages": shared,
+           "census_mapped_pages": mapped,
+           "timing": "wall submit→finish per beam request, warm "
+                     "buckets (compile excluded); value = median lane "
+                     "tokens/s (width x generated / wall)"}
+    assert gain_med >= -1e-3, (
+        f"beam best ({gain_med:+.4f} nats vs greedy) lost to greedy — "
+        "the joint ranking is broken")
+    return _flag_on_chip(_stamp(rec))
+
+
 def bench_inference_fleet(batch, steps):
     """Fleet serving fabric row (ISSUE 18): a seeded open-loop Poisson
     trace with a burst window drives a ``FleetRouter`` that autoscales
@@ -1967,7 +2106,8 @@ def bench_inference_bert_b1(batch, steps):
 INFERENCE_ROWS = ("inference_decode", "inference_ttft_1024",
                   "inference_ttft_4096", "inference_prefix_shared",
                   "inference_fleet", "inference_quant_kv",
-                  "inference_spec_decode",
+                  "inference_spec_decode", "inference_scoring",
+                  "inference_beam",
                   "inference_resnet_b1", "inference_bert_b1")
 
 CONFIGS = {
@@ -1990,6 +2130,8 @@ CONFIGS = {
     "inference_fleet": bench_inference_fleet,
     "inference_quant_kv": bench_inference_quant_kv,
     "inference_spec_decode": bench_inference_spec_decode,
+    "inference_scoring": bench_inference_scoring,
+    "inference_beam": bench_inference_beam,
     "inference_resnet_b1": bench_inference_resnet_b1,
     "inference_bert_b1": bench_inference_bert_b1,
 }
@@ -2030,6 +2172,10 @@ DEFAULTS = {  # (batch, steps) — batch swept on the real chip (r2): charnn
     # window k, steps = decode tokens per rep
     "inference_quant_kv": (4, 8),
     "inference_spec_decode": (8, 48),
+    # scoring row: batch = prompts per wave, steps = timed waves;
+    # beam row: batch = beam width, steps = new tokens per request
+    "inference_scoring": (8, 3),
+    "inference_beam": (4, 24),
     "inference_resnet_b1": (1, 15),
     "inference_bert_b1": (1, 12),
 }

@@ -65,6 +65,7 @@ class _Instrument:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
+        self._sorted_names = tuple(sorted(self.labelnames))
         self._lock = threading.Lock()
 
     def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
@@ -72,7 +73,12 @@ class _Instrument:
         # every per-iteration listener metric takes (hot-path budget)
         if not labels and not self.labelnames:
             return ()
-        if tuple(sorted(labels)) != tuple(sorted(self.labelnames)):
+        # second fast path: labels passed in declared order (every
+        # scheduler hot-path write) — a tuple identity check instead of
+        # two sorts per write keeps labeled gauges inside the <2%
+        # serving bookkeeping budget
+        if tuple(labels) != self.labelnames \
+                and tuple(sorted(labels)) != self._sorted_names:
             raise ValueError(
                 f"{self.name}: labels {sorted(labels)} do not match "
                 f"declared labelnames {sorted(self.labelnames)}")
@@ -196,6 +202,27 @@ class Histogram(_Instrument):
             st.sum += v
             st.min = min(st.min, v)
             st.max = max(st.max, v)
+
+    def observe_many(self, values: Sequence[float], **labels):
+        """Batch ``observe``: one key resolution + lock round for the
+        whole sequence. The serving close-out path records every
+        request's per-token ITL samples at once — per-sample locking
+        measurably ate into the <2% bookkeeping budget."""
+        if not values:
+            return
+        key = self._key(labels)
+        buckets = self.buckets
+        with self._lock:
+            st = self._state(key)
+            counts = st.counts
+            for v in values:
+                counts[bisect_left(buckets, v)] += 1
+                st.sum += v
+                if v < st.min:
+                    st.min = v
+                if v > st.max:
+                    st.max = v
+            st.total += len(values)
 
     def count(self, **labels) -> int:
         st = self._states.get(self._key(labels))

@@ -65,6 +65,7 @@ class RequestTrace:
 
     request_id: int
     replica: str = "0"
+    kind: str = "generate"       # RequestKind value (ISSUE 20)
     events: List[Tuple[str, float, Dict[str, Any]]] = field(
         default_factory=list)
     t0_epoch: float = field(default_factory=time.time)
@@ -142,6 +143,7 @@ class RequestTrace:
         return {
             "request_id": self.request_id,
             "replica": self.replica,
+            "kind": self.kind,
             "status": end[0] if end else "inflight",
             "reason": self.finish_reason(),
             "tokens": self.n_tokens(),
@@ -153,7 +155,8 @@ class RequestTrace:
 
     def to_record(self) -> Dict[str, Any]:
         return {"kind": "reqtrace", "request_id": self.request_id,
-                "replica": self.replica, "t0_epoch": self.t0_epoch,
+                "replica": self.replica, "request_kind": self.kind,
+                "t0_epoch": self.t0_epoch,
                 "summary": self.summary(),
                 "events": [[name, round(ts - self.t0_perf, 6), attrs]
                            for name, ts, attrs in self.events]}

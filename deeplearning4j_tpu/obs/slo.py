@@ -157,6 +157,17 @@ class SLOTracker:
                        if s.get("ttft_s") is not None)
         itls = sorted(x for _, s, *_ in rows
                       for x in (s.get("itl_s") or []))
+        # per-kind census (ISSUE 20): the multi-workload request plane
+        # labels every summary with its RequestKind; a summary without
+        # one (pre-ISSUE-20 dumps) counts as "generate"
+        by_kind: Dict[str, Dict[str, int]] = {}
+        for _, s, good, _, _, failed in rows:
+            k = by_kind.setdefault(str(s.get("kind", "generate")),
+                                   {"requests": 0, "good": 0,
+                                    "failed": 0})
+            k["requests"] += 1
+            k["good"] += good
+            k["failed"] += failed
         return {
             "n": n,
             "good": sum(1 for r in rows if r[2]),
@@ -164,6 +175,7 @@ class SLOTracker:
             "itl_ok": sum(1 for r in rows if r[4]),
             "failed": sum(1 for r in rows if r[5]),
             "ttfts": ttfts, "itls": itls,
+            "by_kind": by_kind,
             "span_s": rows[-1][0] - rows[0][0],
         }
 
@@ -229,6 +241,13 @@ class SLOTracker:
         out["burn_rate"] = self._burn(st["good"], n)
         out["met"] = bool(goodput >= q
                           and error_rate <= cfg.max_error_rate)
+        # per-kind goodput breakdown (ISSUE 20) — what
+        # scripts/slo_report.py renders under the replica table
+        out["by_kind"] = {
+            kind: {"requests": c["requests"], "good": c["good"],
+                   "failed": c["failed"],
+                   "goodput": c["good"] / c["requests"]}
+            for kind, c in sorted(st["by_kind"].items())}
         return out
 
     # ------------------------------------------------------- gauges

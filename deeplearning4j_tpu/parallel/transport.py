@@ -100,34 +100,55 @@ KIND_FLEET_RESULT = 9   # replica -> router: the finished request — lease
 #
 #   FLEET_SUBMIT: uint32 item, uint32 max_new_tokens, float32 temperature,
 #                 int32 top_k (0 = off), int32 eos_id (-1 = none),
+#                 uint8 kind (RequestKind wire byte, ISSUE 20),
+#                 uint8 beam_width (0 = default, BEAM only),
+#                 uint8 pooling (EMBED only: 0 = mean, 1 = last),
+#                 uint32 allowlist length, int32[] allowed token ids
+#                 (CONSTRAINED only; 0 = no mask),
 #                 uint16 session byte length, session bytes (utf-8),
 #                 uint32 prompt length, int32[] prompt token ids
 #   FLEET_RESULT: uint32 item, uint8 reason byte length, reason (utf-8),
-#                 uint32 token count, int32[] generated token ids
+#                 uint8 kind (RequestKind wire byte),
+#                 uint32 token count, int32[] generated token ids,
+#                 uint32 float count, float32[] kind payload (SCORE:
+#                 per-token logprobs; EMBED: the pooled embedding;
+#                 BEAM: [best total logprob]; else empty)
 # ---------------------------------------------------------------------------
 
-_FLEET_SUBMIT_HDR = struct.Struct("<IIfii")
+_FLEET_SUBMIT_HDR = struct.Struct("<IIfiiBBB")
 _FLEET_RESULT_HDR = struct.Struct("<IB")
 
 
 def pack_fleet_submit(item: int, prompt_ids, max_new_tokens: int,
                       temperature: float = 0.0, top_k: int = 0,
                       eos_id: Optional[int] = None,
-                      session_id: Optional[str] = None) -> bytes:
+                      session_id: Optional[str] = None,
+                      kind: int = 0, beam_width: int = 0,
+                      pooling: int = 0, allowed_ids=None) -> bytes:
     sess = (session_id or "").encode()
     if len(sess) > 0xFFFF:
         raise ValueError("session_id too long for wire format")
     ids = np.ascontiguousarray(np.asarray(prompt_ids, np.int32))
+    allow = np.ascontiguousarray(np.asarray(
+        [] if allowed_ids is None else allowed_ids, np.int32))
     return (_FLEET_SUBMIT_HDR.pack(item, max_new_tokens, float(temperature),
                                    int(top_k or 0),
-                                   -1 if eos_id is None else int(eos_id))
+                                   -1 if eos_id is None else int(eos_id),
+                                   int(kind), int(beam_width),
+                                   int(pooling))
+            + struct.pack("<I", allow.size) + allow.tobytes()
             + struct.pack("<H", len(sess)) + sess
             + struct.pack("<I", ids.size) + ids.tobytes())
 
 
 def unpack_fleet_submit(payload: bytes) -> dict:
-    item, max_new, temp, top_k, eos = _FLEET_SUBMIT_HDR.unpack_from(payload)
+    (item, max_new, temp, top_k, eos, kind, beam_width,
+     pooling) = _FLEET_SUBMIT_HDR.unpack_from(payload)
     off = _FLEET_SUBMIT_HDR.size
+    (na,) = struct.unpack_from("<I", payload, off)
+    off += 4
+    allow = np.frombuffer(payload, np.int32, count=na, offset=off).copy()
+    off += 4 * na
     (slen,) = struct.unpack_from("<H", payload, off)
     off += 2
     sess = payload[off:off + slen].decode()
@@ -138,16 +159,23 @@ def unpack_fleet_submit(payload: bytes) -> dict:
     return {"item": item, "prompt_ids": ids, "max_new_tokens": max_new,
             "temperature": temp, "top_k": top_k or None,
             "eos_id": None if eos == -1 else eos,
-            "session_id": sess or None}
+            "session_id": sess or None,
+            "kind": kind, "beam_width": beam_width, "pooling": pooling,
+            "allowed_ids": allow if na else None}
 
 
-def pack_fleet_result(item: int, token_ids, reason: str) -> bytes:
+def pack_fleet_result(item: int, token_ids, reason: str,
+                      kind: int = 0, floats=None) -> bytes:
     rb = reason.encode()
     if len(rb) > 0xFF:
         raise ValueError("finish reason too long for wire format")
     ids = np.ascontiguousarray(np.asarray(token_ids, np.int32))
+    fl = np.ascontiguousarray(np.asarray(
+        [] if floats is None else floats, np.float32))
     return (_FLEET_RESULT_HDR.pack(item, len(rb)) + rb
-            + struct.pack("<I", ids.size) + ids.tobytes())
+            + struct.pack("<B", int(kind))
+            + struct.pack("<I", ids.size) + ids.tobytes()
+            + struct.pack("<I", fl.size) + fl.tobytes())
 
 
 def unpack_fleet_result(payload: bytes) -> dict:
@@ -155,10 +183,18 @@ def unpack_fleet_result(payload: bytes) -> dict:
     off = _FLEET_RESULT_HDR.size
     reason = payload[off:off + rlen].decode()
     off += rlen
+    (kind,) = struct.unpack_from("<B", payload, off)
+    off += 1
     (n,) = struct.unpack_from("<I", payload, off)
     off += 4
     ids = np.frombuffer(payload, np.int32, count=n, offset=off).copy()
-    return {"item": item, "token_ids": ids, "reason": reason}
+    off += 4 * n
+    (nf,) = struct.unpack_from("<I", payload, off)
+    off += 4
+    floats = np.frombuffer(payload, np.float32, count=nf,
+                           offset=off).copy()
+    return {"item": item, "token_ids": ids, "reason": reason,
+            "kind": kind, "floats": floats}
 
 
 def send_frame(conn: socket.socket, kind: int, payload: bytes = b""):

@@ -58,6 +58,18 @@ judges all k in one dispatch, rejected pages roll back via
 decode. Losers fall back silently, counted in
 ``dl4j_autotune_promotions_total``.
 
+The multi-workload request plane (ISSUE 20) makes the scheduler a
+multi-tenant front door: ``submit(kind=...)`` types every request as
+GENERATE, SCORE (prefill-only per-token logprobs + perplexity), EMBED
+(pooled post-``ln_f`` hidden state), BEAM (width-k beam search whose
+beams CoW-share the prompt's pages) or CONSTRAINED (token-mask
+decoding through a pre-warmed masked sampler — zero retraces). The
+:class:`RequestKind` enum rides the fleet SUBMIT frame as one wire
+byte, results come back as :class:`ScoreResult` /
+:class:`EmbedResult` / :class:`BeamResult`, and the
+``dl4j_workload_*`` counters + per-kind SLO goodput
+(``slo_report.py``) account each kind separately.
+
 Quickstart: ``zoo.transformer.generate(params, cfg, ids, 32)`` for a
 one-shot, or README "Serving quickstart" for the scheduler loop and
 "Fleet quickstart" for the router.
@@ -81,18 +93,21 @@ from .spec import (EngineDraft, NgramDraft,  # noqa: F401
                    SpeculativeDecoder, race_spec)
 from .traffic import (Arrival, EpisodeReport, TrafficConfig,  # noqa: F401
                       poisson_arrivals, run_episode)
+from .workloads import (BeamResult, EmbedResult, RequestKind,  # noqa: F401
+                        ScoreResult, vocab_mask)
 
 __all__ = [
-    "Arrival", "Autoscaler", "AutoscalerConfig",
+    "Arrival", "Autoscaler", "AutoscalerConfig", "BeamResult",
     "ContinuousBatchingScheduler", "DEFAULT_PAGE_LEN",
-    "DEFAULT_PREFILL_BUCKETS", "DEFAULT_PREFILL_CHUNK", "EngineDraft",
-    "EpisodeReport", "FleetResult", "FleetRouter",
+    "DEFAULT_PREFILL_BUCKETS", "DEFAULT_PREFILL_CHUNK", "EmbedResult",
+    "EngineDraft", "EpisodeReport", "FleetResult", "FleetRouter",
     "FunctionalInferenceModel", "GenerationEngine", "GenerationResult",
     "InProcessReplica", "NgramDraft", "PageTable", "PrefixCache",
-    "SLOConfig", "SLOTracker", "ServingRequest", "SpeculativeDecoder",
-    "TrafficConfig", "cache_len", "cache_nbytes", "cache_slots",
-    "decide_kv", "decide_weights", "init_cache", "init_paged_cache",
-    "is_paged", "is_quantized", "page_nbytes", "poisson_arrivals",
-    "quantize_rows", "quantized_params", "race_kv", "race_spec",
-    "race_weights", "run_episode", "sample_tokens", "token_nbytes",
+    "RequestKind", "SLOConfig", "SLOTracker", "ScoreResult",
+    "ServingRequest", "SpeculativeDecoder", "TrafficConfig",
+    "cache_len", "cache_nbytes", "cache_slots", "decide_kv",
+    "decide_weights", "init_cache", "init_paged_cache", "is_paged",
+    "is_quantized", "page_nbytes", "poisson_arrivals", "quantize_rows",
+    "quantized_params", "race_kv", "race_spec", "race_weights",
+    "run_episode", "sample_tokens", "token_nbytes", "vocab_mask",
 ]

@@ -74,6 +74,17 @@ def sample_tokens(key, logits, temperature, top_k):
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+def sample_tokens_masked(key, logits, temperature, top_k, mask):
+    """CONSTRAINED-decoding sampler (ISSUE 20): masked logits through
+    the SAME ``sample_tokens`` body. ``mask`` is (B, V) bool — a
+    disallowed token's logit drops to the mask floor BEFORE the top-k
+    threshold and the greedy argmax, so every sampled (or greedy)
+    token lies inside the mask. An all-true mask is the identity:
+    bit-identical to ``sample_tokens`` on the same operands."""
+    masked = jnp.where(mask, logits.astype(jnp.float32), _NEG_INF)
+    return sample_tokens(key, masked, temperature, top_k)
+
+
 def _wload(blk, name, dt):
     """One layer weight in compute dtype. A quantized block stack
     (ISSUE 19, ``serving.quant.quantized_params``) stores int8 values
@@ -211,10 +222,22 @@ class GenerationEngine:
         self._copy_page = CompileSentinel(
             "copy_page", jax.jit(self._copy_page_raw,
                                  donate_argnums=(0,)))
+        # multi-workload request plane (ISSUE 20): the EMBED hidden-row
+        # chunk and the CONSTRAINED masked sampler — same bodies as
+        # their unmasked/logit siblings, pre-warmed by the scheduler so
+        # a new workload never retraces mid-serve
+        self._embed_chunk = CompileSentinel(
+            "embed_chunk",
+            jax.jit(functools.partial(self._prefill_chunk_raw,
+                                      return_hidden=True),
+                    donate_argnums=(1,)))
+        self._sample_masked = CompileSentinel(
+            "sample_tokens_masked", jax.jit(sample_tokens_masked))
         self.sentinels = {s.name: s for s in (
             self._decode, self._prefill, self._prefill_slot, self._sample,
             self._decode_paged, self._decode_paged_kernel,
-            self._prefill_chunk, self._verify_chunk, self._copy_page)}
+            self._prefill_chunk, self._verify_chunk, self._copy_page,
+            self._embed_chunk, self._sample_masked)}
 
     # ------------------------------------------------------------ cache
     def init_cache(self, n_slots: int):
@@ -488,7 +511,7 @@ class GenerationEngine:
         return logits, dict(kv, pos=pos + 1, pages=table)
 
     def _prefill_chunk_raw(self, params, cache, tokens, start, length,
-                           slot, all_logits=False):
+                           slot, all_logits=False, return_hidden=False):
         """One chunked-prefill dispatch (ISSUE 14): tokens (1, C_bucket)
         — the slot's context rows ``[start, start+length)`` padded to a
         chunk bucket — written into the slot's mapped pages, with the
@@ -568,7 +591,13 @@ class GenerationEngine:
 
         x, kv = self._blocks_with_cache(params, cache, x,
                                         write=write, attend=attend)
-        if all_logits:
+        if return_hidden:
+            # EMBED variant (ISSUE 20): the post-ln_f hidden rows the
+            # pooling reduces host-side — (C_bucket, d) f32, rows past
+            # ``length`` garbage the caller slices off. No head matmul:
+            # an embedding request never needs the (C, V) logits.
+            logits = tfm.hidden_rows(params, cfg, x)
+        elif all_logits:
             logits = tfm.head_logits_rows(params, cfg, x)    # (C, V)
         else:
             x_last = x[jnp.clip(length - 1, 0, c - 1)]
@@ -756,6 +785,33 @@ class GenerationEngine:
                                   jnp.asarray(padded), jnp.int32(start),
                                   jnp.int32(n), jnp.int32(slot))
 
+    def embed_chunk(self, cache, tokens, slot: int, start: int = 0):
+        """EMBED workload chunk (ISSUE 20): the ``prefill_chunk`` body
+        with the head swapped for the post-``ln_f`` hidden rows —
+        returns ``((C_bucket, d) f32 hidden rows, cache)``; rows past
+        ``len(tokens)`` are padding garbage the caller slices off. KV
+        rows are written into the slot's mapped pages exactly like a
+        prefill chunk (same bucketing, ≤ 1 compile per bucket)."""
+        if not kvcache.is_paged(cache):
+            raise ValueError("embed_chunk needs a paged cache "
+                             "(init_paged_cache)")
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = tokens.shape[0]
+        if n < 1:
+            raise ValueError("empty chunk")
+        if n > self.chunk_len:
+            raise ValueError(f"chunk of {n} tokens exceeds chunk_len="
+                             f"{self.chunk_len}")
+        if start + n > self.max_len:
+            raise ValueError(f"chunk ends at {start + n}, past cache "
+                             f"capacity max_len={self.max_len}")
+        bucket = next(b for b in self.chunk_buckets if b >= n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = tokens
+        return self._embed_chunk(self.params, cache, jnp.asarray(padded),
+                                 jnp.int32(start), jnp.int32(n),
+                                 jnp.int32(slot))
+
     def sample(self, key, logits, temperature=0.0, top_k=0):
         """Next tokens from (B, V) logits; scalar knobs broadcast to the
         pool, vectors give per-slot control."""
@@ -764,6 +820,21 @@ class GenerationEngine:
             jnp.asarray(temperature, jnp.float32), (bsz,))
         top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (bsz,))
         return self._sample(key, logits, temperature, top_k)
+
+    def sample_masked(self, key, logits, temperature=0.0, top_k=0,
+                      mask=None):
+        """CONSTRAINED-decoding sampler (ISSUE 20): ``mask`` (B, V) or
+        (V,) bool — True admits the token. ``mask=None`` falls through
+        to the plain sampler (same compiled fn GENERATE uses)."""
+        if mask is None:
+            return self.sample(key, logits, temperature, top_k)
+        bsz = logits.shape[0]
+        temperature = jnp.broadcast_to(
+            jnp.asarray(temperature, jnp.float32), (bsz,))
+        top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (bsz,))
+        mask = jnp.broadcast_to(jnp.asarray(mask, bool),
+                                (bsz, logits.shape[-1]))
+        return self._sample_masked(key, logits, temperature, top_k, mask)
 
     def generate(self, prompt_ids, max_new_tokens=32, *, key=None,
                  temperature=0.0, top_k=0, eos_id=None):

@@ -55,20 +55,32 @@ from ..parallel.leases import RequestLeaseTable
 from ..parallel.transport import (KIND_FLEET_RESULT, KIND_FLEET_SUBMIT,
                                   pack_fleet_result, pack_fleet_submit,
                                   unpack_fleet_result, unpack_fleet_submit)
+from . import workloads
 from .engine import GenerationEngine
 from .scheduler import ContinuousBatchingScheduler
+from .workloads import (BeamResult, EmbedResult, RequestKind, ScoreResult,
+                        WIRE_POOLING)
 
 
 @dataclass
 class FleetResult:
-    """What a fleet caller's future resolves to."""
+    """What a fleet caller's future resolves to. The typed request
+    plane (ISSUE 20) rides the same frame for every kind — ``kind``
+    says which of the per-kind payload fields is populated:
+    ``logprobs`` (SCORE, the per-token logprob vector), ``embedding``
+    (EMBED, the pooled hidden state) or ``best_logprob`` (BEAM, the
+    winning hypothesis' total logprob — its ids are ``tokens``)."""
     tokens: np.ndarray          # generated ids, prompt excluded
-    finish_reason: str          # "eos" | "length"
+    finish_reason: str          # "eos" | "length" | "complete"
     item: int                   # lease item id
     replica: str                # label of the replica that COMPLETED it
     reprefills: int             # times the lease moved (replica deaths)
     ttft_s: Optional[float]
     latency_s: float
+    kind: str = "generate"      # RequestKind value string
+    logprobs: Optional[np.ndarray] = None       # SCORE
+    embedding: Optional[np.ndarray] = None      # EMBED
+    best_logprob: Optional[float] = None        # BEAM
 
 
 @dataclass(frozen=True)
@@ -160,20 +172,44 @@ class InProcessReplica:
         if kind != KIND_FLEET_SUBMIT:
             raise ValueError(f"replica cannot serve frame kind {kind}")
         sub = unpack_fleet_submit(payload)
+        kind = RequestKind.coerce(sub["kind"])
         # session retention needs the prefix cache; without it the
         # session id still steered AFFINITY router-side, which is all
         # a dense replica can honour
         sid = sub["session_id"] if getattr(
             self.scheduler, "_prefix", None) is not None else None
+        kwargs: Dict[str, Any] = {}
+        if kind is RequestKind.BEAM:
+            kwargs["beam_width"] = sub["beam_width"]
+        elif kind is RequestKind.EMBED:
+            kwargs["pooling"] = WIRE_POOLING[sub["pooling"]]
+        elif kind is RequestKind.CONSTRAINED:
+            # the wire carries a fixed allowlist — grammar callbacks
+            # cannot cross a socket, so the frame's mask vocabulary is
+            # exactly vocab_mask (rebuilt replica-side against THIS
+            # engine's vocab, which also re-validates the ids)
+            kwargs["token_mask"] = workloads.vocab_mask(
+                sub["allowed_ids"], int(self.engine.cfg.vocab_size))
         return self.scheduler.submit(
             sub["prompt_ids"], sub["max_new_tokens"],
             temperature=sub["temperature"], top_k=sub["top_k"] or 0,
-            eos_id=sub["eos_id"], session_id=sid)
+            eos_id=sub["eos_id"], session_id=sid, kind=kind, **kwargs)
 
     @staticmethod
     def result_frame(item: int, result) -> Tuple[int, bytes]:
+        """Pack any kind's result into ONE wire shape: ids + reason +
+        kind byte + a per-kind float vector (SCORE's logprobs, EMBED's
+        embedding, BEAM's best total logprob)."""
+        kind, floats = RequestKind.GENERATE, None
+        if isinstance(result, ScoreResult):
+            kind, floats = RequestKind.SCORE, result.logprobs
+        elif isinstance(result, EmbedResult):
+            kind, floats = RequestKind.EMBED, result.embedding
+        elif isinstance(result, BeamResult):
+            kind, floats = RequestKind.BEAM, [result.best_logprob]
         return KIND_FLEET_RESULT, pack_fleet_result(
-            item, result.tokens, result.finish_reason)
+            item, result.tokens, result.finish_reason,
+            kind=kind.wire, floats=floats)
 
     # ------------------------------------------------------ signals
     def burn_rate(self) -> Optional[float]:
@@ -213,6 +249,7 @@ class _Outstanding:
     replica_future: Optional[Future] = None
     reprefills: int = 0
     routed_reason: str = ""
+    kind: str = "generate"      # RequestKind value (ISSUE 20)
 
 
 class FleetRouter:
@@ -350,12 +387,33 @@ class FleetRouter:
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                temperature: float = 0.0, top_k: int = 0,
                eos_id: Optional[int] = None,
-               session_id: Optional[str] = None) -> Future:
-        """Lease + route one generation request; returns a Future
-        resolving to a :class:`FleetResult`."""
+               session_id: Optional[str] = None,
+               kind=RequestKind.GENERATE, beam_width: int = 0,
+               pooling: str = "mean",
+               allowed_ids=None) -> Future:
+        """Lease + route one typed serving request (ISSUE 20); returns
+        a Future resolving to a :class:`FleetResult` whose per-kind
+        payload field matches ``kind``. CONSTRAINED over the wire is
+        allowlist-only — ``allowed_ids`` packs into the frame and the
+        replica rebuilds the vocab mask; grammar-step callbacks cannot
+        cross a socket boundary (use the scheduler API directly for
+        those). A kind survives replica death unchanged: the packed
+        frame is re-sent verbatim, so the re-prefilled request is the
+        same typed request."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
+        kind = RequestKind.coerce(kind)
+        if kind is RequestKind.CONSTRAINED and allowed_ids is None:
+            raise ValueError(
+                "fleet constrained decoding needs allowed_ids (fixed "
+                "allowlist; callbacks cannot cross the wire)")
+        if allowed_ids is not None and kind is not RequestKind.CONSTRAINED:
+            raise ValueError("allowed_ids is a CONSTRAINED knob "
+                             f"(got kind={kind.value!r})")
+        if pooling not in workloads.POOLING_WIRE:
+            raise ValueError(f"unknown pooling {pooling!r}; expected "
+                             f"one of {sorted(workloads.POOLING_WIRE)}")
         with self._lock:
             live = self._live_locked()
             if not live:
@@ -363,19 +421,25 @@ class FleetRouter:
             # validate against the engine contract BEFORE creating the
             # lease, so a rejected request never dangles in the table
             max_len = live[0].engine.max_len
-            if prompt.size + max_new_tokens - 1 > max_len:
+            total = prompt.size if kind in (
+                RequestKind.SCORE, RequestKind.EMBED) \
+                else prompt.size + max_new_tokens - 1
+            if total > max_len:
                 raise ValueError(
-                    f"prompt ({prompt.size}) + max_new_tokens "
-                    f"({max_new_tokens}) - 1 exceeds max_len={max_len}")
+                    f"prompt ({prompt.size}) + budget = {total} "
+                    f"exceeds max_len={max_len}")
             item = self.leases.add()
             payload = pack_fleet_submit(
                 item, prompt, max_new_tokens, temperature, top_k,
-                eos_id, session_id)
+                eos_id, session_id, kind=kind.wire,
+                beam_width=int(beam_width),
+                pooling=workloads.POOLING_WIRE[pooling],
+                allowed_ids=allowed_ids)
             rec = _Outstanding(
                 item=item, payload=payload, caller=Future(),
                 session_id=session_id,
                 prefix_key=prompt[:self.affinity_prefix_len].tobytes(),
-                submitted_ts=time.perf_counter())
+                submitted_ts=time.perf_counter(), kind=kind.value)
             self.outstanding[item] = rec
             self._m()["requests"].inc()
             self._route_locked(rec)
@@ -501,12 +565,26 @@ class FleetRouter:
                     m["ghosts"].inc()
                     continue
                 self.outstanding.pop(rec.item, None)
+                # per-kind float payload (ISSUE 20): the frame's kind
+                # byte says how to read the vector; the ROUTER's record
+                # names the caller-facing kind (a CONSTRAINED result
+                # rides a generate-shaped frame)
+                wire_kind = RequestKind.coerce(out["kind"])
+                fl = out["floats"]
                 result = FleetResult(
                     tokens=out["token_ids"],
                     finish_reason=out["reason"], item=rec.item,
                     replica=f"r{rec.rid}", reprefills=rec.reprefills,
                     ttft_s=res.ttft_s,
-                    latency_s=time.perf_counter() - rec.submitted_ts)
+                    latency_s=time.perf_counter() - rec.submitted_ts,
+                    kind=rec.kind,
+                    logprobs=fl if wire_kind is RequestKind.SCORE
+                    else None,
+                    embedding=fl if wire_kind is RequestKind.EMBED
+                    else None,
+                    best_logprob=float(fl[0])
+                    if wire_kind is RequestKind.BEAM and fl.size
+                    else None)
             try:
                 rec.caller.set_result(result)
             except Exception:   # noqa: BLE001 — caller cancelled

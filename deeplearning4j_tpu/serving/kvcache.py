@@ -302,6 +302,13 @@ class PageTable:
         """Tokens the slot's mapped pages can hold right now."""
         return int(self.mapped[slot]) * self.page_len
 
+    def slot_pages(self, slot: int) -> List[int]:
+        """The pool pages ``slot`` currently maps, in logical order —
+        the list ``map_shared`` accepts, so a beam clone (ISSUE 20) or
+        a prefix-cache insert reads a slot's mapping through one
+        accessor instead of poking ``table``/``mapped`` directly."""
+        return [int(p) for p in self.table[slot, :int(self.mapped[slot])]]
+
     # -------------------------------------------------------- mapping
     def can_map(self, slot: int, tokens: int) -> bool:
         need = self.pages_for(tokens) - int(self.mapped[slot])

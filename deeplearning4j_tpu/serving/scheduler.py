@@ -78,7 +78,7 @@ import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
-from typing import List, Optional, Union
+from typing import Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -86,8 +86,10 @@ import numpy as np
 
 from ..obs import (FlightRecorder, RequestTrace, SLOConfig, SLOTracker,
                    get_registry, span)
-from . import kvcache
+from . import kvcache, workloads
 from .engine import GenerationEngine
+from .workloads import (BeamResult, BeamState, EmbedResult, RequestKind,
+                        ScoreResult)
 
 
 @dataclass
@@ -128,6 +130,17 @@ class ServingRequest:
     # admission skipped via shared resident pages
     session_id: Optional[str] = None
     prefix_matched: int = 0
+    # multi-workload plane (ISSUE 20): the typed-request knobs and the
+    # per-kind in-flight state the scheduler accumulates host-side
+    kind: RequestKind = RequestKind.GENERATE
+    beam_width: int = 0
+    pooling: str = "mean"
+    token_mask: Optional[workloads.TokenMask] = None
+    beam: Optional[BeamState] = None
+    score_lps: List[float] = field(default_factory=list)
+    embed_acc: Optional[np.ndarray] = None
+    embed_last: Optional[np.ndarray] = None
+    released_pages: int = 0
 
     def context(self) -> np.ndarray:
         """Token ids to prefill on (re-)admission: the original prompt
@@ -138,7 +151,9 @@ class ServingRequest:
             [self.prompt, np.asarray(self.generated, np.int32)])
 
     def remaining(self) -> int:
-        return self.max_new_tokens - len(self.generated)
+        done = (self.beam.progress() if self.beam is not None
+                else len(self.generated))
+        return self.max_new_tokens - done
 
 
 class ContinuousBatchingScheduler:
@@ -243,6 +258,18 @@ class ContinuousBatchingScheduler:
             # a semantic no-op): the first real split may land after
             # mark_warm(), and it must not count as a retrace
             self.cache = engine.copy_page(self.cache, 0, 0)
+        if hasattr(engine, "sample_masked"):
+            # CONSTRAINED decoding (ISSUE 20): warm the masked sampler
+            # for both sampling shapes — the pool sweep (n_slots, V)
+            # and the admission first-token (1, V) — so the first
+            # grammar step after mark_warm() is never a retrace
+            vocab = int(engine.cfg.vocab_size)
+            wkey = jax.random.PRNGKey(0)
+            for b in {self.n_slots, 1}:
+                engine.sample_masked(
+                    wkey, jnp.zeros((b, vocab), jnp.float32),
+                    np.zeros((b,), np.float32), np.zeros((b,), np.int32),
+                    np.ones((b, vocab), bool))
         # memory plane (ISSUE 12/14): allocated bytes are static under
         # dense slotting (slots × max_len) and MAPPED-page bytes under
         # paging; resident bytes follow the per-slot token counts the
@@ -261,6 +288,12 @@ class ContinuousBatchingScheduler:
         # the ≥2×-concurrency-at-equal-bytes evidence the paged bench
         # row reports (ISSUE 14)
         self._peak_active = 0
+        # last-published per-kind active census (ISSUE 20): the gauge
+        # write is the expensive half, so snapshots publish deltas only
+        # — the steady single-kind serve pays ~0 sets/step, not 5,
+        # which keeps the census inside the <2% bookkeeping budget
+        self._kind_census_pub: Dict[str, int] = {}
+        self._kv_pub_alloc: Optional[float] = None   # last published
         self.slots: List[Optional[ServingRequest]] = [None] * self.n_slots
         self._queue: deque = deque()
         self._draining = False      # drain(): admission gate (ISSUE 18)
@@ -331,6 +364,28 @@ class ContinuousBatchingScheduler:
             "tokens": reg.counter(
                 "dl4j_serving_tokens_total",
                 "Tokens generated across all requests"),
+            # multi-workload census (ISSUE 20): the same request flow,
+            # broken down by RequestKind — capacity planning reads
+            # these to see WHAT the pool serves, not just how much
+            "wl_requests": reg.counter(
+                "dl4j_workload_requests_total",
+                "Requests submitted, by workload kind",
+                labelnames=("kind",)),
+            "wl_completions": reg.counter(
+                "dl4j_workload_completions_total",
+                "Requests completed (finish path), by workload kind",
+                labelnames=("kind",)),
+            "wl_tokens": reg.counter(
+                "dl4j_workload_tokens_total",
+                "Tokens processed per workload kind: generated tokens "
+                "for generate/constrained, beam candidates for beam, "
+                "prompt tokens scored/pooled for score/embed",
+                labelnames=("kind",)),
+            "active_kind": reg.gauge(
+                "dl4j_serving_active_requests",
+                "Admitted in-flight requests at the last snapshot, by "
+                "workload kind (a beam group counts once)",
+                labelnames=("replica", "kind")),
             "occupancy": reg.gauge(
                 "dl4j_serving_slot_occupancy",
                 "Active slots / pool size at the last decode sweep "
@@ -439,11 +494,33 @@ class ContinuousBatchingScheduler:
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                temperature: float = 0.0, top_k: int = 0,
                eos_id: Optional[int] = None,
-               session_id: Optional[str] = None) -> Future:
-        """Queue a generation request; returns a Future resolving to a
-        :class:`GenerationResult`. Rejects requests that could never fit
-        a slot (prompt + budget beyond the cache's ``max_len``) up
-        front — admission never has to partially honour a request.
+               session_id: Optional[str] = None,
+               kind=RequestKind.GENERATE, beam_width: int = 0,
+               pooling: str = "mean", token_mask=None,
+               **extra) -> Future:
+        """Queue a typed serving request; returns a Future resolving to
+        a :class:`GenerationResult` (GENERATE / CONSTRAINED), a
+        :class:`~.workloads.ScoreResult` (SCORE), an
+        :class:`~.workloads.EmbedResult` (EMBED) or a
+        :class:`~.workloads.BeamResult` (BEAM). Everything that could
+        never run — malformed prompts, unknown kwargs, knobs on the
+        wrong kind, capacity overruns — fails HERE with a ValueError,
+        so admission never has to partially honour a request.
+
+        Kinds (ISSUE 20; ``kind`` accepts the enum, its string value,
+        or the fleet wire byte):
+
+        - ``GENERATE`` — the classic continuation path, unchanged;
+        - ``SCORE`` — prefill-only per-token logprobs + perplexity of
+          the prompt itself (paged pool; ``max_new_tokens`` ignored);
+        - ``EMBED`` — pooled post-``ln_f`` hidden state of the prompt
+          (``pooling``: "mean" | "last"; paged pool; prefill-only);
+        - ``BEAM`` — width-``beam_width`` (default 4) beam search;
+          needs ``beam_width`` free lanes and the paged pool, where the
+          beams share the prompt's pages copy-on-write;
+        - ``CONSTRAINED`` — ``token_mask`` gates every sampled token:
+          a fixed (V,) bool allow-array or a callback
+          ``step(generated_ids) -> (V,) bool`` (grammar stepping).
 
         ``session_id`` (ISSUE 16, needs ``prefix_cache=True``) threads a
         multi-turn conversation: at finish the request's written pages
@@ -452,26 +529,107 @@ class ContinuousBatchingScheduler:
         re-prefilling the history — the new turn's delta becomes
         append-only. Each turn's retention supersedes the last;
         :meth:`drop_session` releases it explicitly."""
-        if session_id is not None and self._prefix is None:
-            raise ValueError("session_id needs prefix_cache=True (and "
-                             "the paged pool)")
-        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if extra:
+            raise ValueError(
+                f"submit() got unknown keyword argument(s) "
+                f"{sorted(extra)}; valid: temperature, top_k, eos_id, "
+                "session_id, kind, beam_width, pooling, token_mask")
+        kind = RequestKind.coerce(kind)
+        raw = np.asarray(prompt_ids)
+        if raw.size and not np.issubdtype(raw.dtype, np.integer):
+            raise ValueError("prompt_ids must be integer token ids "
+                             f"(got dtype {raw.dtype})")
+        prompt = raw.reshape(-1).astype(np.int32)
         if prompt.size < 1:
             raise ValueError("empty prompt")
+        vocab = int(self.engine.cfg.vocab_size)
+        if int(prompt.min()) < 0 or int(prompt.max()) >= vocab:
+            raise ValueError(
+                f"prompt ids outside the vocabulary [0, {vocab})")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        total = prompt.size + max_new_tokens - 1
+        # knobs on the wrong kind fail loudly rather than silently
+        # doing nothing — the typed plane's whole point
+        if beam_width and kind is not RequestKind.BEAM:
+            raise ValueError("beam_width is a BEAM knob "
+                             f"(got kind={kind.value!r})")
+        if token_mask is not None and kind is not RequestKind.CONSTRAINED:
+            raise ValueError("token_mask is a CONSTRAINED knob "
+                             f"(got kind={kind.value!r})")
+        if pooling != "mean" and kind is not RequestKind.EMBED:
+            raise ValueError("pooling is an EMBED knob "
+                             f"(got kind={kind.value!r})")
+        if session_id is not None:
+            if self._prefix is None:
+                raise ValueError("session_id needs prefix_cache=True "
+                                 "(and the paged pool)")
+            if kind not in (RequestKind.GENERATE,
+                            RequestKind.CONSTRAINED):
+                raise ValueError("session_id threads multi-turn "
+                                 "generate/constrained requests only "
+                                 f"(got kind={kind.value!r})")
+        if kind in (RequestKind.SCORE, RequestKind.EMBED,
+                    RequestKind.BEAM) and not self.paged:
+            raise ValueError(f"{kind.value} requests need the paged "
+                             "pool (pass page_len and/or n_pages)")
+        if kind is RequestKind.SCORE and prompt.size < 2:
+            raise ValueError("scoring needs at least 2 tokens "
+                             "(position 0 is unconditional)")
+        if kind is RequestKind.EMBED \
+                and pooling not in workloads.POOLING_WIRE:
+            raise ValueError(f"unknown pooling {pooling!r}; expected "
+                             f"one of {sorted(workloads.POOLING_WIRE)}")
+        if kind is RequestKind.CONSTRAINED:
+            if token_mask is None:
+                raise ValueError("constrained decoding needs "
+                                 "token_mask (array or callback)")
+            if not callable(token_mask):
+                # validate + normalize fixed masks once, at the edge
+                token_mask = workloads.resolve_mask(token_mask, [],
+                                                    vocab)
+        if kind is RequestKind.BEAM:
+            beam_width = int(beam_width) or 4
+            if not 1 <= beam_width <= self.n_slots:
+                raise ValueError(
+                    f"beam_width {beam_width} outside "
+                    f"[1, n_slots={self.n_slots}] — the whole group "
+                    "admits together")
+            if temperature > 0 or top_k > 0:
+                raise ValueError("beam search ranks exact log-probs; "
+                                 "temperature/top_k do not apply")
+        else:
+            beam_width = 0
+        if kind in (RequestKind.SCORE, RequestKind.EMBED):
+            # prefill-only: the request retires at its final chunk and
+            # every prompt row's k/v is written (capacity = prompt)
+            max_new_tokens = 1
+            total = int(prompt.size)
+        else:
+            total = prompt.size + max_new_tokens - 1
         if total > self.engine.max_len:
             raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens "
-                f"({max_new_tokens}) - 1 = {total} exceeds the slot "
-                f"capacity max_len={self.engine.max_len}")
-        if self.paged and self._pages.pages_for(total) > self._pages.n_pages:
-            raise ValueError(
-                f"request needs {self._pages.pages_for(total)} pages "
-                f"({total} tokens at page_len={self._pages.page_len}) "
-                f"but the pool holds {self._pages.n_pages} — it could "
-                "never run even alone")
+                f"prompt ({prompt.size}) + budget = {total} exceeds "
+                f"the slot capacity max_len={self.engine.max_len}")
+        if self.paged:
+            full = self._pages.pages_for(total)
+            if kind is RequestKind.BEAM:
+                # fan-out feasibility: the prompt's FULL pages are
+                # shared (one copy across the group), only the
+                # divergent tail is per-beam
+                shr = prompt.size // self._pages.page_len
+                need = shr + beam_width * (full - shr)
+                if need > self._pages.n_pages:
+                    raise ValueError(
+                        f"beam fan-out needs {need} pages ({shr} "
+                        f"shared prefix + {beam_width} x {full - shr} "
+                        f"divergent) but the pool holds "
+                        f"{self._pages.n_pages}")
+            elif full > self._pages.n_pages:
+                raise ValueError(
+                    f"request needs {full} pages ({total} tokens at "
+                    f"page_len={self._pages.page_len}) but the pool "
+                    f"holds {self._pages.n_pages} — it could never "
+                    "run even alone")
         now = time.perf_counter()
         fut: Future = Future()
         with self._lock:
@@ -483,9 +641,14 @@ class ContinuousBatchingScheduler:
                 max_new_tokens=int(max_new_tokens),
                 temperature=float(temperature), top_k=int(top_k),
                 eos_id=eos_id, future=fut, submitted_ts=now,
-                queued_ts=now, session_id=session_id)
+                queued_ts=now, session_id=session_id, kind=kind,
+                beam_width=beam_width, pooling=pooling,
+                token_mask=token_mask)
+            if kind is RequestKind.BEAM:
+                req.beam = BeamState(width=beam_width)
             req.trace = RequestTrace(request_id=req.id,
-                                     replica=self.replica)
+                                     replica=self.replica,
+                                     kind=kind.value)
             req.trace.event("submit", ts=now,
                             prompt_tokens=int(prompt.size),
                             max_new_tokens=int(max_new_tokens))
@@ -494,6 +657,7 @@ class ContinuousBatchingScheduler:
             self._queue.append(req)
             m = self._m()
             m["requests"].inc()
+            m["wl_requests"].inc(kind=kind.value)
             m["queue_depth"].set(len(self._queue), replica=self.replica)
         return fut
 
@@ -555,6 +719,7 @@ class ContinuousBatchingScheduler:
                             * self._kv_token_bytes)
                         self._kv_last_resident = resident
                         self._kv_last_alloc = alloc
+                        self._kv_pub_alloc = alloc
                     m["kv_alloc"].set(float(alloc), replica=self.replica)
                     m["kv_res"].set(float(resident),
                                     replica=self.replica)
@@ -569,6 +734,8 @@ class ContinuousBatchingScheduler:
                     m["kv_res"].set(0.0, replica=self.replica)
                     if self.paged:
                         m["kv_alloc"].set(0.0, replica=self.replica)
+                        with self._lock:
+                            self._kv_pub_alloc = 0
                         m["kv_waste"].set(0.0, replica=self.replica)
                     else:
                         m["kv_waste"].set(1.0, replica=self.replica)
@@ -633,8 +800,12 @@ class ContinuousBatchingScheduler:
         with self._lock:
             slot_ids = [None if r is None else r.id for r in self.slots]
             queued_ids = [r.id for r in self._queue]
-            doomed = [r for r in self.slots if r is not None] + \
-                list(self._queue)
+            doomed, seen = [], set()
+            for r in list(self.slots) + list(self._queue):
+                # a beam group occupies several lanes — fail it ONCE
+                if r is not None and r.id not in seen:
+                    seen.add(r.id)
+                    doomed.append(r)
             self.slots = [None] * self.n_slots
             self._queue.clear()
             if self.paged:      # dead pool leaks no pages
@@ -724,7 +895,10 @@ class ContinuousBatchingScheduler:
         Without the prefix cache this degenerates to the PR 14
         first-chunk page count."""
         ctx_len = req.prompt.size + len(req.generated)
-        if self._prefix is None:
+        if self._prefix is None or req.kind in (RequestKind.SCORE,
+                                                RequestKind.EMBED):
+            # SCORE needs every position's logits and EMBED every
+            # position's hidden row — a prefix hit would skip them
             return [], 0, self._pages.pages_for(
                 min(ctx_len, self.engine.chunk_len))
         ctx = req.context()
@@ -757,10 +931,26 @@ class ContinuousBatchingScheduler:
         ``_lock``): free the lane, return its pages to the pool, reset
         any mid-prefill progress, and re-queue its context at the BACK
         (recompute preemption). Shared by the starvation guard and the
-        page-pressure path."""
+        page-pressure path. A beam request (ISSUE 20) preempts as a
+        GROUP — its lanes share pages and advance in lockstep, so
+        evicting one would orphan the joint ranking; the rerun restarts
+        from the prompt and, being greedy over exact log-probs,
+        reproduces the same hypotheses. Partial SCORE/EMBED tallies
+        reset too (re-admission re-prefills from position 0)."""
         victim = self.slots[victim_slot]
-        self.slots[victim_slot] = None
-        self._release_pages(victim_slot)
+        if victim.beam is not None:
+            for s in range(self.n_slots):
+                if self.slots[s] is victim:
+                    self.slots[s] = None
+                    self._release_pages(s)
+            victim.beam = BeamState(width=victim.beam_width)
+            victim.released_pages = 0
+        else:
+            self.slots[victim_slot] = None
+            self._release_pages(victim_slot)
+        victim.score_lps = []
+        victim.embed_acc = None
+        victim.embed_last = None
         victim.pending = None
         victim.done_tokens = 0
         victim.preemptions += 1
@@ -783,8 +973,7 @@ class ContinuousBatchingScheduler:
     def _slot_pages(self, slot: int) -> List[int]:
         """The slot's mapped pool pages in logical order (paged mode,
         caller holds ``_lock``)."""
-        return [int(self._pages.table[slot, j])
-                for j in range(int(self._pages.mapped[slot]))]
+        return self._pages.slot_pages(slot)
 
     def _retire_slot(self, slot: int, req: "ServingRequest") -> int:
         """Finish-path page retirement (caller holds ``_lock``): with
@@ -841,7 +1030,9 @@ class ContinuousBatchingScheduler:
         if victim_slot is None:
             return False
         victim = self.slots[victim_slot]
-        if victim.remaining() <= 0 or not victim.generated:
+        progress = (victim.beam.progress() if victim.beam is not None
+                    else len(victim.generated))
+        if victim.remaining() <= 0 or not progress:
             return False       # nothing to save / about to finish anyway
         self._preempt_slot(victim_slot, m)
         return True
@@ -853,74 +1044,88 @@ class ContinuousBatchingScheduler:
         cancelled while queued is dropped here — it never costs a
         prefill. Paged mode gates admission on PAGE availability too
         (the head's first chunk must fit the free list) — the pool
-        admits to actual token residency, not lane count."""
+        admits to actual token residency, not lane count. A BEAM head
+        (ISSUE 20) reserves its WHOLE group — ``beam_width`` lanes — in
+        one admission (the root lane prefills; the siblings stay empty
+        until the fan-out) or waits: FIFO holds either way."""
         out = []
         if self._draining:      # drain(): queued entries stay queued —
             return out          # they are handed back, not admitted
         reserved = 0            # pages promised to this batch's heads
-        for slot in self._free_slots():
-            admitted = False
-            while self._queue:
-                req = self._queue[0]
-                if self.paged:
-                    shared, matched, need = self._admission_plan(req)
+        while self._queue:
+            req = self._queue[0]
+            lanes = req.beam_width if req.kind is RequestKind.BEAM \
+                else 1
+            free = self._free_slots()
+            if len(free) < lanes:
+                break           # FIFO holds: the head cannot get lanes
+            shared: List[int] = []
+            matched = need = 0
+            if self.paged:
+                shared, matched, need = self._admission_plan(req)
+                if need > self._pages.free_pages - reserved:
+                    # LRU-evict cold cached prefix pages BEFORE
+                    # refusing admission (ISSUE 16) — the pages the
+                    # head just matched are protected until mapped
+                    if self._prefix is not None:
+                        freed = self._prefix.evict(
+                            need - (self._pages.free_pages
+                                    - reserved),
+                            protect=frozenset(shared))
+                        if freed:
+                            m["kv_prefix_evictions"].inc(freed)
                     if need > self._pages.free_pages - reserved:
-                        # LRU-evict cold cached prefix pages BEFORE
-                        # refusing admission (ISSUE 16) — the pages the
-                        # head just matched are protected until mapped
-                        if self._prefix is not None:
-                            freed = self._prefix.evict(
-                                need - (self._pages.free_pages
-                                        - reserved),
-                                protect=frozenset(shared))
-                            if freed:
-                                m["kv_prefix_evictions"].inc(freed)
-                        if need > self._pages.free_pages - reserved:
-                            break   # FIFO holds: nothing admits past a
-                                    # head that cannot get pages
-                self._queue.popleft()
-                # fresh requests are PENDING → claim them (rejecting
-                # cancelled ones); a re-queued preemption victim is
-                # already RUNNING and must not be re-claimed
-                if not req.future.running() and \
-                        not req.future.set_running_or_notify_cancel():
-                    m["completions"].inc(reason="cancelled")
-                    self._close_trace(req, "cancel", m)
-                    continue
-                now = time.perf_counter()
-                m["queue_wait"].observe(now - req.queued_ts)
-                if req.trace is not None:
-                    req.trace.event("admit", ts=now, slot=slot)
-                if self.paged:
-                    req.pending = req.context()
-                    req.done_tokens = 0
-                    req.prefill_s = 0.0
-                    req.chunks = 0
-                    req.prefix_matched = 0
-                    if shared:
-                        # map the matched prefix NOW (same lock hold as
-                        # the plan — eviction cannot slip between):
-                        # those tokens never prefill, the tail chunks
-                        # start past them
-                        self._pages.map_shared(slot, shared)
-                        self._pages.note_fill(slot, matched)
-                        req.done_tokens = matched
-                        req.prefix_matched = matched
-                        self._prefix.note_hit(matched)
-                        m["kv_prefix_hits"].inc()
-                        m["kv_prefix_hit_tokens"].inc(matched)
-                        if req.trace is not None:
-                            req.trace.event(
-                                "prefix_hit", ts=now,
-                                matched_tokens=int(matched),
-                                shared_pages=len(shared))
-                    reserved += need
+                        break   # FIFO holds: nothing admits past a
+                                # head that cannot get pages
+            self._queue.popleft()
+            # fresh requests are PENDING → claim them (rejecting
+            # cancelled ones); a re-queued preemption victim is
+            # already RUNNING and must not be re-claimed
+            if not req.future.running() and \
+                    not req.future.set_running_or_notify_cancel():
+                m["completions"].inc(reason="cancelled")
+                self._close_trace(req, "cancel", m)
+                continue
+            slot = free[0]
+            now = time.perf_counter()
+            m["queue_wait"].observe(now - req.queued_ts)
+            if req.trace is not None:
+                req.trace.event("admit", ts=now, slot=slot)
+            if self.paged:
+                req.pending = req.context()
+                req.done_tokens = 0
+                req.prefill_s = 0.0
+                req.chunks = 0
+                req.prefix_matched = 0
+                if shared:
+                    # map the matched prefix NOW (same lock hold as
+                    # the plan — eviction cannot slip between):
+                    # those tokens never prefill, the tail chunks
+                    # start past them
+                    self._pages.map_shared(slot, shared)
+                    self._pages.note_fill(slot, matched)
+                    req.done_tokens = matched
+                    req.prefix_matched = matched
+                    self._prefix.note_hit(matched)
+                    m["kv_prefix_hits"].inc()
+                    m["kv_prefix_hit_tokens"].inc(matched)
+                    if req.trace is not None:
+                        req.trace.event(
+                            "prefix_hit", ts=now,
+                            matched_tokens=int(matched),
+                            shared_pages=len(shared))
+                reserved += need
+            if req.kind is RequestKind.BEAM:
+                # group reservation: every lane points at the one
+                # request; only the root (slots[0]) prefills
+                req.beam = BeamState(width=lanes,
+                                     slots=list(free[:lanes]))
+                req.released_pages = 0
+                for s in free[:lanes]:
+                    self.slots[s] = req
+            else:
                 self.slots[slot] = req        # reserve
-                out.append((slot, req))
-                admitted = True
-                break
-            if not admitted:
-                break
+            out.append((slot, req))
         return out
 
     def _admit_one(self, slot, req, m):
@@ -945,11 +1150,17 @@ class ContinuousBatchingScheduler:
         the chunk are mapped first; under page pressure the biggest-
         remaining active neighbour is preempted, and if the pool STILL
         cannot cover the chunk the prefilling request itself re-queues
-        (its turn comes back when pages free). The final chunk's logits
-        are the request's first token (TTFT)."""
+        (its turn comes back when pages free). The final chunk ends the
+        prefill phase per kind (ISSUE 20): GENERATE/CONSTRAINED sample
+        their first token (TTFT), SCORE/EMBED retire on the spot
+        (prefill IS the product), BEAM fans out into its group. A beam
+        group's sibling lanes never prefill — only the root works
+        here."""
         with self._lock:
             work = [(i, r) for i, r in enumerate(self.slots)
-                    if r is not None and r.pending is not None]
+                    if r is not None and r.pending is not None
+                    and (r.beam is None
+                         or (r.beam.slots and i == r.beam.slots[0]))]
         did = False
         for slot, req in work:
             with self._lock:
@@ -964,6 +1175,7 @@ class ContinuousBatchingScheduler:
                 # lock, copied on device outside it
                 cows = self._plan_cow(slot, done, done + n, m) \
                     if ok and self.slots[slot] is req else []
+                ok = ok and self.slots[slot] is req
             if not ok:
                 did = True      # a preemption shuffle IS work
                 continue
@@ -972,22 +1184,80 @@ class ContinuousBatchingScheduler:
                 self.cache = self.engine.copy_page(self.cache, src, dst)
             self.cache = self._pages.sync(self.cache)
             t0 = time.perf_counter()
-            with span("serving.prefill_chunk",
-                      attrs={"request": req.id, "slot": slot,
-                             "start": int(done), "tokens": int(n)}):
-                logits, self.cache = self.engine.prefill_chunk(
-                    self.cache, ctx[done:done + n], slot, start=done)
+            rows = logits = None
+            if req.kind is RequestKind.SCORE:
+                # verify_chunk returns EVERY row's logits (with the
+                # decode-side params, so quantized serving scores with
+                # the weights it decodes with)
+                with span("serving.score_chunk",
+                          attrs={"request": req.id, "slot": slot,
+                                 "start": int(done), "tokens": int(n)}):
+                    rows, self.cache = self.engine.verify_chunk(
+                        self.cache, ctx[done:done + n], slot,
+                        start=done)
+            elif req.kind is RequestKind.EMBED:
+                with span("serving.embed_chunk",
+                          attrs={"request": req.id, "slot": slot,
+                                 "start": int(done), "tokens": int(n)}):
+                    rows, self.cache = self.engine.embed_chunk(
+                        self.cache, ctx[done:done + n], slot,
+                        start=done)
+            else:
+                with span("serving.prefill_chunk",
+                          attrs={"request": req.id, "slot": slot,
+                                 "start": int(done), "tokens": int(n)}):
+                    logits, self.cache = self.engine.prefill_chunk(
+                        self.cache, ctx[done:done + n], slot,
+                        start=done)
+            elapsed = time.perf_counter() - t0
+            if req.kind is RequestKind.SCORE:
+                self._score_rows(req, ctx, done, n, rows)
+            elif req.kind is RequestKind.EMBED:
+                self._embed_rows(req, n, rows)
             with self._lock:
-                req.prefill_s += time.perf_counter() - t0
+                req.prefill_s += elapsed
                 req.chunks += 1
                 req.done_tokens = done + n
                 final = req.done_tokens >= len(ctx)
                 if final:
                     req.pending = None
             if final:
-                self._first_token(slot, req, logits, len(ctx),
-                                  req.prefill_s, m, chunks=req.chunks)
+                if req.kind in (RequestKind.SCORE, RequestKind.EMBED):
+                    self._finish_prefill_only(slot, req, m)
+                elif req.beam is not None:
+                    self._expand_beam(slot, req, logits, len(ctx),
+                                      req.prefill_s, m)
+                else:
+                    self._first_token(slot, req, logits, len(ctx),
+                                      req.prefill_s, m,
+                                      chunks=req.chunks)
         return did
+
+    @staticmethod
+    def _score_rows(req, ctx, done: int, n: int, rows):
+        """Fold one verify chunk's row logits into the running SCORE
+        tally: row i (global position ``done+i``) is the next-token
+        distribution after ``ctx[:done+i+1]``, so it scores
+        ``ctx[done+i+1]`` — the context's final row has no target and
+        is dropped. Host-side f32 log-softmax (one pass per chunk)."""
+        tgt = np.asarray(ctx[done + 1: done + n + 1], np.int64)
+        if not tgt.size:
+            return
+        lg = np.asarray(rows, np.float32)[:tgt.size]
+        mx = lg.max(axis=-1, keepdims=True)
+        lse = mx[:, 0] + np.log(np.exp(lg - mx).sum(axis=-1))
+        req.score_lps.extend(
+            (lg[np.arange(tgt.size), tgt] - lse).tolist())
+
+    @staticmethod
+    def _embed_rows(req, n: int, rows):
+        """Fold one embed chunk's hidden rows into the pooling
+        accumulators: a running sum for "mean", the newest valid row
+        for "last" (rows past ``n`` are bucket padding)."""
+        hid = np.asarray(rows, np.float32)[:n]
+        s = hid.sum(axis=0)
+        req.embed_acc = s if req.embed_acc is None else req.embed_acc + s
+        req.embed_last = hid[-1]
 
     def _ensure_pages(self, slot, req, tokens: int, m) -> bool:
         """Grow ``slot``'s mapping to cover ``tokens`` rows, preempting
@@ -1010,9 +1280,12 @@ class ContinuousBatchingScheduler:
         if self._try_map(slot, tokens, m):
             return True
         while True:
+            # a beam sibling (same request, different lane) is never a
+            # victim here — preempting it would preempt the WHOLE
+            # group, ``slot`` included (ISSUE 20)
             victim_slot = max(
                 (i for i, r in enumerate(self.slots)
-                 if r is not None and i != slot),
+                 if r is not None and i != slot and r is not req),
                 key=lambda i: (self.slots[i].pending is None,
                                -self.slots[i].done_tokens
                                if self.slots[i].pending is not None
@@ -1056,8 +1329,13 @@ class ContinuousBatchingScheduler:
         Starvation ladder when no free page exists for the split:
         evict cold cache, then transfer sole ownership (drop the cache
         holds on the contested page — the write is then private, no
-        copy needed), then preempt the other slot mapping it."""
-        if self._prefix is None or end <= start:
+        copy needed), then preempt the other slot mapping it.
+
+        Runs whenever the pool is paged — beam groups (ISSUE 20) share
+        pages WITHOUT the prefix cache, so the split logic cannot hide
+        behind it; the cache-only ladder rungs are skipped when there
+        is no cache."""
+        if not self.paged or end <= start:
             return []
         plen = self._pages.page_len
         copies = []
@@ -1071,16 +1349,18 @@ class ContinuousBatchingScheduler:
                 split = self._pages.cow(slot, j)
                 if split is not None:
                     copies.append(split)
-                    self._prefix.cow_copies += 1
+                    if self._prefix is not None:
+                        self._prefix.cow_copies += 1
                     m["kv_cow"].inc()
                     break
                 # no free page for the copy: reclaim, cheapest first
-                freed = self._prefix.evict(1)
-                if freed:
-                    m["kv_prefix_evictions"].inc(freed)
-                    continue
-                if self._prefix.release_page_holds(p):
-                    continue                   # may now be private
+                if self._prefix is not None:
+                    freed = self._prefix.evict(1)
+                    if freed:
+                        m["kv_prefix_evictions"].inc(freed)
+                        continue
+                    if self._prefix.release_page_holds(p):
+                        continue               # may now be private
                 other = next(
                     (i for i in range(self.n_slots)
                      if i != slot and self.slots[i] is not None
@@ -1090,6 +1370,10 @@ class ContinuousBatchingScheduler:
                 if other is None:              # cannot happen: refs
                     break                      # must come from somewhere
                 self._preempt_slot(other, m)
+                if self.slots[slot] is None:
+                    # ``other`` was a beam sibling: the group preempt
+                    # took this slot down with it — nothing to plan
+                    return copies
         return copies
 
     def _first_token(self, slot, req, logits, ctx_tokens: int,
@@ -1101,8 +1385,18 @@ class ContinuousBatchingScheduler:
         m["prefills"].inc()
         with self._lock:
             self._key, sub = jax.random.split(self._key)
-        tok = int(np.asarray(self.engine.sample(
-            sub, logits[None], req.temperature, req.top_k))[0])
+        if req.kind is RequestKind.CONSTRAINED:
+            # the pre-warmed masked sampler (ISSUE 20) — an all-true
+            # mask is bit-identical to the plain path
+            mask = workloads.resolve_mask(
+                req.token_mask, req.generated,
+                int(self.engine.cfg.vocab_size))
+            tok = int(np.asarray(self.engine.sample_masked(
+                sub, logits[None], req.temperature, req.top_k,
+                mask[None]))[0])
+        else:
+            tok = int(np.asarray(self.engine.sample(
+                sub, logits[None], req.temperature, req.top_k))[0])
         # the TTFT timestamp is taken BEFORE the sampler-obs pass: its
         # cost is booked to trace_overhead, so it must not also ride
         # the recorded first-token latency (no double counting)
@@ -1134,6 +1428,7 @@ class ContinuousBatchingScheduler:
                     ctx_now, self._slot_pages(slot))
             req.generated.append(tok)
             m["tokens"].inc()
+            m["wl_tokens"].inc(kind=req.kind.value)
             if self._done(req, tok):
                 self.slots[slot] = None
                 released = self._retire_slot(slot, req)
@@ -1202,12 +1497,12 @@ class ContinuousBatchingScheduler:
                     req = self.slots[i]
                     if req is None or req.pending is not None:
                         continue
-                    w = req.prompt.size + len(req.generated)
+                    w = self._slot_tokens(req)
                     ok = self._ensure_pages(i, req, w, m)
                     if ok and self.slots[i] is req:
                         # the sweep writes this slot's row w-1: split
-                        # it first if shared (ISSUE 16 — e.g. a session
-                        # append into the retained partial tail page)
+                        # it first if shared (ISSUE 16 session appends,
+                        # ISSUE 20 beam siblings on one tail page)
                         cows.extend(self._plan_cow(i, w - 1, w, m))
             else:
                 cows = []
@@ -1215,11 +1510,23 @@ class ContinuousBatchingScheduler:
                       if r is not None and r.pending is None]
             if not active:
                 return False
+            vocab = int(self.engine.cfg.vocab_size)
             temps = np.zeros((self.n_slots,), np.float32)
             topks = np.zeros((self.n_slots,), np.int32)
+            masks = None
             for i in active:
                 temps[i] = self.slots[i].temperature
                 topks[i] = self.slots[i].top_k
+                if self.slots[i].kind is RequestKind.CONSTRAINED:
+                    # grammar step (ISSUE 20): consult the mask for the
+                    # NEXT token; unconstrained lanes stay all-true —
+                    # bit-identical to the plain sampler
+                    if masks is None:
+                        masks = np.ones((self.n_slots, vocab), bool)
+                    masks[i] = workloads.resolve_mask(
+                        self.slots[i].token_mask,
+                        self.slots[i].generated, vocab)
+            active_kinds = [self.slots[i].kind.value for i in active]
             tokens_in = jnp.asarray(self._last_tokens)
             self._key, sub = jax.random.split(self._key)
         if self.paged:
@@ -1230,13 +1537,20 @@ class ContinuousBatchingScheduler:
         with span("serving.decode", attrs={"active": len(active)}):
             logits, self.cache = self.engine.decode_step(
                 self.cache, tokens_in)
-            toks = np.asarray(self.engine.sample(sub, logits, temps, topks))
+            if masks is None:
+                toks = np.asarray(self.engine.sample(sub, logits, temps,
+                                                     topks))
+            else:
+                toks = np.asarray(self.engine.sample_masked(
+                    sub, logits, temps, topks, masks))
         dt = time.perf_counter() - t0
         m["decode_steps"].inc()
         m["decode_s"].observe(dt)
         m["occupancy"].set(len(active) / self.n_slots,
                            replica=self.replica)
         m["tokens"].inc(len(active))
+        for kv in set(active_kinds):
+            m["wl_tokens"].inc(active_kinds.count(kv), kind=kv)
         if dt > 0:
             m["tokens_per_s"].set(len(active) / dt, replica=self.replica)
         # token timestamp BEFORE the sampler-obs pass: its cost is
@@ -1255,12 +1569,22 @@ class ContinuousBatchingScheduler:
             t_ov = time.perf_counter()
             for i in active:
                 req = self.slots[i]
-                if req is not None and req.trace is not None:
+                if req is not None and req.beam is None \
+                        and req.trace is not None:
                     req.trace.event("token", ts=tok_ts,
                                     i=len(req.generated))
             self._trace_overhead += time.perf_counter() - t_ov
+            beams = []
             for i in active:
                 req = self.slots[i]
+                if req is None:
+                    continue
+                if req.beam is not None:
+                    # joint advance once per GROUP, below — a per-lane
+                    # independent sample would break the beam ranking
+                    if all(b is not req for b in beams):
+                        beams.append(req)
+                    continue
                 tok = int(toks[i])
                 req.generated.append(tok)
                 self._last_tokens[i] = tok
@@ -1268,6 +1592,10 @@ class ContinuousBatchingScheduler:
                     self.slots[i] = None
                     released = self._retire_slot(i, req)
                     self._finish(req, tok, m, mapped_pages=released)
+            if beams:
+                logits_np = np.asarray(logits, np.float32)
+                for req in beams:
+                    self._advance_beam(req, logits_np, m, tok_ts)
         return True
 
     @staticmethod
@@ -1275,12 +1603,281 @@ class ContinuousBatchingScheduler:
         return (req.eos_id is not None and tok == req.eos_id) \
             or len(req.generated) >= req.max_new_tokens
 
+    @staticmethod
+    def _slot_tokens(r: ServingRequest) -> int:
+        """Tokens a slot holding ``r`` accounts for: chunk progress
+        while prefilling, prompt + generated when decoding — with the
+        beam group's lockstep progress standing in for ``generated``
+        on its lanes (ISSUE 20)."""
+        if r.pending is not None:
+            return r.done_tokens
+        if r.beam is not None:
+            return r.prompt.size + r.beam.progress()
+        return r.prompt.size + len(r.generated)
+
+    # ------------------------------------------------ beam search (20)
+    def _expand_beam(self, root: int, req: ServingRequest, logits,
+                     ctx_tokens: int, prefill_s: float, m):
+        """Fan the finished root prefill out into the beam group: rank
+        the root's next-token log-probs, give the top-k candidates one
+        reserved lane each — the root keeps its lane in place, every
+        sibling ``map_shared``s the root's pages, so the whole prefix
+        costs ONE set of pages and divergence splits lazily through the
+        sweep's CoW pass. This is the TTFT sample. A candidate that is
+        terminal on arrival (instant EOS / budget 1) goes straight to
+        the done list and frees its lane."""
+        m["prefills"].inc()
+        lg = np.asarray(logits, np.float32)
+        lg = lg - lg.max()
+        lsm = lg - np.log(np.exp(lg).sum())
+        now = time.perf_counter()
+        pos_fix = []
+        with self._lock:
+            beam = req.beam
+            if beam is None or self.slots[root] is not req:
+                return          # group preempted since the last chunk
+            if req.first_token_ts is None:
+                req.first_token_ts = now
+                m["ttft"].observe(now - req.submitted_ts)
+            if req.trace is not None:
+                t_ov = time.perf_counter()
+                req.trace.event("prefill", ts=now, slot=root,
+                                tokens=ctx_tokens, time_s=prefill_s,
+                                chunks=req.chunks)
+                req.trace.event("token", ts=now, i=0)
+                self._trace_overhead += time.perf_counter() - t_ov
+            lanes = list(beam.slots)
+            order = np.argsort(-lsm, kind="stable")[:len(lanes)]
+            root_pages = self._pages.slot_pages(root)
+            self._pages.note_fill(root, ctx_tokens)
+            alive_slots: List[int] = []
+            alive_tokens: List[List[int]] = []
+            alive_scores: List[float] = []
+            root_done = False
+            for rank, t in enumerate(order):
+                t, sc = int(t), float(lsm[int(t)])
+                slot = lanes[rank]
+                finished = ((req.eos_id is not None
+                             and t == req.eos_id)
+                            or req.max_new_tokens <= 1)
+                if rank > 0 and not finished:
+                    # the fan-out itself costs ZERO new pages
+                    self._pages.map_shared(slot, root_pages)
+                    self._pages.note_fill(slot, ctx_tokens)
+                    pos_fix.append(slot)
+                if finished:
+                    beam.done.append(([t], sc))
+                    if rank == 0:
+                        root_done = True    # release AFTER clones map
+                    else:
+                        self.slots[slot] = None
+                else:
+                    alive_slots.append(slot)
+                    alive_tokens.append([t])
+                    alive_scores.append(sc)
+                    self._last_tokens[slot] = t
+            for slot in lanes[len(order):]:   # vocab < width leftovers
+                self.slots[slot] = None
+            if root_done:
+                req.released_pages += self._pages.release(root)
+                self.slots[root] = None
+            beam.slots, beam.tokens, beam.scores = \
+                alive_slots, alive_tokens, alive_scores
+            beam.expanded = True
+            m["tokens"].inc(len(order))
+            m["wl_tokens"].inc(len(order), kind=req.kind.value)
+            if not alive_slots:
+                self._finish_beam(req, m)
+        if pos_fix:
+            # sibling lanes were never prefilled — their cache position
+            # must read the shared context length before the next
+            # sweep (a data update on a fixed-shape array, no retrace)
+            pos = np.array(self.cache["pos"])
+            pos[np.asarray(pos_fix)] = ctx_tokens
+            self.cache = dict(self.cache, pos=jnp.asarray(pos))
+
+    def _advance_beam(self, req: ServingRequest, logits_np, m, tok_ts):
+        """One joint beam step after the pool sweep (caller holds
+        ``_lock``): rank score+logprob over every (live beam, token)
+        pair, keep the top ``len(slots)``, and re-point the lanes — a
+        parent's FIRST surviving candidate keeps the parent's lane
+        (and pages) in place; every further candidate of the same
+        parent re-maps a freed lane onto the parent's pages
+        (``map_shared``; the next sweep's CoW pass splits the written
+        tail page on divergence). EOS candidates retire to the done
+        list and shrink the width. With width 1 the single candidate
+        is ``argmax(logits)`` — bit-identical to greedy ``generate``."""
+        beam = req.beam
+        if beam is None or not beam.slots:
+            return
+        lanes = list(beam.slots)
+        ka = len(lanes)
+        lg = logits_np[np.asarray(lanes)]
+        lg = lg - lg.max(axis=-1, keepdims=True)
+        lsm = lg - np.log(np.exp(lg).sum(axis=-1, keepdims=True))
+        vocab = lsm.shape[-1]
+        cand = np.asarray(beam.scores, np.float64)[:, None] + lsm
+        order = np.argsort(-cand, axis=None, kind="stable")[:ka]
+        parents = (order // vocab).astype(int)
+        toks = (order % vocab).astype(int)
+        if req.trace is not None:
+            t_ov = time.perf_counter()
+            req.trace.event("token", ts=tok_ts, i=beam.progress())
+            self._trace_overhead += time.perf_counter() - t_ov
+        written = req.prompt.size + len(beam.tokens[0])
+        # page lists snapshot BEFORE any release — a clone increfs its
+        # parent's pages from this list
+        parent_pages = {int(p): self._pages.slot_pages(lanes[int(p)])
+                        for p in set(parents.tolist())}
+        chosen = set(parents.tolist())
+        # lanes of parents with NO surviving candidate free first —
+        # clones re-map onto them (nobody clones FROM them, so the
+        # release is safe); a selected parent's pages release only
+        # after every clone has incref'd them
+        free_lanes = [lanes[p] for p in range(ka) if p not in chosen]
+        for s in free_lanes:
+            req.released_pages += self._pages.release(s)
+        alive_slots: List[int] = []
+        alive_tokens: List[List[int]] = []
+        alive_scores: List[float] = []
+        deferred: List[int] = []
+        first_seen: set = set()
+        for r in range(len(order)):
+            p, t = int(parents[r]), int(toks[r])
+            sc = float(cand[p, t])
+            seq = beam.tokens[p] + [t]
+            finished = ((req.eos_id is not None and t == req.eos_id)
+                        or len(seq) >= req.max_new_tokens)
+            keeps_lane = p not in first_seen
+            first_seen.add(p)
+            if finished:
+                beam.done.append((seq, sc))
+                if keeps_lane:
+                    deferred.append(lanes[p])
+                continue
+            if keeps_lane:
+                slot = lanes[p]
+            else:
+                slot = free_lanes.pop()
+                self._pages.map_shared(slot, parent_pages[p])
+                self._pages.note_fill(slot, written)
+                self.slots[slot] = req
+            self._last_tokens[slot] = t
+            alive_slots.append(slot)
+            alive_tokens.append(seq)
+            alive_scores.append(sc)
+        for s in deferred:
+            # parents whose lane-keeping candidate finished: release
+            # only now — later-ranked clones of the same parent have
+            # already incref'd the pages
+            req.released_pages += self._pages.release(s)
+            self.slots[s] = None
+        for s in free_lanes:    # unselected lanes no clone claimed
+            self.slots[s] = None
+        beam.slots, beam.tokens, beam.scores = \
+            alive_slots, alive_tokens, alive_scores
+        if not alive_slots:
+            self._finish_beam(req, m)
+
+    # ----------------------------------------- typed finishes (20)
+    def _finish_prefill_only(self, slot: int, req: ServingRequest, m):
+        """SCORE/EMBED retire at their final prefill chunk — they never
+        occupy decode-sweep time. The completion instant doubles as the
+        first-token sample (the prefill IS the product, so TTFT ==
+        latency) and the per-kind token counter books the prompt."""
+        m["prefills"].inc()
+        now = time.perf_counter()
+        with self._lock:
+            if self.slots[slot] is not req:
+                return          # preempted between chunk and finish
+            if req.first_token_ts is None:
+                req.first_token_ts = now
+                m["ttft"].observe(now - req.submitted_ts)
+            if req.trace is not None:
+                t_ov = time.perf_counter()
+                req.trace.event("prefill", ts=now, slot=slot,
+                                tokens=int(req.prompt.size),
+                                time_s=req.prefill_s, chunks=req.chunks)
+                req.trace.event("token", ts=now, i=0)
+                self._trace_overhead += time.perf_counter() - t_ov
+            self.slots[slot] = None
+            released = self._release_pages(slot)
+            n_tok = int(req.prompt.size)
+            if req.kind is RequestKind.SCORE:
+                lps = np.asarray(req.score_lps, np.float32)
+                ppl = (float(np.exp(-lps.mean())) if lps.size
+                       else float("inf"))
+                result = ScoreResult(logprobs=lps, perplexity=ppl,
+                                     prompt_tokens=n_tok)
+            else:
+                emb = (req.embed_last if req.pooling == "last"
+                       else req.embed_acc / float(n_tok))
+                result = EmbedResult(
+                    embedding=np.asarray(emb, np.float32),
+                    pooling=req.pooling, prompt_tokens=n_tok)
+            m["wl_tokens"].inc(n_tok, kind=req.kind.value)
+            self._finish_workload(req, result, "complete", m,
+                                  released, n_tok)
+
+    def _finish_beam(self, req: ServingRequest, m):
+        """All hypotheses done (caller holds ``_lock``; lanes and pages
+        were released as each one finished): resolve the future with
+        the rank-sorted :class:`BeamResult`."""
+        done = req.beam.done
+        order = sorted(range(len(done)), key=lambda i: -done[i][1])
+        seqs = [np.asarray(done[i][0], np.int32) for i in order]
+        scores = [float(done[i][1]) for i in order]
+        reason = ("eos" if (req.eos_id is not None and seqs
+                            and seqs[0].size
+                            and int(seqs[0][-1]) == req.eos_id)
+                  else "length")
+        result = BeamResult(sequences=seqs, scores=scores,
+                            beam_width=req.beam_width,
+                            finish_reason=reason)
+        resident = req.prompt.size + (seqs[0].size if seqs else 0)
+        self._finish_workload(req, result, reason, m,
+                              req.released_pages, resident)
+
+    def _finish_workload(self, req: ServingRequest, result, reason, m,
+                         mapped_pages: int, resident: int):
+        """Shared completion tail for the typed results (SCORE / EMBED
+        / BEAM): latency + residency accounting, trace close-out, and
+        the future resolution — the same discipline as the generation
+        ``_finish`` with the result object swapped."""
+        now = time.perf_counter()
+        m["completions"].inc(reason=reason)
+        m["wl_completions"].inc(kind=req.kind.value)
+        m["latency"].observe(now - req.submitted_ts)
+        result.latency_s = now - req.submitted_ts
+        result.ttft_s = (None if req.first_token_ts is None
+                         else req.first_token_ts - req.submitted_ts)
+        result.prefill_s = req.prefill_s
+        t_ov = time.perf_counter()
+        resident = min(int(resident), self.engine.max_len)
+        if self.paged:
+            cap = max(1, mapped_pages) * self._pages.page_len
+            ratio = min(1.0, resident / cap)
+        else:
+            ratio = resident / self.engine.max_len
+        m["kv_final"].observe(ratio)
+        self._final_res_sum += ratio
+        self._final_res_n += 1
+        self._close_trace(req, "finish", m, reason=reason,
+                          resident_tokens=int(resident),
+                          residency_ratio=round(ratio, 6))
+        self._trace_overhead += time.perf_counter() - t_ov
+        try:
+            req.future.set_result(result)
+        except InvalidStateError:
+            pass   # the caller gave up on an in-flight request
+
     def _finish(self, req: ServingRequest, last_tok: int, m,
                 mapped_pages: int = 0):
         reason = "eos" if (req.eos_id is not None
                            and last_tok == req.eos_id) else "length"
         now = time.perf_counter()
         m["completions"].inc(reason=reason)
+        m["wl_completions"].inc(kind=req.kind.value)
         m["latency"].observe(now - req.submitted_ts)
         t_ov = time.perf_counter()
         # per-request final residency (ISSUE 12/14): how much of what
@@ -1322,8 +1919,7 @@ class ContinuousBatchingScheduler:
             return
         tr.event(kind, **attrs)
         summary = tr.summary()    # computed once: histogram + SLO share
-        for s in summary["itl_s"]:
-            m["itl"].observe(s)
+        m["itl"].observe_many(summary["itl_s"])
         self.flight_recorder.record_request(tr)
         if self.slo is not None:
             self.slo.observe_summary(summary)
@@ -1340,21 +1936,47 @@ class ContinuousBatchingScheduler:
         per snapshot would pay ~16 registry lookups per step, the
         single biggest avoidable cost against the <2% budget."""
         with self._lock:
-            slot_ids = [None if r is None else r.id for r in self.slots]
+            # ONE pass over the slots for ids + active count + kind
+            # census + residency (this runs per step inside the
+            # self-timed <2% bookkeeping budget; four separate
+            # comprehensions measurably blew it). Census notes (ISSUE
+            # 20): a beam group's lanes count its request ONCE (same
+            # id); keyed by the enum member (identity hash) and
+            # converted once at the end — Enum ``.value`` routes
+            # through a DynamicClassAttribute descriptor, too slow for
+            # a per-slot-per-step access. A mid-prefill slot is
+            # resident only to the tokens its chunks actually wrote; a
+            # beam lane's lockstep group progress stands in for
+            # ``generated``.
             queued_ids = [r.id for r in self._queue]
-            resident_tokens = sum(
-                # a mid-prefill slot is resident only to the tokens its
-                # chunks have actually written
-                min(r.done_tokens if r.pending is not None
-                    else r.prompt.size + len(r.generated),
-                    self.engine.max_len)
-                for r in self.slots if r is not None)
+            max_len = self.engine.max_len
+            slot_ids: list = []
+            kinds_e: dict = {}
+            seen_ids: set = set()
+            resident_tokens = 0
+            n_active = 0
+            for r in self.slots:
+                if r is None:
+                    slot_ids.append(None)
+                    continue
+                slot_ids.append(r.id)
+                n_active += 1
+                if r.id not in seen_ids:
+                    seen_ids.add(r.id)
+                    kinds_e[r.kind] = kinds_e.get(r.kind, 0) + 1
+                if r.pending is not None:
+                    t = r.done_tokens
+                elif r.beam is not None:
+                    t = r.prompt.size + r.beam.progress()
+                else:
+                    t = r.prompt.size + len(r.generated)
+                resident_tokens += t if t < max_len else max_len
+            kinds = {k.value: v for k, v in kinds_e.items()}
             # accumulators update under the cheap metadata lock — the
             # lock kv_report/reset_kv_window also take — so a reader
             # never sees a sum without its count, and never waits on
             # device work to see either
             resident = resident_tokens * self._kv_token_bytes
-            n_active = sum(s is not None for s in slot_ids)
             if n_active > self._peak_active:
                 self._peak_active = n_active
             if self.paged and self._prefix is not None:
@@ -1367,8 +1989,8 @@ class ContinuousBatchingScheduler:
                 for i, r in enumerate(self.slots):
                     if r is not None:
                         self._pages.note_fill(
-                            i, r.done_tokens if r.pending is not None
-                            else r.prompt.size + len(r.generated) - 1)
+                            i, self._slot_tokens(r)
+                            - (0 if r.pending is not None else 1))
                 alloc = self._pages.used_pages * self._kv_page_bytes
                 mapped = self._pages.mapped_pages
                 resident = min(self._pages.resident_tokens
@@ -1395,9 +2017,23 @@ class ContinuousBatchingScheduler:
             self._kv_samples += 1
         if m is None:
             m = self._m()
-        m["kv_alloc"].set(float(alloc), replica=self.replica)
+        if alloc != self._kv_pub_alloc:
+            # dense alloc is the static pool — constant across a serve;
+            # skip the per-step gauge write unless it actually moved
+            self._kv_pub_alloc = alloc
+            m["kv_alloc"].set(float(alloc), replica=self.replica)
         m["kv_res"].set(float(resident), replica=self.replica)
         m["kv_waste"].set(waste, replica=self.replica)
+        for kv in workloads.ALL_KINDS:
+            # an idle kind reads 0, not a frozen last-busy value — but
+            # only CHANGED counts pay a gauge write (the first snapshot
+            # publishes all five; a steady one-kind serve then writes
+            # none), keeping the census inside the bookkeeping budget
+            n_kind = kinds.get(kv, 0)
+            if self._kind_census_pub.get(kv) != n_kind:
+                self._kind_census_pub[kv] = n_kind
+                m["active_kind"].set(float(n_kind),
+                                     replica=self.replica, kind=kv)
         self._steps += 1
         paged_fields = {} if not self.paged else {
             "kv_mapped_pages": mapped,
@@ -1422,6 +2058,7 @@ class ContinuousBatchingScheduler:
         self.flight_recorder.record_snapshot(
             step=self._steps, slots=slot_ids, queue=queued_ids,
             queue_depth=len(queued_ids),
+            request_kinds=kinds,
             occupancy=n_active / self.n_slots,
             kv_allocated_bytes=alloc,
             kv_resident_bytes=resident,

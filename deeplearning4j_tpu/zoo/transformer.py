@@ -390,6 +390,14 @@ def head_logits_rows(params, cfg: TransformerConfig, x):
                       ).astype(jnp.float32)
 
 
+def hidden_rows(params, cfg: TransformerConfig, x):
+    """The final-norm hidden rows themselves — (N, d) f32, no head
+    matmul. The EMBED workload's representation (ISSUE 20): the same
+    post-``ln_f`` activations ``head_logits_rows`` projects, surfaced
+    for pooling instead of next-token prediction."""
+    return _rmsnorm(x, params["ln_f"]).astype(jnp.float32)
+
+
 def generate(params, cfg: TransformerConfig, prompt_ids, max_new_tokens=32,
              *, key=None, temperature=0.0, top_k=0, eos_id=None,
              max_len=None):

@@ -99,6 +99,9 @@ PLANE_LABELS = {
     # buckets and shas live in the autotune cost-record keys
     "dl4j_quant_": {"kernel", "mode", "verdict"},
     "dl4j_spec_": {"kernel", "mode", "verdict"},
+    # multi-workload request plane (ISSUE 20): the RequestKind value
+    # is the ONLY label — five fixed kinds, never per-request identity
+    "dl4j_workload_": {"kind"},
 }
 # label names that smell like per-request/per-trace identity — never
 # allowed even if someone adds them to the allowlist above by mistake

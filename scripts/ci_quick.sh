@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Quick gate (ISSUE 7 + 8 + 10 + 11 + 12 + 13 + 14 + 15 + 16 + 17 +
-# 18 + 19): metric-name/label + doc lint, the offline perf-regression
+# 18 + 19 + 20): metric-name/label + doc lint, the offline perf-regression
 # gate over the bench ledger, then the telemetry-plane, roofline-floor,
 # elastic-scaleout, serving-plane, paged-KV/chunked-prefill,
 # prefix-cache/CoW, SLO-plane, memory/compile-plane,
@@ -27,7 +27,7 @@ python scripts/check_metric_names.py
 echo "== perf regression gate (offline replay of runs/perf_ledger.jsonl) =="
 python scripts/perf_gate.py --offline
 
-echo "== obs + floors + scaleout-fast + serving + paged-kv + prefix-cache + slo + memplane + numerics + trend + fleet + quant + spec suites =="
+echo "== obs + floors + scaleout-fast + serving + paged-kv + prefix-cache + slo + memplane + numerics + trend + fleet + quant + spec + workloads suites =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
     tests/test_scaleout_fast.py tests/test_serving.py \
     tests/test_paged_kv.py tests/test_prefix_cache.py \
@@ -36,6 +36,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_floors.py \
     tests/test_memplane.py tests/test_numerics.py \
     tests/test_trend.py tests/test_fleet_fast.py \
     tests/test_quant.py tests/test_spec_decode.py \
+    tests/test_workloads.py \
     -q -m 'not slow' -p no:cacheprovider -p no:randomly
 
 echo "== autotune harness round-trip (record -> sha-bump -> invalidate + re-measure) =="

@@ -41,7 +41,7 @@ from pathlib import Path
 
 _FIELDS = ("max_abs_err", "mean_abs_err", "kl_mean", "kl_max",
            "topk_agreement", "greedy_match_frac", "greedy_prefix_len",
-           "accepted_per_step")
+           "accepted_per_step", "beam_gain_nats")
 
 
 def _is_report(d) -> bool:
@@ -63,6 +63,19 @@ def load_reports(path) -> list:
     if isinstance(doc, dict):        # bench_secondary.json shape
         for section in ("inference",):
             for row_name, row in (doc.get(section) or {}).items():
+                # workload evidence (ISSUE 20): the beam row's search
+                # gain over exact greedy logprob is its fidelity claim
+                # — beam search that LOSES to greedy means the joint
+                # ranking (or the page sharing under it) is broken;
+                # --min-beam-gain pins the floor. Checked before the
+                # fidelity-block guard: the beam row carries no probe
+                # pairs
+                if isinstance(row, dict) and \
+                        row.get("beam_gain_nats") is not None:
+                    out.append({
+                        "row": row_name, "kind": "beam_vs_greedy",
+                        "beam_gain_nats": row["beam_gain_nats"],
+                    })
                 blk = row.get("fidelity") if isinstance(row, dict) \
                     else None
                 if not isinstance(blk, dict):
@@ -117,10 +130,10 @@ def _fmt(v, digits=3):
 def render(reports) -> str:
     cols = ("row", "kind", "max_abs_err", "kl_mean", "kl_max",
             "topk_agreement", "greedy_match_frac", "greedy_prefix_len",
-            "accepted_per_step")
+            "accepted_per_step", "beam_gain_nats")
     heads = ("row", "pair", "max|Δlogit|", "KL mean", "KL max",
              "top-k agree", "greedy match", "greedy prefix",
-             "accept/step")
+             "accept/step", "beam gain")
     rows = [[_fmt(r.get(c)) if c not in ("row", "kind")
              else str(r.get(c, "-")) for c in cols] for r in reports]
     widths = [max(len(h), *(len(row[i]) for row in rows)) if rows
@@ -142,6 +155,10 @@ def main(argv=None) -> int:
     ap.add_argument("--min-accept", type=float, default=None,
                     help="exit 1 if any spec report accepts fewer "
                          "tokens per verify step than this floor")
+    ap.add_argument("--min-beam-gain", type=float, default=None,
+                    help="exit 1 if any beam report's gain over "
+                         "greedy (nats) is below this floor "
+                         "(ISSUE 20; 0.0 = beam must never lose)")
     ap.add_argument("--json", action="store_true",
                     help="emit the reports as strict JSON instead of "
                          "the table")
@@ -200,6 +217,28 @@ def main(argv=None) -> int:
         elif not judged:
             print("spec gate: no accepted/step reports — treating as "
                   "pass (nothing claimed speculation)", file=sys.stderr)
+    if args.min_beam_gain is not None:
+        judged = 0
+        for r in reports:
+            v = r.get("beam_gain_nats")
+            if v is None:
+                continue
+            judged += 1
+            if float(v) < args.min_beam_gain:
+                print(f"BEAM GATE: {r.get('row', '?')}/"
+                      f"{r.get('kind', '?')} beam gain "
+                      f"{float(v):+.3g} nats < floor "
+                      f"{args.min_beam_gain:+.3g}", file=sys.stderr)
+                rc = 1
+        if judged and all(float(r["beam_gain_nats"]) >=
+                          args.min_beam_gain for r in reports
+                          if r.get("beam_gain_nats") is not None):
+            print(f"beam gate: {judged} report(s) at "
+                  f"gain >= {args.min_beam_gain:+.3g} nats")
+        elif not judged:
+            print("beam gate: no beam-gain reports — treating as "
+                  "pass (nothing claimed beam search)",
+                  file=sys.stderr)
     return rc
 
 

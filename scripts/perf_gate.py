@@ -111,6 +111,8 @@ ROW_UNITS = {
     "inference_decode": "tokens/sec/chip",
     "inference_ttft_1024": "ms",
     "inference_ttft_4096": "ms",
+    "inference_scoring": "tokens/sec/chip",
+    "inference_beam": "tokens/sec/chip",
     "inference_resnet_b1": "ms p50 (batch 1)",
     "inference_bert_b1": "ms p50 (batch 1)",
 }

@@ -116,6 +116,10 @@ INFERENCE_LABELS = {
                           "promotion race",
     "inference_spec_decode": "Speculative decode (draft-verify) vs "
                              "plain greedy",
+    "inference_scoring": "SCORE workload: prefill-only per-token "
+                         "logprobs, 8 × 512-token prompts",
+    "inference_beam": "BEAM workload: width-4 beam search, CoW "
+                      "page-shared beams",
     "inference_resnet_b1": "ResNet-50 batch-1 latency (ParallelInference)",
     "inference_bert_b1": "BERT-base batch-1 latency (ParallelInference)",
 }
@@ -230,6 +234,21 @@ def inference_row(name, rec):
         details.append("greedy bit-identical"
                        if spec.get("bit_identical")
                        else "⚠ greedy divergence")
+    if rec.get("perplexity_head") is not None:
+        # the SCORE row (ISSUE 20): prefill-only scoring retires at the
+        # final chunk — surface the wave count so the number reads as a
+        # pipeline throughput, not a single pass
+        details.append(f"{rec.get('requests')} × "
+                       f"{rec.get('prompt_tokens')} tok/wave, "
+                       f"{rec.get('reps')} waves")
+    if rec.get("beam_gain_nats") is not None:
+        # the BEAM row (ISSUE 20): the search-quality gain over greedy
+        # and the page census proving the beams share the prompt
+        details.append(f"+{rec['beam_gain_nats']:.3f} nats vs greedy "
+                       f"(width {rec.get('beam_width')})")
+        if rec.get("census_shared_pages") is not None:
+            details.append(f"{rec['census_shared_pages']} shared / "
+                           f"{rec['census_mapped_pages']} mapped pages")
     if rec.get("ttft_speedup_x") is not None:
         # the CoW prefix-cache row (ISSUE 16): warm-vs-cold TTFT and
         # tokens each user actually keeps resident when the prefix is

@@ -149,6 +149,24 @@ def render(reports, crash_headers) -> str:
             f"{_fmt_s(itl['p99_s'], t['itl_s']):>10} "
             f"{_fmt_pct(rep['error_rate']):>6} "
             f"{rep['burn_rate']:>6.2f}  {verdict}")
+    # per-kind goodput breakdown (ISSUE 20): the multi-workload plane
+    # labels every trace with its RequestKind, so a mixed serve run
+    # shows WHICH workload is burning the budget — rendered only when
+    # some dump record actually carried a kind beyond plain generate
+    kinds = sorted({k for rep in reports.values()
+                    for k in rep.get("by_kind", {})})
+    if kinds and kinds != ["generate"]:
+        lines.append("")
+        khdr = (f"{'replica':>8} {'kind':>12} {'reqs':>5} {'fail':>5} "
+                f"{'goodput':>8}")
+        lines.append(khdr)
+        lines.append("-" * len(khdr))
+        for replica in order:
+            for kind, c in sorted(
+                    reports[replica].get("by_kind", {}).items()):
+                lines.append(
+                    f"{replica:>8} {kind:>12} {c['requests']:>5} "
+                    f"{c['failed']:>5} {_fmt_pct(c['goodput']):>8}")
     return "\n".join(lines)
 
 

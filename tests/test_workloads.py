@@ -1,0 +1,427 @@
+"""Multi-workload request plane (ISSUE 20): SCORE / EMBED / BEAM /
+CONSTRAINED as first-class serving request types.
+
+Equivalence oracles, the rnn_time_step discipline of the serving suite:
+
+- SCORE logprobs match the full forward's log-softmax at EVERY
+  position (and stay close under the int8-KV pool);
+- BEAM width-1 is bit-identical to ``GenerationEngine.generate``;
+- a CONSTRAINED all-true mask is bit-identical to greedy, and every
+  sampled token lies inside the mask under fuzz;
+- EMBED mean-pooling equals the full forward's pooled post-``ln_f``
+  hidden rows.
+
+Plus the structural claims: beam page sharing (k beams of length T
+cost ≈ T + k·divergent pages, ``PageTable.check()`` holds throughout,
+preemption/drain release every lane), zero post-warmup retraces across
+all five kinds, the fleet wire round-trips every kind, and submit()
+rejects malformed requests loudly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.obs import get_registry
+from deeplearning4j_tpu.serving import (BeamResult,
+                                        ContinuousBatchingScheduler,
+                                        EmbedResult, FleetRouter,
+                                        GenerationEngine, RequestKind,
+                                        ScoreResult, vocab_mask)
+from deeplearning4j_tpu.serving import workloads
+from deeplearning4j_tpu.zoo import transformer as tfm
+
+ATOL = 2e-4
+VOCAB = 61
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=VOCAB, d_model=32, n_heads=2, n_layers=2,
+                d_ff=64, max_seq=32, dtype=jnp.float32, remat=False,
+                attn_scores_bf16=False)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    cfg, params = model
+    return GenerationEngine(cfg, params, prefill_chunk=8)
+
+
+def _toks(n, seed=0):
+    return np.random.default_rng(seed).integers(0, VOCAB, (n,)).astype(
+        np.int32)
+
+
+def paged(engine, **kw):
+    kw.setdefault("n_slots", 4)
+    kw.setdefault("page_len", 4)
+    kw.setdefault("n_pages", 32)
+    return ContinuousBatchingScheduler(engine, **kw)
+
+
+def run(sched, *reqs):
+    futs = [sched.submit(*a, **k) for a, k in reqs]
+    sched.run_until_idle()
+    return [f.result(timeout=30) for f in futs]
+
+
+def full_logprobs(params, cfg, toks):
+    """(T, V) log-softmax of the full forward — the SCORE oracle."""
+    lg, _ = tfm.forward(params, cfg, jnp.asarray(toks)[None])
+    lg = np.asarray(lg, np.float32)[0]
+    mx = lg.max(axis=-1, keepdims=True)
+    return lg - mx - np.log(np.exp(lg - mx).sum(-1, keepdims=True))
+
+
+# ----------------------------------------------------------- SCORE
+
+def test_score_matches_full_forward_every_position(model, engine):
+    cfg, params = model
+    toks = _toks(13, seed=1)
+    (res,) = run(paged(engine), ((toks,), dict(kind="score")))
+    assert isinstance(res, ScoreResult)
+    assert res.logprobs.shape == (12,)
+    lsm = full_logprobs(params, cfg, toks)
+    ref = lsm[np.arange(12), toks[1:]]
+    np.testing.assert_allclose(res.logprobs, ref, atol=ATOL)
+    assert res.perplexity == pytest.approx(
+        float(np.exp(-ref.mean())), rel=1e-3)
+    assert res.finish_reason == "complete"
+    assert res.prompt_tokens == 13 and res.tokens.size == 0
+
+
+def test_score_spans_chunk_boundaries(model, engine):
+    # 3 chunks of 8: the target of row chunk_end-1 lives in the NEXT
+    # chunk — the off-by-one a per-chunk scorer gets wrong
+    cfg, params = model
+    toks = _toks(21, seed=2)
+    (res,) = run(paged(engine), ((toks,), dict(kind="score")))
+    lsm = full_logprobs(params, cfg, toks)
+    np.testing.assert_allclose(
+        res.logprobs, lsm[np.arange(20), toks[1:]], atol=ATOL)
+
+
+def test_score_quantized_kv_stays_close(model, engine):
+    # the int8 pool scores with the weights/pages it decodes with —
+    # quantization error is bounded, not bit-exact
+    cfg, params = model
+    toks = _toks(13, seed=3)
+    sched = paged(engine, quant_kv="int8")
+    (res,) = run(sched, ((toks,), dict(kind="score")))
+    lsm = full_logprobs(params, cfg, toks)
+    ref = lsm[np.arange(12), toks[1:]]
+    assert np.isfinite(res.perplexity)
+    np.testing.assert_allclose(res.logprobs, ref, atol=0.3)
+
+
+# ----------------------------------------------------------- EMBED
+
+def test_embed_mean_matches_full_forward(model, engine):
+    cfg, params = model
+    toks = _toks(11, seed=4)
+    (res,) = run(paged(engine), ((toks,), dict(kind="embed")))
+    assert isinstance(res, EmbedResult)
+    assert res.embedding.shape == (cfg.d_model,)
+    assert res.embedding.dtype == np.float32
+    x = tfm.embed(params, cfg, jnp.asarray(toks)[None])
+    x, _ = tfm.apply_blocks(params["blocks"], cfg, x)
+    hid = np.asarray(tfm.hidden_rows(params, cfg, x[0]), np.float32)
+    np.testing.assert_allclose(res.embedding, hid.mean(axis=0),
+                               atol=ATOL)
+
+
+def test_embed_last_pooling(model, engine):
+    cfg, params = model
+    toks = _toks(9, seed=5)
+    (res,) = run(paged(engine),
+                 ((toks,), dict(kind="embed", pooling="last")))
+    assert res.pooling == "last"
+    x = tfm.embed(params, cfg, jnp.asarray(toks)[None])
+    x, _ = tfm.apply_blocks(params["blocks"], cfg, x)
+    hid = np.asarray(tfm.hidden_rows(params, cfg, x[0]), np.float32)
+    np.testing.assert_allclose(res.embedding, hid[-1], atol=ATOL)
+
+
+# ------------------------------------------------------------ BEAM
+
+def test_beam_width1_bit_identical_to_generate(engine):
+    toks = _toks(12, seed=6)
+    oracle = np.asarray(engine.generate(toks, max_new_tokens=6))
+    (res,) = run(paged(engine),
+                 ((toks, 6), dict(kind="beam", beam_width=1)))
+    assert isinstance(res, BeamResult)
+    assert res.tokens.tolist() == oracle.tolist()
+    assert len(res.sequences) == 1
+
+
+def test_beam_never_loses_to_greedy(engine):
+    toks = _toks(12, seed=7)
+    sched = paged(engine)
+    (beam,) = run(sched, ((toks, 6), dict(kind="beam", beam_width=4)))
+    assert len(beam.sequences) == 4
+    assert beam.scores == sorted(beam.scores, reverse=True)
+    (greedy,) = run(sched, ((toks, 6), {}))
+    (score,) = run(sched, ((np.concatenate([toks, greedy.tokens]),),
+                           dict(kind="score")))
+    greedy_lp = float(np.sum(score.logprobs[toks.size - 1:]))
+    assert beam.best_logprob >= greedy_lp - 1e-4
+
+
+def test_beam_page_sharing_census(engine):
+    # k beams of length T cost ≈ T + k·divergent resident pages: the
+    # prompt's full pages are mapped ONCE (shared), only the divergent
+    # tail is per-beam — and the free/refcount invariant holds at
+    # every step
+    toks = _toks(12, seed=8)
+    width, new = 4, 6
+    sched = paged(engine)
+    fut = sched.submit(toks, max_new_tokens=new, kind="beam",
+                       beam_width=width)
+    pt = sched._pages
+    shr = toks.size // pt.page_len            # full prompt pages
+    saw_shared = 0
+    while sched.step():
+        assert sched.check_pages()
+        saw_shared = max(saw_shared, pt.shared_pages)
+        # shared-cost bound: one copy of the prompt + a divergent
+        # per-beam tail (+1 open page per lane)
+        div = pt.pages_for(toks.size + new) - shr + 1
+        assert pt.used_pages <= shr + width * div
+    fut.result(timeout=30)
+    assert saw_shared >= shr > 0
+    assert sched.check_pages()
+    assert pt.used_pages == 0
+
+
+def test_beam_preempt_and_drain_release_every_lane(engine):
+    toks = _toks(12, seed=9)
+    # page pressure: a width-3 group + a generate compete for 12 pages
+    sched = paged(engine, n_pages=12)
+    res = run(sched,
+              ((toks, 10), dict(kind="beam", beam_width=3)),
+              ((toks, 6), {}))
+    assert isinstance(res[0], BeamResult) and len(res[1].tokens) == 6
+    assert sched.check_pages() and sched._pages.used_pages == 0
+    # drain mid-flight: every lane's pages come back, future resolves
+    sched2 = paged(engine)
+    fut = sched2.submit(toks, max_new_tokens=18, kind="beam",
+                        beam_width=4)
+    for _ in range(3):
+        sched2.step()
+    sched2.drain()
+    assert fut.done()
+    assert sched2.check_pages() and sched2._pages.used_pages == 0
+
+
+# ----------------------------------------------------- CONSTRAINED
+
+def test_constrained_all_true_bit_identical_to_greedy(engine):
+    toks = _toks(12, seed=10)
+    oracle = np.asarray(engine.generate(toks, max_new_tokens=6))
+    (res,) = run(paged(engine),
+                 ((toks, 6), dict(kind="constrained",
+                                  token_mask=np.ones(VOCAB, bool))))
+    assert res.tokens.tolist() == oracle.tolist()
+
+
+def test_constrained_tokens_always_in_mask_under_fuzz(engine):
+    rng = np.random.default_rng(11)
+    sched = paged(engine)
+    for trial in range(4):
+        allowed = rng.choice(VOCAB, size=rng.integers(2, 8),
+                             replace=False)
+        (res,) = run(sched, ((_toks(10, seed=trial), 6),
+                             dict(kind="constrained",
+                                  token_mask=vocab_mask(allowed, VOCAB),
+                                  temperature=0.8, top_k=5)))
+        assert set(res.tokens.tolist()) <= set(allowed.tolist()), trial
+
+
+def test_constrained_callable_grammar_steps(engine):
+    calls = []
+
+    def alternate(generated):
+        # grammar stepping: even positions admit evens, odd admit odds
+        calls.append(len(generated))
+        m = np.zeros(VOCAB, bool)
+        m[len(generated) % 2::2] = True
+        return m
+
+    (res,) = run(paged(engine),
+                 ((_toks(9, seed=12), 6),
+                  dict(kind="constrained", token_mask=alternate)))
+    assert [t % 2 for t in res.tokens] == [0, 1, 0, 1, 0, 1]
+    assert calls and calls[0] == 0    # consulted before EVERY token
+
+
+# ------------------------------------------- zero-retrace contract
+
+def test_zero_retraces_after_warm_across_all_kinds(model):
+    cfg, params = model
+    eng = GenerationEngine(cfg, params, prefill_chunk=8)
+    sched = paged(eng)
+    mask = np.ones(VOCAB, bool)
+    warm = [((_toks(12), 5), {}),
+            ((_toks(12), 1), dict(kind="score")),
+            ((_toks(12), 1), dict(kind="embed")),
+            ((_toks(12), 5), dict(kind="beam", beam_width=3)),
+            ((_toks(12), 5), dict(kind="constrained",
+                                  token_mask=mask))]
+    run(sched, *warm)
+    eng.mark_warm()
+    varied = [((_toks(7, seed=1), 6), {}),
+              ((_toks(9, seed=2), 1), dict(kind="score")),
+              ((_toks(5, seed=3), 1), dict(kind="embed",
+                                           pooling="last")),
+              ((_toks(7, seed=4), 7), dict(kind="beam", beam_width=4)),
+              ((_toks(6, seed=5), 4), dict(kind="constrained",
+                                           token_mask=mask,
+                                           temperature=0.5))]
+    run(sched, *varied)
+    rep = eng.compile_report()
+    retraced = {k: v for k, v in rep.items()
+                if v["retraces_after_warm"]}
+    assert not retraced, retraced
+
+
+# -------------------------------------------------- submit contract
+
+def test_submit_rejects_malformed_requests(engine):
+    sched = paged(engine)
+    toks = _toks(10)
+    with pytest.raises(ValueError, match="unknown keyword"):
+        sched.submit(toks, bogus=1)
+    with pytest.raises(ValueError, match="integer token ids"):
+        sched.submit(np.asarray([0.5, 1.5]))
+    with pytest.raises(ValueError, match="vocabulary"):
+        sched.submit(np.asarray([0, VOCAB], np.int32))
+    with pytest.raises(ValueError, match="BEAM knob"):
+        sched.submit(toks, beam_width=2)
+    with pytest.raises(ValueError, match="CONSTRAINED knob"):
+        sched.submit(toks, token_mask=np.ones(VOCAB, bool))
+    with pytest.raises(ValueError, match="EMBED knob"):
+        sched.submit(toks, pooling="last")
+    with pytest.raises(ValueError, match="at least 2"):
+        sched.submit(toks[:1], kind="score")
+    with pytest.raises(ValueError, match="pooling"):
+        sched.submit(toks, kind="embed", pooling="max")
+    with pytest.raises(ValueError, match="token_mask"):
+        sched.submit(toks, kind="constrained")
+    with pytest.raises(ValueError, match="admits no token"):
+        sched.submit(toks, kind="constrained",
+                     token_mask=np.zeros(VOCAB, bool))
+    with pytest.raises(ValueError, match="beam_width"):
+        sched.submit(toks, kind="beam", beam_width=99)
+    with pytest.raises(ValueError, match="temperature"):
+        sched.submit(toks, kind="beam", beam_width=2, temperature=0.5)
+    with pytest.raises(ValueError, match="unknown request kind"):
+        sched.submit(toks, kind="translate")
+
+
+def test_typed_kinds_need_the_paged_pool(engine):
+    dense = ContinuousBatchingScheduler(engine, n_slots=2)
+    for kind in ("score", "embed", "beam"):
+        with pytest.raises(ValueError, match="paged"):
+            dense.submit(_toks(10), kind=kind)
+
+
+def test_workload_metrics_and_kind_census(engine):
+    reg = get_registry()
+    base = reg.counter("dl4j_workload_requests_total",
+                       "Typed serving requests, by kind",
+                       labelnames=("kind",))
+    before = {k: base.value(kind=k) for k in workloads.ALL_KINDS}
+    sched = paged(engine)
+    toks = _toks(12)
+    fut = sched.submit(toks, max_new_tokens=6, kind="beam",
+                       beam_width=2)
+    sched.step()
+    census = [s for s in sched.flight_recorder.snapshots()
+              if s.get("request_kinds")]
+    run(sched, ((toks,), dict(kind="score")))
+    fut.result(timeout=30)
+    assert base.value(kind="beam") == before["beam"] + 1
+    assert base.value(kind="score") == before["score"] + 1
+    assert census and census[-1]["request_kinds"].get("beam") == 1
+
+
+# ------------------------------------------------------- fleet wire
+
+@pytest.fixture(scope="module")
+def fleet(engine):
+    return FleetRouter(engine, n_replicas=2, n_slots=4,
+                       scheduler_kwargs={"page_len": 4, "n_pages": 32})
+
+
+def test_fleet_roundtrips_every_kind(fleet):
+    toks = _toks(12, seed=20)
+    futs = {
+        "generate": fleet.submit(toks, max_new_tokens=5),
+        "score": fleet.submit(toks, kind="score"),
+        "embed": fleet.submit(toks, kind="embed", pooling="last"),
+        "beam": fleet.submit(toks, max_new_tokens=5, kind="beam",
+                             beam_width=3),
+        "constrained": fleet.submit(toks, max_new_tokens=5,
+                                    kind="constrained",
+                                    allowed_ids=[3, 5, 7]),
+    }
+    fleet.run_until_idle()
+    res = {k: f.result(timeout=30) for k, f in futs.items()}
+    for kind, r in res.items():
+        assert r.kind == kind, (kind, r.kind)
+    assert len(res["score"].logprobs) == toks.size - 1
+    assert len(res["embed"].embedding) == 32
+    assert np.isfinite(res["beam"].best_logprob)
+    assert len(res["beam"].tokens) == 5
+    assert set(res["constrained"].tokens.tolist()) <= {3, 5, 7}
+    assert res["generate"].logprobs is None
+    assert res["generate"].embedding is None
+
+
+def test_fleet_constrained_is_allowlist_only(fleet):
+    with pytest.raises(ValueError, match="allowed_ids"):
+        fleet.submit(_toks(10), kind="constrained")
+    with pytest.raises(ValueError, match="CONSTRAINED knob"):
+        fleet.submit(_toks(10), allowed_ids=[1, 2])
+
+
+def test_fleet_kill_reprefills_mid_flight_beam(engine):
+    fl = FleetRouter(engine, n_replicas=2, n_slots=4,
+                     scheduler_kwargs={"page_len": 4, "n_pages": 32})
+    fut = fl.submit(_toks(12, seed=21), max_new_tokens=8, kind="beam",
+                    beam_width=3)
+    for _ in range(3):
+        fl.step()
+    rid = next(rec.rid for rec in fl.outstanding.values())
+    fl.kill_replica(rid)
+    fl.run_until_idle()
+    res = fut.result(timeout=30)
+    assert res.kind == "beam" and res.reprefills == 1
+    assert len(res.tokens) == 8 and np.isfinite(res.best_logprob)
+    for rep in fl.replicas.values():
+        if rep.status == "live":
+            assert rep.scheduler.check_pages()
+
+
+def test_request_kind_coercion():
+    assert RequestKind.coerce("BEAM") is RequestKind.BEAM
+    assert RequestKind.coerce(RequestKind.SCORE) is RequestKind.SCORE
+    assert RequestKind.coerce(2) is RequestKind.EMBED
+    for k in RequestKind:
+        assert RequestKind.coerce(k.wire) is k
+    with pytest.raises(ValueError, match="wire byte"):
+        RequestKind.coerce(99)
+    with pytest.raises(ValueError, match="coerce"):
+        RequestKind.coerce(1.5)
